@@ -1,0 +1,33 @@
+"""cobrix_tpu — a TPU-native COBOL copybook / EBCDIC mainframe data framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of Cobrix
+(SudhirNikam/cobrix): parse COBOL copybooks, decode EBCDIC binary files
+(fixed-length, variable-length RDW/BDW, multisegment, hierarchical) into
+columnar data — with the per-record decode loop replaced by batched TPU
+byte-transcoding kernels over `[batch, record_len]` uint8 arrays.
+"""
+from .copybook.copybook import Copybook, merge_copybooks, parse_copybook
+from .copybook.datatypes import (
+    CommentPolicy,
+    DebugFieldsPolicy,
+    Encoding,
+    FloatingPointFormat,
+    SchemaRetentionPolicy,
+    TrimPolicy,
+    Usage,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Copybook",
+    "parse_copybook",
+    "merge_copybooks",
+    "CommentPolicy",
+    "DebugFieldsPolicy",
+    "Encoding",
+    "FloatingPointFormat",
+    "SchemaRetentionPolicy",
+    "TrimPolicy",
+    "Usage",
+]

@@ -1,0 +1,3 @@
+from .copybook import Copybook, merge_copybooks, parse_copybook
+
+__all__ = ["Copybook", "parse_copybook", "merge_copybooks"]

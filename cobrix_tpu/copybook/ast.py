@@ -1,0 +1,142 @@
+"""Copybook AST: Group / Primitive statement nodes.
+
+Mirrors the reference AST semantics (cobol-parser ast/Statement.scala:20,
+Group.scala:42, Primitive.scala:33, BinaryProperties.scala:20) but is mutable:
+the layout pipeline annotates nodes in place instead of rebuilding immutable
+trees, and decoders are *not* bound into the nodes — the columnar plan
+compiler maps `dtype` to batched TPU kernels instead (the reference binds a
+per-field JVM closure at parse time, which is exactly the per-record design
+we are replacing).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional
+
+from .datatypes import FILLER, Usage
+
+
+@dataclass
+class BinaryProperties:
+    offset: int = 0
+    data_size: int = 0     # size of a single instance
+    actual_size: int = 0   # size including OCCURS repetitions / redefine max
+
+
+class Statement:
+    """Common interface of Group and Primitive."""
+
+    level: int
+    name: str
+    line_number: int
+    parent: Optional["Group"]
+    redefines: Optional[str]
+    is_redefined: bool
+    occurs: Optional[int]
+    to: Optional[int]
+    depending_on: Optional[str]
+    depending_on_handlers: Dict[str, int]
+    is_filler: bool
+    binary_properties: BinaryProperties
+
+    @property
+    def is_array(self) -> bool:
+        return self.occurs is not None
+
+    @property
+    def array_min_size(self) -> int:
+        if self.occurs is None:
+            if self.to is not None:
+                raise ValueError(
+                    f"Field properties 'OCCURS' and 'TO' are incorrectly specified for '{self.name}'")
+            return 1
+        return self.occurs if self.to is not None else 1
+
+    @property
+    def array_max_size(self) -> int:
+        if self.occurs is None:
+            if self.to is not None:
+                raise ValueError(
+                    f"Field properties 'OCCURS' and 'TO' are incorrectly specified for '{self.name}'")
+            return 1
+        return self.to if self.to is not None else self.occurs
+
+    @property
+    def is_child_segment(self) -> bool:
+        return False
+
+
+@dataclass
+class Primitive(Statement):
+    level: int
+    name: str
+    line_number: int
+    dtype: object
+    redefines: Optional[str] = None
+    is_redefined: bool = False
+    occurs: Optional[int] = None
+    to: Optional[int] = None
+    depending_on: Optional[str] = None
+    depending_on_handlers: Dict[str, int] = dc_field(default_factory=dict)
+    is_dependee: bool = False
+    is_filler: bool = False
+    binary_properties: BinaryProperties = dc_field(default_factory=BinaryProperties)
+    parent: Optional["Group"] = None
+
+    def data_size_bytes(self) -> int:
+        from .datatypes import binary_size_bytes
+        return binary_size_bytes(self.dtype)
+
+    def walk(self):
+        yield self
+
+
+@dataclass
+class Group(Statement):
+    level: int
+    name: str
+    line_number: int = -1
+    children: List[Statement] = dc_field(default_factory=list)
+    redefines: Optional[str] = None
+    is_redefined: bool = False
+    is_segment_redefine: bool = False
+    parent_segment: Optional["Group"] = None
+    occurs: Optional[int] = None
+    to: Optional[int] = None
+    depending_on: Optional[str] = None
+    depending_on_handlers: Dict[str, int] = dc_field(default_factory=dict)
+    is_filler: bool = False
+    group_usage: Optional[Usage] = None
+    non_filler_size: int = 0
+    binary_properties: BinaryProperties = dc_field(default_factory=BinaryProperties)
+    parent: Optional["Group"] = None
+
+    @property
+    def is_child_segment(self) -> bool:
+        return self.parent_segment is not None
+
+    def add(self, child: Statement) -> Statement:
+        child.parent = self
+        self.children.append(child)
+        return child
+
+    def walk(self):
+        """Depth-first traversal over all statements below (excluding self)."""
+        for child in self.children:
+            yield child
+            if isinstance(child, Group):
+                yield from child.walk()
+
+    def walk_primitives(self):
+        for st in self.walk():
+            if isinstance(st, Primitive):
+                yield st
+
+
+def new_root() -> Group:
+    return Group(level=0, name="_ROOT_", line_number=-1)
+
+
+def transform_identifier(identifier: str) -> str:
+    """Normalize a COBOL identifier (reference CopybookParser.transformIdentifier)."""
+    return identifier.replace(":", "").replace("-", "_")

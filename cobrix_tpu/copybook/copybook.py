@@ -1,0 +1,328 @@
+"""Compiled copybook schema object and the top-level parse entry point.
+
+Mirrors the reference `Copybook` API (cobol-parser Copybook.scala:28: record
+size, field lookup by name/dot-path, single-field decode, layout report,
+drop_root/restrict_to, merge) and `CopybookParser.parseTree`
+(CopybookParser.scala:200-262).
+"""
+from __future__ import annotations
+
+import copy as _copy
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from . import pipeline
+from .ast import Group, Primitive, Statement, new_root, transform_identifier
+from .datatypes import (
+    CommentPolicy,
+    DebugFieldsPolicy,
+    Encoding,
+    FloatingPointFormat,
+    TrimPolicy,
+)
+from .lexer import preprocess, tokenize
+from .parser import CopybookStatementParser
+
+
+class Copybook:
+    def __init__(self, ast: Group,
+                 string_trimming_policy: TrimPolicy = TrimPolicy.BOTH,
+                 ebcdic_code_page: str = "common",
+                 ascii_charset: str = "us-ascii",
+                 is_utf16_big_endian: bool = True,
+                 floating_point_format: FloatingPointFormat = FloatingPointFormat.IBM):
+        self.ast = ast
+        # decode-time options; carried to the scalar oracle and the plan compiler
+        self.string_trimming_policy = string_trimming_policy
+        self.ebcdic_code_page = ebcdic_code_page
+        self.ascii_charset = ascii_charset
+        self.is_utf16_big_endian = is_utf16_big_endian
+        self.floating_point_format = floating_point_format
+
+    def _with_same_options(self, ast: Group) -> "Copybook":
+        return Copybook(ast,
+                        string_trimming_policy=self.string_trimming_policy,
+                        ebcdic_code_page=self.ebcdic_code_page,
+                        ascii_charset=self.ascii_charset,
+                        is_utf16_big_endian=self.is_utf16_big_endian,
+                        floating_point_format=self.floating_point_format)
+
+    # -- basic properties ------------------------------------------------------
+
+    @property
+    def record_size(self) -> int:
+        return self.ast.binary_properties.offset + self.ast.binary_properties.actual_size
+
+    def get_all_segment_redefines(self) -> List[Group]:
+        return pipeline.get_all_segment_redefines(self.ast)
+
+    def get_parent_children_segment_map(self) -> Dict[str, List[Group]]:
+        return pipeline.get_parent_to_children_map(self.ast)
+
+    def get_root_segment_ast(self) -> Group:
+        return pipeline.get_root_segment_ast(self.ast)
+
+    @property
+    def is_hierarchical(self) -> bool:
+        return any(g.parent_segment is not None for g in self.get_all_segment_redefines())
+
+    def get_root_segment_ids(self, segment_id_redefine_map: Dict[str, str],
+                             field_parent_map: Dict[str, str]) -> List[str]:
+        root_fields = set(field_parent_map.values()) - set(field_parent_map.keys())
+        return [seg_id for seg_id, redefine in segment_id_redefine_map.items()
+                if redefine in root_fields]
+
+    # -- field lookup (reference Copybook.getFieldByName) ----------------------
+
+    def get_field_by_name(self, field_name: str) -> Statement:
+        if "." in field_name:
+            found = self._get_field_by_path_name(field_name)
+        else:
+            found = self._get_field_by_unique_name(field_name)
+        if not found:
+            raise ValueError(f"Field '{field_name}' is not found in the copybook.")
+        if len(found) > 1:
+            raise ValueError(
+                f"Multiple fields with name '{field_name}' found in the copybook. "
+                "Please specify the exact field using '.' notation.")
+        return found[0]
+
+    def _get_field_by_unique_name(self, field_name: str) -> List[Statement]:
+        name = transform_identifier(field_name).upper()
+        out: List[Statement] = []
+        for grp in self.ast.children:
+            if isinstance(grp, Group):
+                if grp.name.upper() == name:
+                    out.append(grp)
+                for st in grp.walk():
+                    if st.name.upper() == name:
+                        out.append(st)
+        return out
+
+    def _get_field_by_path_name(self, field_name: str) -> List[Statement]:
+        path = [transform_identifier(p) for p in field_name.split(".")]
+        roots = [c.name.upper() for c in self.ast.children]
+        if path[0].upper() not in roots and self.ast.children:
+            path = [self.ast.children[0].name] + path
+
+        def in_group(group: Group, parts: List[str]) -> List[Statement]:
+            if not parts:
+                raise ValueError(
+                    f"'{field_name}' is a GROUP and not a primitive field. "
+                    "Cannot extract it's value.")
+            out: List[Statement] = []
+            for child in group.children:
+                if child.name.upper() != parts[0].upper():
+                    continue
+                if isinstance(child, Group):
+                    out.extend(in_group(child, parts[1:]))
+                elif len(parts) == 1:
+                    out.append(child)
+            return out
+
+        out: List[Statement] = []
+        for grp in self.ast.children:
+            if isinstance(grp, Group) and grp.name.upper() == path[0].upper():
+                out.extend(in_group(grp, path[1:]))
+        return out
+
+    # -- single-field decode (parity/debug path; the TPU path is plan+kernels) -
+
+    def extract_primitive_field(self, field: Primitive, record: bytes,
+                                start_offset: int = 0):
+        from ..ops import scalar_decoders
+        off = field.binary_properties.offset + start_offset
+        data = record[off: off + field.binary_properties.actual_size]
+        return scalar_decoders.decode_field(
+            field.dtype, data,
+            trimming=self.string_trimming_policy,
+            ebcdic_code_page=self.ebcdic_code_page,
+            ascii_charset=self.ascii_charset,
+            is_utf16_big_endian=self.is_utf16_big_endian,
+            floating_point_format=self.floating_point_format)
+
+    def get_field_value_by_name(self, field_name: str, record: bytes,
+                                start_offset: int = 0):
+        field = self.get_field_by_name(field_name)
+        if not isinstance(field, Primitive):
+            raise ValueError(
+                f"{field_name} is not a primitive field, cannot extract it's value.")
+        return self.extract_primitive_field(field, record, start_offset)
+
+    # -- layout report (byte-for-byte reference Copybook.generateRecordLayoutPositions)
+
+    def generate_record_layout_positions(self) -> str:
+        field_counter = [0]
+
+        def align_left(s: str, w: int) -> str:
+            return s if len(s) >= w else s + " " * (w - len(s))
+
+        def align_right(s: str, w: int) -> str:
+            return s if len(s) >= w else " " * (w - len(s)) + s
+
+        def group_layout(group: Group, path: str = "  ") -> str:
+            field_strings = []
+            for field in group.children:
+                field_counter[0] += 1
+                redefines = "R" if field.redefines is not None else ""
+                redefined_by = "r" if field.is_redefined else ""
+                is_array = "[]" if field.occurs is not None else ""
+                start = field.binary_properties.offset + 1
+                length = field.binary_properties.actual_size
+                end = start + length - 1
+                if isinstance(field, Group):
+                    modifiers = f"{redefined_by}{redefines}{is_array}"
+                    group_str = group_layout(field, path + "  ")
+                    line = (align_left(f"{path}{field.level} {field.name}", 39)
+                            + align_left(modifiers, 11)
+                            + align_right(str(field_counter[0]), 5)
+                            + align_right(str(start), 7)
+                            + align_right(str(end), 7)
+                            + align_right(str(length), 7))
+                    field_strings.append(line + "\n" + group_str)
+                else:
+                    dependee = "D" if field.is_dependee else ""
+                    modifiers = f"{dependee}{redefined_by}{redefines}{is_array}"
+                    line = (align_left(f"{path}{field.level} {field.name}", 39)
+                            + align_left(modifiers, 11)
+                            + align_right(str(field_counter[0]), 5)
+                            + align_right(str(start), 7)
+                            + align_right(str(end), 7)
+                            + align_right(str(length), 7))
+                    field_strings.append(line)
+            return "\n".join(field_strings)
+
+        strings = []
+        for grp in self.ast.children:
+            start = grp.binary_properties.offset + 1
+            length = grp.binary_properties.actual_size
+            end = start + length - 1
+            group_str = group_layout(grp)  # type: ignore[arg-type]
+            name_part = grp.name if len(grp.name) >= 55 else grp.name + " " * (55 - len(grp.name))
+            line = (name_part
+                    + str(start).rjust(7) + str(end).rjust(7) + str(length).rjust(7))
+            strings.append(f"{line}\n{group_str}")
+        header = ("-------- FIELD LEVEL/NAME --------- --ATTRIBS--    FLD  START"
+                  "     END  LENGTH\n\n")
+        return header + "\n".join(strings)
+
+    # -- restructuring ---------------------------------------------------------
+
+    def drop_root(self) -> "Copybook":
+        if not self.ast.children:
+            raise ValueError("Cannot drop the root of an empty copybook.")
+        if len(self.ast.children) > 1:
+            raise ValueError(
+                "Cannot drop the root of a copybook with more than one root segment.")
+        head = self.ast.children[0]
+        if not isinstance(head, Group) or any(
+                isinstance(c, Primitive) for c in head.children):
+            raise ValueError("All elements of the root element must be record groups.")
+        new_root_grp = _copy.deepcopy(head)
+        new_root_grp.parent = None
+        pipeline.calculate_binary_properties(new_root_grp)
+        return self._with_same_options(new_root_grp)
+
+    def restrict_to(self, field_name: str) -> "Copybook":
+        stmt = self.get_field_by_name(field_name)
+        if isinstance(stmt, Primitive):
+            raise ValueError("Can only restrict the copybook to a group element.")
+        root = new_root()
+        stmt_copy = _copy.deepcopy(stmt)
+        root.add(stmt_copy)
+        pipeline.calculate_binary_properties(root)
+        return self._with_same_options(root)
+
+    def visit_primitives(self, fn) -> None:
+        for st in self.ast.walk_primitives():
+            fn(st)
+
+
+def merge_copybooks(copybooks: Iterable[Copybook]) -> Copybook:
+    """Merge copybooks as REDEFINES of the first root (reference Copybook.merge)."""
+    copybooks = list(copybooks)
+    if not copybooks:
+        raise ValueError("Cannot merge an empty iterable of copybooks.")
+    root_levels = {c.level for cb in copybooks for c in cb.ast.children}
+    if len(root_levels) > 1:
+        raise ValueError("Cannot merge copybooks with differing root levels")
+    root_names = [c.name for cb in copybooks for c in cb.ast.children]
+    if len(set(root_names)) != len(root_names):
+        raise ValueError("Cannot merge copybooks with repeated segment identifiers")
+    for cb in copybooks:
+        if len(cb.ast.children) > 1:
+            head = cb.ast.children[0]
+            if not head.is_redefined or any(
+                    c.redefines != head.name for c in cb.ast.children[1:]):
+                raise ValueError("Copybook segments must redefine top segment.")
+
+    root = new_root()
+    target_name = copybooks[0].ast.children[0].name
+    first = _copy.deepcopy(copybooks[0].ast.children[0])
+    first.redefines = None
+    first.is_redefined = True
+    root.add(first)
+    for st in copybooks[0].ast.children[1:]:
+        st2 = _copy.deepcopy(st)
+        st2.redefines = target_name
+        st2.is_redefined = False
+        root.add(st2)
+    for cb in copybooks[1:]:
+        for st in cb.ast.children:
+            st2 = _copy.deepcopy(st)
+            st2.redefines = target_name
+            st2.is_redefined = False
+            root.add(st2)
+    pipeline.calculate_binary_properties(root)
+    return copybooks[0]._with_same_options(root)
+
+
+def parse_copybook(
+    contents: str,
+    data_encoding: Encoding = Encoding.EBCDIC,
+    drop_group_fillers: bool = False,
+    drop_value_fillers: bool = True,
+    segment_redefines: Sequence[str] = (),
+    field_parent_map: Optional[Dict[str, str]] = None,
+    string_trimming_policy: TrimPolicy = TrimPolicy.BOTH,
+    comment_policy: CommentPolicy = CommentPolicy(),
+    ebcdic_code_page: str = "common",
+    ascii_charset: str = "us-ascii",
+    is_utf16_big_endian: bool = True,
+    floating_point_format: FloatingPointFormat = FloatingPointFormat.IBM,
+    non_terminals: Sequence[str] = (),
+    occurs_mappings: Optional[Dict[str, Dict[str, int]]] = None,
+    debug_fields_policy: DebugFieldsPolicy = DebugFieldsPolicy.NONE,
+) -> Copybook:
+    """Parse copybook text into a compiled `Copybook`
+    (reference CopybookParser.parseTree, CopybookParser.scala:200-262).
+
+    Decode-time options (trimming, code page, charset, float format) are not
+    bound into the AST here; they are carried by the columnar plan compiler
+    (`cobrix_tpu.plan`) which turns the AST into batched TPU decode kernels.
+    """
+    lines = preprocess(contents, comment_policy)
+    statements = tokenize(lines)
+    root = CopybookStatementParser(data_encoding).parse(statements)
+
+    field_parent_map = {
+        transform_identifier(k): transform_identifier(v)
+        for k, v in (field_parent_map or {}).items()}
+    pipeline.validate_field_parent_map(field_parent_map)
+    non_terms = {transform_identifier(n) for n in non_terminals}
+
+    pipeline.calculate_binary_properties(root)
+    pipeline.add_non_terminals(root, non_terms, data_encoding)
+    pipeline.mark_dependee_fields(root, occurs_mappings or {})
+    if drop_group_fillers:
+        pipeline.process_group_fillers(root, drop_value_fillers)
+    pipeline.rename_group_fillers(root, drop_group_fillers, drop_value_fillers)
+    pipeline.mark_segment_redefines(root, segment_redefines)
+    pipeline.set_segment_parents(root, field_parent_map)
+    pipeline.add_debug_fields(root, debug_fields_policy)
+    pipeline.calculate_non_filler_sizes(root)
+    return Copybook(root,
+                    string_trimming_policy=string_trimming_policy,
+                    ebcdic_code_page=ebcdic_code_page,
+                    ascii_charset=ascii_charset,
+                    is_utf16_big_endian=is_utf16_big_endian,
+                    floating_point_format=floating_point_format)

@@ -1,0 +1,239 @@
+"""COBOL data types and related enums.
+
+Semantics mirror the reference implementation's type model
+(cobol-parser ast/datatype/CobolType.scala:19, Decimal.scala:23, Integral.scala:23,
+AlphaNumeric.scala:23, Usage.scala:20-46) while the representation is a plain
+Python dataclass hierarchy designed to be hashed/grouped by the columnar plan
+compiler (fields with equal types share one TPU decode kernel launch).
+"""
+from __future__ import annotations
+
+import enum
+import math
+from dataclasses import dataclass, replace
+from typing import Optional
+
+
+class Usage(enum.Enum):
+    """COBOL USAGE (storage) clauses.
+
+    COMP/BINARY/COMP-0/COMP-4 all map to COMP4 (big-endian two's complement).
+    COMP9 is an artificial little-endian binary usage (reference Usage.scala:44).
+    """
+
+    COMP1 = 1   # single-precision float
+    COMP2 = 2   # double-precision float
+    COMP3 = 3   # packed BCD
+    COMP4 = 4   # binary big-endian
+    COMP5 = 5   # binary (native; treated as big-endian like the reference)
+    COMP9 = 9   # artificial: binary little-endian
+
+    def __str__(self) -> str:
+        return f"COMP-{self.value}"
+
+
+class Encoding(enum.Enum):
+    EBCDIC = "ebcdic"
+    ASCII = "ascii"
+    UTF16 = "utf16"
+    HEX = "hex"
+    RAW = "raw"
+
+
+class SignPosition(enum.Enum):
+    LEFT = "left"
+    RIGHT = "right"
+
+
+class TrimPolicy(enum.Enum):
+    NONE = "none"
+    LEFT = "left"
+    RIGHT = "right"
+    BOTH = "both"
+
+
+class FloatingPointFormat(enum.Enum):
+    IBM = "ibm"
+    IBM_LE = "ibm_little_endian"
+    IEEE754 = "ieee754"
+    IEEE754_LE = "ieee754_little_endian"
+
+
+class DebugFieldsPolicy(enum.Enum):
+    NONE = "none"
+    HEX = "hex"
+    RAW = "raw"
+
+
+class SchemaRetentionPolicy(enum.Enum):
+    KEEP_ORIGINAL = "keep_original"
+    COLLAPSE_ROOT = "collapse_root"
+
+
+@dataclass(frozen=True)
+class CommentPolicy:
+    """Copybook comment truncation (reference policies/CommentPolicy.scala:19)."""
+
+    truncate_comments: bool = True
+    comments_up_to_char: int = 6
+    comments_after_char: int = 72
+
+
+# Numeric precision buckets (reference common/Constants.scala:21-79)
+MAX_INTEGER_PRECISION = 9
+MAX_LONG_PRECISION = 18
+MIN_SHORT_PRECISION, MAX_SHORT_PRECISION = 1, 4
+MIN_INTEGER_PRECISION = 5
+MIN_LONG_PRECISION = 10
+BINARY_SHORT_SIZE = 2
+BINARY_INT_SIZE = 4
+BINARY_LONG_SIZE = 8
+FLOAT_SIZE = 4
+DOUBLE_SIZE = 8
+MAX_FIELD_LENGTH = 100_000
+MAX_RDW_RECORD_SIZE = 100 * 1024 * 1024
+MAX_BIN_INT_PRECISION = 38
+MAX_DECIMAL_PRECISION = 38
+MAX_DECIMAL_SCALE = 18
+
+FILLER = "FILLER"
+NON_TERMINALS_POSTFIX = "_NT"
+
+# Generated-field names (reference common/Constants.scala)
+FILE_ID_FIELD = "File_Id"
+RECORD_ID_FIELD = "Record_Id"
+SEGMENT_ID_FIELD = "Seg_Id"
+
+# EBCDIC punctuation bytes used by zoned-decimal decoding
+EBCDIC_MINUS = 0x60
+EBCDIC_PLUS = 0x4E
+EBCDIC_DOT = 0x4B
+EBCDIC_COMMA = 0x6B
+EBCDIC_SPACE = 0x40
+
+
+@dataclass(frozen=True)
+class AlphaNumeric:
+    """PIC X/A/N field."""
+
+    pic: str
+    length: int
+    enc: Optional[Encoding] = Encoding.EBCDIC
+    original_pic: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Integral:
+    """Whole-number numeric field (scale == 0, no scale factor)."""
+
+    pic: str
+    precision: int
+    sign_position: Optional[SignPosition] = None
+    is_sign_separate: bool = False
+    usage: Optional[Usage] = None
+    enc: Optional[Encoding] = Encoding.EBCDIC
+    original_pic: Optional[str] = None
+
+    @property
+    def is_signed(self) -> bool:
+        return self.sign_position is not None
+
+
+@dataclass(frozen=True)
+class Decimal:
+    """Fractional numeric field (V/explicit-dot/P-scaled)."""
+
+    pic: str
+    scale: int
+    precision: int
+    scale_factor: int = 0
+    explicit_decimal: bool = False
+    sign_position: Optional[SignPosition] = None
+    is_sign_separate: bool = False
+    usage: Optional[Usage] = None
+    enc: Optional[Encoding] = Encoding.EBCDIC
+    original_pic: Optional[str] = None
+
+    @property
+    def is_signed(self) -> bool:
+        return self.sign_position is not None
+
+    @property
+    def effective_precision(self) -> int:
+        # reference Decimal.scala:44
+        return self.precision + abs(self.scale_factor)
+
+    @property
+    def effective_scale(self) -> int:
+        # reference Decimal.scala:48-58
+        if self.scale_factor > 0:
+            return 0
+        if self.scale_factor < 0:
+            return self.effective_precision
+        return self.scale
+
+
+CobolType = object  # union of the three dataclasses above
+
+
+def binary_size_bytes(dtype) -> int:
+    """Byte width of one field instance (reference BinaryUtils.getBytesCount
+    + Primitive.getBinarySizeBytes, BinaryUtils.scala:129-155)."""
+    if isinstance(dtype, AlphaNumeric):
+        return dtype.length
+    if isinstance(dtype, (Integral, Decimal)):
+        usage = dtype.usage
+        precision = dtype.precision
+        explicit_dot = isinstance(dtype, Decimal) and dtype.explicit_decimal
+        if usage in (Usage.COMP4, Usage.COMP5, Usage.COMP9):
+            if usage is Usage.COMP9 and 1 <= precision <= 2:
+                return 1
+            if MIN_SHORT_PRECISION <= precision <= MAX_SHORT_PRECISION:
+                return BINARY_SHORT_SIZE
+            if MIN_INTEGER_PRECISION <= precision <= MAX_INTEGER_PRECISION:
+                return BINARY_INT_SIZE
+            if MIN_LONG_PRECISION <= precision <= MAX_LONG_PRECISION:
+                return BINARY_LONG_SIZE
+            return math.ceil(((math.log(10) / math.log(2)) * precision + 1) / 8)
+        if usage is Usage.COMP1:
+            return FLOAT_SIZE
+        if usage is Usage.COMP2:
+            return DOUBLE_SIZE
+        if usage is Usage.COMP3:
+            return precision // 2 + 1
+        # DISPLAY
+        size = precision
+        if dtype.is_sign_separate:
+            size += 1
+        if explicit_dot:
+            size += 1
+        return size
+    raise TypeError(f"Unknown COBOL type: {dtype!r}")
+
+
+def with_usage(dtype, usage: Optional[Usage]):
+    """Apply a USAGE clause to a numeric type (reference ParserVisitor.replaceUsage)."""
+    if usage is None:
+        return dtype
+    if isinstance(dtype, (Integral, Decimal)):
+        if dtype.usage is not None and dtype.usage != usage:
+            raise SyntaxError(
+                f"Field USAGE ({dtype.usage}) doesn't match group's USAGE ({usage}).")
+        return replace(dtype, usage=usage)
+    raise SyntaxError(f"USAGE {usage} cannot be applied to non-numeric field.")
+
+
+def decimal0_to_integral(dtype):
+    """Decimal(scale=0, scale_factor=0) is a whole number
+    (reference ParserVisitor.replaceDecimal0)."""
+    if isinstance(dtype, Decimal) and dtype.scale == 0 and dtype.scale_factor == 0:
+        return Integral(
+            pic=dtype.pic,
+            precision=dtype.precision,
+            sign_position=dtype.sign_position,
+            is_sign_separate=dtype.is_sign_separate,
+            usage=dtype.usage,
+            enc=dtype.enc,
+            original_pic=dtype.original_pic,
+        )
+    return dtype

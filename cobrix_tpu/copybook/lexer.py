@@ -1,0 +1,134 @@
+"""Copybook text preprocessing and tokenization.
+
+Replaces the reference's ANTLR lexer (copybookLexer.g4, ANTLRParser.scala:55-112)
+with a small hand-rolled scanner: strip columns 1-6 and 72+, normalize special
+whitespace, skip '*' comments, and split the stream into period-terminated
+statements of word tokens.
+
+A '.' terminates a statement only when followed by whitespace or end of input
+(TERMINAL lexer rule); a '.' inside a PIC like '9(4).99' stays part of the token.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from .datatypes import CommentPolicy
+
+
+class CopybookSyntaxError(SyntaxError):
+    def __init__(self, line: int, field: str, msg: str):
+        full = (f"Syntax error in the copybook at line {line}, field {field}: {msg}"
+                if field else
+                f"Syntax error in the copybook at line {line}: {msg}")
+        super().__init__(full)
+        # NB: don't assign self.msg — SyntaxError.__str__ prints it verbatim
+        self.line = line
+        self.field_name = field
+        self.detail = msg
+
+
+@dataclass
+class RawStatement:
+    line_number: int      # line of the first token (1-based, pre-truncation numbering)
+    tokens: List[str]
+
+
+def preprocess(text: str, comment_policy: CommentPolicy = CommentPolicy()) -> List[str]:
+    """Normalize special characters and truncate comment columns per line
+    (reference ANTLRParser.filterSpecialCharacters/truncateComments)."""
+    text = text.replace("\u00a0", " ").replace("\t", " ")
+    lines = text.splitlines()
+    out = []
+    cp = comment_policy
+    for line in lines:
+        if cp.truncate_comments:
+            if cp.comments_up_to_char >= 0 and cp.comments_after_char >= 0:
+                line = line[cp.comments_up_to_char:cp.comments_after_char]
+            elif cp.comments_up_to_char >= 0:
+                line = line[cp.comments_up_to_char:]
+            else:
+                line = line[: len(line) - cp.comments_after_char] if cp.comments_after_char else line
+        out.append(line)
+    return out
+
+
+def tokenize(lines: List[str]) -> List[RawStatement]:
+    """Split preprocessed lines into period-terminated statements of tokens."""
+    statements: List[RawStatement] = []
+    current: List[str] = []
+    current_line = 0
+
+    def flush(line_no: int):
+        nonlocal current, current_line
+        if current:
+            statements.append(RawStatement(current_line, current))
+            current = []
+        current_line = 0
+
+    for line_idx, line in enumerate(lines, start=1):
+        i, n = 0, len(line)
+        while i < n:
+            ch = line[i]
+            if ch in " \r\n\f":
+                i += 1
+                continue
+            if ch == "*":
+                break  # comment to end of line
+            if ch == "\x1a":  # control-Z
+                i += 1
+                continue
+            if ch in "'\"":
+                # quoted literal (doubled quote escapes itself)
+                quote = ch
+                j = i + 1
+                buf = [quote]
+                while j < n:
+                    if line[j] == quote:
+                        if j + 1 < n and line[j + 1] == quote:
+                            buf.append(quote * 2)
+                            j += 2
+                            continue
+                        buf.append(quote)
+                        j += 1
+                        break
+                    buf.append(line[j])
+                    j += 1
+                if not current:
+                    current_line = line_idx
+                current.append("".join(buf))
+                i = j
+                continue
+            # word token: runs up to whitespace; '.' or ',' followed by
+            # whitespace/EOL terminates the word (and '.' the statement)
+            j = i
+            terminal = False
+            while j < n:
+                c = line[j]
+                if c in " \r\n\f*'\"":
+                    break
+                if c == "." and (j + 1 >= n or line[j + 1] in " \r\n\f"):
+                    terminal = True
+                    break
+                if c == "," and (j + 1 >= n or line[j + 1] in " \r\n\f"):
+                    break
+                j += 1
+            word = line[i:j]
+            if word:
+                if not current:
+                    current_line = line_idx
+                current.append(word)
+            if terminal:
+                if not current:
+                    current_line = line_idx
+                flush(line_idx)
+                j += 1
+            elif j < n and line[j] == ",":
+                j += 1  # drop standalone comma separators (values lists)
+            i = j
+
+    if current:
+        # statement without terminating period — accept it (lenient, like a
+        # trailing '.' EOF TERMINAL)
+        statements.append(RawStatement(current_line, current))
+    return statements

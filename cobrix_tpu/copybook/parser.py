@@ -1,0 +1,351 @@
+"""Copybook statement parser: token statements -> raw AST.
+
+Covers the reference grammar (copybookParser.g4: group/primitive/level66/level88
+items with REDEFINES/OCCURS/PIC/USAGE/VALUE/SIGN/JUSTIFIED/BLANK clauses) and
+the level-stack parenting of ParserVisitor.getParentFromLevel (ParserVisitor.scala:196).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .ast import Group, Primitive, Statement, new_root, transform_identifier
+from .datatypes import (
+    Encoding,
+    FILLER,
+    MAX_BIN_INT_PRECISION,
+    MAX_DECIMAL_PRECISION,
+    MAX_DECIMAL_SCALE,
+    MAX_FIELD_LENGTH,
+    AlphaNumeric,
+    Decimal,
+    Integral,
+    Usage,
+    decimal0_to_integral,
+    with_usage,
+)
+from .lexer import CopybookSyntaxError, RawStatement
+from . import pic as picmod
+
+_USAGE_MAP = {
+    "COMP": Usage.COMP4, "COMPUTATIONAL": Usage.COMP4,
+    "COMP-0": Usage.COMP4, "COMPUTATIONAL-0": Usage.COMP4,
+    "COMP-1": Usage.COMP1, "COMPUTATIONAL-1": Usage.COMP1,
+    "COMP-2": Usage.COMP2, "COMPUTATIONAL-2": Usage.COMP2,
+    "COMP-3": Usage.COMP3, "COMPUTATIONAL-3": Usage.COMP3,
+    "PACKED-DECIMAL": Usage.COMP3,
+    "COMP-4": Usage.COMP4, "COMPUTATIONAL-4": Usage.COMP4,
+    "COMP-5": Usage.COMP5, "COMPUTATIONAL-5": Usage.COMP5,
+    "COMP-9": Usage.COMP9, "COMPUTATIONAL-9": Usage.COMP9,
+    "BINARY": Usage.COMP4,
+    "DISPLAY": None,
+}
+
+_SKIP_TOKENS = {"SKIP1", "SKIP2", "SKIP3"}
+
+
+class _Clauses:
+    def __init__(self):
+        self.redefines: Optional[str] = None
+        self.occurs: Optional[int] = None
+        self.occurs_to: Optional[int] = None
+        self.depending_on: Optional[str] = None
+        self.pic_text: Optional[str] = None
+        self.pic_is_comp1: bool = False
+        self.pic_is_comp2: bool = False
+        self.usage: Optional[Usage] = None
+        self.has_usage_clause: bool = False
+        # usage bound inside the PIC clause itself ("PIC 9(5) COMP-3"): does
+        # NOT suppress group-usage application, so conflicts raise (reference
+        # visitPic/visitPrimitive interplay)
+        self.pic_usage: Optional[Usage] = None
+        self.sign_side: Optional[str] = None     # 'L'/'T' from SIGN IS clause
+        self.sign_separate: bool = False
+
+
+def _is_level(token: str) -> bool:
+    return token.isdigit() and len(token) <= 2
+
+
+class CopybookStatementParser:
+    def __init__(self, enc: Encoding = Encoding.EBCDIC):
+        self.enc = enc
+
+    def parse(self, statements: List[RawStatement]) -> Group:
+        root = new_root()
+        # stack entries: (level, group, children_level)
+        stack: List[list] = [[0, root, None]]
+
+        for stmt in statements:
+            # SKIP1/2/3 are skipped wherever they appear (lexer '-> skip' rule)
+            tokens = [t for t in stmt.tokens if t.upper() not in _SKIP_TOKENS]
+            if not tokens:
+                continue
+            head = tokens[0]
+            if not _is_level(head):
+                raise CopybookSyntaxError(stmt.line_number, "",
+                                          f"Invalid input {head!r} — expected a level number")
+            level = int(head)
+            if level == 88:
+                continue  # condition names are ignored (grammar level88statement)
+            if level == 66:
+                raise CopybookSyntaxError(stmt.line_number, "", "Renames not supported yet")
+            if level < 1 or level > 49:
+                raise CopybookSyntaxError(stmt.line_number, "",
+                                          f"Invalid level number {level}")
+            if len(tokens) < 2:
+                raise CopybookSyntaxError(stmt.line_number, "",
+                                          "Field name expected after the level number")
+            name = transform_identifier(tokens[1].strip("'\""))
+            clauses = self._parse_clauses(stmt, name, tokens[2:])
+            parent = self._parent_from_level(stack, level, stmt, name)
+
+            is_primitive = (clauses.pic_text is not None or clauses.pic_is_comp1
+                            or clauses.pic_is_comp2)
+            if is_primitive:
+                node = self._make_primitive(stmt, name, level, parent, clauses)
+                parent.add(node)
+            else:
+                if clauses.usage in (Usage.COMP1, Usage.COMP2):
+                    raise CopybookSyntaxError(
+                        stmt.line_number, name,
+                        f"USAGE {clauses.usage} is not allowed on a group item "
+                        "(grammar groupUsageLiteral).")
+                grp = Group(
+                    level=level,
+                    name=name,
+                    line_number=stmt.line_number,
+                    redefines=clauses.redefines,
+                    occurs=clauses.occurs,
+                    to=clauses.occurs_to,
+                    depending_on=clauses.depending_on,
+                    is_filler=name.upper() == FILLER,
+                    group_usage=clauses.usage,
+                )
+                parent.add(grp)
+                stack.append([level, grp, None])
+        return root
+
+    # -- level stack (reference ParserVisitor.getParentFromLevel) --------------
+
+    def _parent_from_level(self, stack, section: int, stmt: RawStatement, name: str) -> Group:
+        while section <= stack[-1][0] and len(stack) > 1:
+            stack.pop()
+        top = stack[-1]
+        children_level = top[2]
+        if children_level == section:
+            pass
+        elif children_level is None or children_level > section:
+            top[2] = section
+        else:
+            last = top[1].children[-1] if top[1].children else top[1]
+            raise CopybookSyntaxError(
+                last.line_number, last.name,
+                "The field is a leaf element and cannot contain nested fields.")
+        return top[1]
+
+    # -- clause parsing --------------------------------------------------------
+
+    def _parse_clauses(self, stmt: RawStatement, name: str, tokens: List[str]) -> _Clauses:
+        c = _Clauses()
+        i = 0
+        n = len(tokens)
+
+        def err(msg):
+            raise CopybookSyntaxError(stmt.line_number, name, msg)
+
+        def next_tok(what):
+            nonlocal i
+            if i >= n:
+                err(f"{what} expected")
+            t = tokens[i]
+            i += 1
+            return t
+
+        while i < n:
+            tok = tokens[i]
+            up = tok.upper()
+            i += 1
+            if up == "REDEFINES":
+                c.redefines = transform_identifier(next_tok("identifier"))
+            elif up == "OCCURS":
+                c.occurs = int(next_tok("integer"))
+                while i < n:
+                    u2 = tokens[i].upper()
+                    if u2 == "TO":
+                        i += 1
+                        c.occurs_to = int(next_tok("integer"))
+                    elif u2 == "TIMES":
+                        i += 1
+                    elif u2 == "DEPENDING":
+                        i += 1
+                        if i < n and tokens[i].upper() == "ON":
+                            i += 1
+                        c.depending_on = transform_identifier(next_tok("identifier"))
+                    elif u2 in ("ASCENDING", "DESCENDING"):
+                        i += 1
+                        for kw in ("KEY", "IS"):
+                            if i < n and tokens[i].upper() == kw:
+                                i += 1
+                        next_tok("identifier")
+                    elif u2 == "INDEXED":
+                        i += 1
+                        if i < n and tokens[i].upper() == "BY":
+                            i += 1
+                        next_tok("identifier")
+                    else:
+                        break
+            elif up in ("PIC", "PICTURE"):
+                # grammar allows a bare usage between the PIC keyword and the
+                # picture or right after it; both bind inside the pic clause
+                if (i < n and tokens[i].upper() in _USAGE_MAP
+                        and tokens[i].upper() not in ("COMP-1", "COMP-2",
+                                                      "COMPUTATIONAL-1",
+                                                      "COMPUTATIONAL-2")):
+                    c.pic_usage = _USAGE_MAP[tokens[i].upper()]
+                    i += 1
+                pic_tok = next_tok("picture")
+                up_pic = pic_tok.upper()
+                if up_pic in ("COMP-1", "COMPUTATIONAL-1"):
+                    c.pic_is_comp1 = True
+                elif up_pic in ("COMP-2", "COMPUTATIONAL-2"):
+                    c.pic_is_comp2 = True
+                else:
+                    c.pic_text = pic_tok
+                    if (c.pic_usage is None and i < n
+                            and tokens[i].upper() in _USAGE_MAP):
+                        c.pic_usage = _USAGE_MAP[tokens[i].upper()]
+                        i += 1
+            elif up == "USAGE":
+                if i < n and tokens[i].upper() == "IS":
+                    i += 1
+                self._set_usage(c, next_tok("usage").upper(), err)
+            elif up in _USAGE_MAP:
+                if up in ("COMP-1", "COMPUTATIONAL-1") and c.pic_text is None:
+                    c.pic_is_comp1 = True
+                elif up in ("COMP-2", "COMPUTATIONAL-2") and c.pic_text is None:
+                    c.pic_is_comp2 = True
+                else:
+                    self._set_usage(c, up, err)
+            elif up in ("VALUE", "VALUES"):
+                if i < n and tokens[i].upper() in ("IS", "ARE"):
+                    i += 1
+                # consume literal(s) incl. THRU ranges until the next clause keyword
+                while i < n:
+                    u2 = tokens[i].upper()
+                    if u2 in ("REDEFINES", "OCCURS", "PIC", "PICTURE", "USAGE",
+                              "SIGN", "JUSTIFIED", "JUST", "BLANK") or u2 in _USAGE_MAP:
+                        break
+                    i += 1
+            elif up == "SIGN":
+                if i < n and tokens[i].upper() == "IS":
+                    i += 1
+                side = next_tok("LEADING or TRAILING").upper()
+                if side not in ("LEADING", "TRAILING"):
+                    err(f"Expected LEADING or TRAILING, got {side}")
+                c.sign_side = "L" if side == "LEADING" else "T"
+                if i < n and tokens[i].upper() == "SEPARATE":
+                    i += 1
+                    c.sign_separate = True
+                if i < n and tokens[i].upper() == "CHARACTER":
+                    i += 1
+            elif up in ("JUSTIFIED", "JUST"):
+                if i < n and tokens[i].upper() == "RIGHT":
+                    i += 1
+            elif up == "BLANK":
+                if i < n and tokens[i].upper() == "WHEN":
+                    i += 1
+                if i < n and tokens[i].upper() in ("ZERO", "ZEROS", "ZEROES"):
+                    i += 1
+            else:
+                err(f"Invalid input {tok!r}")
+        return c
+
+    def _set_usage(self, c: _Clauses, text: str, err):
+        if text not in _USAGE_MAP:
+            err(f"Unknown Usage literal {text}")
+        c.has_usage_clause = True
+        c.usage = _USAGE_MAP[text]
+
+    # -- primitive construction (reference ParserVisitor.visitPrimitive) -------
+
+    def _make_primitive(self, stmt: RawStatement, name: str, level: int,
+                        parent: Group, c: _Clauses) -> Primitive:
+        if c.pic_is_comp1 or c.pic_is_comp2:
+            dtype = picmod.comp1_comp2_type(
+                Usage.COMP1 if c.pic_is_comp1 else Usage.COMP2, self.enc)
+        else:
+            try:
+                dtype = picmod.parse_pic(c.pic_text, self.enc)
+            except picmod.PicParseError as e:
+                raise CopybookSyntaxError(stmt.line_number, name, str(e)) from e
+            dtype = decimal0_to_integral(dtype)
+
+        # usage resolution (reference visitPic + visitPrimitive): usage bound
+        # inside the PIC clause applies first; a statement-level USAGE clause
+        # suppresses group-usage inheritance, a pic-bound one does not.
+        try:
+            if c.pic_usage is not None:
+                dtype = with_usage(dtype, c.pic_usage)
+            if c.has_usage_clause and c.usage is not None:
+                dtype = with_usage(dtype, c.usage)
+            elif not c.has_usage_clause and parent.group_usage is not None:
+                dtype = with_usage(dtype, parent.group_usage)
+        except SyntaxError as e:
+            raise CopybookSyntaxError(stmt.line_number, name, str(e)) from e
+
+        # SIGN IS LEADING/TRAILING [SEPARATE] clause
+        if c.sign_side is not None and isinstance(dtype, (Integral, Decimal)):
+            if not dtype.is_sign_separate:
+                dtype = picmod.apply_sign(dtype, c.sign_side, "-", c.sign_separate)
+            else:
+                raise CopybookSyntaxError(stmt.line_number, name,
+                                          "Cannot mix explicit signs and SEPARATE clauses")
+
+        self._check_bounds(stmt, name, dtype)
+        return Primitive(
+            level=level,
+            name=name,
+            line_number=stmt.line_number,
+            dtype=dtype,
+            redefines=c.redefines,
+            occurs=c.occurs,
+            to=c.occurs_to,
+            depending_on=c.depending_on,
+            is_filler=name.upper() == FILLER,
+        )
+
+    def _check_bounds(self, stmt: RawStatement, name: str, dtype) -> None:
+        """reference ParserVisitor.checkBounds (ParserVisitor.scala:539)."""
+        def err(msg):
+            raise CopybookSyntaxError(stmt.line_number, name, msg)
+
+        if isinstance(dtype, Decimal):
+            if dtype.is_sign_separate and dtype.usage is not None:
+                err(f"SIGN SEPARATE clause is not supported for {dtype.usage}. "
+                    "It is only supported for DISPLAY formatted fields.")
+            if dtype.scale > MAX_DECIMAL_SCALE:
+                err(f"Decimal numbers with scale bigger than {MAX_DECIMAL_SCALE} "
+                    "are not supported.")
+            if dtype.precision > MAX_DECIMAL_PRECISION:
+                err(f"Decimal numbers with precision bigger than {MAX_DECIMAL_PRECISION} "
+                    "are not supported.")
+            if dtype.usage is not None and dtype.explicit_decimal:
+                err(f"Explicit decimal point in 'PIC {dtype.original_pic}' is not "
+                    f"supported for {dtype.usage}. It is only supported for DISPLAY "
+                    "formatted fields.")
+        elif isinstance(dtype, Integral):
+            if dtype.is_sign_separate and dtype.usage is not None:
+                err(f"SIGN SEPARATE clause is not supported for {dtype.usage}. "
+                    "It is only supported for DISPLAY formatted fields.")
+            if dtype.precision > MAX_BIN_INT_PRECISION and dtype.usage is Usage.COMP4:
+                err(f"BINARY-encoded integers with precision bigger than "
+                    f"{MAX_BIN_INT_PRECISION} are not supported.")
+            if dtype.precision < 1 or dtype.precision >= MAX_FIELD_LENGTH:
+                err(f"Incorrect field size of {dtype.precision} for PIC "
+                    f"{dtype.original_pic}. Supported size is in range from 1 to "
+                    f"{MAX_FIELD_LENGTH}.")
+        elif isinstance(dtype, AlphaNumeric):
+            if dtype.length < 1 or dtype.length >= MAX_FIELD_LENGTH:
+                err(f"Incorrect field size of {dtype.length} for PIC "
+                    f"{dtype.original_pic}. Supported size is in range from 1 to "
+                    f"{MAX_FIELD_LENGTH}.")

@@ -1,0 +1,210 @@
+"""PIC string parsing.
+
+Implements the PIC semantics of the reference front-end
+(ParserVisitor.scala:68-104 regex taxonomy, :574-758 visitors, :584-599
+COMP-1/2 pseudo-PIC) with a single run-length parser instead of a regex
+per grammar branch.
+
+Supported pictures:
+  X/A         -> AlphaNumeric                 (length = char count)
+  N           -> AlphaNumeric UTF-16          (length = 2 * char count)
+  [S]9..      -> Integral
+  [S]9..V9..  -> Decimal(scale = fraction digits)
+  [S]9..P..   -> Decimal(scale_factor = +k)   (value * 10^k, whole number)
+  [S]P..9..   -> Decimal(scale_factor = -k)   (0.00..digits)
+  [S]9...9..  -> Decimal(explicit_decimal)    ('.' or ',' in the picture)
+  Z variants  -> unsigned Decimal/Integral with leading/trailing blanks
+  +/- leading/trailing -> separate sign character
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import replace
+from typing import List, Optional, Tuple
+
+from .datatypes import (
+    AlphaNumeric,
+    Decimal,
+    Encoding,
+    Integral,
+    SignPosition,
+    Usage,
+)
+
+
+class PicParseError(ValueError):
+    pass
+
+
+_RUN_RE = re.compile(r"([9XNPZAS+\-VB.,])(?:\((\d+)\))?")
+
+
+def _expand_runs(text: str) -> List[Tuple[str, int]]:
+    """Expand a PIC like 'S9(4)V99' into merged char runs [('S',1),('9',4),('V',1),('9',2)]."""
+    runs: List[Tuple[str, int]] = []
+    pos = 0
+    while pos < len(text):
+        m = _RUN_RE.match(text, pos)
+        if not m:
+            raise PicParseError(f"Error reading PIC {text!r} at position {pos}")
+        ch, count = m.group(1), m.group(2)
+        n = int(count) if count else 1
+        if runs and runs[-1][0] == ch:
+            runs[-1] = (ch, runs[-1][1] + n)
+        else:
+            runs.append((ch, n))
+        pos = m.end()
+    return runs
+
+
+def _fmt(ch: str, n: int) -> str:
+    return f"{ch}({n})" if n > 0 else ""
+
+
+def comp1_comp2_type(usage: Usage, enc: Encoding):
+    """Pseudo-PIC for a bare COMP-1/COMP-2 field (reference ParserVisitor.scala:584-599)."""
+    return Decimal(
+        pic="9(16)V9(16)",
+        scale=16,
+        precision=32,
+        scale_factor=0,
+        explicit_decimal=False,
+        sign_position=None,
+        is_sign_separate=False,
+        usage=usage,
+        enc=enc,
+        original_pic=None,
+    )
+
+
+def parse_pic(text: str, enc: Encoding = Encoding.EBCDIC):
+    """Parse a PIC string into a CobolType (no USAGE applied yet)."""
+    original = text
+    text = text.upper()
+    runs = _expand_runs(text)
+    chars = {ch for ch, _ in runs}
+
+    if chars and chars <= {"X", "A"}:
+        length = sum(n for _, n in runs)
+        ch = runs[0][0]
+        return AlphaNumeric(pic=f"{ch}({length})", length=length, enc=enc, original_pic=original)
+    if chars <= {"N"}:
+        length = sum(n for _, n in runs)
+        return AlphaNumeric(pic=f"N({length})", length=length * 2,
+                            enc=Encoding.UTF16, original_pic=original)
+
+    return _parse_numeric(original, runs, enc)
+
+
+def _parse_numeric(original: str, runs: List[Tuple[str, int]], enc: Encoding):
+    # Leading/trailing explicit sign characters and the S flag.
+    sign_char: Optional[str] = None
+    sign_side: Optional[str] = None  # 'L' or 'T'
+    if runs and runs[0][0] in "+-":
+        if runs[0][1] != 1:
+            raise PicParseError(f"Error reading PIC {original!r}")
+        sign_char, sign_side = runs[0][0], "L"
+        runs = runs[1:]
+    elif runs and runs[-1][0] in "+-":
+        if runs[-1][1] != 1:
+            raise PicParseError(f"Error reading PIC {original!r}")
+        sign_char, sign_side = runs[-1][0], "T"
+        runs = runs[:-1]
+
+    has_s = bool(runs) and runs[0][0] == "S"
+    if has_s:
+        if runs[0][1] != 1:
+            raise PicParseError(f"Error reading PIC {original!r}")
+        runs = runs[1:]
+
+    # Bucket the remaining runs: [Z1][P_lead][9a][V|dot][P_scale][9b][Z2][P_trail]
+    z1 = n1 = p_lead = p_scale = n2 = z2 = p_trail = 0
+    seen_sep = False   # V or explicit dot seen
+    explicit_dot = False
+    seen_digits = False
+    for ch, n in runs:
+        if ch == "V":
+            if seen_sep:
+                raise PicParseError(f"Error reading PIC {original!r}")
+            seen_sep = True
+        elif ch in ".,":
+            if seen_sep or n != 1:
+                raise PicParseError(f"Error reading PIC {original!r}")
+            seen_sep = True
+            explicit_dot = True
+        elif ch == "9":
+            if seen_sep:
+                n2 += n
+            else:
+                n1 += n
+            seen_digits = True
+        elif ch == "Z":
+            if seen_sep:
+                z2 += n
+            elif seen_digits:
+                raise PicParseError(f"Error reading PIC {original!r}")
+            else:
+                z1 += n
+        elif ch == "P":
+            if seen_sep:
+                p_scale += n
+            elif seen_digits:
+                p_trail += n
+            else:
+                p_lead += n
+        elif ch == "B":
+            raise PicParseError(f"PIC 'B' insertion characters are not supported: {original!r}")
+        else:
+            raise PicParseError(f"Error reading PIC {original!r}")
+
+    if z1 + n1 + n2 + z2 == 0:
+        raise PicParseError(f"Error reading PIC {original!r}")
+    is_z = z1 + z2 > 0
+    if is_z and (has_s or sign_char):
+        raise PicParseError(f"Z pictures cannot be signed: {original!r}")
+
+    s_prefix = "S" if has_s else ""
+    sign_position = SignPosition.LEFT if has_s else None
+
+    if explicit_dot:
+        # reference fromNumericSPicRegexExplicitDot / fromNumericZPicRegexExplicitDot
+        pic = (("Z(%d)" % z1 if z1 else "") + s_prefix + _fmt("9", n1)
+               + "." + _fmt("9", n2) + _fmt("Z", z2))
+        dtype = Decimal(pic=pic, scale=n2 + z2, precision=z1 + n1 + n2 + z2,
+                        scale_factor=0, explicit_decimal=True,
+                        sign_position=sign_position, enc=enc, original_pic=original)
+    elif seen_sep:
+        # reference fromNumericSPicRegexDecimalScaled / fromNumericZPicRegexDecimalScaled
+        # NOTE: the reference stores the P-run between V and the digits as a
+        # *positive* scale factor (ParserVisitor.scala:243) — matched exactly.
+        pic = (_fmt("Z", z1) + s_prefix + _fmt("9", n1) + "V"
+               + _fmt("P", p_scale) + _fmt("9", n2) + _fmt("Z", z2))
+        dtype = Decimal(pic=pic, scale=n2 + z2, precision=z1 + n1 + n2 + z2,
+                        scale_factor=p_scale if not is_z else -p_scale,
+                        explicit_decimal=False,
+                        sign_position=sign_position, enc=enc, original_pic=original)
+    elif p_lead:
+        # reference fromNumericSPicRegexDecimalScaledLead: value = 0.0..digits
+        pic = s_prefix + _fmt("P", p_lead) + _fmt("9", n1)
+        dtype = Decimal(pic=pic, scale=0, precision=n1, scale_factor=-p_lead,
+                        explicit_decimal=False,
+                        sign_position=sign_position, enc=enc, original_pic=original)
+    else:
+        # reference fromNumericSPicRegexScaled / fromNumericZPicRegexScaled
+        pic = _fmt("Z", z1) + s_prefix + _fmt("9", n1) + _fmt("P", p_trail)
+        dtype = Decimal(pic=pic, scale=0, precision=z1 + n1, scale_factor=p_trail,
+                        explicit_decimal=False,
+                        sign_position=sign_position, enc=enc, original_pic=original)
+
+    if sign_char is not None:
+        dtype = apply_sign(dtype, sign_side, sign_char, separate=True)
+    return dtype
+
+
+def apply_sign(dtype, side: str, sign: str, separate: bool):
+    """Apply a leading/trailing sign (reference ParserVisitor.replaceSign)."""
+    position = SignPosition.LEFT if side == "L" else SignPosition.RIGHT
+    new_pic = (sign if side == "L" else "") + dtype.pic + (sign if side == "T" else "")
+    if isinstance(dtype, (Decimal, Integral)):
+        return replace(dtype, pic=new_pic, sign_position=position, is_sign_separate=separate)
+    raise PicParseError("Bad test for sign.")

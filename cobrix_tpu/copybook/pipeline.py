@@ -1,0 +1,463 @@
+"""AST post-processing pipeline.
+
+Mirrors the transform chain of reference CopybookParser.parseTree
+(CopybookParser.scala:225-261): sizes -> offsets -> non-terminals -> dependees
+-> fillers -> segment redefines -> segment parents -> debug fields ->
+non-filler sizes. Operates in place on the mutable Python AST.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+from .ast import Group, Primitive, Statement, transform_identifier
+from .datatypes import (
+    AlphaNumeric,
+    DebugFieldsPolicy,
+    Encoding,
+    FILLER,
+    Integral,
+    NON_TERMINALS_POSTFIX,
+)
+
+
+# ---------------------------------------------------------------------------
+# sizes & offsets (reference calculateSchemaSizes / getSchemaWithOffsets)
+# ---------------------------------------------------------------------------
+
+def calculate_sizes(group: Group) -> None:
+    """Bottom-up data/actual sizes; REDEFINES blocks share the running max size."""
+    redefined_sizes: List[int] = []
+    redefined_names: Set[str] = set()
+    redefined_block: List[Statement] = []
+    for i, child in enumerate(group.children):
+        if child.redefines is None:
+            redefined_sizes.clear()
+            redefined_names.clear()
+            redefined_block.clear()
+        else:
+            if i == 0:
+                from .lexer import CopybookSyntaxError
+                raise CopybookSyntaxError(
+                    child.line_number, child.name,
+                    "The first field of a group cannot use REDEFINES keyword.")
+            if child.redefines.upper() not in redefined_names:
+                from .lexer import CopybookSyntaxError
+                raise CopybookSyntaxError(
+                    child.line_number, child.name,
+                    f"The field {child.name} redefines {child.redefines}, "
+                    "which is not part if the redefined fields block.")
+            group.children[i - 1].is_redefined = True
+
+        if isinstance(child, Group):
+            calculate_sizes(child)
+        else:
+            size = child.data_size_bytes()
+            child.binary_properties.data_size = size
+            child.binary_properties.actual_size = size * child.array_max_size
+
+        redefined_sizes.append(child.binary_properties.actual_size)
+        redefined_names.add(child.name.upper())
+        redefined_block.append(child)
+        if child.redefines is not None:
+            max_size = max(redefined_sizes)
+            for st in redefined_block:
+                st.binary_properties.actual_size = max_size
+
+    group_size = sum(c.binary_properties.actual_size
+                     for c in group.children if c.redefines is None)
+    group.binary_properties.data_size = group_size
+    group.binary_properties.actual_size = group_size * group.array_max_size
+
+
+def assign_offsets(group: Group, start: int = 0) -> None:
+    offset = start
+    redefined_offset = start
+    for child in group.children:
+        if child.redefines is None:
+            use_offset = offset
+            redefined_offset = offset
+        else:
+            use_offset = redefined_offset
+        child.binary_properties.offset = use_offset
+        if isinstance(child, Group):
+            assign_offsets(child, use_offset)
+        if child.redefines is None:
+            offset += child.binary_properties.actual_size
+    group.binary_properties.offset = start
+
+
+def calculate_binary_properties(root: Group) -> Group:
+    calculate_sizes(root)
+    assign_offsets(root, 0)
+    # the root pseudo-group spans from 0
+    root.binary_properties.offset = 0
+    return root
+
+
+# ---------------------------------------------------------------------------
+# non-terminals (reference addNonTerminals)
+# ---------------------------------------------------------------------------
+
+def add_non_terminals(group: Group, non_terminals: Set[str], enc: Encoding) -> None:
+    """For each requested group name, add an X(size) primitive redefining the
+    whole group so its raw content is also exposed as a string column."""
+    if not non_terminals:
+        return
+    new_children: List[Statement] = []
+    for st in group.children:
+        if isinstance(st, Primitive):
+            new_children.append(st)
+            continue
+        add_non_terminals(st, non_terminals, enc)
+        if st.name in non_terminals:
+            st.is_redefined = True
+            new_children.append(st)
+            existing = {c.name for c in group.children}
+            new_name = st.name + NON_TERMINALS_POSTFIX
+            modifier = 0
+            while new_name in existing:
+                modifier += 1
+                new_name = st.name + NON_TERMINALS_POSTFIX + str(modifier)
+            sz = st.binary_properties.actual_size
+            prim = Primitive(
+                level=st.level,
+                name=new_name,
+                line_number=st.line_number,
+                dtype=AlphaNumeric(pic=f"X({sz})", length=sz, enc=enc),
+                redefines=st.name,
+                parent=group,
+            )
+            from .ast import BinaryProperties
+            prim.binary_properties = BinaryProperties(
+                st.binary_properties.offset, sz, sz)
+            new_children.append(prim)
+        else:
+            new_children.append(st)
+    group.children = new_children
+
+
+# ---------------------------------------------------------------------------
+# DEPENDING ON (reference markDependeeFields)
+# ---------------------------------------------------------------------------
+
+def mark_dependee_fields(root: Group,
+                         occurs_handlers: Dict[str, Dict[str, int]]) -> None:
+    flat_fields: List[Primitive] = []
+    dependees: Dict[int, List[Statement]] = {}
+    dependee_by_id: Dict[int, Primitive] = {}
+
+    def traverse(group: Group) -> None:
+        for field in group.children:
+            if field.depending_on is not None:
+                name_upper = field.depending_on.upper()
+                found = [f for f in flat_fields if f.name.upper() == name_upper]
+                if not found:
+                    raise ValueError(
+                        f"Unable to find dependee field {name_upper} from "
+                        "DEPENDING ON clause.")
+                if field.name in occurs_handlers:
+                    field.depending_on_handlers = dict(occurs_handlers[field.name])
+                dependees.setdefault(id(found[0]), []).append(field)
+                dependee_by_id[id(found[0])] = found[0]
+            if isinstance(field, Group):
+                traverse(field)
+            else:
+                flat_fields.append(field)
+
+    traverse(root)
+    for key, stmts in dependees.items():
+        prim = dependee_by_id[key]
+        if not isinstance(prim.dtype, Integral):
+            for stmt in stmts:
+                if not stmt.depending_on_handlers:
+                    raise ValueError(
+                        f"Field {prim.name} is a DEPENDING ON field of an OCCURS, "
+                        f"should be integral, found {type(prim.dtype).__name__}.")
+        prim.is_dependee = True
+
+
+# ---------------------------------------------------------------------------
+# fillers (reference processGroupFillers / renameGroupFillers)
+# ---------------------------------------------------------------------------
+
+def process_group_fillers(group: Group, drop_value_fillers: bool) -> bool:
+    """Mark groups consisting only of fillers as fillers themselves.
+    Returns True if the group has non-filler content."""
+    has_non_fillers = False
+    new_children: List[Statement] = []
+    for st in group.children:
+        if isinstance(st, Group):
+            was_filler = st.is_filler  # reference checks the pre-recursion flag
+            sub_has = process_group_fillers(st, drop_value_fillers)
+            if not sub_has:
+                st.is_filler = True
+            if st.children:
+                new_children.append(st)
+            if not was_filler:
+                has_non_fillers = True
+        else:
+            new_children.append(st)
+            if not st.is_filler or not drop_value_fillers:
+                has_non_fillers = True
+    group.children = new_children
+    return has_non_fillers
+
+
+class _FillerCounter:
+    def __init__(self):
+        self.group = 0
+        self.primitive = 0
+
+
+def rename_group_fillers(root: Group, drop_group_fillers: bool,
+                         drop_value_fillers: bool) -> None:
+    counter = _FillerCounter()
+
+    def process_primitive(st: Primitive) -> None:
+        if not drop_value_fillers and st.is_filler:
+            counter.primitive += 1
+            st.name = f"{FILLER}_P{counter.primitive}"
+            st.is_filler = False
+
+    def rename(group: Group) -> bool:
+        """Returns True if the group holds any non-filler child."""
+        has_non_fillers = False
+        new_children: List[Statement] = []
+        for st in group.children:
+            if isinstance(st, Group):
+                was_filler = st.is_filler
+                sub_has = rename(st)
+                if sub_has:
+                    if st.is_filler and not drop_group_fillers:
+                        counter.group += 1
+                        st.name = f"{FILLER}_{counter.group}"
+                        st.is_filler = False
+                else:
+                    st.is_filler = True
+                if st.children:
+                    new_children.append(st)
+                if not was_filler:
+                    has_non_fillers = True
+            else:
+                process_primitive(st)
+                new_children.append(st)
+                if not st.is_filler:
+                    has_non_fillers = True
+        group.children = new_children
+        return has_non_fillers
+
+    if not rename(root):
+        raise ValueError("The copybook is empty of consists only of FILLER fields.")
+
+
+# ---------------------------------------------------------------------------
+# segments (reference markSegmentRedefines / setSegmentParents)
+# ---------------------------------------------------------------------------
+
+def mark_segment_redefines(root: Group, segment_redefines: Sequence[str]) -> None:
+    if not segment_redefines:
+        return
+    transformed = [transform_identifier(r) for r in segment_redefines]
+    allow_non_redefines = len(segment_redefines) == 1
+    found: Set[str] = set()
+    state = {"v": 0}
+
+    def ensure_in_group(name: str, is_redefine: bool) -> None:
+        if state["v"] == 0 and is_redefine:
+            state["v"] = 1
+        elif state["v"] == 1 and not is_redefine:
+            state["v"] = 2
+        elif state["v"] == 2 and is_redefine:
+            raise ValueError(
+                f"The '{name}' field is specified to be a segment redefine. "
+                "However, it is not in the same group of REDEFINE fields")
+
+    def is_one_of(g: Group) -> bool:
+        # exact-case match like the reference (markSegmentRedefines)
+        return ((allow_non_redefines or g.is_redefined or g.redefines is not None)
+                and g.name in transformed)
+
+    def process(group: Group) -> None:
+        for st in group.children:
+            if isinstance(st, Primitive):
+                ensure_in_group(st.name, False)
+                continue
+            if is_one_of(st):
+                if st.name in found:
+                    raise ValueError(
+                        f"Duplicate segment redefine field '{st.name}' found.")
+                ensure_in_group(st.name, True)
+                found.add(st.name)
+                st.is_segment_redefine = True
+            else:
+                ensure_in_group(st.name, False)
+                if state["v"] == 0:
+                    process(st)
+
+    for st in root.children:
+        if isinstance(st, Group):
+            process(st)
+    not_found = [r for r in transformed if r not in found]
+    if not_found:
+        raise ValueError(
+            f"The following segment redefines not found: [ {','.join(not_found)} ]. "
+            "Please check the fields exist and are redefines/redefined by.")
+
+
+def set_segment_parents(root: Group, field_parent_map: Dict[str, str]) -> None:
+    if not field_parent_map:
+        return
+    redefined_fields = get_all_segment_redefines(root)
+    root_segments: List[str] = []
+
+    def get_parent_field(child_name: str) -> Optional[Group]:
+        parent_name = field_parent_map.get(child_name)
+        if parent_name is None:
+            return None
+        for f in redefined_fields:
+            if f.name == parent_name:
+                return f
+        raise ValueError(
+            f"Field {parent_name} is specified to be the parent of {child_name}, "
+            f"but {parent_name} is not a segment redefine. Please, check if the "
+            "field is specified for any of 'redefine-segment-id-map' options.")
+
+    def process(group: Group) -> None:
+        for st in group.children:
+            if not isinstance(st, Group):
+                continue
+            if st.is_segment_redefine:
+                st.parent_segment = get_parent_field(st.name)
+                if st.parent_segment is None:
+                    root_segments.append(st.name)
+            else:
+                if st.name in field_parent_map:
+                    raise ValueError(
+                        "Parent field is defined for a field that is not a segment "
+                        f"redefine. Field: '{st.name}'. Please, check if the field "
+                        "is specified for any of 'redefine-segment-id-map' options.")
+                process(st)
+
+    process(root)
+    if len(root_segments) > 1:
+        raise ValueError("Only one root segment is allowed. Found root segments: "
+                         f"[ {', '.join(root_segments)} ]. ")
+    if not root_segments:
+        raise ValueError("No root segment found in the segment parent-child map.")
+
+
+def get_all_segment_redefines(root: Group) -> List[Group]:
+    out: List[Group] = []
+
+    def process(group: Group) -> None:
+        for st in group.children:
+            if isinstance(st, Group):
+                if st.is_segment_redefine:
+                    out.append(st)
+                process(st)
+
+    process(root)
+    return out
+
+
+def get_parent_to_children_map(root: Group) -> Dict[str, List[Group]]:
+    redefines = get_all_segment_redefines(root)
+    return {
+        parent.name: [child for child in redefines
+                      if child.parent_segment is not None
+                      and child.parent_segment.name == parent.name]
+        for parent in redefines
+    }
+
+
+def get_root_segment_ast(group: Group) -> Group:
+    """A copy of the AST with child segments removed (reference getRootSegmentAST)."""
+    import copy as _copy
+    new_group = _copy.copy(group)
+    new_children: List[Statement] = []
+    for st in group.children:
+        if isinstance(st, Primitive):
+            new_children.append(st)
+        elif st.parent_segment is None:
+            new_children.append(get_root_segment_ast(st))
+    new_group.children = new_children
+    return new_group
+
+
+# ---------------------------------------------------------------------------
+# debug fields (reference addDebugFields)
+# ---------------------------------------------------------------------------
+
+def add_debug_fields(root: Group, policy: DebugFieldsPolicy) -> None:
+    if policy is DebugFieldsPolicy.NONE:
+        return
+    enc = Encoding.HEX if policy is DebugFieldsPolicy.HEX else Encoding.RAW
+
+    def process(group: Group) -> None:
+        new_children: List[Statement] = []
+        for st in group.children:
+            if isinstance(st, Group):
+                process(st)
+                new_children.append(st)
+            else:
+                st.is_redefined = True
+                new_children.append(st)
+                size = st.binary_properties.data_size
+                from .ast import BinaryProperties
+                dbg = Primitive(
+                    level=st.level,
+                    name=st.name + "_debug",
+                    line_number=st.line_number,
+                    dtype=AlphaNumeric(pic=f"X({size})", length=size, enc=enc),
+                    redefines=st.name,
+                    occurs=st.occurs,
+                    to=st.to,
+                    depending_on=st.depending_on,
+                    is_filler=st.is_filler,
+                    parent=group,
+                )
+                dbg.binary_properties = BinaryProperties(
+                    st.binary_properties.offset,
+                    st.binary_properties.data_size,
+                    st.binary_properties.actual_size)
+                new_children.append(dbg)
+        group.children = new_children
+
+    process(root)
+
+
+# ---------------------------------------------------------------------------
+# non-filler sizes (reference calculateNonFillerSizes)
+# ---------------------------------------------------------------------------
+
+def calculate_non_filler_sizes(root: Group) -> None:
+    def process(group: Group) -> None:
+        new_children: List[Statement] = []
+        for st in group.children:
+            if isinstance(st, Group):
+                process(st)
+                if st.children:
+                    new_children.append(st)
+            else:
+                new_children.append(st)
+        group.children = new_children
+        group.non_filler_size = sum(
+            1 for c in group.children if not c.is_filler and not c.is_child_segment)
+
+    process(root)
+    root.non_filler_size = sum(
+        1 for c in root.children if not c.is_filler and not c.is_child_segment)
+
+
+def validate_field_parent_map(field_parent_map: Dict[str, str]) -> None:
+    """Detect cycles in the segment parent map (reference validateFieldParentMap)."""
+    for field in field_parent_map:
+        visited = {field}
+        current = field
+        while current in field_parent_map:
+            current = field_parent_map[current]
+            if current in visited:
+                raise ValueError(
+                    f"Segment parent-child relation map has a cycle involving "
+                    f"'{field}'.")
+            visited.add(current)

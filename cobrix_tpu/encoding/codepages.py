@@ -1,0 +1,114 @@
+"""EBCDIC code pages: 256-entry EBCDIC->Unicode tables.
+
+Table data matches the reference code pages (cobol-parser
+parser/encoding/codepage/: CodePageCommon.scala:24 "invariant" subset,
+CodePageCommonExt.scala:25, CodePage037.scala:23-60, CodePage037Ext.scala,
+CodePage875.scala:23). The tables are exposed both as Python strings (host
+decode paths) and as uint8/uint16 numpy LUTs for the batched TPU gather
+kernels. Custom code pages register via `register_code_page`.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+_COMMON = (
+    "             \x0a                  "
+    "     \x0d                          "
+    "           .<(+|&         !$*); "
+    "-/        |,%_>?         `:#@'=\""
+    " abcdefghi       jklmnopqr      "
+    " ~stuvwxyz      ^         []    "
+    "{ABCDEFGHI-     }JKLMNOPQR      "
+    "\\ STUVWXYZ      0123456789      "
+)
+
+_COMMON_EXTENDED = (
+    "\x00\x01\x02\x03\x1a\x09\x1a \x1a\x1a\x1a\x0b\x0c\x0a\x0e\x0f\x10\x11\x12\x13\x1a\x1a\x08\x1a\x18\x19\x1a\x1a\x1c\x1d\x1e\x1f"
+    "     \x0d\x17\x1b     \x05\x06\x07  \x16    \x04    \x14\x15  "
+    "           .<(+|&         !$*); "
+    "-/        |,%_>?         `:#@'=\""
+    " abcdefghi       jklmnopqr      "
+    " ~stuvwxyz      ^         []    "
+    "{ABCDEFGHI-     }JKLMNOPQR      "
+    "\\ STUVWXYZ      0123456789      "
+)
+
+_CP037 = (
+    "             \x0a       \x85          "
+    "     \x0d                          "
+    " \xa0\xe2\xe4\xe0\xe1\xe3\xe5\xe7\xf1\xa2.<(+|&\xe9\xea\xeb\xe8\xed\xee\xef\xec\xdf!$*);\xac"
+    "-/\xc2\xc4\xc0\xc1\xc3\xc5\xc7\xd1|,%_>?\xf8\xc9\xca\xcb\xc8\xcd\xce\xcf\xcc`:#@'=\""
+    "\xd8abcdefghi\xab\xbb\xf0\xfd\xfe\xb1\xb0jklmnopqr\xaa\xba\xe6\xb8\xc6\xa4"
+    "\xb5~stuvwxyz\xa1\xbf\xd0\xdd\xde\xae^\xa3\xa5\xb7\xa9\xa7\xb6\xbc\xbd\xbe[]\xaf\xa8\xb4\xd7"
+    "{ABCDEFGHI\xad\xf4\xf6\xf2\xf3\xf5}JKLMNOPQR\xb9\xfb\xfc\xf9\xfa\xff"
+    "\\\xf7STUVWXYZ\xb2\xd4\xd6\xd2\xd3\xd50123456789\xb3\xdb\xdc\xd9\xda "
+)
+
+_CP037_EXTENDED = (
+    "\x00\x01\x02\x03 \x09 \x7f   \x0b\x0c\x0a\x0e\x0f\x10\x11\x12\x13 \x85\x08 \x18\x19  \x1c\x1d\x1e\x1f"
+    "     \x0d\x17\x1b     \x05\x06\x07  \x16    \x04    \x14\x15 \x1a"
+    " \xa0\xe2\xe4\xe0\xe1\xe3\xe5\xe7\xf1\xa2.<(+|&\xe9\xea\xeb\xe8\xed\xee\xef\xec\xdf!$*);\xac"
+    "-/\xc2\xc4\xc0\xc1\xc3\xc5\xc7\xd1|,%_>?\xf8\xc9\xca\xcb\xc8\xcd\xce\xcf\xcc`:#@'=\""
+    "\xd8abcdefghi\xab\xbb\xf0\xfd\xfe\xb1\xb0jklmnopqr\xaa\xba\xe6\xb8\xc6\xa4"
+    "\xb5~stuvwxyz\xa1\xbf\xd0\xdd\xde\xae^\xa3\xa5\xb7\xa9\xa7\xb6\xbc\xbd\xbe[]\xaf\xa8\xb4\xd7"
+    "{ABCDEFGHI\xad\xf4\xf6\xf2\xf3\xf5}JKLMNOPQR\xb9\xfb\xfc\xf9\xfa\xff"
+    "\\\xf7STUVWXYZ\xb2\xd4\xd6\xd2\xd3\xd50123456789\xb3\xdb\xdc\xd9\xda "
+)
+
+_CP875 = (
+    "             \x0a                  "
+    "     \x0d                          "
+    " \u0391\u0392\u0393\u0394\u0395\u0396\u0397\u0398\u0399[.<(+!&\u039a\u039b\u039c\u039d\u039e\u039f\u03a0\u03a1\u03a3]$*);^"
+    "-/\u03a4\u03a5\u03a6\u03a7\u03a8\u03a9\u03aa\u03ab|,%_>?\xa8\u0386\u0388\u0389 \u038a\u038c\u038e\u038f`:#@'=\""
+    "\u0385abcdefghi\u03b1\u03b2\u03b3\u03b4\u03b5\u03b6\xb0jklmnopqr\u03b7\u03b8\u03b9\u03ba\u03bb\u03bc"
+    "\xb4~stuvwxyz\u03bd\u03be\u03bf\u03c0\u03c1\u03c3\xa3\u03ac\u03ad\u03ae\u03ca\u03af\u03cc\u03cd\u03cb\u03ce\u03c2\u03c4\u03c5\u03c6\u03c7\u03c8"
+    "{ABCDEFGHI-\u03c9\u0390\u03b0\u2018\u2015}JKLMNOPQR\xb1\xbd \xb7\u2019\xa6"
+    "\\\u20afSTUVWXYZ\xb2\xa7\u037a \xab\xac0123456789\xb3\xa9\u20ac \xbb "
+)
+
+_TABLES: Dict[str, str] = {
+    "common": _COMMON,
+    "common_extended": _COMMON_EXTENDED,
+    "cp037": _CP037,
+    "cp037_extended": _CP037_EXTENDED,
+    "cp875": _CP875,
+}
+
+_CUSTOM: Dict[str, str] = {}
+
+
+def register_code_page(name: str, table: str) -> None:
+    """Register a custom 256-entry EBCDIC->Unicode table (the equivalent of the
+    reference's `getCodePageByClass` reflection loading, CodePage.scala:~50-75)."""
+    if len(table) != 256:
+        raise ValueError("A code page table must have exactly 256 entries")
+    _CUSTOM[name] = table
+
+
+def get_code_page_table(name: str) -> str:
+    """256-char Unicode string indexed by EBCDIC byte value."""
+    if name in _CUSTOM:
+        return _CUSTOM[name]
+    try:
+        return _TABLES[name]
+    except KeyError:
+        raise ValueError(
+            f"The ebcdic code page '{name}' is not one of the builtin EBCDIC code "
+            f"pages: {sorted(_TABLES)} (or a registered custom one)") from None
+
+
+def code_page_lut_u16(name: str) -> np.ndarray:
+    """[256] uint16 LUT (Unicode code points) for device-side transcoding."""
+    return np.frombuffer(
+        get_code_page_table(name).encode("utf-16-le"), dtype=np.uint16).copy()
+
+
+def code_page_lut_ascii(name: str) -> np.ndarray:
+    """[256] uint8 LUT; non-ASCII code points map to '?' (used by fast-path
+    kernels when every mapped char is ASCII, which holds for 'common')."""
+    lut = code_page_lut_u16(name)
+    out = lut.astype(np.int32)
+    out[out > 127] = ord("?")
+    return out.astype(np.uint8)

@@ -1,0 +1,224 @@
+"""Output schema: COBOL AST -> columnar/nested schema.
+
+Mirrors the reference AST->Spark StructType mapping
+(spark-cobol schema/CobolSchema.scala:77-243): Decimal->decimal(p,s) with
+effective precision/scale, COMP-1/2->float/double, Integral->int/long/decimal
+by precision buckets, RAW->binary, OCCURS->array, hierarchical child segments
+nested as arrays of structs, generated fields prepended.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional
+
+from ..copybook.ast import Group, Primitive
+from ..copybook.copybook import Copybook
+from ..copybook.datatypes import (
+    AlphaNumeric,
+    Decimal,
+    Encoding,
+    FILE_ID_FIELD,
+    Integral,
+    MAX_INTEGER_PRECISION,
+    MAX_LONG_PRECISION,
+    RECORD_ID_FIELD,
+    SEGMENT_ID_FIELD,
+    SchemaRetentionPolicy,
+    Usage,
+)
+
+
+@dataclass
+class Field:
+    name: str
+    dtype: "DataType"
+    nullable: bool = True
+
+
+@dataclass
+class StructType:
+    fields: List[Field] = dc_field(default_factory=list)
+
+    def to_json_dict(self):
+        return {"type": "struct",
+                "fields": [{"name": f.name, "type": _type_json(f.dtype),
+                            "nullable": f.nullable, "metadata": {}}
+                           for f in self.fields]}
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_json_dict(), separators=(",", ":"))
+
+    def field_names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+
+@dataclass
+class ArrayType:
+    element: "DataType"
+    contains_null: bool = True
+
+
+@dataclass
+class SimpleType:
+    name: str  # string|integer|long|float|double|binary|decimal(p,s)
+
+
+DataType = object
+
+
+def _type_json(t):
+    if isinstance(t, SimpleType):
+        return t.name
+    if isinstance(t, StructType):
+        return t.to_json_dict()
+    if isinstance(t, ArrayType):
+        return {"type": "array", "elementType": _type_json(t.element),
+                "containsNull": t.contains_null}
+    raise TypeError(t)
+
+
+STRING = SimpleType("string")
+INTEGER = SimpleType("integer")
+LONG = SimpleType("long")
+FLOAT = SimpleType("float")
+DOUBLE = SimpleType("double")
+BINARY = SimpleType("binary")
+
+
+def decimal_type(precision: int, scale: int) -> SimpleType:
+    return SimpleType(f"decimal({precision},{scale})")
+
+
+def primitive_data_type(p: Primitive):
+    """reference CobolSchema.parsePrimitive (schema/CobolSchema.scala:144-173)."""
+    dt = p.dtype
+    if isinstance(dt, Decimal):
+        if dt.usage is Usage.COMP1:
+            return FLOAT
+        if dt.usage is Usage.COMP2:
+            return DOUBLE
+        return decimal_type(dt.effective_precision, dt.effective_scale)
+    if isinstance(dt, AlphaNumeric):
+        return BINARY if dt.enc is Encoding.RAW else STRING
+    if isinstance(dt, Integral):
+        if dt.precision > MAX_LONG_PRECISION:
+            return decimal_type(dt.precision, 0)
+        if dt.precision > MAX_INTEGER_PRECISION:
+            return LONG
+        return INTEGER
+    raise TypeError(f"Unknown AST object {dt!r}")
+
+
+class CobolOutputSchema:
+    """Nested and flat output schemas + generated-field bookkeeping
+    (reference reader/schema/CobolSchema.scala:38-76 and
+    spark-cobol schema/CobolSchema.scala)."""
+
+    def __init__(self,
+                 copybook: Copybook,
+                 policy: SchemaRetentionPolicy = SchemaRetentionPolicy.KEEP_ORIGINAL,
+                 input_file_name_field: str = "",
+                 generate_record_id: bool = False,
+                 generate_seg_id_field_count: int = 0,
+                 segment_id_prefix: str = ""):
+        self.copybook = copybook
+        self.policy = policy
+        self.input_file_name_field = input_file_name_field
+        self.generate_record_id = generate_record_id
+        self.generate_seg_id_field_count = generate_seg_id_field_count
+        self.segment_id_prefix = segment_id_prefix
+        self._schema: Optional[StructType] = None
+
+    @property
+    def schema(self) -> StructType:
+        if self._schema is None:
+            self._schema = self._create_schema()
+        return self._schema
+
+    def _create_schema(self) -> StructType:
+        redefines = self.copybook.get_all_segment_redefines()
+        records = [self._parse_group(g, redefines)
+                   for g in self.copybook.ast.children if isinstance(g, Group)]
+        if self.policy is SchemaRetentionPolicy.COLLAPSE_ROOT:
+            expanded: List[Field] = []
+            for rec in records:
+                expanded.extend(rec.dtype.fields if isinstance(rec.dtype, StructType)
+                                else [rec])
+            records = expanded
+        if self.generate_seg_id_field_count > 0:
+            seg_fields = [Field(f"{SEGMENT_ID_FIELD}{lvl}", STRING, True)
+                          for lvl in range(self.generate_seg_id_field_count)]
+            records = seg_fields + records
+        if self.input_file_name_field:
+            records = [Field(self.input_file_name_field, STRING, True)] + records
+        if self.generate_record_id:
+            records = [Field(FILE_ID_FIELD, INTEGER, False),
+                       Field(RECORD_ID_FIELD, LONG, False)] + records
+        return StructType(records)
+
+    def _parse_group(self, group: Group, segment_redefines: List[Group]) -> Field:
+        fields: List[Field] = []
+        for child in group.children:
+            if child.is_filler:
+                continue
+            if isinstance(child, Group):
+                if child.parent_segment is None:
+                    fields.append(self._parse_group(child, segment_redefines))
+            else:
+                dt = primitive_data_type(child)
+                if child.is_array:
+                    fields.append(Field(child.name, ArrayType(dt)))
+                else:
+                    fields.append(Field(child.name, dt))
+        # child segments become nested arrays of structs
+        for segment in segment_redefines:
+            if (segment.parent_segment is not None
+                    and segment.parent_segment.name.upper() == group.name.upper()):
+                child_struct = self._parse_group(segment, segment_redefines)
+                fields.append(Field(segment.name,
+                                    ArrayType(child_struct.dtype)))
+        if group.is_array:
+            return Field(group.name, ArrayType(StructType(fields)))
+        return Field(group.name, StructType(fields))
+
+    # -- flat schema (reference parseGroupFlat) -------------------------------
+
+    def flat_schema(self) -> StructType:
+        fields: List[Field] = []
+        for record in self.copybook.ast.children:
+            if isinstance(record, Group):
+                fields.extend(self._parse_group_flat(record, f"{record.name}_"))
+        return StructType(fields)
+
+    def _parse_group_flat(self, group: Group, path: str) -> List[Field]:
+        fields: List[Field] = []
+        for child in group.children:
+            if child.is_filler:
+                continue
+            if isinstance(child, Group):
+                if child.is_array:
+                    for i in range(1, child.array_max_size + 1):
+                        fields.extend(self._parse_group_flat(
+                            child, f"{path}{child.name}_{i}_"))
+                else:
+                    fields.extend(self._parse_group_flat(child, f"{path}{child.name}_"))
+            else:
+                dt = self._flat_primitive_type(child)
+                if child.is_array:
+                    for i in range(1, child.array_max_size + 1):
+                        fields.append(Field(f"{path}{child.name}_{i}", dt))
+                else:
+                    fields.append(Field(f"{path}{child.name}", dt))
+        return fields
+
+    @staticmethod
+    def _flat_primitive_type(p: Primitive):
+        dt = p.dtype
+        if isinstance(dt, Decimal):
+            return decimal_type(dt.effective_precision, dt.effective_scale)
+        if isinstance(dt, AlphaNumeric):
+            return BINARY if dt.enc is Encoding.RAW else STRING
+        if isinstance(dt, Integral):
+            return LONG if dt.precision > MAX_INTEGER_PRECISION else INTEGER
+        raise TypeError(f"Unknown AST object {dt!r}")

@@ -1,0 +1,252 @@
+"""Copybook front-end tests: PIC semantics, sizes, layout goldens.
+
+Mirrors the reference tier-1 strategy (SURVEY.md §4): copybook string ->
+parse -> assert layout/size against golden strings from the reference's
+own `data/` directory.
+"""
+import pytest
+
+from cobrix_tpu import parse_copybook
+from cobrix_tpu.copybook.datatypes import (
+    AlphaNumeric,
+    Decimal,
+    Encoding,
+    Integral,
+    SignPosition,
+    Usage,
+    binary_size_bytes,
+)
+from cobrix_tpu.copybook.pic import parse_pic
+from cobrix_tpu.copybook.lexer import CopybookSyntaxError
+
+from util import read_copybook, read_golden_lines
+
+
+def wrap(fields: str) -> str:
+    lines = ["       01  RECORD."]
+    for f in fields.strip().splitlines():
+        lines.append("           " + f.strip())
+    return "\n".join(lines)
+
+
+class TestPicParsing:
+    def test_alpha_x(self):
+        t = parse_pic("X(10)")
+        assert isinstance(t, AlphaNumeric) and t.length == 10
+
+    def test_alpha_x_repeated(self):
+        t = parse_pic("XXX")
+        assert t.length == 3
+
+    def test_alpha_x_mixed(self):
+        assert parse_pic("XX(4)X").length == 6
+
+    def test_alpha_n_utf16(self):
+        t = parse_pic("N(5)")
+        assert t.length == 10 and t.enc is Encoding.UTF16
+
+    def test_unsigned_integral(self):
+        t = parse_pic("9(5)")
+        from cobrix_tpu.copybook.datatypes import decimal0_to_integral
+        t = decimal0_to_integral(t)
+        assert isinstance(t, Integral) and t.precision == 5 and not t.is_signed
+
+    def test_signed_integral(self):
+        from cobrix_tpu.copybook.datatypes import decimal0_to_integral
+        t = decimal0_to_integral(parse_pic("S9(7)"))
+        assert isinstance(t, Integral) and t.precision == 7
+        assert t.sign_position is SignPosition.LEFT and not t.is_sign_separate
+
+    def test_decimal_v(self):
+        t = parse_pic("S9(7)V99")
+        assert isinstance(t, Decimal)
+        assert t.precision == 9 and t.scale == 2 and not t.explicit_decimal
+
+    def test_decimal_explicit_dot(self):
+        t = parse_pic("9(8).9(2)")
+        assert isinstance(t, Decimal)
+        assert t.precision == 10 and t.scale == 2 and t.explicit_decimal
+
+    def test_trailing_p(self):
+        t = parse_pic("9(3)P(2)")
+        assert isinstance(t, Decimal)
+        assert t.precision == 3 and t.scale == 0 and t.scale_factor == 2
+        assert t.effective_scale == 0 and t.effective_precision == 5
+
+    def test_leading_p(self):
+        t = parse_pic("SP(2)9(3)")
+        assert isinstance(t, Decimal)
+        assert t.scale_factor == -2 and t.effective_scale == 5
+
+    def test_z_pic(self):
+        from cobrix_tpu.copybook.datatypes import decimal0_to_integral
+        t = decimal0_to_integral(parse_pic("ZZZ9"))
+        assert isinstance(t, Integral) and t.precision == 4 and not t.is_signed
+
+    def test_z_decimal(self):
+        t = parse_pic("ZZ9V99")
+        assert isinstance(t, Decimal) and t.precision == 5 and t.scale == 2
+
+
+class TestSizes:
+    @pytest.mark.parametrize("pic,usage,expected", [
+        ("9(4)", Usage.COMP4, 2),
+        ("9(9)", Usage.COMP4, 4),
+        ("9(10)", Usage.COMP4, 8),
+        ("9(18)", Usage.COMP4, 8),
+        ("S9(4)", Usage.COMP5, 2),
+        ("9(5)", Usage.COMP3, 3),      # precision/2 + 1
+        ("9(7)", Usage.COMP3, 4),
+        ("9(3)", None, 3),             # DISPLAY
+    ])
+    def test_binary_sizes(self, pic, usage, expected):
+        from cobrix_tpu.copybook.datatypes import decimal0_to_integral, with_usage
+        t = with_usage(decimal0_to_integral(parse_pic(pic)), usage)
+        assert binary_size_bytes(t) == expected
+
+    def test_display_sign_separate_size(self):
+        cb = parse_copybook(wrap("05 F PIC S9(5) SIGN IS LEADING SEPARATE."))
+        assert cb.record_size == 6
+
+    def test_explicit_decimal_size(self):
+        cb = parse_copybook(wrap("05 F PIC 9(4).99."))
+        assert cb.record_size == 7
+
+    def test_comp12_sizes(self):
+        cb = parse_copybook(wrap("05 F1 COMP-1.\n05 F2 COMP-2."))
+        assert cb.record_size == 12
+
+
+class TestStructure:
+    def test_redefines_share_offsets(self):
+        cb = parse_copybook(wrap("""
+            05 A PIC X(4).
+            05 B REDEFINES A PIC 9(4).
+            05 C PIC X(2).
+        """))
+        a = cb.get_field_by_name("A")
+        b = cb.get_field_by_name("B")
+        c = cb.get_field_by_name("C")
+        assert a.binary_properties.offset == b.binary_properties.offset == 0
+        assert c.binary_properties.offset == 4
+        assert a.is_redefined and b.redefines == "A"
+
+    def test_redefines_max_size(self):
+        cb = parse_copybook(wrap("""
+            05 A PIC X(2).
+            05 B REDEFINES A PIC X(10).
+            05 C PIC X(1).
+        """))
+        assert cb.record_size == 11
+        assert cb.get_field_by_name("C").binary_properties.offset == 10
+
+    def test_occurs_size(self):
+        cb = parse_copybook(wrap("05 A OCCURS 5 PIC 9(3)."))
+        assert cb.record_size == 15
+
+    def test_occurs_depending_on(self):
+        cb = parse_copybook(wrap("""
+            05 CNT PIC 9(1).
+            05 A OCCURS 1 TO 5 TIMES DEPENDING ON CNT PIC X(2).
+        """))
+        cnt = cb.get_field_by_name("CNT")
+        assert cnt.is_dependee
+        assert cb.record_size == 11
+
+    def test_group_usage_inheritance(self):
+        cb = parse_copybook(wrap("""
+            05 G COMP-3.
+               10 F PIC 9(5).
+        """))
+        f = cb.get_field_by_name("F")
+        assert f.dtype.usage is Usage.COMP3
+        assert cb.record_size == 3
+
+    def test_conflicting_usage_rejected(self):
+        with pytest.raises(CopybookSyntaxError):
+            parse_copybook(wrap("""
+                05 G COMP-3.
+                   10 F PIC 9(5) COMP.
+            """))
+
+    def test_filler_primitive_dropped_by_default(self):
+        cb = parse_copybook(wrap("""
+            05 A PIC X.
+            05 FILLER PIC X(3).
+        """))
+        rec = cb.ast.children[0]
+        names = [c.name for c in rec.children]
+        fillers = [c for c in rec.children if c.is_filler]
+        assert len(fillers) == 1 and cb.record_size == 4
+
+    def test_filler_groups_renamed(self):
+        cb = parse_copybook(wrap("""
+            05 FILLER.
+               10 A PIC X.
+            05 FILLER.
+               10 B PIC X.
+        """))
+        rec = cb.ast.children[0]
+        assert [c.name for c in rec.children] == ["FILLER_1", "FILLER_2"]
+
+    def test_66_renames_unsupported(self):
+        with pytest.raises(CopybookSyntaxError, match="Renames"):
+            parse_copybook("       01  R.\n           05 A PIC X.\n       66  B RENAMES A.")
+
+    def test_88_levels_ignored(self):
+        cb = parse_copybook(wrap("""
+            05 A PIC X.
+            88 A-ON VALUE 'Y'.
+            05 B PIC X.
+        """))
+        assert cb.record_size == 2
+
+    def test_nesting_under_leaf_rejected(self):
+        with pytest.raises(CopybookSyntaxError, match="leaf"):
+            parse_copybook("       01 R.\n         05 A PIC X.\n           10 B PIC X.")
+
+    def test_first_field_redefines_rejected(self):
+        with pytest.raises(CopybookSyntaxError, match="first field"):
+            parse_copybook(wrap("05 B REDEFINES A PIC X."))
+
+
+class TestLayoutGoldens:
+    def test_test19_layout_golden(self):
+        cb = parse_copybook(read_copybook("test19_display_num.cob"))
+        golden = "\n".join(read_golden_lines(
+            "test19_display_num_expected/test19_layout.txt"))
+        actual = cb.generate_record_layout_positions()
+        assert actual.rstrip("\n") == golden.rstrip("\n")
+
+    @pytest.mark.parametrize("cob,size", [
+        ("test1_copybook.cob", 2202),
+        ("test19_display_num.cob", 80),
+    ])
+    def test_record_sizes(self, cob, size):
+        assert parse_copybook(read_copybook(cob)).record_size == size
+
+
+class TestCopybookApi:
+    def test_field_by_dot_path(self):
+        cb = parse_copybook(read_copybook("test1_copybook.cob"))
+        f = cb.get_field_by_name("COMPANY.SHORT-NAME")
+        assert f.binary_properties.offset == 2
+
+    def test_ambiguous_name_raises(self):
+        cb = parse_copybook(wrap("""
+            05 G1.
+               10 X PIC 9.
+            05 G2.
+               10 X PIC 9.
+        """))
+        with pytest.raises(ValueError, match="Multiple fields"):
+            cb.get_field_by_name("X")
+
+    def test_extract_field_value(self):
+        cb = parse_copybook(wrap("05 F PIC 9(3)."))
+        assert cb.get_field_value_by_name("F", bytes([0xF1, 0xF2, 0xF3])) == 123
+
+    def test_restrict_to(self):
+        cb = parse_copybook(read_copybook("test1_copybook.cob"))
+        sub = cb.restrict_to("COMPANY")
+        assert sub.record_size == 13

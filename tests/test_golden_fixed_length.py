@@ -1,0 +1,76 @@
+"""End-to-end golden parity: fixed-length files vs the reference's own
+expected outputs (data/testN_expected — Spark toJSON lines + schema JSON).
+Tier-3 strategy of SURVEY.md §4, without a cluster.
+"""
+import json
+import os
+
+import pytest
+
+from cobrix_tpu import parse_copybook
+from cobrix_tpu.copybook.datatypes import SchemaRetentionPolicy
+from cobrix_tpu.reader.extractors import extract_record
+from cobrix_tpu.reader.json_out import rows_to_json
+from cobrix_tpu.reader.schema import CobolOutputSchema
+
+from util import REFERENCE_DATA, read_binary, read_copybook, read_golden_lines
+
+
+def decode_fixed(cb, data, policy, **kwargs):
+    rs = cb.record_size
+    assert len(data) % rs == 0
+    return [extract_record(cb.ast, data[i * rs:(i + 1) * rs], policy=policy,
+                           record_id=i, **kwargs)
+            for i in range(len(data) // rs)]
+
+
+class TestTest1:
+    """Fixed-length records, OCCURS DEPENDING ON, REDEFINES, COMP-3/COMP
+    (reference Test1FixedLengthRecordsSpec)."""
+
+    @pytest.fixture(scope="class")
+    def result(self):
+        cb = parse_copybook(read_copybook("test1_copybook.cob"))
+        data = read_binary("test1_data")
+        schema = CobolOutputSchema(cb, policy=SchemaRetentionPolicy.COLLAPSE_ROOT)
+        rows = decode_fixed(cb, data, SchemaRetentionPolicy.COLLAPSE_ROOT)
+        return schema, rows
+
+    def test_schema_golden(self, result):
+        schema, _ = result
+        expected = json.loads("\n".join(
+            read_golden_lines("test1_expected/test1_schema.json")))
+        assert schema.schema.to_json_dict() == expected
+
+    def test_rows_golden(self, result):
+        schema, rows = result
+        actual = rows_to_json(rows, schema.schema)
+        expected = read_golden_lines("test1_expected/test1.txt")
+        assert actual == expected
+
+
+class TestTest19:
+    """DISPLAY-format numerics incl. explicit decimal point
+    (reference Test19DisplayNumbersSpec); generates Record_Id fields."""
+
+    @pytest.fixture(scope="class")
+    def result(self):
+        cb = parse_copybook(read_copybook("test19_display_num.cob"))
+        data = read_binary("test19_display_num")
+        schema = CobolOutputSchema(cb, policy=SchemaRetentionPolicy.COLLAPSE_ROOT,
+                                   generate_record_id=True)
+        rows = decode_fixed(cb, data, SchemaRetentionPolicy.COLLAPSE_ROOT,
+                            generate_record_id=True)
+        return schema, rows
+
+    def test_schema_golden(self, result):
+        schema, _ = result
+        expected = json.loads("\n".join(
+            read_golden_lines("test19_display_num_expected/test19_schema.json")))
+        assert schema.schema.to_json_dict() == expected
+
+    def test_rows_golden(self, result):
+        schema, rows = result
+        actual = rows_to_json(rows, schema.schema)
+        expected = read_golden_lines("test19_display_num_expected/test19.txt")
+        assert actual == expected

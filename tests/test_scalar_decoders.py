@@ -1,0 +1,184 @@
+"""Scalar decoder parity tests (the oracle layer).
+
+Byte patterns follow the reference decoder unit suites
+(BinaryDecoderSpec, StringDecodersSpec, BCD/FP specs — SURVEY.md §4 tier 1):
+handcrafted bytes -> expected values, including malformed->None policy.
+"""
+import decimal
+import math
+
+import pytest
+
+from cobrix_tpu.copybook.datatypes import TrimPolicy
+from cobrix_tpu.encoding.codepages import get_code_page_table
+from cobrix_tpu.ops import scalar_decoders as d
+
+D = decimal.Decimal
+COMMON = get_code_page_table("common")
+
+
+class TestStrings:
+    def test_ebcdic_string(self):
+        data = bytes([0xC8, 0x85, 0x93, 0x93, 0x96])  # "Hello"
+        assert d.decode_ebcdic_string(data, TrimPolicy.BOTH, COMMON) == "Hello"
+
+    def test_ebcdic_trimming(self):
+        data = bytes([0x40, 0xC1, 0x40])  # " A "
+        assert d.decode_ebcdic_string(data, TrimPolicy.NONE, COMMON) == " A "
+        assert d.decode_ebcdic_string(data, TrimPolicy.LEFT, COMMON) == "A "
+        assert d.decode_ebcdic_string(data, TrimPolicy.RIGHT, COMMON) == " A"
+        assert d.decode_ebcdic_string(data, TrimPolicy.BOTH, COMMON) == "A"
+
+    def test_ascii_string_masks_control_and_high(self):
+        assert d.decode_ascii_string(b"\x01A\xffB", TrimPolicy.NONE) == " A B"
+
+    def test_hex(self):
+        assert d.decode_hex(bytes([0x01, 0xAB, 0xFF])) == "01ABFF"
+
+    def test_raw(self):
+        assert d.decode_raw(b"\x00\x01") == b"\x00\x01"
+
+
+class TestZonedNumbers:
+    def test_unsigned_digits(self):
+        assert d.decode_ebcdic_number(bytes([0xF1, 0xF2, 0xF3]), True) == "123"
+
+    def test_overpunched_positive(self):
+        # last digit C3 => +3
+        assert d.decode_ebcdic_number(bytes([0xF1, 0xF2, 0xC3]), False) == "+123"
+
+    def test_overpunched_negative(self):
+        assert d.decode_ebcdic_number(bytes([0xF1, 0xF2, 0xD3]), False) == "-123"
+
+    def test_negative_unsigned_is_null(self):
+        assert d.decode_ebcdic_number(bytes([0xF1, 0xD2]), True) is None
+
+    def test_explicit_minus(self):
+        assert d.decode_ebcdic_number(bytes([0x60, 0xF1, 0xF2]), False) == "-12"
+
+    def test_explicit_plus(self):
+        assert d.decode_ebcdic_number(bytes([0x4E, 0xF5]), False) == "+5"
+
+    def test_spaces_skipped(self):
+        assert d.decode_ebcdic_number(bytes([0x40, 0xF1, 0x40]), True) == "1"
+
+    def test_malformed_is_null(self):
+        assert d.decode_ebcdic_number(bytes([0xF1, 0x81]), True) is None
+
+    def test_decimal_point(self):
+        assert d.decode_ebcdic_number(bytes([0xF1, 0x4B, 0xF5]), True) == "1.5"
+
+    def test_comma_decimal_point(self):
+        assert d.decode_ebcdic_number(bytes([0xF1, 0x6B, 0xF5]), True) == "1.5"
+
+    def test_ascii_number(self):
+        assert d.decode_ascii_number(b"123", True) == "123"
+        assert d.decode_ascii_number(b"-123", False) == "-123"
+        assert d.decode_ascii_number(b"-1", True) is None
+        assert d.decode_ascii_number(b"12,5", True) == "12.5"
+
+
+class TestAddDecimalPoint:
+    @pytest.mark.parametrize("value,scale,sf,expected", [
+        ("123456", 2, 0, "1234.56"),
+        ("12", 4, 0, "0.0012"),
+        ("-12", 4, 0, "-0.0012"),
+        ("-123456", 2, 0, "-1234.56"),
+        ("123", 0, 0, "123"),
+        ("123", 0, 2, "12300"),
+        ("123", 0, -2, "0.00123"),
+        ("-123", 0, -2, "-0.00123"),
+    ])
+    def test_cases(self, value, scale, sf, expected):
+        assert d.add_decimal_point(value, scale, sf) == expected
+
+
+class TestBCD:
+    def test_positive(self):
+        assert d.decode_bcd_integral(bytes([0x12, 0x3C])) == 123
+
+    def test_negative(self):
+        assert d.decode_bcd_integral(bytes([0x12, 0x3D])) == -123
+
+    def test_unsigned(self):
+        assert d.decode_bcd_integral(bytes([0x12, 0x3F])) == 123
+
+    def test_bad_sign_nibble(self):
+        assert d.decode_bcd_integral(bytes([0x12, 0x3A])) is None
+
+    def test_bad_digit_nibble(self):
+        assert d.decode_bcd_integral(bytes([0x1B, 0x3C])) is None
+
+    def test_empty(self):
+        assert d.decode_bcd_integral(b"") is None
+
+    def test_scaled_string(self):
+        assert d.decode_bcd_string(bytes([0x12, 0x34, 0x5C]), 2, 0) == "123.45"
+
+    def test_scale_bigger_than_digits(self):
+        assert d.decode_bcd_string(bytes([0x1C]), 2, 0) == "0.01"
+
+    def test_negative_scaled(self):
+        assert d.decode_bcd_string(bytes([0x12, 0x34, 0x5D]), 2, 0) == "-123.45"
+
+    def test_scale_factor_positive(self):
+        assert d.decode_bcd_string(bytes([0x12, 0x3C]), 0, 2) == "12300"
+
+    def test_scale_factor_negative(self):
+        assert d.decode_bcd_string(bytes([0x12, 0x3C]), 0, -2) == "0.00123"
+
+    def test_decimal_value(self):
+        assert d.decode_bcd_decimal(bytes([0x12, 0x34, 0x5C]), 2, 0) == D("123.45")
+
+
+class TestBinary:
+    def test_signed_short_be(self):
+        assert d.decode_binary_int(bytes([0xFF, 0xFE]), True, True, 2) == -2
+
+    def test_signed_short_le(self):
+        assert d.decode_binary_int(bytes([0xFE, 0xFF]), False, True, 2) == -2
+
+    def test_unsigned_int_overflow_null(self):
+        assert d.decode_binary_int(bytes([0x80, 0, 0, 0]), True, False, 4) is None
+
+    def test_unsigned_long_overflow_null(self):
+        assert d.decode_binary_int(bytes([0x80] + [0] * 7), True, False, 8) is None
+
+    def test_signed_long(self):
+        assert d.decode_binary_int(bytes([0xFF] * 8), True, True, 8) == -1
+
+    def test_short_data_null(self):
+        assert d.decode_binary_int(b"\x01", True, True, 2) is None
+
+    def test_arbitrary_precision(self):
+        data = bytes([0x01] * 10)
+        v = d.decode_binary_arbitrary(data, True, False)
+        assert v == D(int.from_bytes(data, "big"))
+
+    def test_binary_number_string_scale(self):
+        assert d.decode_binary_number_string(bytes([0x30, 0x39]), True, True, 2) == "123.45"
+
+
+class TestFloats:
+    def test_ieee_single(self):
+        import struct
+        assert d.decode_ieee754_single(struct.pack(">f", 1.5)) == 1.5
+
+    def test_ieee_double_le(self):
+        import struct
+        assert d.decode_ieee754_double(struct.pack("<d", -2.25), False) == -2.25
+
+    def test_ibm_double_100(self):
+        # IBM hex double: 100.0 = 0x42 64000000000000 (exp 66, fract 0x64/16^2)
+        data = bytes([0x42, 0x64, 0, 0, 0, 0, 0, 0])
+        assert d.decode_ibm_double(data) == 100.0
+
+    def test_ibm_double_zero(self):
+        assert d.decode_ibm_double(bytes(8)) == 0.0
+
+    def test_ibm_single_zero_fraction(self):
+        assert d.decode_ibm_single(bytes([0x42, 0, 0, 0])) == 0.0
+
+    def test_short_returns_null(self):
+        assert d.decode_ieee754_single(b"\x01") is None
+        assert d.decode_ibm_double(b"\x01") is None

@@ -1,0 +1,31 @@
+"""Shared helpers for golden-parity tests."""
+import glob
+import os
+
+REFERENCE_DATA = "/root/reference/data"
+
+
+def read_copybook(name: str) -> str:
+    with open(os.path.join(REFERENCE_DATA, name), encoding="utf-8") as f:
+        return f.read()
+
+
+def read_binary(name: str) -> bytes:
+    """Read a data file; reference data entries may be directories of .bin files."""
+    path = os.path.join(REFERENCE_DATA, name)
+    if os.path.isdir(path):
+        chunks = []
+        for f in sorted(glob.glob(os.path.join(path, "*"))):
+            base = os.path.basename(f)
+            if base.startswith((".", "_")):
+                continue
+            with open(f, "rb") as fh:
+                chunks.append(fh.read())
+        return b"".join(chunks)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def read_golden_lines(name: str):
+    with open(os.path.join(REFERENCE_DATA, name), encoding="iso-8859-1") as f:
+        return f.read().splitlines()
