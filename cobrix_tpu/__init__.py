@@ -6,6 +6,7 @@ A from-scratch JAX/XLA/Pallas re-design of the capabilities of Cobrix
 columnar data — with the per-record decode loop replaced by batched TPU
 byte-transcoding kernels over `[batch, record_len]` uint8 arrays.
 """
+from .api import CobolData, read_cobol
 from .copybook.copybook import Copybook, merge_copybooks, parse_copybook
 from .copybook.datatypes import (
     CommentPolicy,
@@ -20,6 +21,8 @@ from .copybook.datatypes import (
 __version__ = "0.1.0"
 
 __all__ = [
+    "CobolData",
+    "read_cobol",
     "Copybook",
     "parse_copybook",
     "merge_copybooks",

@@ -1,0 +1,427 @@
+"""User-facing API: `read_cobol(path, copybook=..., **options)`.
+
+The equivalent of the reference's Spark DataSource surface
+(`spark.read.format("cobol").option(...).load(path)` — DefaultSource.scala:50,
+CobolRelation.scala:85, CobolParametersParser.scala:191): the same ~45
+string-keyed options, the same pedantic/unused-key auditing and option
+incompatibility matrices, a deterministic multi-file ordering with per-file
+Record_Id bases, and output as columns/rows/pandas/Arrow instead of an RDD.
+"""
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .copybook.copybook import Copybook
+from .copybook.datatypes import (
+    CommentPolicy,
+    DebugFieldsPolicy,
+    FloatingPointFormat,
+    SchemaRetentionPolicy,
+    TrimPolicy,
+)
+from .reader.fixed_len_reader import FixedLenReader
+from .reader.json_out import rows_to_json
+from .reader.parameters import (
+    DEFAULT_FILE_RECORD_ID_INCREMENT,
+    MultisegmentParameters,
+    ReaderParameters,
+)
+from .reader.schema import CobolOutputSchema, StructType
+from .reader.stream import FSStream
+from .reader.var_len_reader import VarLenReader, default_segment_id_prefix
+
+
+class Options:
+    """Option map wrapper tracking key usage for pedantic-mode auditing
+    (reference Parameters.scala:27-98)."""
+
+    def __init__(self, options: Dict[str, object]):
+        self._map = {str(k): str(v) for k, v in options.items()}
+        self._used = set()
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        if key in self._map:
+            self._used.add(key)
+            return self._map[key]
+        return default
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._map
+
+    def mark_used(self, key: str) -> None:
+        self._used.add(key)
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self.get(key)
+        if v is None:
+            return default
+        return v.strip().lower() in ("true", "1", "yes")
+
+    def get_int(self, key: str, default: Optional[int] = None) -> Optional[int]:
+        v = self.get(key)
+        return default if v is None else int(v)
+
+    def keys(self):
+        return self._map.keys()
+
+    def unused_keys(self) -> List[str]:
+        return [k for k in self._map if k not in self._used]
+
+
+_ENUM_PARSERS = {
+    "schema_retention_policy": {
+        "keep_original": SchemaRetentionPolicy.KEEP_ORIGINAL,
+        "collapse_root": SchemaRetentionPolicy.COLLAPSE_ROOT,
+    },
+    "string_trimming_policy": {
+        "none": TrimPolicy.NONE, "left": TrimPolicy.LEFT,
+        "right": TrimPolicy.RIGHT, "both": TrimPolicy.BOTH,
+    },
+    "floating_point_format": {
+        "ibm": FloatingPointFormat.IBM,
+        "ibm_little_endian": FloatingPointFormat.IBM_LE,
+        "ieee754": FloatingPointFormat.IEEE754,
+        "ieee754_little_endian": FloatingPointFormat.IEEE754_LE,
+    },
+    "debug": {
+        "false": DebugFieldsPolicy.NONE, "none": DebugFieldsPolicy.NONE,
+        "true": DebugFieldsPolicy.HEX, "hex": DebugFieldsPolicy.HEX,
+        "raw": DebugFieldsPolicy.RAW,
+    },
+}
+
+
+def _parse_enum(opts: Options, key: str, default: str):
+    value = opts.get(key, default)
+    table = _ENUM_PARSERS[key]
+    parsed = table.get(value.strip().lower())
+    if parsed is None:
+        raise ValueError(f"Invalid value '{value}' for '{key}' option.")
+    return parsed
+
+
+def _parse_segment_levels(opts: Options) -> List[str]:
+    levels = []
+    i = 0
+    while True:
+        name = f"segment_id_level{i}"
+        if name in opts:
+            levels.append(opts.get(name))
+        elif i == 0 and "segment_id_root" in opts:
+            levels.append(opts.get("segment_id_root"))
+        else:
+            return levels
+        i += 1
+
+
+def _parse_prefixed_map(opts: Options,
+                        prefixes: Tuple[str, ...]) -> Dict[str, str]:
+    """Parse 'redefine-segment-id-map:N' / 'segment-children:N' options
+    ('FIELD => A,B') into {item: field} (segment-id -> redefine name, or
+    child -> parent respectively)."""
+    from .copybook.ast import transform_identifier
+    out: Dict[str, str] = {}
+    for key in list(opts.keys()):
+        k = key.lower()
+        if any(k.startswith(p) for p in prefixes):
+            opts.mark_used(key)
+            value = opts.get(key)
+            parts = value.split("=>")
+            if len(parts) != 2:
+                raise ValueError(
+                    f"Illegal argument for the '{prefixes[0]}' option: '{value}'.")
+            field = transform_identifier(parts[0].strip())
+            for item in (transform_identifier(s.strip())
+                         for s in parts[1].split(",")):
+                out[item] = field
+    return out
+
+
+def parse_options(options: Dict[str, object]) -> Tuple[ReaderParameters, Options]:
+    """String options -> typed ReaderParameters
+    (reference CobolParametersParser.parse, :191)."""
+    opts = Options(options)
+
+    encoding = (opts.get("encoding", "") or "").strip().lower()
+    if encoding not in ("", "ebcdic", "ascii"):
+        raise ValueError(f"Invalid value '{encoding}' for 'encoding' option. "
+                         "Should be either 'EBCDIC' or 'ASCII'.")
+    is_ebcdic = encoding in ("", "ebcdic")
+
+    comment_policy = CommentPolicy(
+        truncate_comments=opts.get_bool("truncate_comments", True),
+        comments_up_to_char=opts.get_int("comments_lbound", 6),
+        comments_after_char=opts.get_int("comments_ubound", 72))
+    if not comment_policy.truncate_comments and (
+            "comments_lbound" in options or "comments_ubound" in options):
+        raise ValueError(
+            "When 'truncate_comments=false' the following parameters cannot be "
+            "used: 'comments_lbound', 'comments_ubound'.")
+
+    is_record_sequence = (opts.get_bool("is_xcom") or
+                          opts.get_bool("is_record_sequence"))
+    if "record_length_field" in opts and (
+            "is_record_sequence" in opts or "is_xcom" in opts):
+        raise ValueError("Option 'record_length_field' cannot be used together "
+                         "with 'is_record_sequence' or 'is_xcom'.")
+
+    multisegment = None
+    if "segment_field" in opts:
+        filter_str = opts.get("segment_filter")
+        multisegment = MultisegmentParameters(
+            segment_id_field=opts.get("segment_field"),
+            segment_id_filter=filter_str.split(",") if filter_str else None,
+            segment_level_ids=_parse_segment_levels(opts),
+            segment_id_prefix=opts.get("segment_id_prefix", ""),
+            segment_id_redefine_map=_parse_prefixed_map(
+                opts, ("redefine-segment-id-map", "redefine_segment_id_map")),
+            field_parent_map=_parse_prefixed_map(
+                opts, ("segment-children", "segment_children")))
+
+    occurs_mappings = {}
+    if "occurs_mappings" in opts:
+        occurs_mappings = {
+            k: {sk: int(sv) for sk, sv in v.items()}
+            for k, v in json.loads(opts.get("occurs_mappings")).items()}
+
+    non_terminals = tuple(
+        s for s in (opts.get("non_terminals", "") or "").split(",") if s)
+
+    params = ReaderParameters(
+        is_ebcdic=is_ebcdic,
+        is_text=opts.get_bool("is_text"),
+        ebcdic_code_page=opts.get("ebcdic_code_page_class")
+        or opts.get("ebcdic_code_page", "common"),
+        ascii_charset=opts.get("ascii_charset", "") or "us-ascii",
+        is_utf16_big_endian=opts.get_bool("is_utf16_big_endian", True),
+        floating_point_format=_parse_enum(opts, "floating_point_format", "ibm"),
+        variable_size_occurs=opts.get_bool("variable_size_occurs"),
+        record_length_override=opts.get_int("record_length"),
+        length_field_name=opts.get("record_length_field"),
+        is_record_sequence=is_record_sequence,
+        is_rdw_big_endian=opts.get_bool("is_rdw_big_endian"),
+        is_rdw_part_of_record_length=opts.get_bool("is_rdw_part_of_record_length"),
+        rdw_adjustment=opts.get_int("rdw_adjustment", 0),
+        is_index_generation_needed=opts.get_bool("enable_indexes", True),
+        input_split_records=opts.get_int("input_split_records"),
+        input_split_size_mb=opts.get_int("input_split_size_mb"),
+        start_offset=opts.get_int("record_start_offset", 0),
+        end_offset=opts.get_int("record_end_offset", 0),
+        file_start_offset=opts.get_int("file_start_offset", 0),
+        file_end_offset=opts.get_int("file_end_offset", 0),
+        generate_record_id=opts.get_bool("generate_record_id"),
+        schema_policy=_parse_enum(opts, "schema_retention_policy", "keep_original"),
+        string_trimming_policy=_parse_enum(opts, "string_trimming_policy", "both"),
+        multisegment=multisegment,
+        comment_policy=comment_policy,
+        drop_group_fillers=opts.get_bool("drop_group_fillers"),
+        drop_value_fillers=opts.get_bool("drop_value_fillers", True),
+        non_terminals=non_terminals,
+        occurs_mappings=occurs_mappings,
+        debug_fields_policy=_parse_enum(opts, "debug", "false"),
+        record_header_parser=opts.get("record_header_parser"),
+        record_extractor=opts.get("record_extractor"),
+        rhp_additional_info=opts.get("rhp_additional_info"),
+        re_additional_info=opts.get("re_additional_info", ""),
+        input_file_name_column=opts.get("with_input_file_name_col", ""),
+    )
+    _validate_options(opts, params)
+    return params, opts
+
+
+def _validate_options(opts: Options, params: ReaderParameters) -> None:
+    """Option incompatibility matrices + pedantic unused-key audit
+    (reference validateSparkCobolOptions, :473-610)."""
+    rdw_ish = ["is_text", "record_length", "is_record_sequence", "is_xcom",
+               "is_rdw_big_endian", "is_rdw_part_of_record_length",
+               "rdw_adjustment", "record_length_field",
+               "record_header_parser", "rhp_additional_info"]
+    if "record_extractor" in opts:
+        bad = [k for k in rdw_ish if k in opts]
+        if bad:
+            raise ValueError(
+                f"Option 'record_extractor' and {', '.join(bad)} cannot be "
+                "used together.")
+    if "record_length" in opts:
+        bad = [k for k in rdw_ish[2:] if k in opts] \
+            + (["is_text"] if "is_text" in opts else [])
+        if bad:
+            raise ValueError(
+                f"Option 'record_length' and {', '.join(bad)} cannot be "
+                "used together.")
+    seg = params.multisegment
+    if seg and seg.field_parent_map and seg.segment_level_ids:
+        raise ValueError(
+            "Options 'segment_id_level*'/'segment_id_root' and "
+            "'segment-children:*' cannot be used together.")
+    if seg and seg.field_parent_map and not seg.segment_id_redefine_map:
+        raise ValueError(
+            "Option 'segment-children:*' requires 'redefine-segment-id-map:*' "
+            "to be set as well.")
+    pedantic = opts.get_bool("pedantic")  # marks the key used
+    unused = opts.unused_keys()
+    if unused and pedantic:
+        raise ValueError("Redundant or unrecognized option(s) to 'spark-cobol': "
+                         + ", ".join(sorted(unused)) + ".")
+
+
+def list_input_files(path) -> List[str]:
+    """Recursive globbed listing skipping hidden files, stable order
+    (reference FileUtils.scala:54-228, getListFilesWithOrder)."""
+    paths = [path] if isinstance(path, str) else list(path)
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if not d.startswith((".", "_")))
+                for f in sorted(files):
+                    if not f.startswith((".", "_")):
+                        out.append(os.path.join(root, f))
+        elif os.path.isfile(p):
+            out.append(p)
+        else:
+            matched = sorted(_glob.glob(p))
+            if not matched:
+                raise FileNotFoundError(f"Input path does not exist: {p}")
+            for m in matched:
+                out.extend(list_input_files(m))
+    return out
+
+
+class CobolData:
+    """Decoded result: rows + schema, materializable as JSON lines, pandas,
+    or Arrow."""
+
+    def __init__(self, rows: List[List[object]], schema: CobolOutputSchema):
+        self._rows = rows
+        self.output_schema = schema
+
+    @property
+    def schema(self) -> StructType:
+        return self.output_schema.schema
+
+    def __len__(self) -> int:
+        return len(self._rows)
+
+    def to_rows(self) -> List[List[object]]:
+        return self._rows
+
+    def to_dicts(self) -> List[dict]:
+        names = self.schema.field_names()
+        return [dict(zip(names, row)) for row in self._rows]
+
+    def to_json_lines(self) -> List[str]:
+        return rows_to_json(self._rows, self.schema)
+
+    def to_pandas(self):
+        import pandas as pd
+        return pd.DataFrame(self.to_dicts())
+
+    def to_arrow(self):
+        import pyarrow as pa
+        names = self.schema.field_names()
+        columns = list(zip(*self._rows)) if self._rows else [[] for _ in names]
+        return pa.table({n: list(c) for n, c in zip(names, columns)})
+
+
+def read_cobol(path=None,
+               copybook: Optional[str] = None,
+               copybook_contents=None,
+               backend: str = "numpy",
+               **options) -> CobolData:
+    """Read mainframe file(s) into decoded rows.
+
+    `copybook` is a path (or list of paths) to copybook file(s);
+    `copybook_contents` passes the text directly. Remaining keyword options
+    use the reference's option names (README.md:1070-1155).
+    """
+    if "copybook" in options and copybook is None:
+        copybook = options.pop("copybook")
+    if "copybook_contents" in options and copybook_contents is None:
+        copybook_contents = options.pop("copybook_contents")
+    if "copybooks" in options and copybook is None:
+        copybook = options.pop("copybooks").split(",")
+
+    if copybook_contents is None:
+        if copybook is None:
+            raise ValueError(
+                "COPYBOOK is not provided. Please, provide either 'copybook' "
+                "path or 'copybook_contents'.")
+        books = [copybook] if isinstance(copybook, str) else list(copybook)
+        contents = []
+        for b in books:
+            with open(b, encoding="utf-8") as f:
+                contents.append(f.read())
+        copybook_contents = contents if len(contents) > 1 else contents[0]
+    if path is None:
+        raise ValueError("'path' must be specified for read_cobol.")
+
+    params, opts = parse_options(options)
+    debug_ignore_file_size = opts.get_bool("debug_ignore_file_size")
+    files = list_input_files(path)
+    if not files:
+        raise FileNotFoundError(f"No input files found for path {path}")
+
+    is_var_len = (params.is_record_sequence or params.is_text
+                  or params.length_field_name or params.record_extractor
+                  or params.variable_size_occurs or params.file_start_offset > 0
+                  or params.file_end_offset > 0)
+
+    # Seg_Id columns exist only on the variable-length path (the reference
+    # fixed-length reader never generates them)
+    seg_count = (len(params.multisegment.segment_level_ids)
+                 if params.multisegment and is_var_len else 0)
+    rows: List[List[object]] = []
+    copybook_obj: Optional[Copybook] = None
+
+    if is_var_len:
+        reader = VarLenReader(copybook_contents, params)
+        copybook_obj = reader.copybook
+        prefix = (params.multisegment.segment_id_prefix
+                  if params.multisegment and params.multisegment.segment_id_prefix
+                  else default_segment_id_prefix())
+        for file_order, file_path in enumerate(files):
+            with FSStream(file_path) as stream:
+                if backend == "host":
+                    file_rows = list(reader.iter_rows(
+                        stream, file_id=file_order, segment_id_prefix=prefix,
+                        start_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT))
+                else:
+                    file_rows = reader.read_rows_columnar(
+                        stream, file_id=file_order, backend=backend,
+                        segment_id_prefix=prefix,
+                        start_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT)
+            rows.extend(file_rows)
+    else:
+        reader = FixedLenReader(copybook_contents, params)
+        copybook_obj = reader.copybook
+        for file_order, file_path in enumerate(files):
+            with open(file_path, "rb") as f:
+                data = f.read()
+            if backend == "host":
+                file_rows = list(reader.iter_rows_host(
+                    data, file_id=file_order,
+                    first_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT,
+                    input_file_name=file_path,
+                    ignore_file_size=debug_ignore_file_size))
+            else:
+                file_rows = reader.read_rows(
+                    data, backend=backend, file_id=file_order,
+                    first_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT,
+                    input_file_name=file_path,
+                    ignore_file_size=debug_ignore_file_size)
+            rows.extend(file_rows)
+
+    schema = CobolOutputSchema(
+        copybook_obj,
+        policy=params.schema_policy,
+        input_file_name_field=params.input_file_name_column,
+        generate_record_id=params.generate_record_id,
+        generate_seg_id_field_count=seg_count,
+        segment_id_prefix="")
+    return CobolData(rows, schema)

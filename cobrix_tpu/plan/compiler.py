@@ -1,0 +1,286 @@
+"""Columnar field-plan compiler: copybook AST -> flat decode plan.
+
+This is the central TPU-first redesign. The reference binds a per-field JVM
+closure at parse time and walks the AST per record
+(RecordExtractors.scala:49, DecoderSelector.scala:54). Here the AST is
+compiled ONCE into a flat list of column specs — (byte offset, width, codec,
+params) per primitive leaf, with every OCCURS element expanded to its own
+static slot — and specs are grouped by (codec, width) so one batched kernel
+launch decodes the same-shaped columns of ALL records at once from a
+`[batch, record_len]` uint8 matrix.
+
+Variable layouts are handled statically where possible:
+- OCCURS (fixed): expanded slots, all offsets static.
+- OCCURS DEPENDING ON with the default fixed-size layout
+  (`variable_size_occurs=false`): slots are static; per-record element
+  visibility is a post-decode gate on the dependee column.
+- REDEFINES: multiple columns over the same offsets (decode is read-only).
+- Segment redefines: columns are tagged with their segment group; row
+  materialization nulls inactive segments.
+- variable_size_occurs=true layouts are record-dependent; those fall back to
+  the host extractor (reader.extractors), like >18-digit arbitrary-precision
+  corner cases fall back to the scalar oracle.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Tuple
+
+from ..copybook.ast import Group, Primitive, Statement
+from ..copybook.copybook import Copybook
+from ..copybook.datatypes import (
+    AlphaNumeric,
+    Decimal,
+    Encoding,
+    FloatingPointFormat,
+    Integral,
+    MAX_LONG_PRECISION,
+    TrimPolicy,
+    Usage,
+)
+
+
+class Codec(enum.Enum):
+    """Kernel family a column decodes with (mirrors the ★ decoder components
+    of SURVEY.md §2.1)."""
+
+    EBCDIC_STRING = "ebcdic_string"      # LUT transcode
+    ASCII_STRING = "ascii_string"        # mask controls/high bytes
+    UTF16_STRING = "utf16_string"
+    HEX_STRING = "hex_string"
+    RAW_BYTES = "raw"
+    DISPLAY_NUM = "display_num"          # zoned decimal (EBCDIC overpunch)
+    DISPLAY_NUM_ASCII = "display_num_ascii"
+    BCD = "bcd"                          # COMP-3 packed decimal
+    BINARY = "binary"                    # COMP/COMP-4/5/9 two's complement
+    FLOAT_IBM = "float_ibm"              # COMP-1 IBM hex float
+    FLOAT_IEEE = "float_ieee"
+    DOUBLE_IBM = "double_ibm"            # COMP-2
+    DOUBLE_IEEE = "double_ieee"
+    HOST_FALLBACK = "host"               # scalar-oracle per value
+
+
+@dataclass(frozen=True)
+class CodecParams:
+    """Per-column decode parameters; hashable so identical (codec, width,
+    params) columns batch into one kernel launch."""
+
+    signed: bool = False
+    big_endian: bool = True
+    scale: int = 0
+    scale_factor: int = 0
+    explicit_decimal: bool = False
+    precision: int = 0
+    is_sign_separate: bool = False
+
+
+@dataclass(frozen=True)
+class Gate:
+    """Visibility gate from OCCURS DEPENDING ON: the element at `elem_index`
+    of the array exists iff elem_index < actual_count, where actual_count is
+    the dependee column's value clamped to [min_size, max_size] (out-of-range
+    values fall back to max_size — reference RecordExtractors.scala:64-80)."""
+
+    depend_col: int
+    min_size: int
+    max_size: int
+    elem_index: int
+
+
+@dataclass
+class ColumnSpec:
+    """One output column: a primitive leaf at one static OCCURS slot."""
+
+    index: int                       # position in the plan's column list
+    path: Tuple[str, ...]            # group names from root to the field
+    name: str
+    offset: int                      # byte offset within the record
+    width: int                       # bytes of one instance
+    codec: Codec
+    params: CodecParams
+    dtype: object                    # the CobolType (for host fallback/schema)
+    slot_path: Tuple[int, ...] = ()  # occurrence indices of enclosing arrays
+    gates: Tuple[Gate, ...] = ()     # ODO visibility gates (outermost first)
+    statement: Optional[Primitive] = None
+    segment: Optional[str] = None    # nearest enclosing segment redefine
+
+
+@dataclass
+class ColumnGroup:
+    """Columns sharing (codec, width) — one batched kernel launch."""
+
+    codec: Codec
+    width: int
+    columns: List[ColumnSpec] = dc_field(default_factory=list)
+
+
+@dataclass
+class FieldPlan:
+    record_size: int
+    columns: List[ColumnSpec]
+    groups: List[ColumnGroup]
+    trimming: TrimPolicy
+    ebcdic_code_page: str
+    ascii_charset: str
+    is_utf16_big_endian: bool
+    floating_point_format: FloatingPointFormat
+
+    def columns_for(self, st: Statement) -> List["ColumnSpec"]:
+        return [c for c in self.columns if c.statement is st]
+
+
+def _classify(dtype, fp_format: FloatingPointFormat) -> Tuple[Codec, CodecParams]:
+    """Map a CobolType to its kernel family (mirrors DecoderSelector dispatch)."""
+    if isinstance(dtype, AlphaNumeric):
+        enc = dtype.enc or Encoding.EBCDIC
+        if enc is Encoding.EBCDIC:
+            return Codec.EBCDIC_STRING, CodecParams()
+        if enc is Encoding.ASCII:
+            return Codec.ASCII_STRING, CodecParams()
+        if enc is Encoding.UTF16:
+            return Codec.UTF16_STRING, CodecParams()
+        if enc is Encoding.HEX:
+            return Codec.HEX_STRING, CodecParams()
+        return Codec.RAW_BYTES, CodecParams()
+
+    is_ebcdic = (dtype.enc or Encoding.EBCDIC) is Encoding.EBCDIC
+    usage = dtype.usage
+    if isinstance(dtype, Decimal):
+        scale, sf, expl = dtype.scale, dtype.scale_factor, dtype.explicit_decimal
+    else:
+        scale, sf, expl = 0, 0, False
+    params = CodecParams(
+        signed=dtype.is_signed,
+        big_endian=usage is not Usage.COMP9,
+        scale=scale,
+        scale_factor=sf,
+        explicit_decimal=expl,
+        precision=dtype.precision,
+        is_sign_separate=dtype.is_sign_separate,
+    )
+    if usage is None:
+        if dtype.precision > MAX_LONG_PRECISION:
+            return Codec.HOST_FALLBACK, params
+        return (Codec.DISPLAY_NUM if is_ebcdic else Codec.DISPLAY_NUM_ASCII), params
+    if usage is Usage.COMP3:
+        if dtype.precision > MAX_LONG_PRECISION:
+            return Codec.HOST_FALLBACK, params
+        return Codec.BCD, params
+    if usage in (Usage.COMP4, Usage.COMP5, Usage.COMP9):
+        if dtype.precision > MAX_LONG_PRECISION:
+            return Codec.HOST_FALLBACK, params
+        return Codec.BINARY, params
+    if usage is Usage.COMP1:
+        if fp_format in (FloatingPointFormat.IBM, FloatingPointFormat.IBM_LE):
+            return Codec.FLOAT_IBM, CodecParams(
+                big_endian=fp_format is FloatingPointFormat.IBM)
+        return Codec.FLOAT_IEEE, CodecParams(
+            big_endian=fp_format is FloatingPointFormat.IEEE754)
+    if usage is Usage.COMP2:
+        if fp_format in (FloatingPointFormat.IBM, FloatingPointFormat.IBM_LE):
+            return Codec.DOUBLE_IBM, CodecParams(
+                big_endian=fp_format is FloatingPointFormat.IBM)
+        return Codec.DOUBLE_IEEE, CodecParams(
+            big_endian=fp_format is FloatingPointFormat.IEEE754)
+    raise ValueError(f"Unknown usage {usage}")
+
+
+def compile_plan(copybook: Copybook,
+                 active_segment: Optional[str] = None) -> FieldPlan:
+    """Flatten the AST into columns. `active_segment`: compile only columns
+    visible when that segment redefine is active (plus common columns);
+    None compiles everything (single-segment / fixed-length files)."""
+    columns: List[ColumnSpec] = []
+    fp_format = copybook.floating_point_format
+    # dependee statement name -> column index of its first compiled slot
+    dependee_cols: Dict[str, int] = {}
+
+    def resolve_gate(st: Statement, elem_index: int) -> Optional[Gate]:
+        if st.depending_on is None:
+            return None
+        col = dependee_cols.get(st.depending_on)
+        if col is None:
+            return None
+        return Gate(depend_col=col, min_size=st.array_min_size,
+                    max_size=st.array_max_size, elem_index=elem_index)
+
+    def add_column(st: Primitive, path: Tuple[str, ...], offset: int,
+                   slot_path: Tuple[int, ...], gates: Tuple[Gate, ...],
+                   segment: Optional[str]) -> None:
+        codec, params = _classify(st.dtype, fp_format)
+        spec = ColumnSpec(
+            index=len(columns),
+            path=path,
+            name=st.name,
+            offset=offset,
+            width=st.binary_properties.data_size,
+            codec=codec,
+            params=params,
+            dtype=st.dtype,
+            slot_path=slot_path,
+            gates=gates,
+            statement=st,
+            segment=segment,
+        )
+        columns.append(spec)
+        if st.is_dependee and st.name not in dependee_cols:
+            dependee_cols[st.name] = spec.index
+
+    def walk_children(group: Group, path: Tuple[str, ...], group_offset: int,
+                      slot_path: Tuple[int, ...], gates: Tuple[Gate, ...],
+                      segment: Optional[str]) -> None:
+        for st in group.children:
+            rel = st.binary_properties.offset - group.binary_properties.offset
+            st_offset = group_offset + rel
+            if isinstance(st, Group):
+                seg = segment
+                if st.is_segment_redefine:
+                    if (active_segment is not None
+                            and st.name.upper() != active_segment.upper()):
+                        continue
+                    seg = st.name
+                if st.is_array:
+                    stride = st.binary_properties.data_size
+                    for k in range(st.array_max_size):
+                        gate = resolve_gate(st, k)
+                        new_gates = gates + ((gate,) if gate else ())
+                        walk_children(st, path + (st.name,),
+                                      st_offset + k * stride,
+                                      slot_path + (k,), new_gates, seg)
+                else:
+                    walk_children(st, path + (st.name,), st_offset,
+                                  slot_path, gates, seg)
+            else:
+                if st.is_array:
+                    stride = st.binary_properties.data_size
+                    for k in range(st.array_max_size):
+                        gate = resolve_gate(st, k)
+                        new_gates = gates + ((gate,) if gate else ())
+                        add_column(st, path, st_offset + k * stride,
+                                   slot_path + (k,), new_gates, segment)
+                else:
+                    add_column(st, path, st_offset, slot_path, gates, segment)
+
+    for root in copybook.ast.children:
+        if isinstance(root, Group):
+            walk_children(root, (root.name,), root.binary_properties.offset,
+                          (), (), None)
+
+    group_map: Dict[Tuple[Codec, int], ColumnGroup] = {}
+    for c in columns:
+        key = (c.codec, c.width)
+        if key not in group_map:
+            group_map[key] = ColumnGroup(codec=c.codec, width=c.width)
+        group_map[key].columns.append(c)
+
+    return FieldPlan(
+        record_size=copybook.record_size,
+        columns=columns,
+        groups=list(group_map.values()),
+        trimming=copybook.string_trimming_policy,
+        ebcdic_code_page=copybook.ebcdic_code_page,
+        ascii_charset=copybook.ascii_charset,
+        is_utf16_big_endian=copybook.is_utf16_big_endian,
+        floating_point_format=copybook.floating_point_format,
+    )

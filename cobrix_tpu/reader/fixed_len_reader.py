@@ -1,0 +1,141 @@
+"""Fixed-length reader.
+
+Mirrors the reference FixedLenNestedReader (reader/FixedLenNestedReader.scala:43-144):
+copybook load/merge, record size validation against the data size, file
+header/footer trimming, record-length override — with decode going through
+either the host extractor (oracle) or the columnar batch path.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from ..copybook.copybook import Copybook, merge_copybooks, parse_copybook
+from .columnar import ColumnarDecoder, DecodedBatch
+from .extractors import DecodeOptions, extract_record
+from .parameters import ReaderParameters
+
+
+class FixedLenReader:
+    def __init__(self, copybook_contents, params: ReaderParameters):
+        if isinstance(copybook_contents, str):
+            contents_list = [copybook_contents]
+        else:
+            contents_list = list(copybook_contents)
+        copybooks = [
+            parse_copybook(
+                c,
+                data_encoding=params.data_encoding,
+                drop_group_fillers=params.drop_group_fillers,
+                drop_value_fillers=params.drop_value_fillers,
+                string_trimming_policy=params.string_trimming_policy,
+                comment_policy=params.comment_policy,
+                ebcdic_code_page=params.ebcdic_code_page,
+                ascii_charset=params.ascii_charset,
+                is_utf16_big_endian=params.is_utf16_big_endian,
+                floating_point_format=params.floating_point_format,
+                non_terminals=params.non_terminals,
+                occurs_mappings=params.occurs_mappings,
+                debug_fields_policy=params.debug_fields_policy,
+            ) for c in contents_list]
+        self.copybook = (copybooks[0] if len(copybooks) == 1
+                         else merge_copybooks(copybooks))
+        self.params = params
+        self._decoder: Optional[ColumnarDecoder] = None
+
+    @property
+    def record_size(self) -> int:
+        if self.params.record_length_override:
+            return self.params.record_length_override
+        return (self.copybook.record_size + self.params.start_offset
+                + self.params.end_offset)
+
+    def check_binary_data_validity(self, data_size: int,
+                                   ignore_file_size: bool = False) -> None:
+        """reference FixedLenNestedReader.checkBinaryDataValidity."""
+        rs = self.record_size
+        if self.params.start_offset < 0:
+            raise ValueError(
+                f"Invalid record start offset = {self.params.start_offset}. "
+                "A record start offset cannot be negative.")
+        if self.params.end_offset < 0:
+            raise ValueError(
+                f"Invalid record end offset = {self.params.end_offset}. "
+                "A record end offset cannot be negative.")
+        if ignore_file_size:
+            return
+        payload = (data_size - self.params.file_start_offset
+                   - self.params.file_end_offset)
+        if payload % rs != 0:
+            raise ValueError(
+                f"Binary record size {rs} does not divide data size {payload}.")
+
+    def to_record_matrix(self, data: bytes,
+                         ignore_file_size: bool = False) -> np.ndarray:
+        """Slice file bytes into a [N, record_size] uint8 matrix."""
+        start = self.params.file_start_offset
+        end = len(data) - self.params.file_end_offset
+        data = data[start:end]
+        rs = self.record_size
+        n = len(data) // rs
+        if ignore_file_size:
+            data = data[: n * rs]
+        arr = np.frombuffer(data, dtype=np.uint8)
+        return arr.reshape(-1, rs)
+
+    def decoder(self, backend: str = "numpy") -> ColumnarDecoder:
+        if self._decoder is None or self._decoder.backend != backend:
+            self._decoder = ColumnarDecoder(self.copybook, backend=backend)
+        return self._decoder
+
+    def decode_batch(self, data: bytes, backend: str = "numpy",
+                     ignore_file_size: bool = False) -> DecodedBatch:
+        self.check_binary_data_validity(len(data), ignore_file_size)
+        matrix = self.to_record_matrix(data, ignore_file_size)
+        start = self.params.start_offset
+        rs_cb = self.copybook.record_size
+        if start or self.params.end_offset or matrix.shape[1] != rs_cb:
+            width = min(rs_cb, matrix.shape[1] - start)
+            trimmed = np.zeros((matrix.shape[0], rs_cb), dtype=np.uint8)
+            trimmed[:, :width] = matrix[:, start: start + width]
+            lengths = np.full(matrix.shape[0], width, dtype=np.int64)
+            return self.decoder(backend).decode(
+                trimmed, lengths=lengths if width < rs_cb else None)
+        return self.decoder(backend).decode(matrix)
+
+    def read_rows(self, data: bytes, backend: str = "numpy", file_id: int = 0,
+                  first_record_id: int = 0,
+                  input_file_name: str = "",
+                  ignore_file_size: bool = False) -> List[List[object]]:
+        batch = self.decode_batch(data, backend, ignore_file_size)
+        return batch.to_rows(
+            policy=self.params.schema_policy,
+            generate_record_id=self.params.generate_record_id,
+            file_id=file_id,
+            first_record_id=first_record_id,
+            generate_input_file_field=bool(self.params.input_file_name_column),
+            input_file_name=input_file_name)
+
+    def iter_rows_host(self, data: bytes, file_id: int = 0,
+                       first_record_id: int = 0,
+                       input_file_name: str = "",
+                       ignore_file_size: bool = False
+                       ) -> Iterator[List[object]]:
+        """Per-record host walk (oracle path)."""
+        self.check_binary_data_validity(len(data), ignore_file_size)
+        matrix = self.to_record_matrix(data, ignore_file_size)
+        options = DecodeOptions.from_copybook(self.copybook)
+        for i in range(matrix.shape[0]):
+            yield extract_record(
+                self.copybook.ast,
+                matrix[i].tobytes(),
+                offset_bytes=self.params.start_offset,
+                policy=self.params.schema_policy,
+                variable_length_occurs=self.params.variable_size_occurs,
+                generate_record_id=self.params.generate_record_id,
+                file_id=file_id,
+                record_id=first_record_id + i,
+                generate_input_file_field=bool(self.params.input_file_name_column),
+                input_file_name=input_file_name,
+                options=options)

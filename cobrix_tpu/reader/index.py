@@ -1,0 +1,125 @@
+"""Sparse index generation — the sequential-to-parallel bridge.
+
+One sequential pass over a variable-length stream produces split points every
+N records / M MB so shards can be decoded in parallel; for hierarchical data
+splits land only at root-segment boundaries, and size-based splitting carries
+the drift so shard boundaries stay aligned with storage blocks. Mirrors the
+reference IndexGenerator.sparseIndexGenerator (reader/index/IndexGenerator.scala:33-127)
+and SparseIndexEntry (reader/index/entry/SparseIndexEntry.scala:19).
+
+In the TPU design the index entries become the unit of host-side data
+parallelism: each entry maps to one byte-range shard a host worker frames
+and ships to the device as a `[batch, max_len]` block (SURVEY.md §2.5).
+"""
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, replace
+from typing import List, Optional
+
+from ..copybook.ast import Primitive
+from ..copybook.copybook import Copybook
+from .header_parsers import RecordHeaderParser
+from .parameters import DEFAULT_INDEX_ENTRY_SIZE_MB, MEGABYTE
+from .raw_extractors import RawRecordExtractor
+from .stream import SimpleStream
+
+
+@dataclass(frozen=True)
+class SparseIndexEntry:
+    offset_from: int
+    offset_to: int      # -1 = to end of file
+    file_id: int
+    record_index: int
+
+
+def sparse_index_generator(file_id: int,
+                           data_stream: SimpleStream,
+                           record_header_parser: Optional[RecordHeaderParser] = None,
+                           record_extractor: Optional[RawRecordExtractor] = None,
+                           records_per_index_entry: Optional[int] = None,
+                           size_per_index_entry_mb: Optional[int] = None,
+                           copybook: Optional[Copybook] = None,
+                           segment_field: Optional[Primitive] = None,
+                           is_hierarchical: bool = False,
+                           root_segment_id: str = "") -> List[SparseIndexEntry]:
+    root_segment_ids = root_segment_id.split(",")
+    byte_index = 0
+    index: List[SparseIndexEntry] = [SparseIndexEntry(0, -1, file_id, 0)]
+    root_record_id = ""
+    records_in_chunk = 0
+    bytes_in_chunk = 0
+    record_index = 0
+    is_really_hierarchical = (copybook is not None and segment_field is not None
+                              and is_hierarchical)
+    is_split_by_size = records_per_index_entry is None
+    if records_per_index_entry is not None:
+        def need_split(records: int, size: int) -> bool:
+            return records >= records_per_index_entry
+    else:
+        bytes_per_entry = (size_per_index_entry_mb
+                           or DEFAULT_INDEX_ENTRY_SIZE_MB) * MEGABYTE
+
+        def need_split(records: int, size: int) -> bool:
+            return size >= bytes_per_entry
+
+    def get_segment_id(record: bytes) -> str:
+        value = copybook.extract_primitive_field(segment_field, record)
+        return "" if value is None else str(value).strip()
+
+    end_of_file = False
+    while not end_of_file:
+        record = None
+        if record_extractor is not None:
+            offset0 = record_extractor.offset
+            if record_extractor.has_next():
+                record = next(record_extractor)
+                is_valid = True
+            else:
+                is_valid = False
+            record_size = record_extractor.offset - offset0
+            has_more = record_extractor.has_next()
+        else:
+            header = data_stream.next(record_header_parser.header_length)
+            meta = record_header_parser.get_record_metadata(
+                header, data_stream.offset, data_stream.size(), record_index)
+            if meta.record_length > 0:
+                record = data_stream.next(meta.record_length)
+            record_size = data_stream.offset - byte_index
+            has_more = record_size > 0
+            is_valid = meta.is_valid
+
+        if data_stream.is_end_of_stream or not has_more:
+            end_of_file = True
+        elif is_valid:
+            if is_really_hierarchical and not root_record_id:
+                cur = get_segment_id(record)
+                if (cur and not root_segment_ids) or cur in root_segment_ids:
+                    root_record_id = cur
+            if need_split(records_in_chunk, bytes_in_chunk):
+                if (not is_really_hierarchical
+                        or get_segment_id(record) in root_segment_ids):
+                    entry = SparseIndexEntry(byte_index, -1, file_id, record_index)
+                    index[-1] = replace(index[-1], offset_to=entry.offset_from)
+                    index.append(entry)
+                    records_in_chunk = 0
+                    if is_split_by_size:
+                        # carry the size-split drift so shard boundaries stay
+                        # aligned with storage blocks
+                        bytes_in_chunk -= (size_per_index_entry_mb
+                                           or DEFAULT_INDEX_ENTRY_SIZE_MB) * MEGABYTE
+                    else:
+                        bytes_in_chunk = 0
+        record_index += 1
+        records_in_chunk += 1
+        byte_index += record_size
+        bytes_in_chunk += record_size
+    if is_really_hierarchical and root_segment_id and not root_record_id:
+        logging.getLogger(__name__).error(
+            "Root segment %s=='%s' not found in the data file.",
+            segment_field.name, root_segment_id)
+    elif is_really_hierarchical and not root_record_id:
+        logging.getLogger(__name__).error(
+            "Root segment %s is empty for every record in the data file.",
+            segment_field.name)
+    return index

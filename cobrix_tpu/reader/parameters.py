@@ -1,0 +1,81 @@
+"""Typed reader configuration.
+
+Mirrors the reference parameter objects (reader/parameters/
+ReaderParameters.scala:50-95, CobolParameters.scala:60-88,
+VariableLengthParameters.scala:45-66, MultisegmentParameters.scala:22-29).
+The string-keyed `.option()` surface lives in cobrix_tpu.api.options.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Dict, List, Optional, Sequence
+
+from ..copybook.datatypes import (
+    CommentPolicy,
+    DebugFieldsPolicy,
+    Encoding,
+    FloatingPointFormat,
+    SchemaRetentionPolicy,
+    TrimPolicy,
+)
+
+DEFAULT_FILE_RECORD_ID_INCREMENT = 2 ** 32      # reference reader Constants.scala:28
+DEFAULT_INDEX_ENTRY_SIZE_MB = 100
+MAX_NUM_PARTITIONS = 2048
+MEGABYTE = 1024 * 1024
+
+
+@dataclass
+class MultisegmentParameters:
+    segment_id_field: str = ""
+    segment_id_filter: Optional[List[str]] = None
+    segment_level_ids: List[str] = dc_field(default_factory=list)
+    segment_id_prefix: str = ""
+    segment_id_redefine_map: Dict[str, str] = dc_field(default_factory=dict)
+    field_parent_map: Dict[str, str] = dc_field(default_factory=dict)
+
+
+@dataclass
+class ReaderParameters:
+    """Flattened reader configuration (the ~45 option surface)."""
+
+    is_ebcdic: bool = True
+    is_text: bool = False
+    ebcdic_code_page: str = "common"
+    ascii_charset: str = "us-ascii"
+    is_utf16_big_endian: bool = True
+    floating_point_format: FloatingPointFormat = FloatingPointFormat.IBM
+    variable_size_occurs: bool = False
+    record_length_override: Optional[int] = None
+    length_field_name: Optional[str] = None
+    is_record_sequence: bool = False
+    is_rdw_big_endian: bool = False
+    is_rdw_part_of_record_length: bool = False
+    rdw_adjustment: int = 0
+    is_index_generation_needed: bool = False
+    input_split_records: Optional[int] = None
+    input_split_size_mb: Optional[int] = None
+    hdfs_default_block_size: Optional[int] = None
+    start_offset: int = 0
+    end_offset: int = 0
+    file_start_offset: int = 0
+    file_end_offset: int = 0
+    generate_record_id: bool = False
+    schema_policy: SchemaRetentionPolicy = SchemaRetentionPolicy.KEEP_ORIGINAL
+    string_trimming_policy: TrimPolicy = TrimPolicy.BOTH
+    multisegment: Optional[MultisegmentParameters] = None
+    comment_policy: CommentPolicy = dc_field(default_factory=CommentPolicy)
+    drop_group_fillers: bool = False
+    drop_value_fillers: bool = True
+    non_terminals: Sequence[str] = ()
+    occurs_mappings: Dict[str, Dict[str, int]] = dc_field(default_factory=dict)
+    debug_fields_policy: DebugFieldsPolicy = DebugFieldsPolicy.NONE
+    record_header_parser: Optional[str] = None
+    record_extractor: Optional[str] = None
+    rhp_additional_info: Optional[str] = None
+    re_additional_info: str = ""
+    input_file_name_column: str = ""
+
+    @property
+    def data_encoding(self) -> Encoding:
+        return Encoding.EBCDIC if self.is_ebcdic else Encoding.ASCII

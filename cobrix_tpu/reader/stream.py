@@ -1,0 +1,112 @@
+"""Byte stream abstraction for record readers.
+
+Mirrors the reference SimpleStream contract (stream/SimpleStream.scala:21:
+size/offset/next(n)/close + inputFileName) with local-file and in-memory
+implementations (FSStream.scala:21, spark FileStreamer byte-range semantics:
+seek to a partition offset and serve at most `maximum_bytes`).
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import Optional
+
+
+class SimpleStream:
+    def size(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def offset(self) -> int:
+        raise NotImplementedError
+
+    @property
+    def input_file_name(self) -> str:
+        return ""
+
+    @property
+    def is_end_of_stream(self) -> bool:
+        return self.offset >= self.size()
+
+    def next(self, n: int) -> bytes:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class MemoryStream(SimpleStream):
+    """In-memory stream (test tier-2 equivalent of TestStringStream)."""
+
+    def __init__(self, data: bytes, file_name: str = "", start_offset: int = 0,
+                 maximum_bytes: int = 0):
+        end = len(data)
+        if maximum_bytes > 0:
+            end = min(end, start_offset + maximum_bytes)
+        self._data = data[start_offset:end]
+        self._base = start_offset
+        self._pos = 0
+        self._file_name = file_name
+
+    def size(self) -> int:
+        return self._base + len(self._data)
+
+    @property
+    def offset(self) -> int:
+        return self._base + self._pos
+
+    @property
+    def input_file_name(self) -> str:
+        return self._file_name
+
+    def next(self, n: int) -> bytes:
+        chunk = self._data[self._pos: self._pos + n]
+        self._pos += len(chunk)
+        return chunk
+
+
+class FSStream(SimpleStream):
+    """Local-file stream with optional byte-range bounding
+    (reference FSStream + FileStreamer maximumBytes semantics: `size()`
+    reports the logical end of the allowed range)."""
+
+    def __init__(self, path: str, start_offset: int = 0, maximum_bytes: int = 0,
+                 buffer_size: int = 8 * 1024 * 1024):
+        self._path = path
+        self._file_size = os.path.getsize(path)
+        self._f = open(path, "rb", buffering=buffer_size)
+        if start_offset:
+            self._f.seek(start_offset)
+        self._pos = start_offset
+        if maximum_bytes > 0:
+            self._limit = min(self._file_size, start_offset + maximum_bytes)
+        else:
+            self._limit = self._file_size
+
+    def size(self) -> int:
+        return self._limit
+
+    @property
+    def offset(self) -> int:
+        return self._pos
+
+    @property
+    def input_file_name(self) -> str:
+        return self._path
+
+    def next(self, n: int) -> bytes:
+        n = min(n, self._limit - self._pos)
+        if n <= 0:
+            return b""
+        chunk = self._f.read(n)
+        self._pos += len(chunk)
+        return chunk
+
+    def close(self) -> None:
+        self._f.close()

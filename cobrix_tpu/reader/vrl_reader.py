@@ -1,0 +1,160 @@
+"""Variable-record-length framing: iterate (segment_id, record_bytes).
+
+Mirrors the reference VRLRecordReader (reader/iterator/VRLRecordReader.scala:39):
+records come from a raw extractor, RDW-style headers, or a record-length
+field decoded mid-stream; tracks byte and record indices for deterministic
+Record_Id generation.
+
+This is the host-side framing pass of the TPU design: it yields record
+boundaries; the columnar reader packs the framed records into padded
+`[batch, max_len]` device blocks (reader/var_len_reader.py).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+from ..copybook.ast import Primitive
+from ..copybook.copybook import Copybook
+from .header_parsers import RecordHeaderParser
+from .parameters import ReaderParameters
+from .raw_extractors import RawRecordExtractor
+from .stream import SimpleStream
+
+
+def resolve_length_field(length_field_name: Optional[str],
+                         copybook: Copybook) -> Optional[Primitive]:
+    """reference ReaderParametersValidator.getLengthField."""
+    if not length_field_name:
+        return None
+    field = copybook.get_field_by_name(length_field_name)
+    if not isinstance(field, Primitive):
+        raise ValueError(
+            f"The record length field '{length_field_name}' must be a primitive.")
+    from ..copybook.datatypes import Integral
+    if not isinstance(field.dtype, Integral) and not field.depending_on_handlers:
+        raise ValueError(
+            f"The record length field '{length_field_name}' must be an integral type.")
+    return field
+
+
+def resolve_segment_id_field(params: ReaderParameters,
+                             copybook: Copybook) -> Optional[Primitive]:
+    """reference ReaderParametersValidator.getSegmentIdField."""
+    if params.multisegment is None or not params.multisegment.segment_id_field:
+        return None
+    field = copybook.get_field_by_name(params.multisegment.segment_id_field)
+    if not isinstance(field, Primitive):
+        raise ValueError(
+            f"The segment id field '{params.multisegment.segment_id_field}' "
+            "must be a primitive.")
+    return field
+
+
+class VRLRecordReader:
+    """Iterator of (segment_id, record_bytes)."""
+
+    def __init__(self,
+                 copybook: Copybook,
+                 data_stream: SimpleStream,
+                 params: ReaderParameters,
+                 record_header_parser: RecordHeaderParser,
+                 record_extractor: Optional[RawRecordExtractor] = None,
+                 start_record_id: int = 0,
+                 starting_file_offset: int = 0):
+        self.copybook = copybook
+        self.stream = data_stream
+        self.params = params
+        self.header_parser = record_header_parser
+        self.record_extractor = record_extractor
+        self._byte_index = starting_file_offset
+        self._record_index = start_record_id - 1
+        self.length_field = resolve_length_field(params.length_field_name, copybook)
+        self.segment_id_field = resolve_segment_id_field(params, copybook)
+        self._cached: Optional[Tuple[str, bytes]] = None
+        self._fetch()
+
+    def __iter__(self) -> Iterator[Tuple[str, bytes]]:
+        return self
+
+    def has_next(self) -> bool:
+        return self._cached is not None
+
+    @property
+    def record_index(self) -> int:
+        return self._record_index
+
+    @property
+    def byte_index(self) -> int:
+        return self._byte_index
+
+    def __next__(self) -> Tuple[str, bytes]:
+        if self._cached is None:
+            raise StopIteration
+        value = self._cached
+        self._fetch()
+        self._record_index += 1
+        return value
+
+    def _fetch(self) -> None:
+        if self.record_extractor is not None:
+            data = (next(self.record_extractor)
+                    if self.record_extractor.has_next() else None)
+        elif self.params.is_record_sequence or self.length_field is None:
+            data = self._fetch_using_headers()
+        else:
+            data = self._fetch_using_length_field()
+        if data is None:
+            self._cached = None
+            return
+        segment_id = ""
+        if self.segment_id_field is not None:
+            value = self.copybook.extract_primitive_field(
+                self.segment_id_field, data, self.params.start_offset)
+            segment_id = "" if value is None else str(value).strip()
+        self._cached = (segment_id, data)
+
+    def _fetch_using_length_field(self) -> Optional[bytes]:
+        lf = self.length_field
+        length_field_block = (lf.binary_properties.offset
+                              + lf.binary_properties.actual_size)
+        head_len = self.params.start_offset + length_field_block
+        start = self.stream.next(head_len)
+        self._byte_index += head_len
+        if len(start) < head_len:
+            return None
+        value = self.copybook.extract_primitive_field(
+            lf, start, self.params.start_offset)
+        if value is None or isinstance(value, (bytes, float)):
+            raise ValueError(
+                f"Record length value of the field {lf.name} must be an "
+                "integral type.")
+        record_length = int(value) + self.params.rdw_adjustment
+        rest = record_length - length_field_block + self.params.end_offset
+        self._byte_index += rest
+        if rest > 0:
+            return start + self.stream.next(rest)
+        return start
+
+    def _fetch_using_headers(self) -> Optional[bytes]:
+        header_block = self.header_parser.header_length
+        is_valid = False
+        end_of_file = False
+        header = b""
+        record = b""
+        while not is_valid and not end_of_file:
+            header = self.stream.next(header_block)
+            meta = self.header_parser.get_record_metadata(
+                header, self.stream.offset, self.stream.size(),
+                self._record_index)
+            self._byte_index += len(header)
+            if meta.record_length > 0:
+                record = self.stream.next(meta.record_length)
+                self._byte_index += len(record)
+            else:
+                end_of_file = True
+            is_valid = meta.is_valid
+        if end_of_file:
+            return None
+        if self.header_parser.is_header_defined_in_copybook:
+            return header + record
+        return record

@@ -1,0 +1,243 @@
+"""EBCDIC test-data generators — the encode side.
+
+Reimplements the behavior of the reference's example data generators
+(examples-collection generators: TestDataGen3Companies for the exp2
+multisegment-narrow profile, TestDataGen4CompaniesWide for the exp3
+multisegment-wide profile, TestDataGen6TypeVariety-style fixed-length
+records for exp1; GeneratorTools ASCII->EBCDIC encode helpers) with
+vectorized numpy so benchmark-sized inputs (GBs) generate quickly.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..encoding.codepages import get_code_page_table
+
+# ASCII -> EBCDIC encode LUT: inverse of the "common" invariant decode table
+# (unmappable characters encode as EBCDIC space 0x40)
+_DECODE = get_code_page_table("common")
+_ENCODE_LUT = np.full(128, 0x40, dtype=np.uint8)
+for _ebcdic in range(255, -1, -1):
+    _ch = _DECODE[_ebcdic]
+    if ord(_ch) < 128:
+        _ENCODE_LUT[ord(_ch)] = _ebcdic
+_ENCODE_LUT[ord(" ")] = 0x40
+
+
+def ebcdic_encode(text: str, length: Optional[int] = None,
+                  pad: int = 0x00) -> bytes:
+    """Encode ASCII text to EBCDIC, padded to `length` with `pad` bytes
+    (the reference generators pad with NULs, GeneratorTools.putStringToArray)."""
+    raw = np.frombuffer(text.encode("ascii", "replace"), dtype=np.uint8)
+    out = _ENCODE_LUT[np.minimum(raw, 127)]
+    if length is not None:
+        padded = np.full(length, pad, dtype=np.uint8)
+        padded[: min(len(out), length)] = out[:length]
+        return padded.tobytes()
+    return out.tobytes()
+
+
+def encode_strings_column(values, width: int, pad: int = 0x00) -> np.ndarray:
+    """[N] of str -> [N, width] EBCDIC uint8."""
+    n = len(values)
+    out = np.full((n, width), pad, dtype=np.uint8)
+    for i, v in enumerate(values):
+        enc = np.frombuffer(v.encode("ascii", "replace")[:width], dtype=np.uint8)
+        out[i, : len(enc)] = _ENCODE_LUT[np.minimum(enc, 127)]
+    return out
+
+
+def encode_display_unsigned(values: np.ndarray, digits: int) -> np.ndarray:
+    """[N] ints -> [N, digits] EBCDIC zoned (0xF0..0xF9)."""
+    n = len(values)
+    out = np.zeros((n, digits), dtype=np.uint8)
+    v = values.astype(np.int64).copy()
+    for pos in range(digits - 1, -1, -1):
+        out[:, pos] = 0xF0 + (v % 10)
+        v //= 10
+    return out
+
+
+def encode_comp3_unsigned(values: np.ndarray, digits: int) -> np.ndarray:
+    """[N] ints -> [N, digits//2+1] packed BCD with 0xF sign nibble."""
+    width = digits // 2 + 1
+    n = len(values)
+    nibble_count = width * 2 - 1
+    nibbles = np.zeros((n, nibble_count), dtype=np.uint8)
+    v = values.astype(np.int64).copy()
+    for pos in range(nibble_count - 1, -1, -1):
+        nibbles[:, pos] = v % 10
+        v //= 10
+    out = np.zeros((n, width), dtype=np.uint8)
+    for b in range(width):
+        high = nibbles[:, b * 2]
+        low = nibbles[:, b * 2 + 1] if b * 2 + 1 < nibble_count \
+            else np.full(n, 0x0F, dtype=np.uint8)
+        out[:, b] = (high << 4) | low
+    out[:, -1] = (nibbles[:, -1] << 4) | 0x0F
+    return out
+
+
+def encode_comp_be(values: np.ndarray, width: int) -> np.ndarray:
+    """[N] ints -> [N, width] big-endian binary."""
+    n = len(values)
+    out = np.zeros((n, width), dtype=np.uint8)
+    v = values.astype(np.int64).copy()
+    for b in range(width - 1, -1, -1):
+        out[:, b] = v & 0xFF
+        v >>= 8
+    return out
+
+
+EXP2_COPYBOOK = """
+        01  COMPANY-DETAILS.
+            05  SEGMENT-ID        PIC X(5).
+            05  COMPANY-ID        PIC X(10).
+            05  STATIC-DETAILS.
+               10  COMPANY-NAME      PIC X(15).
+               10  ADDRESS           PIC X(25).
+               10  TAXPAYER.
+                  15  TAXPAYER-TYPE  PIC X(1).
+                  15  TAXPAYER-STR   PIC X(8).
+                  15  TAXPAYER-NUM  REDEFINES TAXPAYER-STR
+                                     PIC 9(8) COMP.
+            05  CONTACTS REDEFINES STATIC-DETAILS.
+               10  PHONE-NUMBER      PIC X(17).
+               10  CONTACT-PERSON    PIC X(28).
+"""
+
+EXP3_COPYBOOK = """
+        01  COMPANY-DETAILS.
+            05  SEGMENT-ID        PIC X(5).
+            05  COMPANY-ID        PIC X(10).
+            05  STATIC-DETAILS.
+               10  COMPANY-NAME      PIC X(15).
+               10  ADDRESS           PIC X(25).
+               10  TAXPAYER.
+                  15  TAXPAYER-TYPE  PIC X(1).
+                  15  TAXPAYER-STR   PIC X(8).
+                  15  TAXPAYER-NUM  REDEFINES TAXPAYER-STR
+                                     PIC 9(8) COMP.
+               10  STRATEGY.
+                 15  STRATEGY-DETAIL OCCURS 2000.
+                   25  NUM1 PIC 9(7) COMP.
+                   25  NUM2 PIC 9(7) COMP-3.
+            05  CONTACTS REDEFINES STATIC-DETAILS.
+               10  PHONE-NUMBER      PIC X(17).
+               10  CONTACT-PERSON    PIC X(28).
+"""
+
+EXP1_COPYBOOK = """
+        01  RECORD.
+            05  ACCOUNT-ID        PIC X(16).
+            05  CUSTOMER-NAME     PIC X(30).
+            05  BALANCE-A         PIC S9(9)V99 COMP-3.
+            05  BALANCE-B         PIC 9(12)V99.
+            05  FLAGS             PIC 9(4)  COMP.
+            05  COUNTERS OCCURS 20.
+               10  CNT-A          PIC 9(7)  COMP.
+               10  CNT-B          PIC 9(5)  COMP-3.
+               10  CNT-TAG        PIC X(3).
+            05  NOTES             PIC X(40).
+"""
+
+_COMPANIES = ["ABCD Ltd.", "ECRONO GmbH", "ZjkLPj Ltd.", "Eqartion Inc.",
+              "Test Bank", "Pear GMBH.", "Beiereqweq.", "Joan Q & Z",
+              "Robotrd Inc.", "Xingzhoug", "MapMot Inc.", "Dobry Pivivar",
+              "Xingzhoug", "Hadlway Hotels"]
+_FIRST = ["Jene", "Maya", "Starr", "Lynell", "Eliana", "Tyesha", "Beatrice",
+          "Otelia", "Timika", "Wilbert", "Mindy", "Sunday"]
+_LAST = ["Corle", "Mackinnon", "Mork", "Shapiro", "Boettcher", "Flatt",
+         "Acuna", "Thorpe", "Riojas", "Lepe", "Maccarthy", "Filipski"]
+
+
+def _rdw(length: int, big_endian: bool = False) -> bytes:
+    if big_endian:
+        return bytes([length >> 8, length & 0xFF, 0, 0])
+    return bytes([0, 0, length & 0xFF, length >> 8])
+
+
+def generate_exp2(num_records: int, seed: int = 100,
+                  big_endian_rdw: bool = False) -> bytes:
+    """RDW multisegment narrow profile (68/64-byte records, 'C'/'P' segments)."""
+    return _generate_companies(num_records, seed, big_endian_rdw,
+                               wide_detail_count=0)
+
+
+def generate_exp3(num_records: int, seed: int = 100,
+                  big_endian_rdw: bool = False) -> bytes:
+    """RDW multisegment wide profile: segment 'C' records carry 2000
+    (COMP + COMP-3) strategy elements (16068-byte records)."""
+    return _generate_companies(num_records, seed, big_endian_rdw,
+                               wide_detail_count=2000)
+
+
+def _generate_companies(num_records: int, seed: int, big_endian_rdw: bool,
+                        wide_detail_count: int) -> bytes:
+    rng = np.random.default_rng(seed)
+    chunks = []
+    i = 0
+    while i < num_records:
+        company = _COMPANIES[rng.integers(0, len(_COMPANIES))]
+        company_id = f"{rng.integers(10000, 99999)}{rng.integers(10000, 99999)}"
+        payload = bytearray()
+        payload += ebcdic_encode("C", 5)
+        payload += ebcdic_encode(company_id, 10)
+        payload += ebcdic_encode(company, 15)
+        payload += ebcdic_encode(f"{rng.integers(1, 500)} Main Street", 25)
+        taxpayer = int(rng.integers(10000000, 99999999))
+        if rng.integers(0, 2) == 1:
+            payload += ebcdic_encode("A", 1)
+            payload += ebcdic_encode(str(taxpayer), 8)
+        else:
+            payload += ebcdic_encode("N", 1)
+            payload += taxpayer.to_bytes(4, "big") + b"\x00\x00\x00\x00"
+        if wide_detail_count:
+            nums = rng.integers(0, 9999999, size=wide_detail_count)
+            comp = encode_comp_be(nums, 4)
+            comp3 = encode_comp3_unsigned(nums, 7)
+            detail = np.concatenate([comp, comp3], axis=1)
+            payload += detail.tobytes()
+        chunks.append(_rdw(len(payload), big_endian_rdw) + bytes(payload))
+        i += 1
+        n_contacts = int(rng.integers(0, 5))
+        for _ in range(n_contacts):
+            if i >= num_records:
+                break
+            contact = bytearray()
+            contact += ebcdic_encode("P", 5)
+            contact += ebcdic_encode(company_id, 10)
+            phone = (f"+({rng.integers(1, 921)}) {rng.integers(100, 999)} "
+                     f"{rng.integers(10, 99)} {rng.integers(10, 99)}")
+            contact += ebcdic_encode(phone, 17)
+            person = (_FIRST[rng.integers(0, len(_FIRST))] + " "
+                      + _LAST[rng.integers(0, len(_LAST))])
+            contact += ebcdic_encode(person, 28)
+            chunks.append(_rdw(len(contact), big_endian_rdw) + bytes(contact))
+            i += 1
+    return b"".join(chunks)
+
+
+def generate_exp1(num_records: int, seed: int = 100) -> np.ndarray:
+    """Fixed-length type-variety profile -> [N, record_size] uint8
+    (vectorized; suitable for generating benchmark-sized batches)."""
+    rng = np.random.default_rng(seed)
+    n = num_records
+    parts = []
+    parts.append(encode_strings_column(
+        [f"ACC{rng.integers(10**9):013d}" for _ in range(n)], 16, pad=0x40))
+    parts.append(encode_strings_column(
+        [f"{_FIRST[rng.integers(0, len(_FIRST))]} {_LAST[rng.integers(0, len(_LAST))]}"
+         for _ in range(n)], 30, pad=0x40))
+    parts.append(encode_comp3_unsigned(rng.integers(0, 10 ** 11, size=n), 11))
+    parts.append(encode_display_unsigned(rng.integers(0, 10 ** 14, size=n), 14))
+    parts.append(encode_comp_be(rng.integers(0, 9999, size=n), 2))
+    for _ in range(20):
+        parts.append(encode_comp_be(rng.integers(0, 9999999, size=n), 4))
+        parts.append(encode_comp3_unsigned(rng.integers(0, 99999, size=n), 5))
+        parts.append(encode_strings_column(
+            ["T%02d" % rng.integers(0, 99)] * n, 3, pad=0x40))
+    parts.append(np.full((n, 40), 0x40, dtype=np.uint8))
+    return np.concatenate(parts, axis=1)

@@ -1,15 +1,45 @@
 import os
+import subprocess
 import sys
 
-# Virtual 8-device CPU mesh for sharding tests; must be set before jax import.
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
+# Ask for a virtual 8-device CPU mesh for sharding tests. NOTE: in the axon
+# environment JAX_PLATFORMS is force-set to "axon" and the site hook
+# initializes the TPU client regardless, so this is best-effort.
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REFERENCE_DATA = "/root/reference/data"
 
+_jax_usable = None
 
-def reference_data_available() -> bool:
-    return os.path.isdir(REFERENCE_DATA)
+
+def jax_usable() -> bool:
+    """True if jax backend init completes promptly (probed in a subprocess —
+    a wedged TPU tunnel would otherwise hang the whole test process)."""
+    global _jax_usable
+    if _jax_usable is None:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=45, capture_output=True)
+            _jax_usable = proc.returncode == 0
+        except subprocess.TimeoutExpired:
+            _jax_usable = False
+    return _jax_usable
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+    if jax_usable():
+        return
+    skip = pytest.mark.skip(
+        reason="jax backend init timed out (TPU tunnel unavailable)")
+    for item in items:
+        if "jax" in item.name or item.get_closest_marker("jax"):
+            item.add_marker(skip)
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "jax: test requires a usable jax backend")

@@ -1,0 +1,90 @@
+"""User-facing API tests: read_cobol with the reference option names,
+option validation, pedantic mode, pandas/Arrow materialization."""
+import os
+
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.api import list_input_files, parse_options
+
+from util import REFERENCE_DATA, read_golden_lines
+
+
+def test_read_cobol_fixed_length_golden():
+    data = read_cobol(
+        os.path.join(REFERENCE_DATA, "test1_data"),
+        copybook=os.path.join(REFERENCE_DATA, "test1_copybook.cob"),
+        schema_retention_policy="collapse_root")
+    assert data.to_json_lines() == read_golden_lines("test1_expected/test1.txt")
+
+
+def test_read_cobol_multisegment_golden():
+    data = read_cobol(
+        os.path.join(REFERENCE_DATA, "test4_data"),
+        copybook=os.path.join(REFERENCE_DATA, "test4_copybook.cob"),
+        encoding="ascii",
+        is_record_sequence="true",
+        segment_field="SEGMENT_ID",
+        segment_id_level0="C",
+        segment_id_level1="P",
+        generate_record_id="true",
+        schema_retention_policy="collapse_root",
+        segment_id_prefix="A")
+    expected = read_golden_lines("test4_expected/test4.txt")
+    assert data.to_json_lines()[: len(expected)] == expected
+
+
+def test_read_cobol_to_pandas():
+    data = read_cobol(
+        os.path.join(REFERENCE_DATA, "test19_display_num"),
+        copybook=os.path.join(REFERENCE_DATA, "test19_display_num.cob"),
+        schema_retention_policy="collapse_root")
+    df = data.to_pandas()
+    assert len(df) == len(data)
+    assert "WS_DATE_NUM" in df.columns
+
+
+def test_pedantic_unknown_option():
+    with pytest.raises(ValueError, match="Redundant or unrecognized"):
+        parse_options({"pedantic": "true", "dummy": "unknown"})
+
+
+def test_unknown_option_tolerated_without_pedantic():
+    parse_options({"dummy": "unknown"})
+
+
+def test_record_extractor_incompatibilities():
+    with pytest.raises(ValueError, match="cannot be used together"):
+        parse_options({"record_extractor": "x.Y", "is_record_sequence": "true"})
+
+
+def test_record_length_field_vs_sequence():
+    with pytest.raises(ValueError, match="cannot be used together"):
+        parse_options({"record_length_field": "LEN", "is_record_sequence": "true"})
+
+
+def test_invalid_encoding():
+    with pytest.raises(ValueError, match="encoding"):
+        parse_options({"encoding": "utf8"})
+
+
+def test_redefine_segment_id_map_parsing():
+    params, _ = parse_options({
+        "segment_field": "SEG",
+        "redefine-segment-id-map:0": "COMPANY => C,D",
+        "redefine-segment-id-map:1": "CONTACT => P"})
+    assert params.multisegment.segment_id_redefine_map == {
+        "C": "COMPANY", "D": "COMPANY", "P": "CONTACT"}
+
+
+def test_segment_children_requires_redefine_map():
+    with pytest.raises(ValueError, match="requires"):
+        parse_options({
+            "segment_field": "SEG",
+            "segment-children:0": "COMPANY => DEPT"})
+
+
+def test_list_input_files_skips_hidden():
+    files = list_input_files(os.path.join(REFERENCE_DATA, "test1_data"))
+    assert files and all(not os.path.basename(f).startswith((".", "_"))
+                         for f in files)
