@@ -230,6 +230,9 @@ def parse_options(options: Dict[str, object]) -> Tuple[ReaderParameters, Options
         re_additional_info=opts.get("re_additional_info", ""),
         input_file_name_column=opts.get("with_input_file_name_col", ""),
     )
+    # recognized keys consumed later by read_cobol — mark used before the
+    # pedantic unused-key audit runs
+    opts.get_bool("debug_ignore_file_size")
     _validate_options(opts, params)
     return params, opts
 
@@ -346,6 +349,10 @@ def read_cobol(path=None,
         copybook_contents = options.pop("copybook_contents")
     if "copybooks" in options and copybook is None:
         copybook = options.pop("copybooks").split(",")
+    if isinstance(options.get("occurs_mappings"), (dict, list)):
+        # Python-native callers pass the mapping directly; the option layer
+        # is string-keyed like the reference's .option() map
+        options["occurs_mappings"] = json.dumps(options["occurs_mappings"])
 
     if copybook_contents is None:
         if copybook is None:

@@ -189,6 +189,9 @@ def decode_display_ascii(data: jnp.ndarray, signed: bool, allow_dot: bool,
 # ---------------------------------------------------------------------------
 
 def decode_ieee_float(data: jnp.ndarray, big_endian: bool, double: bool):
+    """For `double`, returns the IEEE754 *bit pattern* as uint64 — the host
+    views it as float64 after transfer. TPUs have no native f64; a device-side
+    bitcast to f64 rounds through the emulation path and loses the last ULP."""
     w = 8 if double else 4
     slab = data[..., :w]
     if not big_endian:
@@ -197,8 +200,9 @@ def decode_ieee_float(data: jnp.ndarray, big_endian: bool, double: bool):
     acc = jnp.zeros(slab.shape[:-1], dtype=acc_dtype)
     for i in range(w):
         acc = (acc << 8) | slab[..., i].astype(acc_dtype)
-    values = jax.lax.bitcast_convert_type(
-        acc, jnp.float64 if double else jnp.float32)
+    if double:
+        return acc, jnp.ones(acc.shape, dtype=jnp.bool_)
+    values = jax.lax.bitcast_convert_type(acc, jnp.float32)
     return values, jnp.ones(values.shape, dtype=jnp.bool_)
 
 
@@ -264,8 +268,9 @@ def decode_ibm_float64(data: jnp.ndarray):
     ieee = (conv_exp << 52) + conv_fract
     ieee_u = ieee.astype(jnp.uint64) | (sign_bit.astype(jnp.uint64) << 63)
     ieee_u = jnp.where(is_zero, jnp.uint64(0), ieee_u)
-    return jax.lax.bitcast_convert_type(ieee_u, jnp.float64), \
-        jnp.ones(ieee_u.shape, dtype=jnp.bool_)
+    # return raw IEEE754 bits; the host bitcasts after transfer (TPUs round
+    # device-side f64 bitcasts through the emulation path)
+    return ieee_u, jnp.ones(ieee_u.shape, dtype=jnp.bool_)
 
 
 # ---------------------------------------------------------------------------

@@ -160,7 +160,9 @@ def _classify(dtype, fp_format: FloatingPointFormat) -> Tuple[Codec, CodecParams
         is_sign_separate=dtype.is_sign_separate,
     )
     if usage is None:
-        if dtype.precision > MAX_LONG_PRECISION:
+        # scale_factor (PIC P) semantics depend on the decoded digit-char
+        # count, which only the scalar oracle reproduces exactly
+        if dtype.precision > MAX_LONG_PRECISION or sf != 0:
             return Codec.HOST_FALLBACK, params
         return (Codec.DISPLAY_NUM if is_ebcdic else Codec.DISPLAY_NUM_ASCII), params
     if usage is Usage.COMP3:
@@ -168,7 +170,7 @@ def _classify(dtype, fp_format: FloatingPointFormat) -> Tuple[Codec, CodecParams
             return Codec.HOST_FALLBACK, params
         return Codec.BCD, params
     if usage in (Usage.COMP4, Usage.COMP5, Usage.COMP9):
-        if dtype.precision > MAX_LONG_PRECISION:
+        if dtype.precision > MAX_LONG_PRECISION or sf != 0:
             return Codec.HOST_FALLBACK, params
         return Codec.BINARY, params
     if usage is Usage.COMP1:

@@ -140,8 +140,8 @@ class DecodedBatch:
             if sf < 0:
                 return PyDecimal(mantissa).scaleb(sf - n_digits)
             return PyDecimal(mantissa).scaleb(-spec.params.scale)
-        if sf > 0:
-            return PyDecimal(mantissa).scaleb(sf)
+        # non-COMP3 decimals with scale_factor != 0 compile to HOST_FALLBACK
+        # (the digit-count-dependent PIC P semantics live in the oracle)
         return PyDecimal(mantissa).scaleb(-spec.params.scale)
 
     def _string_value(self, spec: ColumnSpec, out: dict, i: int):
@@ -434,6 +434,10 @@ class ColumnarDecoder:
                                         "dot_scale": dots[:, pos]}
             else:
                 values, valid = (np.asarray(o)[:n] for o in out)
+                if g.codec in (Codec.DOUBLE_IBM, Codec.DOUBLE_IEEE):
+                    # device returns IEEE754 bit patterns (uint64); f64
+                    # bitcasts on TPU round through the emulation path
+                    values = values.view(np.float64)
                 for pos, c in enumerate(g.columns):
                     outputs[c.index] = {"values": values[:, pos],
                                         "valid": valid[:, pos]}

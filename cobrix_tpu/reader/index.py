@@ -110,6 +110,11 @@ def sparse_index_generator(file_id: int,
                                            or DEFAULT_INDEX_ENTRY_SIZE_MB) * MEGABYTE
                     else:
                         bytes_in_chunk = 0
+        # NOTE: invalid records (file headers/footers) ARE counted, mirroring
+        # the reference exactly (IndexGenerator.scala:117-120 increments
+        # unconditionally) — even though VRLRecordReader skips invalid
+        # records without numbering them. The resulting Record_Id shift
+        # after a file header on indexed reads is reference behavior.
         record_index += 1
         records_in_chunk += 1
         byte_index += record_size

@@ -47,18 +47,13 @@ def _java_double_str(v: float) -> str:
     # scientific
     mantissa, _, exp = s.partition("e")
     if not exp:
-        # python chose decimal notation; convert
-        e = math.floor(math.log10(a))
-        m = v / (10 ** e)
-        for prec in range(1, 18):
-            cand = f"{m:.{prec}g}"
-            if float(f"{cand}E{e}") == v:
-                m_str = cand
-                break
-        else:
-            m_str = repr(m)
-        if "." not in m_str:
-            m_str += ".0"
+        # python chose decimal notation; convert exactly via the digit string
+        # (float division by 10**e would double-round near mantissa 10.0)
+        sign, digits, dexp = PyDecimal(s).as_tuple()
+        e = dexp + len(digits) - 1
+        dig = "".join(map(str, digits)).rstrip("0") or "0"
+        m_str = ("-" if sign else "") + dig[0] + \
+            ("." + dig[1:] if len(dig) > 1 else ".0")
         return f"{m_str}E{e}"
     if "." not in mantissa:
         mantissa += ".0"
@@ -93,9 +88,12 @@ def _java_float_str(v: float) -> str:
         return cand
     mantissa, _, exp = cand.lower().partition("e")
     if not exp:
-        e = math.floor(math.log10(a))
-        m = PyDecimal(cand).scaleb(-e)
-        mantissa, exp = format(m.normalize(), "f"), str(e)
+        sign, digits, dexp = PyDecimal(cand).as_tuple()
+        e = dexp + len(digits) - 1
+        dig = "".join(map(str, digits)).rstrip("0") or "0"
+        mantissa = ("-" if sign else "") + dig[0] + \
+            ("." + dig[1:] if len(dig) > 1 else "")
+        exp = str(e)
     if "." not in mantissa:
         mantissa += ".0"
     return f"{mantissa}E{int(exp)}"

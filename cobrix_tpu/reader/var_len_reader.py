@@ -325,6 +325,14 @@ class VarLenReader:
                            starting_file_offset: int = 0) -> List[List[object]]:
         """Frame all records, pack per-active-segment padded batches, decode
         with the batched kernels, and reassemble rows in file order."""
+        if self.copybook.is_hierarchical:
+            # hierarchical assembly (parent/child nesting) is host-side for
+            # now; the host iterator produces the nested rows the schema
+            # expects (reference extractHierarchicalRecord, RecordExtractors.scala:211)
+            return list(self.iter_rows(
+                stream, file_id=file_id, start_record_id=start_record_id,
+                starting_file_offset=starting_file_offset,
+                segment_id_prefix=segment_id_prefix))
         params = self.params
         seg = params.multisegment
         prefix = segment_id_prefix or default_segment_id_prefix()

@@ -1,0 +1,158 @@
+"""Regression pins for bugs found in code review.
+
+Each test encodes the observable contract that was broken:
+1. hierarchical copybooks through the default (columnar) backend,
+2. PIC P (scale_factor) fields on the columnar path,
+3. pedantic mode + debug_ignore_file_size,
+4. occurs_mappings passed as a Python dict,
+5. sparse-index record numbering across skipped header records.
+"""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from cobrix_tpu import parse_copybook, read_cobol
+from cobrix_tpu.plan.compiler import Codec, compile_plan
+from cobrix_tpu.reader.columnar import ColumnarDecoder
+from cobrix_tpu.reader.extractors import extract_record
+from cobrix_tpu.testing.generators import ebcdic_encode
+
+
+def _write(tmp, name, data: bytes) -> str:
+    p = os.path.join(tmp, name)
+    with open(p, "wb") as f:
+        f.write(data)
+    return p
+
+
+def _rdw(length: int) -> bytes:
+    """Big-endian RDW: length in bytes [0..1]."""
+    return length.to_bytes(2, "big") + bytes([0, 0])
+
+
+HIER_COPYBOOK = """
+       01 RECORD.
+          05 SEG-ID    PIC X(1).
+          05 COMPANY REDEFINES SEG-ID-DATA.
+             10 NAME   PIC X(5).
+          05 CONTACT REDEFINES COMPANY.
+             10 PHONE  PIC X(5).
+"""
+
+
+def test_hierarchical_default_backend_matches_host():
+    """segment-children reads must produce nested rows on every backend."""
+    copybook = """
+       01 RECORD.
+          05 SEG-ID    PIC X(1).
+          05 COMPANY.
+             10 NAME   PIC X(5).
+          05 CONTACT REDEFINES COMPANY.
+             10 PHONE  PIC X(5).
+"""
+    recs = [("C", "ACME "), ("P", "12345"), ("P", "67890"), ("C", "GLOBX")]
+    payload = b"".join(
+        _rdw(6) + ebcdic_encode(sid + body) for sid, body in recs)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "h.bin", payload)
+        kwargs = dict(
+            copybook_contents=copybook,
+            is_record_sequence=True,
+            is_rdw_big_endian="true",
+            segment_field="SEG-ID",
+            **{"redefine-segment-id-map:0": "COMPANY => C",
+               "redefine-segment-id-map:1": "CONTACT => P",
+               "segment-children:0": "COMPANY => CONTACT"})
+        host = read_cobol(path, backend="host", **kwargs)
+        default = read_cobol(path, backend="numpy", **kwargs)
+        assert host.to_json_lines() == default.to_json_lines()
+        assert len(host) == 2  # two root records with nested children
+
+
+def test_scale_factor_display_columnar_matches_host():
+    copybook = """
+       01 REC.
+          05 A PIC SVP(2)9(3).
+          05 B PIC 9(3)P(2).
+          05 C PIC S9(3)PP COMP.
+"""
+    cb = parse_copybook(copybook)
+    plan = compile_plan(cb)
+    codecs = {c.name: c.codec for c in plan.columns}
+    assert codecs["A"] is Codec.HOST_FALLBACK
+    assert codecs["B"] is Codec.HOST_FALLBACK
+    assert codecs["C"] is Codec.HOST_FALLBACK
+    rows_data = [ebcdic_encode("012345") + (77).to_bytes(2, "big"),
+                 ebcdic_encode("900001") + (0x8000).to_bytes(2, "big")]
+    data = np.frombuffer(b"".join(rows_data), dtype=np.uint8).reshape(2, -1)
+    dec = ColumnarDecoder(cb, backend="numpy")
+    got = dec.decode(data).to_rows()
+    want = [extract_record(cb.ast, bytes(r)) for r in rows_data]
+    assert got == want
+
+
+def test_pedantic_accepts_debug_ignore_file_size():
+    copybook = """
+       01 REC.
+          05 A PIC X(4).
+"""
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "d.bin", ebcdic_encode("ABCDEFG"))  # 7 bytes, rs=4
+        out = read_cobol(path, copybook_contents=copybook,
+                         pedantic="true", debug_ignore_file_size="true")
+        assert len(out) == 1  # trailing partial record dropped
+        with pytest.raises(ValueError, match="Redundant or unrecognized"):
+            read_cobol(path, copybook_contents=copybook,
+                       pedantic="true", no_such_option="1",
+                       debug_ignore_file_size="true")
+
+
+def test_occurs_mappings_accepts_python_dict():
+    copybook = """
+       01 REC.
+          05 KIND  PIC X(1).
+          05 ITEMS OCCURS 0 TO 3 TIMES DEPENDING ON KIND.
+             10 V PIC X(1).
+"""
+    mapping = {"ITEMS": {"A": 1, "B": 3}}
+    data = ebcdic_encode("AX--") + ebcdic_encode("BXYZ")
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "o.bin", data)
+        for occ in (mapping, json.dumps(mapping)):
+            out = read_cobol(path, copybook_contents=copybook,
+                             occurs_mappings=occ)
+            rows = out.to_rows()
+            assert len(rows[0][0][1]) == 1
+            assert len(rows[1][0][1]) == 3
+
+
+def test_index_counts_invalid_records_like_reference():
+    """The index generator numbers file-header (invalid) records while the
+    record reader skips them without numbering — both mirror the reference
+    (IndexGenerator.scala:117-120 vs VRLRecordReader.scala:151-186), so the
+    Record_Id shift after a file header on indexed reads is intentional."""
+    from cobrix_tpu.reader.parameters import ReaderParameters
+    from cobrix_tpu.reader.stream import MemoryStream
+    from cobrix_tpu.reader.var_len_reader import VarLenReader
+
+    copybook = """
+       01 REC.
+          05 A PIC X(4).
+"""
+    header = b"HEADER"  # 6 bytes: > RDW size, so the tail is an invalid record
+    payload = header + b"".join(
+        _rdw(4) + ebcdic_encode(f"R{i:03d}") for i in range(10))
+    params = ReaderParameters(is_record_sequence=True, is_rdw_big_endian=True,
+                              file_start_offset=6,
+                              input_split_records=3,
+                              is_index_generation_needed=True)
+    reader = VarLenReader(copybook, params)
+    index = reader.generate_index(MemoryStream(payload), file_id=0)
+    # the header region counts as record 0, so splits land one valid record
+    # early: entries at generator-count 3, 6, 9 == valid records R2, R5, R8
+    assert [e.record_index for e in index] == [0, 3, 6, 9]
+    whole = list(reader.iter_rows(MemoryStream(payload), file_id=0))
+    assert len(whole) == 10
