@@ -1,0 +1,153 @@
+"""Benchmark: exp3 multisegment-wide decode throughput (MB/s).
+
+Reproduces the reference's north-star workload (BASELINE.md exp3:
+RDW variable-length multisegment file; wide 'C' segments with
+STRATEGY-DETAIL OCCURS 2000 of COMP + COMP-3, 16,068-byte records,
+interleaved with 64-byte 'P' contact segments). Reference single-core
+throughput is ~8.0 MB/s (performance/exp3_multiseg_wide.csv); the
+vs_baseline field is measured MB/s / 8.0.
+
+Pipeline timed end-to-end: RDW record framing (host) -> per-segment batch
+packing (host) -> columnar kernel decode (device) -> typed column arrays
+on host. Data generation and jit warmup are excluded; row/JSON
+materialization is excluded (columnar output is the product, as Parquet
+columns are for the reference).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_MBPS = 8.0  # exp3, 1 executor (BASELINE.md)
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _probe_jax(timeout: int = 60) -> bool:
+    """Check device init in a subprocess first — a wedged TPU tunnel would
+    hang this process forever."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout, capture_output=True)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def frame_rdw(data: bytes):
+    """Host RDW scan -> (offsets, lengths) of record payloads
+    (little-endian RDW, reference RecordHeaderParserRDW.scala:24-87)."""
+    offsets = []
+    lengths = []
+    pos = 0
+    n = len(data)
+    while pos + 4 <= n:
+        length = data[pos + 2] + 256 * data[pos + 3]
+        if length == 0:
+            raise ValueError(f"zero RDW at {pos}")
+        offsets.append(pos + 4)
+        lengths.append(length)
+        pos += 4 + length
+    return np.asarray(offsets, dtype=np.int64), np.asarray(lengths, np.int64)
+
+
+def pack_batches(buf: np.ndarray, offsets: np.ndarray, lengths: np.ndarray):
+    """Split records by segment (wide 'C' vs narrow 'P' by length) and pack
+    each group into a padded [n, max_len] matrix with one vectorized gather."""
+    batches = {}
+    for seg_len in np.unique(lengths):
+        mask = lengths == seg_len
+        offs = offsets[mask]
+        idx = offs[:, None] + np.arange(seg_len)[None, :]
+        batches[int(seg_len)] = (buf[idx], np.nonzero(mask)[0])
+    return batches
+
+
+def run(backend: str, mb_target: float) -> dict:
+    from cobrix_tpu.reader.parameters import (
+        MultisegmentParameters,
+        ReaderParameters,
+    )
+    from cobrix_tpu.reader.var_len_reader import VarLenReader
+    from cobrix_tpu.testing.generators import EXP3_COPYBOOK, generate_exp3
+
+    # same reader configuration as the reference exp3 run (SparkCobolApp
+    # with redefine-segment-id-map): the copybook is parsed with
+    # STATIC-DETAILS / CONTACTS marked as segment redefines
+    params = ReaderParameters(
+        is_record_sequence=True,
+        multisegment=MultisegmentParameters(
+            segment_id_field="SEGMENT-ID",
+            segment_id_redefine_map={"C": "STATIC_DETAILS", "P": "CONTACTS"}))
+    reader = VarLenReader(EXP3_COPYBOOK, params)
+
+    # ~1/3 of records are 16 KB 'C' segments, the rest 64-byte contacts
+    est_per_record = 16072 * 0.33 + 68 * 0.67
+    n_records = max(64, int(mb_target * 1024 * 1024 / est_per_record))
+    t0 = time.perf_counter()
+    raw = generate_exp3(n_records, seed=100)
+    _log(f"generated {len(raw) / 1e6:.1f} MB, {n_records} records "
+         f"in {time.perf_counter() - t0:.1f}s")
+
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    total_mb = len(raw) / (1024 * 1024)
+
+    def decode_all():
+        offsets, lengths = frame_rdw(raw)
+        batches = pack_batches(buf, offsets, lengths)
+        out = []
+        for seg_len, (batch, _) in sorted(batches.items()):
+            # segment discrimination by record length (C records carry the
+            # 2000-element strategy block; P contacts are 60 bytes)
+            active = "CONTACTS" if seg_len < 1000 else "STATIC_DETAILS"
+            dec = reader._decoder_for_segment(active, backend)
+            out.append(dec.decode(
+                batch, lengths=np.full(batch.shape[0], seg_len)))
+        return out
+
+    # warmup (jit compile; excluded from timing)
+    t0 = time.perf_counter()
+    decode_all()
+    _log(f"warmup (incl. compile): {time.perf_counter() - t0:.1f}s")
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        decoded = decode_all()
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    n_rows = sum(d.n_records for d in decoded)
+    mbps = total_mb / best
+    _log(f"runs: {[f'{t:.2f}s' for t in times]}; {n_rows} records; "
+         f"{mbps:.1f} MB/s; {n_rows / best:.0f} rec/s")
+    return {
+        "metric": f"exp3_multiseg_wide_decode_{backend}",
+        "value": round(mbps, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(mbps / BASELINE_MBPS, 2),
+    }
+
+
+def main():
+    mb_target = float(os.environ.get("BENCH_MB", "64"))
+    backend = os.environ.get("BENCH_BACKEND", "")
+    if not backend:
+        backend = "jax" if _probe_jax() else "numpy"
+        if backend == "numpy":
+            _log("WARNING: jax device init timed out; numpy fallback")
+    result = run(backend, mb_target)
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""Distribution layer: device-mesh sharded decode + host-side planning.
+
+TPU-native replacement for the reference's Spark distribution stack
+(RDD[SparseIndexEntry] + HDFS locality + LocationBalancer — SURVEY.md §2.5).
+"""
+from .mesh import batch_sharding, data_mesh, pad_batch_to_multiple
+from .planner import WorkShard, balance, plan_files, shards_from_index
+from .sharded import ShardedColumnarDecoder, sharded_decode
+
+__all__ = [
+    "batch_sharding", "data_mesh", "pad_batch_to_multiple",
+    "WorkShard", "balance", "plan_files", "shards_from_index",
+    "ShardedColumnarDecoder", "sharded_decode",
+]

@@ -1,0 +1,65 @@
+"""Device-mesh distribution for the decode plane.
+
+The reference's unit of parallelism is a byte-range partition of a mainframe
+file — `SparseIndexEntry` built by a sequential index pass
+(IndexGenerator.scala:33), distributed as an `RDD[SparseIndexEntry]`
+(IndexBuilder.scala:121-134) over Spark executors with HDFS block locality
+(LocationBalancer.scala:42). The TPU-native mapping (SURVEY.md §2.5):
+
+- the *device* axis: record batches are sharded across a 1-D ``data`` mesh
+  axis (`jax.sharding.Mesh` + `NamedSharding`). Each device decodes its
+  shard of the `[batch, record_len]` byte matrix; decode itself is
+  collective-free, and aggregations (record counts, validity stats) reduce
+  over the mesh with XLA-inserted collectives riding ICI.
+- the *host* axis: files / index entries are assigned to hosts by the
+  planner (planner.py), the LocationBalancer analogue — data never crosses
+  hosts, only metrics do (DCN).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def data_mesh(n_devices: Optional[int] = None, devices=None):
+    """A 1-D mesh over the ``data`` axis. `n_devices` takes the first N
+    available devices (default: all)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if n_devices > len(devices):
+                raise ValueError(
+                    f"Requested {n_devices} devices, only {len(devices)} "
+                    "available")
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), axis_names=("data",))
+
+
+def batch_sharding(mesh):
+    """NamedSharding placing the leading (record/batch) axis on ``data``."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec("data"))
+
+
+def replicated_sharding(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def pad_batch_to_multiple(arr: np.ndarray, multiple: int) -> np.ndarray:
+    """Pad the leading axis up to a multiple (zero records decode to valid
+    garbage that the caller slices off — same trick as the single-chip
+    bucket padding in ColumnarDecoder._decode_jax)."""
+    n = arr.shape[0]
+    target = -(-n // multiple) * multiple
+    if target == n:
+        return arr
+    padded = np.zeros((target,) + arr.shape[1:], dtype=arr.dtype)
+    padded[:n] = arr
+    return padded

@@ -1,0 +1,78 @@
+"""Host-side work planning: files and index entries -> hosts.
+
+The reference plans work on the Spark driver: `IndexBuilder.buildIndex`
+runs one index task per file, collects `SparseIndexEntry` lists, queries
+HDFS block locations, and `LocationBalancer.balance` re-assigns entries
+from busy executors to idle ones (IndexBuilder.scala:49-116,
+LocationBalancer.scala:42-66). Here the same planning is a pure function:
+shards (whole files, or index entries within files) are assigned to hosts
+by greedy longest-processing-time balancing on byte size. Each host then
+feeds its shard list to its local device mesh; no record bytes ever move
+between hosts (DCN carries only metrics), mirroring §2.5 of SURVEY.md.
+"""
+from __future__ import annotations
+
+import heapq
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..reader.index import SparseIndexEntry
+
+
+@dataclass(frozen=True)
+class WorkShard:
+    """A byte range of one file, assigned to one host."""
+    file_path: str
+    file_order: int
+    offset_from: int
+    offset_to: int          # -1 = to end of file
+    record_index: int       # Record_Id seed for the shard (reference
+                            # SparseIndexEntry.recordIndex semantics)
+
+    @property
+    def size(self) -> int:
+        return -1 if self.offset_to < 0 else self.offset_to - self.offset_from
+
+
+def shards_from_index(file_path: str, file_order: int,
+                      entries: Sequence[SparseIndexEntry],
+                      file_size: Optional[int] = None) -> List[WorkShard]:
+    if file_size is None:
+        file_size = os.path.getsize(file_path)
+    out = []
+    for e in entries:
+        end = e.offset_to if e.offset_to >= 0 else file_size
+        out.append(WorkShard(file_path, file_order, e.offset_from, end,
+                             e.record_index))
+    return out
+
+
+def balance(shards: Sequence[WorkShard], n_hosts: int
+            ) -> List[List[WorkShard]]:
+    """Greedy LPT bin packing of shards onto hosts by byte size — the
+    LocationBalancer analogue (no locality term: TPU hosts read from
+    shared storage, so only load balance matters)."""
+    if n_hosts <= 0:
+        raise ValueError("n_hosts must be positive")
+    assignments: List[List[WorkShard]] = [[] for _ in range(n_hosts)]
+    # heap of (assigned_bytes, host_id)
+    heap: List[Tuple[int, int]] = [(0, h) for h in range(n_hosts)]
+    heapq.heapify(heap)
+    for shard in sorted(shards, key=lambda s: -(s.size if s.size >= 0 else 0)):
+        load, host = heapq.heappop(heap)
+        assignments[host].append(shard)
+        heapq.heappush(heap, (load + max(shard.size, 0), host))
+    # deterministic per-host order: by (file_order, offset)
+    for a in assignments:
+        a.sort(key=lambda s: (s.file_order, s.offset_from))
+    return assignments
+
+
+def plan_files(files: Sequence[str], n_hosts: int) -> List[List[WorkShard]]:
+    """Whole-file sharding (fixed-length / no-index path): one shard per
+    file, balanced across hosts."""
+    shards = [
+        WorkShard(f, order, 0, os.path.getsize(f), 0)
+        for order, f in enumerate(files)]
+    return balance(shards, n_hosts)

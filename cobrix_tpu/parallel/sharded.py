@@ -1,0 +1,101 @@
+"""Sharded columnar decode: the multi-chip data-parallel decode plane.
+
+Replaces the reference's executor-side scan (`CobolScanners.
+buildScanForVarLenIndex`, CobolScanners.scala:38 — one task per index
+entry, each decoding records sequentially) with ONE jitted XLA program
+whose batch axis is sharded over a device mesh: every chip decodes its
+shard of the `[batch, record_len]` byte matrix simultaneously. Decode is
+embarrassingly parallel so the program contains no collectives; the
+`decode_stats` aggregation shows where XLA inserts psum-style reductions
+over the mesh (record counts / validity totals), the analogue of the
+reference's driver-side index statistics (IndexBuilder.scala:216).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..copybook.copybook import Copybook
+from ..reader.columnar import ColumnarDecoder, DecodedBatch
+from .mesh import batch_sharding, data_mesh, pad_batch_to_multiple
+
+
+class ShardedColumnarDecoder(ColumnarDecoder):
+    """ColumnarDecoder whose jax path shards the batch axis over a mesh.
+
+    The decode program is identical to the single-chip one
+    (`build_jax_decode_fn`); only the shardings differ — GSPMD partitions
+    the computation, which is the point: no per-device code, no explicit
+    communication, the mesh layout is declarative.
+    """
+
+    def __init__(self, copybook: Copybook,
+                 mesh=None,
+                 active_segment: Optional[str] = None):
+        super().__init__(copybook, active_segment=active_segment,
+                         backend="jax")
+        self.mesh = mesh if mesh is not None else data_mesh()
+        self._stats_fn = None
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def _decode_jax(self, arr: np.ndarray) -> Dict[int, dict]:
+        import jax
+
+        if self._jax_fn is None:
+            sharding = batch_sharding(self.mesh)
+            self._jax_fn = jax.jit(
+                self.build_jax_decode_fn(),
+                in_shardings=sharding,
+                # every output's leading axis is the record axis; keep the
+                # results distributed — transfers gather only what the host
+                # materializes
+                out_shardings=sharding)
+
+        n = arr.shape[0]
+        bucket = max(self._bucket_size(n), self.n_devices)
+        padded = pad_batch_to_multiple(arr, bucket)
+        device_outs = self._jax_fn(padded)
+        return self.collect_outputs(device_outs, n)
+
+    def decode_stats(self, arr: np.ndarray) -> Dict[str, int]:
+        """Mesh-reduced decode statistics (record count, per-codec valid
+        counts). The reductions cross the shard boundary, so XLA lowers
+        them to all-reduce collectives over ICI — the only cross-chip
+        traffic the decode plane needs (SURVEY.md §2.5)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._stats_fn is None:
+            decode_all = self.build_jax_decode_fn()
+            groups = self.kernel_groups
+
+            def stats(data):
+                outs = decode_all(data)
+                total_valid = jnp.zeros((), dtype=jnp.int64)
+                per_group = {}
+                for g, out in zip(groups, outs):
+                    if len(out) >= 2 and out[1].dtype == jnp.bool_:
+                        v = out[1].sum(dtype=jnp.int64)
+                        per_group[f"{g.codec.value}_w{g.width}"] = v
+                        total_valid = total_valid + v
+                return {"records": jnp.asarray(data.shape[0], jnp.int64),
+                        "valid_values": total_valid, **per_group}
+
+            sharding = batch_sharding(self.mesh)
+            self._stats_fn = jax.jit(stats, in_shardings=sharding)
+
+        padded = pad_batch_to_multiple(
+            arr, max(self._bucket_size(arr.shape[0]), self.n_devices))
+        out = self._stats_fn(padded)
+        return {k: int(v) for k, v in out.items()}
+
+
+def sharded_decode(copybook: Copybook, data, mesh=None,
+                   lengths: Optional[np.ndarray] = None) -> DecodedBatch:
+    """One-shot helper: decode bytes/[N, rs] uint8 across the mesh."""
+    dec = ShardedColumnarDecoder(copybook, mesh=mesh)
+    return dec.decode(data, lengths=lengths)

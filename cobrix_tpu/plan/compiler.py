@@ -129,6 +129,14 @@ class FieldPlan:
     def columns_for(self, st: Statement) -> List["ColumnSpec"]:
         return [c for c in self.columns if c.statement is st]
 
+    @property
+    def max_extent(self) -> int:
+        """Largest byte any column reads — the minimum row width a batch
+        matrix needs for this plan. Much smaller than record_size when an
+        active segment restricts the plan to a narrow redefine (exp2/exp3:
+        64-byte contact records vs a 16 KB wide layout)."""
+        return max((c.offset + c.width for c in self.columns), default=0)
+
 
 def _classify(dtype, fp_format: FloatingPointFormat) -> Tuple[Codec, CodecParams]:
     """Map a CobolType to its kernel family (mirrors DecoderSelector dispatch)."""

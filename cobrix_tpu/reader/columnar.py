@@ -301,6 +301,13 @@ class ColumnarDecoder:
             arr = np.asarray(data, dtype=np.uint8)
             if arr.ndim != 2:
                 raise ValueError("Expected a [batch, record_len] uint8 array")
+            extent = self.plan.max_extent
+            if arr.shape[1] < extent:
+                # pad to the plan's byte extent; columns past a record's
+                # true end are nulled via `lengths`
+                padded = np.zeros((arr.shape[0], extent), dtype=np.uint8)
+                padded[:, :arr.shape[1]] = arr
+                arr = padded
         if self.backend == "jax":
             outputs = self._decode_jax(arr)
         else:
@@ -387,28 +394,36 @@ class ColumnarDecoder:
 
     # -- jax backend ------------------------------------------------------
 
-    def _decode_jax(self, arr: np.ndarray) -> Dict[int, dict]:
-        import jax
+    def build_jax_decode_fn(self):
+        """The pure decode program: [batch, record_len] uint8 -> list of
+        per-kernel-group output tuples. One XLA computation; suitable for
+        `jax.jit` directly (single chip) or a sharded jit over a device mesh
+        (parallel.ShardedColumnarDecoder)."""
         import jax.numpy as jnp
         from ..ops import batch_jax
 
+        batch_jax.ensure_x64()
+        kernel_groups = self.kernel_groups
+        lut = self.lut
+
+        def decode_all(data):
+            outs = []
+            for g in kernel_groups:
+                if g.codec is Codec.HOST_FALLBACK:
+                    outs.append(())
+                    continue
+                offs = jnp.asarray(g.offsets)
+                slab = data[:, offs[:, None] + jnp.arange(g.width)[None, :]]
+                outs.append(self._run_group_jax(g, slab, jnp, batch_jax, lut))
+            return outs
+
+        return decode_all
+
+    def _decode_jax(self, arr: np.ndarray) -> Dict[int, dict]:
+        import jax
+
         if self._jax_fn is None:
-            batch_jax.ensure_x64()
-            kernel_groups = self.kernel_groups
-            lut = self.lut
-
-            def decode_all(data):
-                outs = []
-                for g in kernel_groups:
-                    if g.codec is Codec.HOST_FALLBACK:
-                        outs.append(())
-                        continue
-                    offs = jnp.asarray(g.offsets)
-                    slab = data[:, offs[:, None] + jnp.arange(g.width)[None, :]]
-                    outs.append(self._run_group_jax(g, slab, jnp, batch_jax, lut))
-                return outs
-
-            self._jax_fn = jax.jit(decode_all)
+            self._jax_fn = jax.jit(self.build_jax_decode_fn())
 
         n = arr.shape[0]
         bucket = self._bucket_size(n)
@@ -418,6 +433,11 @@ class ColumnarDecoder:
         else:
             padded = arr
         device_outs = self._jax_fn(padded)
+        return self.collect_outputs(device_outs, n)
+
+    def collect_outputs(self, device_outs, n: int) -> Dict[int, dict]:
+        """Transfer per-group device outputs to host numpy column arrays,
+        dropping batch padding (`n` = real record count)."""
         outputs: Dict[int, dict] = {}
         for g, out in zip(self.kernel_groups, device_outs):
             if g.codec is Codec.HOST_FALLBACK:

@@ -365,7 +365,9 @@ class VarLenReader:
 
         for active, positions in by_segment.items():
             decoder = self._decoder_for_segment(active, backend)
-            rs = decoder.plan.record_size
+            # pack to the plan's byte extent, not the full record size —
+            # narrow segments of a wide copybook decode from narrow matrices
+            rs = decoder.plan.max_extent
             batch = np.zeros((len(positions), rs), dtype=np.uint8)
             lengths = np.zeros(len(positions), dtype=np.int64)
             for row_i, pos in enumerate(positions):
