@@ -44,35 +44,6 @@ def _probe_jax(timeout: int = 60) -> bool:
         return False
 
 
-def frame_rdw(data: bytes):
-    """Host RDW scan -> (offsets, lengths) of record payloads
-    (little-endian RDW, reference RecordHeaderParserRDW.scala:24-87)."""
-    offsets = []
-    lengths = []
-    pos = 0
-    n = len(data)
-    while pos + 4 <= n:
-        length = data[pos + 2] + 256 * data[pos + 3]
-        if length == 0:
-            raise ValueError(f"zero RDW at {pos}")
-        offsets.append(pos + 4)
-        lengths.append(length)
-        pos += 4 + length
-    return np.asarray(offsets, dtype=np.int64), np.asarray(lengths, np.int64)
-
-
-def pack_batches(buf: np.ndarray, offsets: np.ndarray, lengths: np.ndarray):
-    """Split records by segment (wide 'C' vs narrow 'P' by length) and pack
-    each group into a padded [n, max_len] matrix with one vectorized gather."""
-    batches = {}
-    for seg_len in np.unique(lengths):
-        mask = lengths == seg_len
-        offs = offsets[mask]
-        idx = offs[:, None] + np.arange(seg_len)[None, :]
-        batches[int(seg_len)] = (buf[idx], np.nonzero(mask)[0])
-    return batches
-
-
 def run(backend: str, mb_target: float) -> dict:
     from cobrix_tpu.reader.parameters import (
         MultisegmentParameters,
@@ -99,20 +70,26 @@ def run(backend: str, mb_target: float) -> dict:
     _log(f"generated {len(raw) / 1e6:.1f} MB, {n_records} records "
          f"in {time.perf_counter() - t0:.1f}s")
 
-    buf = np.frombuffer(raw, dtype=np.uint8)
+    from cobrix_tpu import native
+
     total_mb = len(raw) / (1024 * 1024)
+    _log(f"native framing: {native.available()}")
 
     def decode_all():
-        offsets, lengths = frame_rdw(raw)
-        batches = pack_batches(buf, offsets, lengths)
+        # native RDW scan (VRLRecordReader loop in C++) + per-segment pack
+        offsets, lengths = native.rdw_scan(raw, big_endian=False)
         out = []
-        for seg_len, (batch, _) in sorted(batches.items()):
+        for seg_len in np.unique(lengths):
             # segment discrimination by record length (C records carry the
             # 2000-element strategy block; P contacts are 60 bytes)
+            pos = np.nonzero(lengths == seg_len)[0]
             active = "CONTACTS" if seg_len < 1000 else "STATIC_DETAILS"
             dec = reader._decoder_for_segment(active, backend)
+            extent = dec.plan.max_extent
+            batch = native.pack_records(
+                raw, offsets[pos], lengths[pos], extent)
             out.append(dec.decode(
-                batch, lengths=np.full(batch.shape[0], seg_len)))
+                batch, lengths=np.minimum(lengths[pos], extent)))
         return out
 
     # warmup (jit compile; excluded from timing)

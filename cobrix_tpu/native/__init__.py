@@ -1,0 +1,271 @@
+"""Native runtime bindings: C++ record framing + batch packing.
+
+Builds `framing.cpp` into a shared library on first use (g++, cached next
+to the source; rebuilt when the source is newer) and binds it with ctypes
+— the image has no pybind11, and the C ABI keeps the boundary trivial.
+Every entry point has a NumPy fallback so the package works without a
+toolchain; `available()` reports which path is active.
+"""
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "framing.cpp")
+_LIB_PATH = os.path.join(_HERE, "_libframing.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+MAX_RDW_RECORD_SIZE = 100 * 1024 * 1024
+
+_I64P = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
+_U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-std=c++17",
+           _SRC, "-o", _LIB_PATH]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        _logger.warning("native framing build failed (%s); using NumPy "
+                        "fallbacks", exc)
+        return False
+    if proc.returncode != 0:
+        _logger.warning("native framing build failed:\n%s",
+                        proc.stderr.decode(errors="replace"))
+        return False
+    return True
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        needs_build = (not os.path.exists(_LIB_PATH)
+                       or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC))
+        if needs_build and not _build():
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as exc:
+            _logger.warning("native framing load failed (%s)", exc)
+            _build_failed = True
+            return None
+        lib.rdw_scan.restype = ctypes.c_int64
+        lib.rdw_scan.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int64, _I64P, _I64P, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.length_field_scan.restype = ctypes.c_int64
+        lib.length_field_scan.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int64, _I64P, _I64P, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.text_scan.restype = ctypes.c_int64
+        lib.text_scan.argtypes = [
+            _U8P, ctypes.c_int64, _I64P, _I64P, ctypes.c_int64]
+        lib.pack_records.restype = None
+        lib.pack_records.argtypes = [
+            _U8P, ctypes.c_int64, _I64P, _I64P, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, _U8P]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_u8(data) -> np.ndarray:
+    if isinstance(data, np.ndarray):
+        return np.ascontiguousarray(data, dtype=np.uint8)
+    return np.frombuffer(data, dtype=np.uint8)
+
+
+def rdw_scan(data, big_endian: bool, rdw_adjustment: int = 0,
+             file_header_bytes: int = 0, file_footer_bytes: int = 0
+             ) -> Tuple[np.ndarray, np.ndarray]:
+    """All RDW record (payload offset, length) pairs of a file image.
+    Raises ValueError on zero/oversized headers (reference
+    RecordHeaderParserRDW hard errors)."""
+    buf = _as_u8(data)
+    size = buf.size
+    cap = max(16, size // 4 + 2)
+    offsets = np.empty(cap, dtype=np.int64)
+    lengths = np.empty(cap, dtype=np.int64)
+    lib = _load()
+    if lib is not None:
+        err = ctypes.c_int64(0)
+        n = lib.rdw_scan(buf, size, int(big_endian), int(rdw_adjustment),
+                         file_header_bytes, file_footer_bytes, offsets,
+                         lengths, cap, ctypes.byref(err))
+        if n == -1:
+            hdr = ",".join(str(b) for b in buf[err.value:err.value + 4])
+            raise ValueError(
+                f"RDW headers should never be zero ({hdr}). "
+                f"Found zero size record at {err.value}.")
+        if n == -2:
+            raise ValueError(f"RDW headers too big at {err.value}.")
+        return offsets[:n].copy(), lengths[:n].copy()
+    # NumPy fallback (still sequential in Python — the chain is data-dependent)
+    pos = 0
+    body_end = size - file_footer_bytes if 0 < file_footer_bytes < size else size
+    out_o, out_l = [], []
+    while pos + 4 <= body_end:
+        if file_header_bytes > 4 and pos == 0:
+            pos = file_header_bytes
+            continue
+        if big_endian:
+            ln = int(buf[pos + 1]) + 256 * int(buf[pos])
+        else:
+            ln = int(buf[pos + 2]) + 256 * int(buf[pos + 3])
+        ln += rdw_adjustment
+        if ln <= 0:
+            hdr = ",".join(str(b) for b in buf[pos:pos + 4])
+            raise ValueError(
+                f"RDW headers should never be zero ({hdr}). "
+                f"Found zero size record at {pos}.")
+        if ln > MAX_RDW_RECORD_SIZE:
+            raise ValueError(f"RDW headers too big at {pos}.")
+        out_o.append(pos + 4)
+        out_l.append(min(ln, body_end - (pos + 4)))
+        pos += 4 + ln
+    return (np.asarray(out_o, dtype=np.int64),
+            np.asarray(out_l, dtype=np.int64))
+
+
+LENGTH_FIELD_BINARY_BE = 0
+LENGTH_FIELD_BINARY_LE = 1
+LENGTH_FIELD_DISPLAY_EBCDIC = 2
+LENGTH_FIELD_DISPLAY_ASCII = 3
+
+
+def length_field_scan(data, field_offset: int, field_width: int, kind: int,
+                      length_adjust: int = 0
+                      ) -> Tuple[np.ndarray, np.ndarray, int]:
+    """Frame records whose byte length is a field inside each record.
+    Returns (offsets, lengths, resume_pos): resume_pos < len(data) means an
+    unreadable length field stopped the scan there (caller decides)."""
+    buf = _as_u8(data)
+    size = buf.size
+    cap = max(16, size // max(field_offset + field_width, 1) + 2)
+    offsets = np.empty(cap, dtype=np.int64)
+    lengths = np.empty(cap, dtype=np.int64)
+    lib = _load()
+    if lib is not None:
+        err = ctypes.c_int64(size)
+        n = lib.length_field_scan(buf, size, field_offset, field_width,
+                                  kind, length_adjust, offsets, lengths,
+                                  cap, ctypes.byref(err))
+        resume = err.value if err.value < size else (
+            int(offsets[n - 1] + lengths[n - 1]) if n else 0)
+        if n and offsets[n - 1] + lengths[n - 1] >= size:
+            resume = size
+        return offsets[:n].copy(), lengths[:n].copy(), resume
+    out_o, out_l = [], []
+    pos = 0
+    while pos < size:
+        if pos + field_offset + field_width > size:
+            break
+        f = buf[pos + field_offset: pos + field_offset + field_width]
+        value = 0
+        bad = False
+        if kind == LENGTH_FIELD_BINARY_BE:
+            for b in f:
+                value = (value << 8) | int(b)
+        elif kind == LENGTH_FIELD_BINARY_LE:
+            for b in f[::-1]:
+                value = (value << 8) | int(b)
+        else:
+            for b in f:
+                b = int(b)
+                if kind == LENGTH_FIELD_DISPLAY_EBCDIC:
+                    if b == 0x40:
+                        continue
+                    if not (0xF0 <= b <= 0xF9):
+                        bad = True
+                        break
+                    value = value * 10 + (b - 0xF0)
+                else:
+                    if b == 0x20:
+                        continue
+                    if not (0x30 <= b <= 0x39):
+                        bad = True
+                        break
+                    value = value * 10 + (b - 0x30)
+        value += length_adjust
+        if bad or value <= 0:
+            return (np.asarray(out_o, dtype=np.int64),
+                    np.asarray(out_l, dtype=np.int64), pos)
+        out_o.append(pos)
+        out_l.append(min(value, size - pos))
+        pos += value
+    return (np.asarray(out_o, dtype=np.int64),
+            np.asarray(out_l, dtype=np.int64),
+            size if not out_o or out_o[-1] + out_l[-1] >= size else pos)
+
+
+def text_scan(data) -> Tuple[np.ndarray, np.ndarray]:
+    """(offset, length) of LF/CRLF-delimited text records."""
+    buf = _as_u8(data)
+    lib = _load()
+    if lib is not None:
+        cap = buf.size + 1
+        offsets = np.empty(cap, dtype=np.int64)
+        lengths = np.empty(cap, dtype=np.int64)
+        n = lib.text_scan(buf, buf.size, offsets, lengths, cap)
+        return offsets[:n].copy(), lengths[:n].copy()
+    out_o, out_l = [], []
+    pos = 0
+    size = buf.size
+    nl = np.flatnonzero(buf == 0x0A)
+    for eol in list(nl) + ([size] if size and (not len(nl) or nl[-1] != size - 1)
+                           else []):
+        end = int(eol)
+        if end > pos and buf[end - 1] == 0x0D:
+            end -= 1
+        out_o.append(pos)
+        out_l.append(end - pos)
+        pos = int(eol) + 1
+    return (np.asarray(out_o, dtype=np.int64),
+            np.asarray(out_l, dtype=np.int64))
+
+
+def pack_records(data, offsets: np.ndarray, lengths: np.ndarray,
+                 extent: int, start_offset: int = 0) -> np.ndarray:
+    """Zero-padded [n, extent] uint8 batch matrix of the selected records."""
+    buf = _as_u8(data)
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    lengths = np.ascontiguousarray(lengths, dtype=np.int64)
+    n = offsets.shape[0]
+    out = np.empty((n, extent), dtype=np.uint8)
+    lib = _load()
+    if lib is not None:
+        lib.pack_records(buf, buf.size, offsets, lengths, n, extent,
+                         start_offset, out)
+        return out
+    out[:] = 0
+    for i in range(n):
+        off = int(offsets[i]) + start_offset
+        ln = min(int(lengths[i]) - start_offset, extent)
+        if off < 0 or ln <= 0 or off >= buf.size:
+            continue
+        ln = min(ln, buf.size - off)
+        out[i, :ln] = buf[off:off + ln]
+    return out

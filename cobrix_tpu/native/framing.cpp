@@ -1,0 +1,180 @@
+// Native record-framing and batch-packing runtime.
+//
+// The reference frames variable-length records on the JVM, one record per
+// iteration (VRLRecordReader.scala:151-186 RDW path, :114-149
+// record-length-field path; TextRecordExtractor.scala:27-103 for text),
+// and the sequential index pass walks the same loop (IndexGenerator.
+// scala:33). Here the host-side hot loops are C++: a single pass emits
+// every record's (offset, length) into flat arrays, and a second routine
+// packs selected records into the padded [batch, extent] uint8 matrix the
+// TPU decode kernels consume. Python keeps the slow/flexible paths
+// (custom extractors, copybook-driven length fields with exotic types).
+//
+// Exposed via a plain C ABI for ctypes binding (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Error codes (mirrors the hard-error semantics of
+// RecordHeaderParserRDW.scala: zero/oversized RDW kills the read).
+enum FramingStatus : int64_t {
+  FRAMING_OK = 0,
+  FRAMING_ZERO_LENGTH = -1,
+  FRAMING_TOO_BIG = -2,
+};
+
+static const int64_t kMaxRdwRecordSize = 100L * 1024 * 1024;  // 100 MB cap
+
+// Scan RDW (record descriptor word) headers.
+//   data/size:        whole file image
+//   big_endian:       1 = length in bytes [0..1], 0 = bytes [3..2]
+//   rdw_adjustment:   added to each header length
+//   file_header_bytes/file_footer_bytes: leading/trailing regions emitted
+//                     as *invalid* records (skipped here, but their bytes
+//                     are consumed) — reference RecordHeaderParserRDW
+//                     file-header handling
+//   offsets/lengths:  out arrays (caller-allocated, capacity max_records)
+//   error_pos:        byte position of a fatal header on error
+// Returns number of records, or a FramingStatus < 0.
+int64_t rdw_scan(const uint8_t* data, int64_t size, int32_t big_endian,
+                 int32_t rdw_adjustment, int64_t file_header_bytes,
+                 int64_t file_footer_bytes, int64_t* offsets,
+                 int64_t* lengths, int64_t max_records, int64_t* error_pos) {
+  int64_t pos = 0;
+  int64_t n = 0;
+  int64_t body_end = size;
+  if (file_footer_bytes > 0 && file_footer_bytes < size) {
+    body_end = size - file_footer_bytes;
+  }
+  while (pos + 4 <= body_end && n < max_records) {
+    // leading file-header region: consumed as an invalid record
+    if (file_header_bytes > 4 && pos == 0) {
+      pos = file_header_bytes;
+      continue;
+    }
+    int64_t len;
+    if (big_endian) {
+      len = (int64_t)data[pos + 1] + 256 * (int64_t)data[pos];
+    } else {
+      len = (int64_t)data[pos + 2] + 256 * (int64_t)data[pos + 3];
+    }
+    len += rdw_adjustment;
+    if (len <= 0) {
+      *error_pos = pos;
+      return FRAMING_ZERO_LENGTH;
+    }
+    if (len > kMaxRdwRecordSize) {
+      *error_pos = pos;
+      return FRAMING_TOO_BIG;
+    }
+    offsets[n] = pos + 4;
+    int64_t avail = body_end - (pos + 4);
+    lengths[n] = len < avail ? len : avail;
+    ++n;
+    pos += 4 + len;
+  }
+  return n;
+}
+
+// Scan records whose length comes from a field inside each record.
+//   field_offset/field_width: where the length field sits
+//   kind: 0 = unsigned binary big-endian, 1 = unsigned binary
+//         little-endian, 2 = zoned DISPLAY digits (EBCDIC F0-F9),
+//         3 = zoned DISPLAY digits (ASCII '0'-'9')
+//   length_adjust: added to the decoded value (e.g. +header size when the
+//                  field holds the payload length)
+// Stops cleanly at a record whose length field is unreadable (returns
+// records so far; *error_pos = position) — Python re-checks the tail.
+int64_t length_field_scan(const uint8_t* data, int64_t size,
+                          int64_t field_offset, int64_t field_width,
+                          int32_t kind, int64_t length_adjust,
+                          int64_t* offsets, int64_t* lengths,
+                          int64_t max_records, int64_t* error_pos) {
+  int64_t pos = 0;
+  int64_t n = 0;
+  while (pos < size && n < max_records) {
+    if (pos + field_offset + field_width > size) break;
+    const uint8_t* f = data + pos + field_offset;
+    int64_t value = 0;
+    if (kind == 0) {
+      for (int64_t i = 0; i < field_width; ++i) value = (value << 8) | f[i];
+    } else if (kind == 1) {
+      for (int64_t i = field_width - 1; i >= 0; --i)
+        value = (value << 8) | f[i];
+    } else {
+      for (int64_t i = 0; i < field_width; ++i) {
+        uint8_t d = f[i];
+        uint8_t digit;
+        if (kind == 2) {  // EBCDIC zoned
+          if (d == 0x40) continue;  // space
+          if (d < 0xF0 || d > 0xF9) { *error_pos = pos; return n; }
+          digit = d - 0xF0;
+        } else {  // ASCII
+          if (d == ' ') continue;
+          if (d < '0' || d > '9') { *error_pos = pos; return n; }
+          digit = d - '0';
+        }
+        value = value * 10 + digit;
+      }
+    }
+    value += length_adjust;
+    if (value <= 0) { *error_pos = pos; return n; }
+    offsets[n] = pos;
+    int64_t avail = size - pos;
+    lengths[n] = value < avail ? value : avail;
+    ++n;
+    pos += value;
+  }
+  return n;
+}
+
+// Scan text records delimited by LF / CRLF (reference TextRecordExtractor:
+// boundaries at EOL; CR stripped when followed by LF).
+int64_t text_scan(const uint8_t* data, int64_t size, int64_t* offsets,
+                  int64_t* lengths, int64_t max_records) {
+  int64_t pos = 0;
+  int64_t n = 0;
+  while (pos < size && n < max_records) {
+    int64_t eol = pos;
+    while (eol < size && data[eol] != '\n') ++eol;
+    int64_t end = eol;
+    if (end > pos && end <= size && end > 0 && data[end - 1] == '\r') --end;
+    offsets[n] = pos;
+    lengths[n] = end - pos;
+    ++n;
+    pos = eol < size ? eol + 1 : size;
+  }
+  return n;
+}
+
+// Pack selected records into a zero-padded [n, extent] row-major matrix.
+// start_offset skips leading bytes of each record (reference
+// record_start_offset semantics); bytes past a record's length are zero.
+void pack_records(const uint8_t* data, int64_t data_size,
+                  const int64_t* offsets, const int64_t* lengths, int64_t n,
+                  int64_t extent, int64_t start_offset, uint8_t* out) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t i = 0; i < n; ++i) {
+    uint8_t* row = out + i * extent;
+    int64_t off = offsets[i] + start_offset;
+    int64_t len = lengths[i] - start_offset;
+    if (len > extent) len = extent;
+    if (off < 0 || len <= 0 || off >= data_size) {
+      std::memset(row, 0, extent);
+      continue;
+    }
+    if (off + len > data_size) len = data_size - off;
+    std::memcpy(row, data + off, len);
+    if (len < extent) std::memset(row + len, 0, extent - len);
+  }
+}
+
+}  // extern "C"

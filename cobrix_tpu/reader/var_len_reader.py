@@ -318,6 +318,137 @@ class VarLenReader:
                 backend=backend)
         return self._decoders[key]
 
+    # -- vectorized fast framing (native scan) ------------------------------
+
+    def _frame_fast(self, stream: SimpleStream):
+        """Whole-shard RDW framing via the native scanner. Returns
+        (data, base_offset, offsets, lengths, segment_ids) or None when the
+        configuration needs the generic per-record reader (custom
+        extractors/parsers, text mode, length fields, variable OCCURS)."""
+        from .. import native
+
+        p = self.params
+        if (p.record_extractor or p.record_header_parser or p.is_text
+                or p.length_field_name or p.variable_size_occurs
+                or not p.is_record_sequence):
+            return None
+        base = stream.offset
+        data = stream.next(stream.size() - base)
+        adjustment = p.rdw_adjustment
+        if p.is_rdw_part_of_record_length:
+            adjustment -= 4
+        offsets, lengths = native.rdw_scan(
+            data, p.is_rdw_big_endian, adjustment,
+            # the file-header region rule only applies at the file start
+            p.file_start_offset if base == 0 else 0,
+            p.file_end_offset)
+        seg_field = resolve_segment_id_field(p, self.copybook)
+        segment_ids: Optional[List[str]] = None
+        if seg_field is not None:
+            segment_ids = self._segment_ids_vectorized(
+                data, offsets, lengths, seg_field)
+        return data, base, offsets, lengths, segment_ids
+
+    def _segment_ids_vectorized(self, data, offsets, lengths,
+                                seg_field: Primitive) -> List[str]:
+        """Per-record segment-id strings: gather just the id field's bytes,
+        decode each *unique* byte pattern once (the scalar oracle), then
+        broadcast — the columnar analogue of getSegmentId per record."""
+        from .. import native
+
+        start = self.params.start_offset
+        seg_off = seg_field.binary_properties.offset
+        seg_w = seg_field.binary_properties.actual_size
+        extent = start + seg_off + seg_w
+        packed = native.pack_records(data, offsets, lengths, extent)
+        field_bytes = packed[:, start + seg_off:]
+        short = lengths < extent  # id field truncated -> decode actual bytes
+        uniq, inverse = np.unique(field_bytes, axis=0, return_inverse=True)
+        options = DecodeOptions.from_copybook(self.copybook)
+        decoded = []
+        for row in uniq:
+            value = options.decode(seg_field.dtype, bytes(row))
+            decoded.append("" if value is None else str(value).strip())
+        out = [decoded[i] for i in inverse]
+        for i in np.nonzero(short)[0]:
+            chunk = bytes(packed[i, start + seg_off: int(lengths[i])])
+            value = options.decode(seg_field.dtype, chunk)
+            out[i] = "" if value is None else str(value).strip()
+        return out
+
+    def _read_rows_columnar_fast(self, data, base: int, offsets, lengths,
+                                 segment_ids: Optional[List[str]],
+                                 file_id: int, backend: str,
+                                 prefix: str,
+                                 start_record_id: int,
+                                 input_file_name: str) -> List[List[object]]:
+        params = self.params
+        seg = params.multisegment
+        n = len(offsets)
+        level_count = len(seg.segment_level_ids) if seg else 0
+        segment_filter = (set(seg.segment_id_filter)
+                          if seg and seg.segment_id_filter else None)
+        generate_input_file = bool(params.input_file_name_column)
+
+        keep = np.ones(n, dtype=bool)
+        level_ids_per_record: Optional[List[List[Optional[str]]]] = None
+        if level_count and segment_ids is not None:
+            acc = SegmentIdAccumulator(seg.segment_level_ids, prefix, file_id)
+            level_ids_per_record = []
+            for i in range(n):
+                acc.acquired_segment_id(segment_ids[i], start_record_id + i)
+                ids = [acc.get_segment_level_id(lv) for lv in range(level_count)]
+                level_ids_per_record.append(ids)
+                if ids and ids[0] is None:
+                    keep[i] = False  # before the first root segment
+        if segment_filter is not None and segment_ids is not None:
+            keep &= np.asarray(
+                [sid in segment_filter for sid in segment_ids], dtype=bool)
+
+        actives = (["" if segment_ids is None else
+                    self.segment_redefine_map.get(sid, "")
+                    for sid in segment_ids] if segment_ids is not None
+                   else [""] * n)
+        by_segment: Dict[str, np.ndarray] = {}
+        kept = np.nonzero(keep)[0]
+        active_arr = np.asarray(actives, dtype=object)
+        for active in set(active_arr[kept].tolist()):
+            mask = keep & (active_arr == active)
+            by_segment[active] = np.nonzero(mask)[0]
+
+        from .. import native
+        start = params.start_offset
+        rows_by_pos: Dict[int, List[object]] = {}
+        for active, positions in by_segment.items():
+            decoder = self._decoder_for_segment(active, backend)
+            extent = decoder.plan.max_extent
+            batch = native.pack_records(
+                data, offsets[positions], lengths[positions], extent,
+                start_offset=start)
+            seg_lengths = np.minimum(lengths[positions] - start, extent)
+            decoded = decoder.decode(batch, lengths=seg_lengths)
+            seg_rows = decoded.to_rows(
+                policy=params.schema_policy,
+                generate_record_id=False,
+                active_segments=[active or None] * len(positions))
+            for row_i, pos in enumerate(positions):
+                record_index = start_record_id + int(pos)
+                body = list(seg_rows[row_i])
+                seg_vals: List[object] = (
+                    list(level_ids_per_record[pos])
+                    if level_ids_per_record is not None else [])
+                if params.generate_record_id and generate_input_file:
+                    row = ([file_id, record_index, input_file_name]
+                           + seg_vals + body)
+                elif params.generate_record_id:
+                    row = [file_id, record_index] + seg_vals + body
+                elif generate_input_file:
+                    row = seg_vals + [input_file_name] + body
+                else:
+                    row = seg_vals + body
+                rows_by_pos[int(pos)] = row
+        return [rows_by_pos[i] for i in sorted(rows_by_pos)]
+
     def read_rows_columnar(self, stream: SimpleStream, file_id: int = 0,
                            backend: str = "numpy",
                            segment_id_prefix: Optional[str] = None,
@@ -333,6 +464,13 @@ class VarLenReader:
                 stream, file_id=file_id, start_record_id=start_record_id,
                 starting_file_offset=starting_file_offset,
                 segment_id_prefix=segment_id_prefix))
+        fast = self._frame_fast(stream)
+        if fast is not None:
+            data, base, offsets, lengths, segment_ids = fast
+            return self._read_rows_columnar_fast(
+                data, base, offsets, lengths, segment_ids, file_id, backend,
+                segment_id_prefix or default_segment_id_prefix(),
+                start_record_id, stream.input_file_name)
         params = self.params
         seg = params.multisegment
         prefix = segment_id_prefix or default_segment_id_prefix()
