@@ -84,6 +84,19 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.pack_records.argtypes = [
             _U8P, ctypes.c_int64, _I64P, _I64P, ctypes.c_int64,
             ctypes.c_int64, ctypes.c_int64, _U8P]
+        lib.decode_binary_cols.restype = None
+        lib.decode_binary_cols.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_int64, _I64P, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, _I64P, _U8P]
+        lib.decode_bcd_cols.restype = None
+        lib.decode_bcd_cols.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_int64, _I64P, ctypes.c_int64,
+            ctypes.c_int32, _I64P, _U8P]
+        lib.decode_display_cols.restype = None
+        lib.decode_display_cols.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_int64, _I64P, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, _I64P, _U8P, _I64P]
         _lib = lib
         return _lib
 
@@ -245,6 +258,72 @@ def text_scan(data) -> Tuple[np.ndarray, np.ndarray]:
         pos = int(eol) + 1
     return (np.asarray(out_o, dtype=np.int64),
             np.asarray(out_l, dtype=np.int64))
+
+
+DISPLAY_EBCDIC = 0
+DISPLAY_ASCII = 1
+
+
+def _batch_and_offsets(batch: np.ndarray, col_offsets: np.ndarray):
+    b = np.ascontiguousarray(batch, dtype=np.uint8)
+    offs = np.ascontiguousarray(col_offsets, dtype=np.int64)
+    return b, offs
+
+
+def decode_binary_cols(batch: np.ndarray, col_offsets: np.ndarray,
+                       width: int, signed: bool, big_endian: bool
+                       ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """All same-width COMP columns of a packed [n, extent] batch in one
+    native pass (ops/batch_np.decode_binary semantics). None when the
+    native library is unavailable (caller uses the numpy slab path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    b, offs = _batch_and_offsets(batch, col_offsets)
+    n, extent = b.shape
+    ncols = offs.shape[0]
+    values = np.empty((n, ncols), dtype=np.int64)
+    valid = np.empty((n, ncols), dtype=np.uint8)
+    lib.decode_binary_cols(b, n, extent, offs, ncols, width,
+                           int(signed), int(big_endian), values, valid)
+    return values, valid.view(bool)
+
+
+def decode_bcd_cols(batch: np.ndarray, col_offsets: np.ndarray, width: int
+                    ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """All same-width COMP-3 columns in one native pass
+    (ops/batch_np.decode_bcd semantics)."""
+    lib = _load()
+    if lib is None:
+        return None
+    b, offs = _batch_and_offsets(batch, col_offsets)
+    n, extent = b.shape
+    ncols = offs.shape[0]
+    values = np.empty((n, ncols), dtype=np.int64)
+    valid = np.empty((n, ncols), dtype=np.uint8)
+    lib.decode_bcd_cols(b, n, extent, offs, ncols, width, values, valid)
+    return values, valid.view(bool)
+
+
+def decode_display_cols(batch: np.ndarray, col_offsets: np.ndarray,
+                        width: int, kind: int, signed: bool, allow_dot: bool,
+                        require_digits: bool
+                        ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """All same-shaped DISPLAY numeric columns in one native pass
+    (ops/batch_np.decode_display_{ebcdic,ascii} semantics)."""
+    lib = _load()
+    if lib is None:
+        return None
+    b, offs = _batch_and_offsets(batch, col_offsets)
+    n, extent = b.shape
+    ncols = offs.shape[0]
+    values = np.empty((n, ncols), dtype=np.int64)
+    valid = np.empty((n, ncols), dtype=np.uint8)
+    dots = np.empty((n, ncols), dtype=np.int64)
+    lib.decode_display_cols(b, n, extent, offs, ncols, width, kind,
+                            int(signed), int(allow_dot), int(require_digits),
+                            values, valid, dots)
+    return values, valid.view(bool), dots
 
 
 def pack_records(data, offsets: np.ndarray, lengths: np.ndarray,

@@ -331,9 +331,44 @@ class ColumnarDecoder:
         for g in self.kernel_groups:
             if g.codec is Codec.HOST_FALLBACK:
                 continue
+            if self._run_group_native(g, arr, outputs):
+                continue
             slab = arr[:, g.offsets[:, None] + np.arange(g.width)[None, :]]
             self._run_group_numpy(g, slab, outputs)
         return outputs
+
+    def _run_group_native(self, g: _KernelGroup, arr: np.ndarray,
+                          outputs: Dict[int, dict]) -> bool:
+        """Single-pass C++ kernels reading straight from the packed batch
+        (no intermediate slab). False -> caller uses the numpy path."""
+        from .. import native
+
+        if g.codec is Codec.BINARY:
+            signed, big_endian, _ = g.variant
+            res = native.decode_binary_cols(
+                arr, g.offsets, g.width, signed, big_endian)
+            if res is None:
+                return False
+            self._store_numeric(g, outputs, *res)
+            return True
+        if g.codec is Codec.BCD:
+            res = native.decode_bcd_cols(arr, g.offsets, g.width)
+            if res is None:
+                return False
+            self._store_numeric(g, outputs, *res)
+            return True
+        if g.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
+            signed, allow_dot, require_digits, _ = g.variant
+            kind = (native.DISPLAY_EBCDIC if g.codec is Codec.DISPLAY_NUM
+                    else native.DISPLAY_ASCII)
+            res = native.decode_display_cols(
+                arr, g.offsets, g.width, kind, signed, allow_dot,
+                require_digits)
+            if res is None:
+                return False
+            self._store_numeric(g, outputs, *res)
+            return True
+        return False
 
     def _run_group_numpy(self, g: _KernelGroup, slab: np.ndarray,
                          outputs: Dict[int, dict]) -> None:
@@ -349,10 +384,7 @@ class ColumnarDecoder:
             fn = (batch_np.decode_display_ebcdic
                   if g.codec is Codec.DISPLAY_NUM else batch_np.decode_display_ascii)
             values, valid, dots = fn(slab, signed, allow_dot, require_digits)
-            for pos, c in enumerate(g.columns):
-                outputs[c.index] = {"values": values[:, pos],
-                                    "valid": valid[:, pos],
-                                    "dot_scale": dots[:, pos]}
+            self._store_numeric(g, outputs, values, valid, dots)
         elif g.codec is Codec.FLOAT_IBM:
             s = slab if g.columns[0].params.big_endian else slab[..., ::-1]
             values, valid = batch_np.decode_ibm_float32(s)
@@ -386,11 +418,15 @@ class ColumnarDecoder:
                 outputs[c.index] = {"bytes": slab[:, pos]}
 
     def _store_numeric(self, g: _KernelGroup, outputs: Dict[int, dict],
-                       values, valid) -> None:
+                       values, valid, dot_scale=None) -> None:
         values = np.asarray(values)
         valid = np.asarray(valid)
+        dots = None if dot_scale is None else np.asarray(dot_scale)
         for pos, c in enumerate(g.columns):
-            outputs[c.index] = {"values": values[:, pos], "valid": valid[:, pos]}
+            out = {"values": values[:, pos], "valid": valid[:, pos]}
+            if dots is not None:
+                out["dot_scale"] = dots[:, pos]
+            outputs[c.index] = out
 
     # -- jax backend ------------------------------------------------------
 
@@ -432,7 +468,9 @@ class ColumnarDecoder:
             padded[:n] = arr
         else:
             padded = arr
-        device_outs = self._jax_fn(padded)
+        # explicit H2D: the implicit transfer inside jit dispatch is far
+        # slower than device_put on remote-attached (tunneled) devices
+        device_outs = self._jax_fn(jax.device_put(padded))
         return self.collect_outputs(device_outs, n)
 
     def collect_outputs(self, device_outs, n: int) -> Dict[int, dict]:

@@ -1,0 +1,97 @@
+"""Native C++ columnar decode kernels vs the numpy blueprint.
+
+The C++ kernels (native/framing.cpp decode_*_cols) must match
+ops/batch_np exactly on arbitrary bytes — batch_np is itself pinned to
+the reference's scalar semantics by tests/test_scalar_decoders.py, so
+agreement here transitively pins the native path to the reference's
+malformed->null policy (DecoderSelector.scala:283-291).
+"""
+import numpy as np
+import pytest
+
+from cobrix_tpu import native
+from cobrix_tpu.ops import batch_np
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable")
+
+
+def _random_batch(rng, n, extent):
+    return rng.integers(0, 256, size=(n, extent), dtype=np.uint8)
+
+
+def _adversarial_bytes(rng, n, extent):
+    """Bytes biased toward the interesting classes: digits, signs,
+    spaces, sign nibbles, zeros."""
+    pool = np.array(
+        [0x00, 0x0C, 0x0D, 0x0F, 0x1C, 0x1D, 0x20, 0x2B, 0x2D, 0x2E,
+         0x2C, 0x30, 0x39, 0x40, 0x4B, 0x4E, 0x60, 0x6B, 0x80, 0x99,
+         0xC0, 0xC9, 0xD0, 0xD9, 0xF0, 0xF9, 0xFF], dtype=np.uint8)
+    return pool[rng.integers(0, len(pool), size=(n, extent))]
+
+
+@pytest.mark.parametrize("width", [1, 2, 4, 8])
+@pytest.mark.parametrize("signed", [False, True])
+@pytest.mark.parametrize("big_endian", [False, True])
+def test_binary_parity(width, signed, big_endian):
+    rng = np.random.default_rng(width * 100 + signed * 10 + big_endian)
+    batch = _random_batch(rng, 64, 64)
+    offsets = np.arange(0, 48, width, dtype=np.int64)
+    res = native.decode_binary_cols(batch, offsets, width, signed, big_endian)
+    slab = batch[:, offsets[:, None] + np.arange(width)[None, :]]
+    exp_v, exp_ok = batch_np.decode_binary(slab, signed, big_endian)
+    np.testing.assert_array_equal(res[0], exp_v)
+    np.testing.assert_array_equal(res[1], exp_ok)
+
+
+@pytest.mark.parametrize("width", [1, 2, 3, 5, 10])
+@pytest.mark.parametrize("gen", ["random", "adversarial"])
+def test_bcd_parity(width, gen):
+    rng = np.random.default_rng(width)
+    make = _random_batch if gen == "random" else _adversarial_bytes
+    batch = make(rng, 128, 64)
+    offsets = np.arange(0, 50, width, dtype=np.int64)
+    res = native.decode_bcd_cols(batch, offsets, width)
+    slab = batch[:, offsets[:, None] + np.arange(width)[None, :]]
+    exp_v, exp_ok = batch_np.decode_bcd(slab)
+    np.testing.assert_array_equal(res[0], exp_v)
+    np.testing.assert_array_equal(res[1], exp_ok)
+
+
+@pytest.mark.parametrize("kind,blueprint", [
+    (native.DISPLAY_EBCDIC, batch_np.decode_display_ebcdic),
+    (native.DISPLAY_ASCII, batch_np.decode_display_ascii),
+])
+@pytest.mark.parametrize("signed", [False, True])
+@pytest.mark.parametrize("allow_dot", [False, True])
+@pytest.mark.parametrize("require_digits", [False, True])
+@pytest.mark.parametrize("gen", ["random", "adversarial"])
+def test_display_parity(kind, blueprint, signed, allow_dot, require_digits,
+                        gen):
+    rng = np.random.default_rng(
+        kind * 31 + signed * 7 + allow_dot * 3 + require_digits)
+    make = _random_batch if gen == "random" else _adversarial_bytes
+    batch = make(rng, 128, 72)
+    width = 6
+    offsets = np.arange(0, 72 - width, width, dtype=np.int64)
+    res = native.decode_display_cols(
+        batch, offsets, width, kind, signed, allow_dot, require_digits)
+    slab = batch[:, offsets[:, None] + np.arange(width)[None, :]]
+    exp_v, exp_ok, exp_dots = blueprint(slab, signed, allow_dot,
+                                        require_digits)
+    np.testing.assert_array_equal(res[0], exp_v)
+    np.testing.assert_array_equal(res[1], exp_ok)
+    np.testing.assert_array_equal(res[2], exp_dots)
+
+
+def test_int64_wraparound_parity():
+    """>18-digit BCD mantissas wrap identically in C++ (uint64 internally)
+    and numpy int64 (JVM Long multiply-add semantics)."""
+    # 12 bytes = 23 digits, all 9s, positive sign -> wraps
+    rec = bytes([0x99] * 11 + [0x9C])
+    batch = np.frombuffer(rec, np.uint8)[None, :].copy()
+    offsets = np.array([0], dtype=np.int64)
+    res = native.decode_bcd_cols(batch, offsets, 12)
+    exp_v, exp_ok = batch_np.decode_bcd(batch[:, None, :])
+    np.testing.assert_array_equal(res[0], exp_v)
+    np.testing.assert_array_equal(res[1], exp_ok)
