@@ -33,6 +33,10 @@ class Copybook:
         self.ast = ast
         # decode-time options; carried to the scalar oracle and the plan compiler
         self.string_trimming_policy = string_trimming_policy
+        # fail fast on unknown code pages, like the reference's decoder
+        # binding at parse time (CodePage.getCodePageByName, CodePage.scala:~50)
+        from ..encoding.codepages import get_code_page_table
+        get_code_page_table(ebcdic_code_page)
         self.ebcdic_code_page = ebcdic_code_page
         self.ascii_charset = ascii_charset
         self.is_utf16_big_endian = is_utf16_big_endian

@@ -1,0 +1,222 @@
+"""Fused Pallas TPU kernel for the numeric decode hot plane.
+
+The decode of a record batch has two parts: byte *layout* (pulling each
+field's bytes out of the `[batch, record_len]` byte matrix) and byte
+*arithmetic* (turning those bytes into typed values + validity — the
+reference's per-field hot loop, RecordExtractors.scala:49 +
+BinaryNumberDecoders.scala:21, BCDNumberDecoders.scala:29).
+
+Layout stays in XLA: for a column group whose offsets form an arithmetic
+progression (the layout OCCURS arrays compile to — e.g. exp3's
+`STRATEGY-DETAIL OCCURS 2000` of `9(7) COMP` + `9(7) COMP-3`,
+TestDataGen4CompaniesWide.scala:37-54), byte ``j`` of every field is one
+strided slice `data[:, base+j::stride]` — a regular layout op XLA lowers
+well on TPU. Mosaic (the Pallas TPU compiler) does not currently support
+strided lane slices, minor-dim int8 reshapes, or u8 lane gathers inside a
+kernel, so doing the layout in-kernel is not expressible; the byte planes
+are computed in XLA and flow into the kernel.
+
+Arithmetic is the Pallas kernel: ONE launch decodes every eligible group —
+place-value accumulation, sign handling, digit/sign-nibble validity — as
+2D int32/bool VPU math over `[BATCH_TILE, count]` tiles, instead of one
+XLA op-chain per group. Groups must fit int32 lanes (the reference's Int
+precision bucket, Constants.scala:21-79); wide columns stay on the XLA
+gather path since TPUs have no native int64 lanes.
+
+Both paths produce identical (values, valid) pairs; parity is pinned by
+tests/test_pallas_kernels.py against the numpy blueprint kernels.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BATCH_TILE = 32  # uint8 sublane tile
+
+
+class StridedGroup:
+    """Static decode spec for one eligible kernel group.
+
+    base/stride/count describe the offset progression; width is the field
+    byte width; kind is "binary" or "bcd"; signed/big_endian apply to
+    binary only.
+    """
+
+    def __init__(self, base: int, stride: int, count: int, width: int,
+                 kind: str, signed: bool = False, big_endian: bool = True):
+        if count > 1 and stride < width:
+            raise ValueError("columns overlap: stride < width")
+        self.base = base
+        self.stride = stride
+        self.count = count
+        self.width = width
+        self.kind = kind
+        self.signed = signed
+        self.big_endian = big_endian
+
+    @property
+    def end(self) -> int:
+        return self.base + (self.count - 1) * self.stride + self.width
+
+
+def offsets_progression(offsets: Sequence[int]) -> Tuple[int, int] | None:
+    """(base, stride) if `offsets` is a non-decreasing arithmetic
+    progression, else None. A single column is a progression of stride 0."""
+    offs = list(int(o) for o in offsets)
+    if not offs:
+        return None
+    if len(offs) == 1:
+        return offs[0], 0
+    stride = offs[1] - offs[0]
+    if stride <= 0:
+        return None
+    for a, b in zip(offs, offs[1:]):
+        if b - a != stride:
+            return None
+    return offs[0], stride
+
+
+def _byte_planes(data, g: StridedGroup):
+    """XLA-side layout: byte j of every field in the group, j = 0..width-1.
+    Each plane is a [batch, count] strided slice of the byte matrix."""
+    planes = []
+    for j in range(g.width):
+        start = g.base + j
+        if g.count == 1:
+            planes.append(jax.lax.slice_in_dim(data, start, start + 1, axis=1))
+        else:
+            limit = start + (g.count - 1) * g.stride + 1
+            planes.append(jax.lax.slice_in_dim(
+                data, start, limit, stride=g.stride, axis=1))
+    return planes
+
+
+def _decode_binary_planes(planes, g: StridedGroup):
+    """W x [TB, K] uint8 -> ([TB, K] int32 values, [TB, K] bool valid)."""
+    w = g.width
+    order = range(w) if g.big_endian else range(w - 1, -1, -1)
+    acc = None
+    for j in order:
+        b = planes[j].astype(jnp.uint32)
+        acc = b if acc is None else (acc << 8) | b
+    nbits = 8 * w
+    valid = jnp.ones(acc.shape, dtype=jnp.bool_)
+    if g.signed:
+        if nbits == 32:
+            values = jax.lax.bitcast_convert_type(acc, jnp.int32)
+        else:
+            ivals = acc.astype(jnp.int32)
+            sign_bit = jnp.uint32(1 << (nbits - 1))
+            values = jnp.where((acc & sign_bit) != 0,
+                               ivals - jnp.int32(1 << nbits), ivals)
+    else:
+        # unsigned with the top bit set exceeds the declared precision
+        # bucket -> null (BinaryNumberDecoders.scala unsigned-overflow rule)
+        if w == 4:
+            valid = (acc >> 31) == 0
+        # bitcast + typed zero: keeps Mosaic off the x64-promoted int64
+        # conversion path (which recurses in its lowering); valid values
+        # have the top bit clear so the bitcast equals the value
+        values = jnp.where(valid, jax.lax.bitcast_convert_type(
+            acc, jnp.int32), jnp.int32(0))
+    return values, valid
+
+
+def _decode_bcd_planes(planes, g: StridedGroup):
+    """COMP-3: two digits per byte, trailing sign nibble
+    (BCDNumberDecoders.scala:29 semantics, int32 lanes)."""
+    w = g.width
+    acc = jnp.zeros(planes[0].shape, dtype=jnp.int32)
+    digit_ok = jnp.ones(acc.shape, dtype=jnp.bool_)
+    sign = None
+    for j in range(w):
+        b = planes[j].astype(jnp.int32)
+        high = (b >> 4) & 0x0F
+        low = b & 0x0F
+        digit_ok &= high < 10
+        acc = acc * 10 + high
+        if j + 1 < w:
+            digit_ok &= low < 10
+            acc = acc * 10 + low
+        else:
+            sign = low
+    sign_ok = (sign == 0x0C) | (sign == 0x0D) | (sign == 0x0F)
+    values = jnp.where(sign == 0x0D, -acc, acc)
+    valid = digit_ok & sign_ok
+    return jnp.where(valid, values, jnp.int32(0)), valid
+
+
+def _fused_kernel(groups: List[StridedGroup], *refs):
+    n_in = sum(g.width for g in groups)
+    in_refs, out_refs = refs[:n_in], refs[n_in:]
+    pos = 0
+    for i, g in enumerate(groups):
+        planes = [in_refs[pos + j][:] for j in range(g.width)]
+        pos += g.width
+        if g.kind == "binary":
+            values, valid = _decode_binary_planes(planes, g)
+        else:
+            values, valid = _decode_bcd_planes(planes, g)
+        out_refs[2 * i][:] = values
+        out_refs[2 * i + 1][:] = valid
+
+
+def build_fused_decode(groups: Sequence[StridedGroup], record_len: int,
+                       interpret: bool | None = None):
+    """Returns fn(data: [B, record_len] uint8) -> [(values, valid), ...]
+    (one int32/bool pair per group, batch-aligned with the input).
+
+    jit-traceable; pads the batch to the tile size, extracts the byte
+    planes in XLA, and runs the single fused pallas_call over batch tiles.
+    """
+    from jax.experimental import pallas as pl
+
+    groups = list(groups)
+    need_len = max([record_len] + [g.end for g in groups])
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def fn(data):
+        b = data.shape[0]
+        bpad = -b % BATCH_TILE
+        lpad = need_len - data.shape[1]
+        if bpad or lpad > 0:
+            data = jnp.pad(data, ((0, bpad), (0, max(lpad, 0))))
+        n_tiles = (b + bpad) // BATCH_TILE
+
+        def batch_row(i):
+            # typed zero: under jax_enable_x64 a literal 0 traces as i64
+            # and Mosaic rejects the (i32, i64) index tuple
+            return (i, jnp.int32(0))
+
+        inputs = []
+        in_specs = []
+        out_shapes = []
+        out_specs = []
+        for g in groups:
+            inputs.extend(_byte_planes(data, g))
+            in_specs.extend(
+                pl.BlockSpec((BATCH_TILE, g.count), batch_row)
+                for _ in range(g.width))
+            for dtype in (jnp.int32, jnp.bool_):
+                out_shapes.append(jax.ShapeDtypeStruct(
+                    (b + bpad, g.count), dtype))
+                out_specs.append(pl.BlockSpec(
+                    (BATCH_TILE, g.count), batch_row))
+        outs = pl.pallas_call(
+            functools.partial(_fused_kernel, groups),
+            grid=(n_tiles,),
+            in_specs=in_specs,
+            out_specs=out_specs,
+            out_shape=out_shapes,
+            interpret=interpret,
+        )(*inputs)
+        return [(outs[2 * i][:b], outs[2 * i + 1][:b])
+                for i in range(len(groups))]
+
+    return fn

@@ -75,6 +75,33 @@ class _KernelGroup:
         self.offsets = np.array([c.offset for c in columns], dtype=np.int64)
 
 
+def _pallas_group_spec(g: _KernelGroup):
+    """StridedGroup for the fused Pallas kernel, or None if the group needs
+    the XLA gather path (non-int32 lanes, irregular offsets, wide fields)."""
+    from ..ops import pallas_tpu
+
+    if g.codec is Codec.BINARY:
+        signed, big_endian, fits32 = g.variant
+        if not fits32 or g.width > 4:
+            return None
+        kind, kw = "binary", {"signed": signed, "big_endian": big_endian}
+    elif g.codec is Codec.BCD:
+        (fits32,) = g.variant
+        if not fits32 or g.width > 5:
+            return None
+        kind, kw = "bcd", {}
+    else:
+        return None
+    prog = pallas_tpu.offsets_progression(g.offsets)
+    if prog is None:
+        return None
+    base, stride = prog
+    if 0 < stride < g.width:
+        return None
+    return pallas_tpu.StridedGroup(base, stride, len(g.columns), g.width,
+                                   kind, **kw)
+
+
 class DecodedBatch:
     """Decoded columns of one record batch."""
 
@@ -308,7 +335,7 @@ class ColumnarDecoder:
                 padded = np.zeros((arr.shape[0], extent), dtype=np.uint8)
                 padded[:, :arr.shape[1]] = arr
                 arr = padded
-        if self.backend == "jax":
+        if self.backend in ("jax", "pallas"):
             outputs = self._decode_jax(arr)
         else:
             outputs = self._decode_numpy(arr)
@@ -434,7 +461,13 @@ class ColumnarDecoder:
         """The pure decode program: [batch, record_len] uint8 -> list of
         per-kernel-group output tuples. One XLA computation; suitable for
         `jax.jit` directly (single chip) or a sharded jit over a device mesh
-        (parallel.ShardedColumnarDecoder)."""
+        (parallel.ShardedColumnarDecoder).
+
+        backend "pallas": numeric groups whose offsets form an arithmetic
+        progression (OCCURS-array layouts) decode through the single fused
+        Pallas kernel — one VMEM pass of each batch tile for the whole
+        numeric plane (ops/pallas_tpu.py); remaining groups use the XLA
+        gather path below."""
         import jax.numpy as jnp
         from ..ops import batch_jax
 
@@ -442,15 +475,35 @@ class ColumnarDecoder:
         kernel_groups = self.kernel_groups
         lut = self.lut
 
+        fused = None
+        fused_indices: List[int] = []
+        if self.backend == "pallas":
+            from ..ops import pallas_tpu
+
+            strided = []
+            for gi, g in enumerate(kernel_groups):
+                sg = _pallas_group_spec(g)
+                if sg is not None:
+                    fused_indices.append(gi)
+                    strided.append(sg)
+            if strided:
+                fused = pallas_tpu.build_fused_decode(
+                    strided, self.plan.max_extent)
+
         def decode_all(data):
-            outs = []
-            for g in kernel_groups:
+            outs: List[tuple] = [None] * len(kernel_groups)
+            if fused is not None:
+                for gi, pair in zip(fused_indices, fused(data)):
+                    outs[gi] = pair
+            for gi, g in enumerate(kernel_groups):
+                if outs[gi] is not None:
+                    continue
                 if g.codec is Codec.HOST_FALLBACK:
-                    outs.append(())
+                    outs[gi] = ()
                     continue
                 offs = jnp.asarray(g.offsets)
                 slab = data[:, offs[:, None] + jnp.arange(g.width)[None, :]]
-                outs.append(self._run_group_jax(g, slab, jnp, batch_jax, lut))
+                outs[gi] = self._run_group_jax(g, slab, jnp, batch_jax, lut)
             return outs
 
         return decode_all
