@@ -87,10 +87,44 @@ def register_code_page(name: str, table: str) -> None:
     _CUSTOM[name] = table
 
 
+def load_code_page_class(class_path: str) -> str:
+    """Import and instantiate a user code-page class (the equivalent of the
+    reference's `getCodePageByClass` reflection loading,
+    CodePage.scala:~50-75) and register its table under the class path.
+
+    The class must expose the 256-entry EBCDIC->Unicode table as a `table`
+    attribute/property or a `get_table()` method."""
+    import importlib
+
+    module_name, _, cls_name = class_path.rpartition(".")
+    if not module_name:
+        raise ValueError(
+            f"Invalid code page class '{class_path}': expected a fully "
+            f"qualified 'module.ClassName' path")
+    try:
+        cls = getattr(importlib.import_module(module_name), cls_name)
+    except (ImportError, AttributeError) as e:
+        raise ValueError(
+            f"Unable to load code page class '{class_path}': {e}") from e
+    instance = cls()
+    table = getattr(instance, "table", None)
+    if table is None and hasattr(instance, "get_table"):
+        table = instance.get_table()
+    if not isinstance(table, str):
+        raise ValueError(
+            f"Code page class '{class_path}' must provide the 256-entry "
+            f"table via a 'table' attribute or a 'get_table()' method")
+    register_code_page(class_path, table)
+    return table
+
+
 def get_code_page_table(name: str) -> str:
-    """256-char Unicode string indexed by EBCDIC byte value."""
+    """256-char Unicode string indexed by EBCDIC byte value. Dotted names
+    are treated as custom code-page class paths and loaded on first use."""
     if name in _CUSTOM:
         return _CUSTOM[name]
+    if "." in name:
+        return load_code_page_class(name)
     try:
         return _TABLES[name]
     except KeyError:

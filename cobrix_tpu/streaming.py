@@ -1,0 +1,142 @@
+"""Micro-batch streaming reads.
+
+The equivalent of the reference's experimental DStream integration
+(`CobolStreamer.cobolStream`, spark-cobol
+source/streaming/CobolStreamer.scala:42-82): fixed-length records arrive
+as a stream — either an iterable of byte chunks (sockets, queues) or new
+files appearing in a directory (the `binaryRecordsStream` semantic) — and
+each micro-batch is decoded with the standard fixed-length reader into a
+`CobolData` batch. Record_Id numbering continues monotonically across
+batches so re-assembled streams stay reproducible.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Iterable, Iterator, Optional
+
+from .api import CobolData, list_input_files, parse_options
+from .reader.fixed_len_reader import FixedLenReader
+from .reader.schema import CobolOutputSchema
+
+
+class CobolStreamer:
+    """Decode a stream of fixed-length COBOL records in micro-batches.
+
+    Options are the standard `read_cobol` option keys (record layout,
+    schema policy, generate_record_id, ...). Variable-length streams are
+    not supported, matching the reference (CobolStreamer.scala uses the
+    fixed-length reader only).
+    """
+
+    def __init__(self, copybook_contents, backend: str = "numpy", **options):
+        params, _ = parse_options(options)
+        if params.is_record_sequence:
+            raise ValueError(
+                "Streaming supports fixed-length records only "
+                "(like the reference's CobolStreamer)")
+        self.backend = backend
+        self.reader = FixedLenReader(copybook_contents, params)
+        self.params = params
+        self._schema = CobolOutputSchema(
+            self.reader.copybook,
+            policy=params.schema_policy,
+            input_file_name_field=params.input_file_name_column,
+            generate_record_id=params.generate_record_id)
+        self._next_record_id = 0
+
+    @property
+    def record_size(self) -> int:
+        return self.reader.record_size
+
+    def _batch(self, data: bytes, file_id: int = 0,
+               input_file_name: str = "") -> CobolData:
+        rows = self.reader.read_rows(
+            data, backend=self.backend, file_id=file_id,
+            first_record_id=self._next_record_id,
+            input_file_name=input_file_name)
+        self._next_record_id += len(rows)
+        return CobolData(rows, self._schema)
+
+    # -- chunked byte stream ------------------------------------------------
+
+    def stream_chunks(self, chunks: Iterable[bytes]) -> Iterator[CobolData]:
+        """One decoded batch per incoming chunk (chunks need not align to
+        record boundaries; partial records carry over)."""
+        rs = self.record_size
+        pending = b""
+        for chunk in chunks:
+            pending += bytes(chunk)
+            usable = len(pending) - (len(pending) % rs)
+            if usable == 0:
+                continue
+            data, pending = pending[:usable], pending[usable:]
+            yield self._batch(data)
+        if pending:
+            raise ValueError(
+                f"Stream ended mid-record: {len(pending)} trailing bytes "
+                f"(record size {rs})")
+
+    # -- directory watching -------------------------------------------------
+
+    def stream_directory(self, path, poll_interval: float = 1.0,
+                         max_batches: Optional[int] = None,
+                         idle_timeout: Optional[float] = None
+                         ) -> Iterator[CobolData]:
+        """Yield one batch per new file appearing under `path` (the
+        `binaryRecordsStream` micro-batch semantic). Stops after
+        `max_batches` files, or after `idle_timeout` seconds without new
+        files (None = poll forever).
+
+        A file is consumed only once its size is stable across two polls
+        (an in-progress write is left for the next poll), and is marked
+        consumed only after a successful decode — a file that fails to
+        decode raises, and a restarted iteration retries it."""
+        consumed = set()
+        pending_sizes = {}
+        produced = 0
+        idle_since = time.monotonic()
+        while True:
+            try:
+                files = list_input_files(path)
+            except FileNotFoundError:
+                files = []  # directory/glob not created yet — keep polling
+            progressed = False
+            for f in files:
+                if f in consumed:
+                    continue
+                try:
+                    size = os.path.getsize(f)
+                except OSError:
+                    continue  # vanished between listing and stat
+                if pending_sizes.get(f) != size:
+                    pending_sizes[f] = size  # new or still growing
+                    continue
+                if size % self.record_size != 0:
+                    # stable but mid-record: still being appended (or
+                    # junk); leave pending — idle_timeout bounds the wait
+                    continue
+                with open(f, "rb") as fh:
+                    data = fh.read()
+                batch = self._batch(data, file_id=produced,
+                                    input_file_name=f)
+                consumed.add(f)
+                pending_sizes.pop(f, None)
+                yield batch
+                produced += 1
+                progressed = True
+                idle_since = time.monotonic()
+                if max_batches is not None and produced >= max_batches:
+                    return
+            if not progressed:
+                if (idle_timeout is not None
+                        and time.monotonic() - idle_since >= idle_timeout):
+                    return
+            time.sleep(poll_interval)
+
+
+def stream_cobol(copybook_contents, chunks: Iterable[bytes],
+                 backend: str = "numpy", **options) -> Iterator[CobolData]:
+    """Functional shorthand: decode an iterable of byte chunks."""
+    return CobolStreamer(copybook_contents, backend=backend,
+                         **options).stream_chunks(chunks)

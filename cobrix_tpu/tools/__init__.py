@@ -1,0 +1,4 @@
+"""Standalone operational tools (the reference's `replication/` package)."""
+from .replicate import replicate_files
+
+__all__ = ["replicate_files"]

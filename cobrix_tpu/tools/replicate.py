@@ -1,0 +1,77 @@
+"""Test-data replication tool.
+
+The equivalent of the reference's standalone test-data multiplier
+(spark-cobol replication/CobolBinaryFilesReplicator.scala:31-75 with
+HDFSFileWriter and IncrementalFileIdProvider): copy a set of binary
+mainframe files round-robin into a target directory, each copy under a new
+incremental file id, until a total byte budget is reached. Used to scale
+small golden files up to benchmark-sized datasets.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import List, Sequence
+
+
+def replicate_files(source_files: Sequence[str], target_dir: str,
+                    target_bytes: int, threads: int = 4) -> List[str]:
+    """Replicate `source_files` into `target_dir` until their cumulative
+    size reaches `target_bytes`. Returns the created file paths
+    (`<stem>_<id><ext>`, ids increasing from 0)."""
+    sources = [s for s in source_files if os.path.getsize(s) > 0]
+    if not sources:
+        raise ValueError("No non-empty source files to replicate")
+    if target_bytes <= 0:
+        raise ValueError(f"Invalid byte budget {target_bytes}")
+    os.makedirs(target_dir, exist_ok=True)
+
+    lock = threading.Lock()
+    state = {"bytes": 0, "next_id": 0, "round_robin": 0}
+    created: List[str] = []
+
+    def claim():
+        """One replication task: (source, target path) or None when the
+        budget is spent."""
+        with lock:
+            if state["bytes"] >= target_bytes:
+                return None
+            src = sources[state["round_robin"] % len(sources)]
+            state["round_robin"] += 1
+            state["bytes"] += os.path.getsize(src)
+            file_id = state["next_id"]
+            state["next_id"] += 1
+        stem, ext = os.path.splitext(os.path.basename(src))
+        dst = os.path.join(target_dir, f"{stem}_{file_id}{ext}")
+        return src, dst
+
+    errors: List[BaseException] = []
+
+    def worker():
+        while True:
+            with lock:
+                if errors:
+                    return
+            task = claim()
+            if task is None:
+                return
+            src, dst = task
+            try:
+                shutil.copyfile(src, dst)
+            except BaseException as e:
+                with lock:
+                    errors.append(e)
+                return
+            with lock:
+                created.append(dst)
+
+    pool = [threading.Thread(target=worker) for _ in range(max(1, threads))]
+    for t in pool:
+        t.start()
+    for t in pool:
+        t.join()
+    if errors:
+        raise RuntimeError(
+            f"Replication failed after {len(created)} copies") from errors[0]
+    return sorted(created)

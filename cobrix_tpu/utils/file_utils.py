@@ -1,0 +1,37 @@
+"""File-scanning helpers.
+
+Equivalents of the reference's `FileUtils` (spark-cobol
+utils/FileUtils.scala:54-228): recursive globbed listing skipping hidden
+files (re-exported from the API layer) and the non-divisible-file scan
+used to validate fixed-length inputs before launching a read
+(FileUtils.findAndLogAllNonDivisibleFiles, used by
+CobolScanners.scala:88).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Sequence, Tuple
+
+from ..api import list_input_files  # noqa: F401  (re-export)
+
+
+def find_non_divisible_files(path, divisor: int) -> List[Tuple[str, int]]:
+    """(file, size) for every input file whose byte size is not a multiple
+    of `divisor` (the record size). Empty list means the fixed-length read
+    is safe."""
+    if divisor < 1:
+        raise ValueError(f"Invalid divisor {divisor}")
+    out: List[Tuple[str, int]] = []
+    for f in list_input_files(path):
+        size = os.path.getsize(f)
+        if size % divisor != 0:
+            out.append((f, size))
+    return out
+
+
+def get_number_of_files(path) -> int:
+    return len(list_input_files(path))
+
+
+def total_size(path) -> int:
+    return sum(os.path.getsize(f) for f in list_input_files(path))

@@ -1,0 +1,228 @@
+"""Tests for the auxiliary surface: flatten/convert utils (SparkUtils
+equivalents), the micro-batch streaming API (CobolStreamer equivalent),
+custom code-page class loading, and the replication tool."""
+import os
+
+import numpy as np
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.encoding.codepages import get_code_page_table
+from cobrix_tpu.streaming import CobolStreamer, stream_cobol
+from cobrix_tpu.tools import replicate_files
+from cobrix_tpu.utils import (
+    convert_fields_to_strings,
+    find_non_divisible_files,
+    flatten_schema,
+    list_input_files,
+    total_size,
+)
+
+COPYBOOK = """
+        01  R.
+            05  GRP.
+               10  NAME   PIC X(4).
+               10  NUM    PIC 9(3)  COMP-3.
+            05  CNT    PIC 9(1).
+            05  TAGS   PIC X(2) OCCURS 3 DEPENDING ON CNT.
+"""
+
+SIMPLE = """
+        01  R.
+            05  A PIC 9(7) COMP.
+            05  B PIC X(3).
+"""
+
+
+def _simple_records(n, start=0):
+    # A = i (4-byte BE), B = 'Rnn'
+    return b"".join((start + i).to_bytes(4, "big")
+                    + f"R{(start + i) % 100:02d}".encode("ascii")
+                    for i in range(n))
+
+
+@pytest.fixture
+def simple_file(tmp_path):
+    p = tmp_path / "data.bin"
+    p.write_bytes(_simple_records(6))
+    return str(p)
+
+
+class TestFlatten:
+    def test_flatten_structs_and_arrays(self, tmp_path):
+        # CNT field drives a variable OCCURS; flattening must project to
+        # the max observed element count
+        # variable_size_occurs: records shrink to the actual OCCURS count
+        # (reference VarOccursRecordExtractor semantics)
+        recs = []
+        for cnt, tags in ((2, b"aabb"), (3, b"ccddee")):
+            recs.append(b"ABCD" + b"\x12\x3C" + str(cnt).encode() + tags)
+        p = tmp_path / "d.bin"
+        p.write_bytes(b"".join(recs))
+        data = read_cobol(str(p), copybook_contents=COPYBOOK,
+                          encoding="ascii", schema_retention_policy="collapse_root",
+                          variable_size_occurs="true")
+        flat = flatten_schema(data)
+        names = flat.schema.field_names()
+        assert names == ["GRP_NAME", "GRP_NUM", "CNT", "TAGS_1", "TAGS_2",
+                         "TAGS_3"]
+        rows = flat.to_rows()
+        assert rows[0] == ["ABCD", 123, 2, "aa", "bb", None]
+        assert rows[1] == ["ABCD", 123, 3, "cc", "dd", "ee"]
+
+    def test_flatten_array_nested_in_struct(self, tmp_path):
+        """Arrays below a kept root struct must still produce columns
+        (review regression: nested-array paths were dropped from the
+        schema while their values were emitted)."""
+        recs = []
+        for cnt, tags in ((2, b"aabb"), (3, b"ccddee")):
+            recs.append(b"ABCD" + b"\x12\x3C" + str(cnt).encode() + tags)
+        p = tmp_path / "d.bin"
+        p.write_bytes(b"".join(recs))
+        data = read_cobol(str(p), copybook_contents=COPYBOOK,
+                          encoding="ascii", variable_size_occurs="true")
+        flat = flatten_schema(data)
+        names = flat.schema.field_names()
+        assert names == ["R_GRP_NAME", "R_GRP_NUM", "R_CNT",
+                         "R_TAGS_1", "R_TAGS_2", "R_TAGS_3"]
+        for row in flat.to_rows():
+            assert len(row) == len(names)
+        assert flat.to_rows()[1] == ["ABCD", 123, 3, "cc", "dd", "ee"]
+
+    def test_convert_fields_to_strings(self, simple_file):
+        data = read_cobol(simple_file, copybook_contents=SIMPLE,
+                          encoding="ascii",
+                          schema_retention_policy="collapse_root")
+        s = convert_fields_to_strings(data)
+        assert all(t.name == "string" for t in
+                   (f.dtype for f in s.schema.fields))
+        assert s.to_rows()[0] == ["0", "R00"]
+
+
+class TestFileUtils:
+    def test_non_divisible_scan(self, tmp_path):
+        good = tmp_path / "good.bin"
+        good.write_bytes(b"\x00" * 21)
+        bad = tmp_path / "bad.bin"
+        bad.write_bytes(b"\x00" * 20)
+        hidden = tmp_path / ".hidden"
+        hidden.write_bytes(b"\x00" * 5)
+        res = find_non_divisible_files(str(tmp_path), 7)
+        assert res == [(str(bad), 20)]
+        assert total_size(str(tmp_path)) == 41
+        assert [os.path.basename(f) for f in list_input_files(str(tmp_path))] \
+            == ["bad.bin", "good.bin"]
+
+
+class TestStreaming:
+    def test_stream_chunks_partial_records(self):
+        # 7-byte records delivered in chunks that straddle boundaries
+        payload = _simple_records(10)
+        chunks = [payload[:10], payload[10:11], payload[11:40], payload[40:]]
+        batches = list(stream_cobol(
+            SIMPLE, chunks, encoding="ascii",
+            schema_retention_policy="collapse_root"))
+        rows = [r for b in batches for r in b.to_rows()]
+        assert [r[0] for r in rows] == list(range(10))
+        assert len(batches) >= 2
+
+    def test_stream_chunks_trailing_garbage(self):
+        streamer = CobolStreamer(SIMPLE, encoding="ascii")
+        with pytest.raises(ValueError, match="mid-record"):
+            list(streamer.stream_chunks([b"\x00" * 3]))
+
+    def test_record_ids_continue_across_batches(self):
+        payload = _simple_records(4)
+        streamer = CobolStreamer(
+            SIMPLE, encoding="ascii", generate_record_id="true",
+            schema_retention_policy="collapse_root")
+        batches = list(streamer.stream_chunks([payload[:14], payload[14:]]))
+        ids = [r[1] for b in batches for r in b.to_rows()]
+        assert ids == [0, 1, 2, 3]
+
+    def test_stream_directory(self, tmp_path):
+        (tmp_path / "a.bin").write_bytes(_simple_records(2))
+        (tmp_path / "b.bin").write_bytes(_simple_records(3, start=2))
+        streamer = CobolStreamer(SIMPLE, encoding="ascii",
+                                 schema_retention_policy="collapse_root")
+        batches = list(streamer.stream_directory(
+            str(tmp_path), poll_interval=0.01, max_batches=2))
+        assert [len(b) for b in batches] == [2, 3]
+        vals = [r[0] for b in batches for r in b.to_rows()]
+        assert vals == [0, 1, 2, 3, 4]
+
+    def test_stream_directory_waits_for_missing_path(self, tmp_path):
+        streamer = CobolStreamer(SIMPLE, encoding="ascii")
+        missing = str(tmp_path / "landing")
+        batches = list(streamer.stream_directory(
+            missing, poll_interval=0.01, idle_timeout=0.05))
+        assert batches == []  # polls instead of raising FileNotFoundError
+
+    def test_stream_directory_skips_growing_file(self, tmp_path):
+        """A file whose size changes between polls is not consumed until
+        stable; a failed decode leaves it unconsumed for retry."""
+        p = tmp_path / "grow.bin"
+        p.write_bytes(_simple_records(1)[:4])  # partial record
+        streamer = CobolStreamer(SIMPLE, encoding="ascii",
+                                 schema_retention_policy="collapse_root")
+        gen = streamer.stream_directory(str(tmp_path), poll_interval=0.01,
+                                        max_batches=1, idle_timeout=1.0)
+        import threading
+        import time
+
+        def finish_write():
+            time.sleep(0.05)
+            p.write_bytes(_simple_records(3))
+
+        t = threading.Thread(target=finish_write)
+        t.start()
+        batches = list(gen)
+        t.join()
+        assert [len(b) for b in batches] == [3]
+
+    def test_rejects_variable_length(self):
+        with pytest.raises(ValueError, match="fixed-length"):
+            CobolStreamer(SIMPLE, is_record_sequence="true")
+
+
+class FakeCodePage:
+    """Mirrors the reference's FakeCodePage custom-code-page test class
+    (encoding/codepage/FakeCodePage.scala)."""
+
+    @property
+    def table(self):
+        t = ["#"] * 256
+        t[0xC1] = "A"
+        t[0xC2] = "B"
+        return "".join(t)
+
+
+class TestCustomCodePage:
+    def test_load_by_class_path(self, tmp_path):
+        from cobrix_tpu import parse_copybook
+        from cobrix_tpu.reader.extractors import DecodeOptions, extract_record
+
+        cls_path = f"{__name__}.FakeCodePage"
+        assert get_code_page_table(cls_path)[0xC1] == "A"
+        cb = parse_copybook("        01  R.\n            05  F PIC X(3).\n",
+                            ebcdic_code_page=cls_path)
+        row = extract_record(cb.ast, b"\xC1\xC2\xC3",
+                             options=DecodeOptions.from_copybook(cb))
+        assert row == [("AB#",)]
+
+    def test_bad_class_path(self):
+        with pytest.raises(ValueError, match="Unable to load"):
+            get_code_page_table("no.such.module.Cls")
+
+
+class TestReplication:
+    def test_replicate_to_budget(self, tmp_path):
+        src = tmp_path / "src.bin"
+        src.write_bytes(b"\x01" * 100)
+        out = tmp_path / "out"
+        created = replicate_files([str(src)], str(out), target_bytes=450,
+                                  threads=3)
+        assert len(created) == 5  # 5 x 100 bytes reaches the 450 budget
+        assert sum(os.path.getsize(f) for f in created) == 500
+        assert sorted(os.path.basename(f) for f in created) == [
+            f"src_{i}.bin" for i in range(5)]
