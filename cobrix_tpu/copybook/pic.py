@@ -160,7 +160,9 @@ def _parse_numeric(original: str, runs: List[Tuple[str, int]], enc: Encoding):
     if z1 + n1 + n2 + z2 == 0:
         raise PicParseError(f"Error reading PIC {original!r}")
     is_z = z1 + z2 > 0
-    if is_z and (has_s or sign_char):
+    if is_z and has_s:
+        # reference Z regexes carry no S flag; explicit +/- signs are fine
+        # (grammar rule trailingSign/leadingSign wraps any precision9)
         raise PicParseError(f"Z pictures cannot be signed: {original!r}")
 
     s_prefix = "S" if has_s else ""

@@ -202,9 +202,13 @@ class DecodedBatch:
                 generate_input_file_field: bool = False,
                 input_file_name: str = "",
                 segment_level_ids: Optional[List[List[object]]] = None,
-                active_segments: Optional[Sequence[Optional[str]]] = None
+                active_segments: Optional[Sequence[Optional[str]]] = None,
+                record_ids: Optional[Sequence[int]] = None
                 ) -> List[List[object]]:
-        """Assemble nested rows (same shape as reader.extractors.extract_record)."""
+        """Assemble nested rows (same shape as reader.extractors.extract_record).
+        `record_ids` overrides the sequential first_record_id+i numbering
+        (used when a batch holds non-contiguous records, e.g. one segment
+        of a multisegment file)."""
         rows = []
         for i in range(self.n_records):
             active = active_segments[i] if active_segments is not None else None
@@ -219,10 +223,12 @@ class DecodedBatch:
             else:
                 body = records
             seg = list(segment_level_ids[i]) if segment_level_ids else []
+            rid = (record_ids[i] if record_ids is not None
+                   else first_record_id + i)
             if generate_record_id and generate_input_file_field:
-                row = [file_id, first_record_id + i, input_file_name] + seg + body
+                row = [file_id, rid, input_file_name] + seg + body
             elif generate_record_id:
-                row = [file_id, first_record_id + i] + seg + body
+                row = [file_id, rid] + seg + body
             elif generate_input_file_field:
                 row = seg + [input_file_name] + body
             else:

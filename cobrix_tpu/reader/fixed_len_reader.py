@@ -15,6 +15,7 @@ from ..copybook.copybook import Copybook, merge_copybooks, parse_copybook
 from .columnar import ColumnarDecoder, DecodedBatch
 from .extractors import DecodeOptions, extract_record
 from .parameters import ReaderParameters
+from .vrl_reader import decode_segment_id_bytes, resolve_segment_id_field
 
 
 class FixedLenReader:
@@ -23,12 +24,16 @@ class FixedLenReader:
             contents_list = [copybook_contents]
         else:
             contents_list = list(copybook_contents)
+        seg = params.multisegment
         copybooks = [
             parse_copybook(
                 c,
                 data_encoding=params.data_encoding,
                 drop_group_fillers=params.drop_group_fillers,
                 drop_value_fillers=params.drop_value_fillers,
+                segment_redefines=sorted(set(
+                    (seg.segment_id_redefine_map or {}).values())) if seg else (),
+                field_parent_map=dict(seg.field_parent_map) if seg else None,
                 string_trimming_policy=params.string_trimming_policy,
                 comment_policy=params.comment_policy,
                 ebcdic_code_page=params.ebcdic_code_page,
@@ -42,7 +47,10 @@ class FixedLenReader:
         self.copybook = (copybooks[0] if len(copybooks) == 1
                          else merge_copybooks(copybooks))
         self.params = params
+        self.segment_redefine_map = dict(
+            seg.segment_id_redefine_map) if seg else {}
         self._decoder: Optional[ColumnarDecoder] = None
+        self._seg_decoders: dict = {}
 
     @property
     def record_size(self) -> int:
@@ -89,25 +97,36 @@ class FixedLenReader:
             self._decoder = ColumnarDecoder(self.copybook, backend=backend)
         return self._decoder
 
+    def _trimmed_matrix(self, matrix: np.ndarray):
+        """Strip record start/end offsets to the copybook layout width.
+        Returns (trimmed, width) — width < record_size means columns past a
+        record's end must be nulled via `lengths`."""
+        start = self.params.start_offset
+        rs_cb = self.copybook.record_size
+        width = min(rs_cb, matrix.shape[1] - start)
+        if start or self.params.end_offset or matrix.shape[1] != rs_cb:
+            trimmed = np.zeros((matrix.shape[0], rs_cb), dtype=np.uint8)
+            trimmed[:, :width] = matrix[:, start: start + width]
+            return trimmed, width
+        return matrix, width
+
     def decode_batch(self, data: bytes, backend: str = "numpy",
                      ignore_file_size: bool = False) -> DecodedBatch:
         self.check_binary_data_validity(len(data), ignore_file_size)
         matrix = self.to_record_matrix(data, ignore_file_size)
-        start = self.params.start_offset
-        rs_cb = self.copybook.record_size
-        if start or self.params.end_offset or matrix.shape[1] != rs_cb:
-            width = min(rs_cb, matrix.shape[1] - start)
-            trimmed = np.zeros((matrix.shape[0], rs_cb), dtype=np.uint8)
-            trimmed[:, :width] = matrix[:, start: start + width]
-            lengths = np.full(matrix.shape[0], width, dtype=np.int64)
-            return self.decoder(backend).decode(
-                trimmed, lengths=lengths if width < rs_cb else None)
-        return self.decoder(backend).decode(matrix)
+        trimmed, width = self._trimmed_matrix(matrix)
+        lengths = (np.full(matrix.shape[0], width, dtype=np.int64)
+                   if width < self.copybook.record_size else None)
+        return self.decoder(backend).decode(trimmed, lengths=lengths)
 
     def read_rows(self, data: bytes, backend: str = "numpy", file_id: int = 0,
                   first_record_id: int = 0,
                   input_file_name: str = "",
                   ignore_file_size: bool = False) -> List[List[object]]:
+        if self._is_multisegment:
+            return self._read_rows_multiseg(
+                data, backend, file_id, first_record_id, input_file_name,
+                ignore_file_size)
         batch = self.decode_batch(data, backend, ignore_file_size)
         return batch.to_rows(
             policy=self.params.schema_policy,
@@ -116,6 +135,74 @@ class FixedLenReader:
             first_record_id=first_record_id,
             generate_input_file_field=bool(self.params.input_file_name_column),
             input_file_name=input_file_name)
+
+    # -- multisegment fixed-length records ---------------------------------
+    # (reference FixedLenNestedRowIterator.scala:~55-66: per-record segment
+    # redefine choice + segment filter over fixed-size records)
+
+    @property
+    def _is_multisegment(self) -> bool:
+        seg = self.params.multisegment
+        return bool(seg and seg.segment_id_field
+                    and (self.segment_redefine_map or seg.segment_id_filter))
+
+    def _decoder_for_segment(self, active: str,
+                             backend: str) -> ColumnarDecoder:
+        key = f"{active}|{backend}"
+        if key not in self._seg_decoders:
+            self._seg_decoders[key] = ColumnarDecoder(
+                self.copybook, active_segment=active or None, backend=backend)
+        return self._seg_decoders[key]
+
+    def _segment_values(self, matrix: np.ndarray) -> List[str]:
+        """Per-record segment-id strings (shared unique-pattern decode with
+        the variable-length reader)."""
+        seg_field = resolve_segment_id_field(self.params, self.copybook)
+        start = self.params.start_offset
+        off = start + seg_field.binary_properties.offset
+        w = seg_field.binary_properties.actual_size
+        return decode_segment_id_bytes(
+            matrix[:, off:off + w], seg_field,
+            DecodeOptions.from_copybook(self.copybook))
+
+    def _read_rows_multiseg(self, data: bytes, backend: str, file_id: int,
+                            first_record_id: int, input_file_name: str,
+                            ignore_file_size: bool) -> List[List[object]]:
+        params = self.params
+        seg = params.multisegment
+        self.check_binary_data_validity(len(data), ignore_file_size)
+        matrix = self.to_record_matrix(data, ignore_file_size)
+        segment_ids = self._segment_values(matrix)
+
+        keep = np.ones(matrix.shape[0], dtype=bool)
+        if seg.segment_id_filter:
+            allowed = set(seg.segment_id_filter)
+            keep &= np.asarray([s in allowed for s in segment_ids], dtype=bool)
+        actives = np.asarray(
+            [self.segment_redefine_map.get(s, "") for s in segment_ids],
+            dtype=object)
+
+        trimmed, width = self._trimmed_matrix(matrix)
+
+        rows_by_pos = {}
+        kept = np.nonzero(keep)[0]
+        for active in set(actives[kept].tolist()):
+            positions = np.nonzero(keep & (actives == active))[0]
+            decoder = self._decoder_for_segment(active, backend)
+            lengths = (np.full(len(positions), width, dtype=np.int64)
+                       if width < self.copybook.record_size else None)
+            decoded = decoder.decode(trimmed[positions], lengths=lengths)
+            seg_rows = decoded.to_rows(
+                policy=params.schema_policy,
+                generate_record_id=params.generate_record_id,
+                file_id=file_id,
+                record_ids=[first_record_id + int(p) for p in positions],
+                generate_input_file_field=bool(params.input_file_name_column),
+                input_file_name=input_file_name,
+                active_segments=[active or None] * len(positions))
+            for row_i, pos in enumerate(positions):
+                rows_by_pos[int(pos)] = seg_rows[row_i]
+        return [rows_by_pos[i] for i in sorted(rows_by_pos)]
 
     def iter_rows_host(self, data: bytes, file_id: int = 0,
                        first_record_id: int = 0,
@@ -126,7 +213,17 @@ class FixedLenReader:
         self.check_binary_data_validity(len(data), ignore_file_size)
         matrix = self.to_record_matrix(data, ignore_file_size)
         options = DecodeOptions.from_copybook(self.copybook)
+        seg = self.params.multisegment
+        segment_ids = (self._segment_values(matrix)
+                       if self._is_multisegment else None)
+        allowed = (set(seg.segment_id_filter)
+                   if seg and seg.segment_id_filter else None)
         for i in range(matrix.shape[0]):
+            active = ""
+            if segment_ids is not None:
+                if allowed is not None and segment_ids[i] not in allowed:
+                    continue
+                active = self.segment_redefine_map.get(segment_ids[i], "")
             yield extract_record(
                 self.copybook.ast,
                 matrix[i].tobytes(),
@@ -136,6 +233,7 @@ class FixedLenReader:
                 generate_record_id=self.params.generate_record_id,
                 file_id=file_id,
                 record_id=first_record_id + i,
+                active_segment_redefine=active,
                 generate_input_file_field=bool(self.params.input_file_name_column),
                 input_file_name=input_file_name,
                 options=options)

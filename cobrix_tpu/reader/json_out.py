@@ -10,6 +10,7 @@ from __future__ import annotations
 import base64
 import decimal as _decimal
 import json
+import re
 import math
 import struct
 from typing import Iterable, List, Sequence
@@ -140,12 +141,17 @@ def _render_value(value, dtype):
         except (TypeError, ValueError, _decimal.InvalidOperation):
             return None
         try:
-            q = d.quantize(PyDecimal(1).scaleb(-s), rounding=_decimal.ROUND_HALF_UP)
+            # the default 28-digit context breaks COBOL's 38-digit range
+            with _decimal.localcontext() as ctx:
+                ctx.prec = 60
+                q = d.quantize(PyDecimal(1).scaleb(-s),
+                               rounding=_decimal.ROUND_HALF_UP)
         except _decimal.InvalidOperation:
             return None
         _, digits, exp = q.as_tuple()
-        # overflow check: number of integral digits must fit precision - scale
-        int_digits = max(len(digits) + exp, 1) if exp < 0 else len(digits) + exp
+        # overflow check: number of integral digits must fit precision -
+        # scale (zero for pure fractions like 0.3050393 in decimal(7,7))
+        int_digits = max(len(digits) + exp, 0)
         if int_digits > p - s:
             return None
         return _RawNum(format(q, "f"))
@@ -161,14 +167,37 @@ def _render_struct(values: Sequence[object], schema: StructType) -> dict:
     return out
 
 
+_STR_ESCAPES = {'"': '\\"', "\\": "\\\\", "\n": "\\n", "\r": "\\r",
+                "\t": "\\t", "\b": "\\b", "\f": "\\f"}
+_NEEDS_ESCAPE = re.compile(r'[\x00-\x1f"\\]')
+
+
+def _escape_char(m) -> str:
+    ch = m.group(0)
+    esc = _STR_ESCAPES.get(ch)
+    return esc if esc is not None else "\\u%04X" % ord(ch)
+
+
+def _json_str(s: str) -> str:
+    """Jackson-style string escaping: control chars as uppercase \\u00XX
+    (Python's json emits lowercase hex, which breaks byte-for-byte golden
+    parity on non-printable data). Unescaped strings — the vast majority —
+    take the no-copy fast path."""
+    if _NEEDS_ESCAPE.search(s) is None:
+        return f'"{s}"'
+    return '"' + _NEEDS_ESCAPE.sub(_escape_char, s) + '"'
+
+
 def _dump(obj) -> str:
     if isinstance(obj, _RawNum):
         return obj.text
     if isinstance(obj, dict):
-        return "{" + ",".join(f"{json.dumps(k, ensure_ascii=False)}:{_dump(v)}"
+        return "{" + ",".join(f"{_json_str(k)}:{_dump(v)}"
                               for k, v in obj.items()) + "}"
     if isinstance(obj, list):
         return "[" + ",".join(_dump(v) for v in obj) + "]"
+    if isinstance(obj, str):
+        return _json_str(obj)
     return json.dumps(obj, ensure_ascii=False)
 
 

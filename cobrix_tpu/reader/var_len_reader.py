@@ -42,7 +42,11 @@ from .raw_extractors import (
     create_raw_record_extractor,
 )
 from .stream import SimpleStream
-from .vrl_reader import VRLRecordReader, resolve_segment_id_field
+from .vrl_reader import (
+    VRLRecordReader,
+    decode_segment_id_bytes,
+    resolve_segment_id_field,
+)
 
 
 class SegmentIdAccumulator:
@@ -88,6 +92,35 @@ def default_segment_id_prefix() -> str:
     return time.strftime("%Y%m%d%H%M%S")
 
 
+def _has_dynamic_occurs_layout(root: Group) -> bool:
+    """True when a variable-size OCCURS makes later field offsets
+    record-dependent: a DEPENDING ON array followed by any other field, or
+    nested inside another array. A single *trailing* depending array keeps
+    static element offsets and stays on the columnar path."""
+    state = {"after_var_array": False, "dynamic": False}
+
+    def walk(group: Group, in_array: bool) -> None:
+        for st in group.children:
+            if state["dynamic"]:
+                return
+            if state["after_var_array"]:
+                state["dynamic"] = True
+                return
+            is_dep_array = st.is_array and st.depending_on is not None
+            if is_dep_array and in_array:
+                state["dynamic"] = True
+                return
+            if isinstance(st, Group):
+                walk(st, in_array or st.is_array)
+                if state["dynamic"]:
+                    return
+            if is_dep_array:
+                state["after_var_array"] = True
+
+    walk(root, False)
+    return state["dynamic"]
+
+
 class VarLenReader:
     """Core variable-length reader bound to one copybook + parameters."""
 
@@ -122,6 +155,13 @@ class VarLenReader:
         self.segment_redefine_map = dict(
             seg.segment_id_redefine_map) if seg else {}
         self._decoders: Dict[str, ColumnarDecoder] = {}
+        # variable-size OCCURS that shift later fields make the static
+        # columnar plan inapplicable — those records decode on the host.
+        # Walked over the whole record (all 01-level roots in one pass): a
+        # variable array at the end of one root shifts every later root.
+        self.dynamic_occurs_layout = (
+            params.variable_size_occurs
+            and _has_dynamic_occurs_layout(self.copybook.ast))
 
     # -- plumbing ----------------------------------------------------------
 
@@ -363,13 +403,8 @@ class VarLenReader:
         packed = native.pack_records(data, offsets, lengths, extent)
         field_bytes = packed[:, start + seg_off:]
         short = lengths < extent  # id field truncated -> decode actual bytes
-        uniq, inverse = np.unique(field_bytes, axis=0, return_inverse=True)
         options = DecodeOptions.from_copybook(self.copybook)
-        decoded = []
-        for row in uniq:
-            value = options.decode(seg_field.dtype, bytes(row))
-            decoded.append("" if value is None else str(value).strip())
-        out = [decoded[i] for i in inverse]
+        out = decode_segment_id_bytes(field_bytes, seg_field, options)
         for i in np.nonzero(short)[0]:
             chunk = bytes(packed[i, start + seg_off: int(lengths[i])])
             value = options.decode(seg_field.dtype, chunk)
@@ -456,10 +491,11 @@ class VarLenReader:
                            starting_file_offset: int = 0) -> List[List[object]]:
         """Frame all records, pack per-active-segment padded batches, decode
         with the batched kernels, and reassemble rows in file order."""
-        if self.copybook.is_hierarchical:
-            # hierarchical assembly (parent/child nesting) is host-side for
-            # now; the host iterator produces the nested rows the schema
-            # expects (reference extractHierarchicalRecord, RecordExtractors.scala:211)
+        if self.copybook.is_hierarchical or self.dynamic_occurs_layout:
+            # hierarchical assembly and dynamic variable-OCCURS layouts are
+            # host-side: nesting / per-record offset shifts have no static
+            # columnar plan (reference extractHierarchicalRecord,
+            # RecordExtractors.scala:211; VarOccursRecordExtractor)
             return list(self.iter_rows(
                 stream, file_id=file_id, start_record_id=start_record_id,
                 starting_file_offset=starting_file_offset,

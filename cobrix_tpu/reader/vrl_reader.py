@@ -37,6 +37,21 @@ def resolve_length_field(length_field_name: Optional[str],
     return field
 
 
+def decode_segment_id_bytes(field_bytes, seg_field: Primitive,
+                            options) -> list:
+    """Per-record segment-id strings from a [n, field_width] byte matrix,
+    decoding each unique byte pattern once (shared by the fixed-length and
+    variable-length readers)."""
+    import numpy as np
+
+    uniq, inverse = np.unique(field_bytes, axis=0, return_inverse=True)
+    decoded = []
+    for row in uniq:
+        value = options.decode(seg_field.dtype, bytes(row))
+        decoded.append("" if value is None else str(value).strip())
+    return [decoded[i] for i in inverse]
+
+
 def resolve_segment_id_field(params: ReaderParameters,
                              copybook: Copybook) -> Optional[Primitive]:
     """reference ReaderParametersValidator.getSegmentIdField."""

@@ -55,7 +55,9 @@ class CobolStreamer:
             data, backend=self.backend, file_id=file_id,
             first_record_id=self._next_record_id,
             input_file_name=input_file_name)
-        self._next_record_id += len(rows)
+        # advance by records CONSUMED, not rows emitted — a segment filter
+        # drops rows but their record ids stay assigned by position
+        self._next_record_id += len(data) // self.record_size
         return CobolData(rows, self._schema)
 
     # -- chunked byte stream ------------------------------------------------

@@ -156,3 +156,34 @@ def test_index_counts_invalid_records_like_reference():
     assert [e.record_index for e in index] == [0, 3, 6, 9]
     whole = list(reader.iter_rows(MemoryStream(payload), file_id=0))
     assert len(whole) == 10
+
+
+def test_var_occurs_shifts_following_root_group():
+    """A variable OCCURS at the tail of one 01-level root shifts every
+    sibling root; such layouts must leave the static columnar plan
+    (review regression: per-root dynamic-layout detection missed it)."""
+    from cobrix_tpu import read_cobol
+
+    copybook = """
+       01 A.
+          05 CNT PIC 9.
+          05 ARR PIC X OCCURS 0 TO 5 TIMES DEPENDING ON CNT.
+       01 B.
+          05 F PIC X(3).
+"""
+    records = b"2xyQQQ" + b"0ZZZ" + b"5abcdeWWW"
+    import tempfile, os
+    path = tempfile.mktemp(suffix=".bin")
+    with open(path, "wb") as f:
+        f.write(records)
+    try:
+        res = read_cobol(path, copybook_contents=copybook, encoding="ascii",
+                         variable_size_occurs="true")
+        rows = res.to_rows()
+    finally:
+        os.unlink(path)
+    assert rows == [
+        [(2, ["x", "y"]), ("QQQ",)],
+        [(0, []), ("ZZZ",)],
+        [(5, ["a", "b", "c", "d", "e"]), ("WWW",)],
+    ]
