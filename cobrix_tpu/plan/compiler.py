@@ -272,10 +272,20 @@ def compile_plan(copybook: Copybook,
                 else:
                     add_column(st, path, st_offset, slot_path, gates, segment)
 
+    # 01-level roots lay out SEQUENTIALLY, even when one REDEFINES another:
+    # the reference record walk advances the offset for every root
+    # (RecordExtractors.scala:176-180, `nextOffset += size` unconditionally)
+    # although the parsed offsets overlay — parity requires matching the
+    # walk, not the parsed offsets.
+    root_offset = 0
     for root in copybook.ast.children:
         if isinstance(root, Group):
-            walk_children(root, (root.name,), root.binary_properties.offset,
-                          (), (), None)
+            walk_children(root, (root.name,), root_offset, (), (), None)
+            # advance by the walked size (children sum x occurs), not
+            # actual_size: a REDEFINES max-size adjustment does not move
+            # the reference's walk
+            root_offset += (root.binary_properties.data_size
+                            * max(root.array_max_size, 1))
 
     group_map: Dict[Tuple[Codec, int], ColumnGroup] = {}
     for c in columns:

@@ -287,6 +287,18 @@ class DecodedBatch:
         return self.value(col, i)
 
 
+def decoder_for_segment(cache: Dict[str, "ColumnarDecoder"],
+                        copybook: Copybook, active: str,
+                        backend: str) -> "ColumnarDecoder":
+    """Shared per-(active segment, backend) decoder cache used by both the
+    fixed-length and variable-length readers."""
+    key = f"{active}|{backend}"
+    if key not in cache:
+        cache[key] = ColumnarDecoder(
+            copybook, active_segment=active or None, backend=backend)
+    return cache[key]
+
+
 class ColumnarDecoder:
     def __init__(self, copybook: Copybook,
                  active_segment: Optional[str] = None,

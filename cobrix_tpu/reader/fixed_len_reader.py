@@ -12,7 +12,7 @@ from typing import Iterator, List, Optional
 import numpy as np
 
 from ..copybook.copybook import Copybook, merge_copybooks, parse_copybook
-from .columnar import ColumnarDecoder, DecodedBatch
+from .columnar import ColumnarDecoder, DecodedBatch, decoder_for_segment
 from .extractors import DecodeOptions, extract_record
 from .parameters import ReaderParameters
 from .vrl_reader import decode_segment_id_bytes, resolve_segment_id_field
@@ -148,11 +148,8 @@ class FixedLenReader:
 
     def _decoder_for_segment(self, active: str,
                              backend: str) -> ColumnarDecoder:
-        key = f"{active}|{backend}"
-        if key not in self._seg_decoders:
-            self._seg_decoders[key] = ColumnarDecoder(
-                self.copybook, active_segment=active or None, backend=backend)
-        return self._seg_decoders[key]
+        return decoder_for_segment(self._seg_decoders, self.copybook,
+                                   active, backend)
 
     def _segment_values(self, matrix: np.ndarray) -> List[str]:
         """Per-record segment-id strings (shared unique-pattern decode with

@@ -21,7 +21,7 @@ import numpy as np
 
 from ..copybook.ast import Group, Primitive
 from ..copybook.copybook import Copybook, merge_copybooks, parse_copybook
-from .columnar import ColumnarDecoder
+from .columnar import ColumnarDecoder, decoder_for_segment
 from .extractors import (
     DecodeOptions,
     extract_hierarchical_record,
@@ -332,31 +332,33 @@ class VarLenReader:
                 input_file_name=stream.input_file_name,
                 options=options)
 
+        # Record_Id parity quirk: the reference's hierarchical iterator
+        # stamps each assembled row with the raw record index of the record
+        # that TRIGGERS the flush — the next root (or the total record
+        # count at end of stream), VarLenHierarchicalIterator.scala:99-135
+        last_index = start_record_id - 1
         for record_index, segment_id, data in self.frame_records(
                 stream, start_record_id, starting_file_offset):
             redefine = segment_id_redefine_map.get(segment_id)
             is_root = redefine is not None and redefine.name in root_names
             if is_root:
                 if buffer:
+                    root_record_index = record_index
                     yield flush()
                 buffer = [(segment_id, data)]
-                root_record_index = record_index
             elif buffer:
                 buffer.append((segment_id, data))
+            last_index = record_index
         if buffer:
+            root_record_index = last_index + 1
             yield flush()
 
     # -- columnar batch path -------------------------------------------------
 
     def _decoder_for_segment(self, active_segment: str,
                              backend: str) -> ColumnarDecoder:
-        key = f"{active_segment}|{backend}"
-        if key not in self._decoders:
-            self._decoders[key] = ColumnarDecoder(
-                self.copybook,
-                active_segment=active_segment or None,
-                backend=backend)
-        return self._decoders[key]
+        return decoder_for_segment(self._decoders, self.copybook,
+                                   active_segment, backend)
 
     # -- vectorized fast framing (native scan) ------------------------------
 

@@ -56,8 +56,11 @@ class CobolStreamer:
             first_record_id=self._next_record_id,
             input_file_name=input_file_name)
         # advance by records CONSUMED, not rows emitted — a segment filter
-        # drops rows but their record ids stay assigned by position
-        self._next_record_id += len(data) // self.record_size
+        # drops rows but their record ids stay assigned by position; file
+        # header/footer regions are not records
+        body = (len(data) - self.params.file_start_offset
+                - self.params.file_end_offset)
+        self._next_record_id += max(body, 0) // self.record_size
         return CobolData(rows, self._schema)
 
     # -- chunked byte stream ------------------------------------------------

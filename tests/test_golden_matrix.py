@@ -55,6 +55,47 @@ class ReferenceCustomCodePage:
         return "".join(t)
 
 
+from cobrix_tpu.reader.header_parsers import (  # noqa: E402
+    RecordHeaderParser,
+    RecordMetadata,
+)
+
+
+class CustomRdw5ByteParser(RecordHeaderParser):
+    """Replica of the reference's Test10CustomRDWParser (5-byte header,
+    byte0 validity flag, little-endian length in bytes 3-4)."""
+
+    additional_info = ""
+
+    @property
+    def header_length(self):
+        return 5
+
+    @property
+    def is_header_defined_in_copybook(self):
+        return False
+
+    def get_record_metadata(self, header, file_offset, file_size, record_num):
+        if len(header) < self.header_length:
+            return RecordMetadata(-1, False)
+        is_valid = header[0] == 1
+        length = header[3] + 256 * header[4]
+        if length <= 0:
+            raise ValueError(f"Custom RDW headers should never be zero "
+                             f"at {file_offset}.")
+        return RecordMetadata(length, is_valid)
+
+    def on_receive_additional_info(self, additional_info):
+        CustomRdw5ByteParser.additional_info = additional_info
+
+SEG17 = {"redefine_segment_id_map:1": "COMPANY => 1",
+         "redefine-segment-id-map:2": "DEPT => 2",
+         "redefine-segment-id-map:3": "EMPLOYEE => 3",
+         "redefine-segment-id-map:4": "OFFICE => 4",
+         "redefine-segment-id-map:5": "CUSTOMER => 5",
+         "redefine-segment-id-map:6": "CONTACT => 6",
+         "redefine-segment-id-map:7": "CONTRACT => 7"}
+
 # (case id, copybook file, data path, expected txt, expected schema, options)
 CASES = [
     ("test3", "test3_copybook.cob", "test3_data",
@@ -126,6 +167,117 @@ CASES = [
      dict(schema_retention_policy="collapse_root",
           floating_point_format="IEEE754", pedantic="true", debug="raw",
           __order_by__="ID")),
+    ("test5", "test5_copybook.cob", "test5_data",
+     "test5_expected/test5.txt", "test5_expected/test5_schema.json",
+     dict(is_record_sequence="true", segment_field="SEGMENT_ID",
+          segment_id_level0="C", segment_id_level1="P",
+          generate_record_id="true",
+          schema_retention_policy="collapse_root", segment_id_prefix="A")),
+    ("test5a", "test5_copybook.cob", "test5_data",
+     "test5_expected/test5a.txt", "test5_expected/test5a_schema.json",
+     dict(is_record_sequence="true", input_split_records="100",
+          segment_field="SEGMENT_ID", segment_id_root="C",
+          generate_record_id="true",
+          schema_retention_policy="collapse_root", segment_id_prefix="B")),
+    ("test5b", "test5_copybook.cob", "test5b_data",
+     "test5_expected/test5b.txt", "test5_expected/test5b_schema.json",
+     dict(is_record_sequence="true", is_rdw_big_endian="true",
+          segment_field="SEGMENT_ID", segment_id_level0="C",
+          segment_id_level1="P", generate_record_id="true",
+          schema_retention_policy="collapse_root", segment_id_prefix="A")),
+    ("test5c", "test5_copybook.cob", "test5_data",
+     "test5_expected/test5c.txt", "test5_expected/test5c_schema.json",
+     dict(is_record_sequence="true", input_split_records="100",
+          segment_field="SEGMENT_ID", segment_id_root="C",
+          generate_record_id="true",
+          schema_retention_policy="collapse_root", segment_id_prefix="B",
+          **{"redefine_segment_id_map:0": "STATIC-DETAILS => C,D",
+             "redefine-segment-id-map:1": "CONTACTS => P"})),
+    ("test18a", "test18 special_char.cob",
+     "test18 special_char/HIERARCHICAL.DATA.RDW.dat",
+     "test18 special_char_expected/test18a.txt",
+     "test18 special_char_expected/test18a_schema.json",
+     dict(pedantic="true", is_record_sequence="true",
+          generate_record_id="true",
+          schema_retention_policy="collapse_root",
+          segment_field="SEGMENT_ID", **SEG17)),
+    ("test5d", "test5d_copybook.cob", "test5b_data",
+     "test5_expected/test5d.txt", "test5_expected/test5d_schema.json",
+     dict(record_length_field="RECORD-LENGTH", rdw_adjustment="4",
+          segment_field="SEGMENT_ID", segment_id_level0="C",
+          segment_id_level1="P", generate_record_id="true",
+          schema_retention_policy="collapse_root", segment_id_prefix="A")),
+    ("test11", "test11_copybook.cob", "test11_data",
+     "test11_expected/test11.txt", "test11_expected/test11_schema.json",
+     dict(is_record_sequence="true", generate_record_id="true",
+          schema_retention_policy="collapse_root",
+          record_header_parser=f"{__name__}.CustomRdw5ByteParser",
+          rhp_additional_info="rhp info")),
+    ("test12", "test12_copybook.cob", "test12_data",
+     "test12_expected/test12.txt", "test12_expected/test12_schema.json",
+     dict(encoding="ascii")),
+    ("test12_merged", "test12_copybook_a.cob,test12_copybook_b.cob",
+     "test12_data",
+     "test12_expected/test12.txt", "test12_expected/test12_schema.json",
+     dict(encoding="ascii")),
+    ("test13a", "test13a_file_header_footer.cob", "test13a_data",
+     "test13_expected/test13a.txt", "test13_expected/test13a_schema.json",
+     dict(schema_retention_policy="collapse_root",
+          file_start_offset="10", file_end_offset="12",
+          __order_by__=("COMPANY_ID", "AMOUNT"))),
+    ("test13b", "test13b_vrl_file_headers.cob", "test13b_data",
+     "test13_expected/test13b.txt", "test13_expected/test13b_schema.json",
+     dict(schema_retention_policy="collapse_root",
+          is_record_sequence="true", is_rdw_big_endian="true",
+          segment_field="SEGMENT_ID", segment_id_level0="C",
+          segment_id_level1="P", generate_record_id="true",
+          segment_id_prefix="A",
+          file_start_offset="100", file_end_offset="120")),
+    ("test14a", "test14_copybook.cob", "test14_data",
+     "test14_expected/test14.txt", "test14_expected/test14_schema.json",
+     dict(is_record_sequence="true", segment_field="SEGMENT_ID",
+          segment_id_level0="C", segment_id_level1="P",
+          generate_record_id="true",
+          schema_retention_policy="collapse_root", segment_id_prefix="A",
+          is_rdw_part_of_record_length="true",
+          **{"redefine_segment_id_map:0": "STATIC-DETAILS => C,D",
+             "redefine-segment-id-map:1": "CONTACTS => P"})),
+    ("test14b", "test14_copybook.cob", "test14_data",
+     "test14_expected/test14.txt", "test14_expected/test14_schema.json",
+     dict(is_record_sequence="true", segment_field="SEGMENT_ID",
+          segment_id_level0="C", segment_id_level1="P",
+          generate_record_id="true",
+          schema_retention_policy="collapse_root", segment_id_prefix="A",
+          rdw_adjustment="-4",
+          **{"redefine_segment_id_map:0": "STATIC-DETAILS => C,D",
+             "redefine-segment-id-map:1": "CONTACTS => P"})),
+    ("test15", "test15_copybook.cob", "test15_data/*",
+     "test15_expected/test15.txt", "test15_expected/test15_schema.json",
+     dict(schema_retention_policy="collapse_root", __order_by__=("ID",))),
+    ("test17a", "test17_hierarchical.cob", "test17/HIERARCHICAL.DATA.RDW.dat",
+     "test17_expected/test17a.txt", "test17_expected/test17a_schema.json",
+     dict(pedantic="true", is_record_sequence="true",
+          generate_record_id="true",
+          schema_retention_policy="collapse_root",
+          segment_field="SEGMENT_ID", **SEG17)),
+    ("test17b", "test17_hierarchical.cob", "test17/HIERARCHICAL.DATA.RDW.dat",
+     "test17_expected/test17b.txt", "test17_expected/test17b_schema.json",
+     dict(pedantic="true", is_record_sequence="true",
+          generate_record_id="true",
+          schema_retention_policy="collapse_root",
+          segment_field="SEGMENT_ID", segment_id_level0="1",
+          segment_id_level1="2,5", segment_id_level2="3,4,6,7",
+          segment_id_prefix="A", **SEG17)),
+    ("test17c", "test17_hierarchical.cob", "test17/HIERARCHICAL.DATA.RDW.dat",
+     "test17_expected/test17c.txt", "test17_expected/test17c_schema.json",
+     dict(pedantic="true", is_record_sequence="true",
+          generate_record_id="true",
+          schema_retention_policy="collapse_root",
+          segment_field="SEGMENT_ID",
+          **{"segment-children:1": "COMPANY => DEPT,CUSTOMER",
+             "segment-children:2": "DEPT => EMPLOYEE,OFFICE",
+             "segment-children:3": "CUSTOMER => CONTACT,CONTRACT"},
+          **SEG17)),
     ("test25", "test25_copybook.cob", "test25_data",
      "test25_expected/test25.txt", "test25_expected/test25_schema.json",
      dict(encoding="ascii", variable_size_occurs="true",
@@ -142,12 +294,16 @@ def test_golden(case_id, copybook, data, expected_txt, expected_schema,
                 options):
     options = dict(options)
     order_by = options.pop("__order_by__", None)
-    result = read_cobol(ref(data), copybook=ref(copybook), **options)
+    books = [ref(c) for c in copybook.split(",")]
+    result = read_cobol(ref(data),
+                        copybook=books if len(books) > 1 else books[0],
+                        **options)
     if order_by:
-        # the reference spec goldens rows of df.orderBy(col)
-        col = result.schema.field_names().index(order_by)
+        # the reference spec goldens rows of df.orderBy(cols...)
+        cols = ((order_by,) if isinstance(order_by, str) else order_by)
+        idxs = [result.schema.field_names().index(c) for c in cols]
         result._rows.sort(
-            key=lambda r: (r[col] is not None, r[col]))
+            key=lambda r: tuple((r[i] is not None, r[i]) for i in idxs))
 
     with open(ref(expected_schema), encoding="utf-8") as f:
         exp_schema = json.load(f)
