@@ -142,9 +142,12 @@ def _parse_prefixed_map(opts: Options,
     return out
 
 
-def parse_options(options: Dict[str, object]) -> Tuple[ReaderParameters, Options]:
+def parse_options(options: Dict[str, object],
+                  streaming: bool = False) -> Tuple[ReaderParameters, Options]:
     """String options -> typed ReaderParameters
-    (reference CobolParametersParser.parse, :191)."""
+    (reference CobolParametersParser.parse, :191). `streaming`: relax the
+    per-record input-file-column gate — the micro-batch streamer tracks
+    file names per batch even for fixed-length records."""
     opts = Options(options)
 
     encoding = (opts.get("encoding", "") or "").strip().lower()
@@ -233,11 +236,12 @@ def parse_options(options: Dict[str, object]) -> Tuple[ReaderParameters, Options
     # recognized keys consumed later by read_cobol — mark used before the
     # pedantic unused-key audit runs
     opts.get_bool("debug_ignore_file_size")
-    _validate_options(opts, params)
+    _validate_options(opts, params, streaming)
     return params, opts
 
 
-def _validate_options(opts: Options, params: ReaderParameters) -> None:
+def _validate_options(opts: Options, params: ReaderParameters,
+                      streaming: bool = False) -> None:
     """Option incompatibility matrices + pedantic unused-key audit
     (reference validateSparkCobolOptions, :473-610)."""
     rdw_ish = ["is_text", "record_length", "is_record_sequence", "is_xcom",
@@ -257,6 +261,14 @@ def _validate_options(opts: Options, params: ReaderParameters) -> None:
             raise ValueError(
                 f"Option 'record_length' and {', '.join(bad)} cannot be "
                 "used together.")
+    if params.input_file_name_column and not streaming:
+        if not params.is_variable_length:
+            raise ValueError(
+                "Option 'with_input_file_name_col' is supported only when "
+                "one of this holds: 'is_record_sequence' = true or "
+                "'variable_size_occurs' = true or one of these options is "
+                "set: 'record_length_field', 'file_start_offset', "
+                "'file_end_offset' or a custom record extractor is specified")
     seg = params.multisegment
     if seg and seg.field_parent_map and seg.segment_level_ids:
         raise ValueError(
@@ -374,10 +386,7 @@ def read_cobol(path=None,
     if not files:
         raise FileNotFoundError(f"No input files found for path {path}")
 
-    is_var_len = (params.is_record_sequence or params.is_text
-                  or params.length_field_name or params.record_extractor
-                  or params.variable_size_occurs or params.file_start_offset > 0
-                  or params.file_end_offset > 0)
+    is_var_len = params.is_variable_length
 
     # Seg_Id columns exist only on the variable-length path (the reference
     # fixed-length reader never generates them)

@@ -79,3 +79,13 @@ class ReaderParameters:
     @property
     def data_encoding(self) -> Encoding:
         return Encoding.EBCDIC if self.is_ebcdic else Encoding.ASCII
+
+    @property
+    def is_variable_length(self) -> bool:
+        """True when the configuration needs the variable-length reader
+        (also the gate for per-record input-file tracking). Shared by the
+        read dispatch and option validation so they cannot drift."""
+        return bool(self.is_record_sequence or self.is_text
+                    or self.variable_size_occurs or self.length_field_name
+                    or self.record_extractor or self.file_start_offset > 0
+                    or self.file_end_offset > 0)

@@ -30,7 +30,7 @@ class CobolStreamer:
     """
 
     def __init__(self, copybook_contents, backend: str = "numpy", **options):
-        params, _ = parse_options(options)
+        params, _ = parse_options(options, streaming=True)
         if params.is_record_sequence:
             raise ValueError(
                 "Streaming supports fixed-length records only "

@@ -1,0 +1,251 @@
+"""Ports of the reference integration specs that generate their test data
+inline (no data/testN_* directory): Test20 (input file name column),
+Test22 (hierarchical variable OCCURS), Test23 (PIC N national strings),
+Test26 (custom record extractor), Test27 (record_length override).
+"""
+import json
+import os
+
+import pytest
+
+from cobrix_tpu import parse_copybook, read_cobol
+
+from util import REFERENCE_DATA
+
+
+def write(tmp_path, name, payload: bytes) -> str:
+    p = tmp_path / name
+    p.write_bytes(payload)
+    return str(p)
+
+
+class TestHierarchicalVariableOccurs:
+    """Reference Test22HierarchicalOccursSpec: variable-size OCCURS inside
+    hierarchical segments."""
+
+    COPYBOOK = """      01 RECORD.
+          02 SEG PIC X(1).
+          02 SEG1.
+            03 COUNT1 PIC 9(1).
+            03 GROUP1 OCCURS 0 TO 2 TIMES DEPENDING ON COUNT1.
+               04 INNER-COUNT1 PIC 9(1).
+               04 INNER-GROUP1 OCCURS 0 TO 3 TIMES
+                                DEPENDING ON INNER-COUNT1.
+                  05 FIELD1 PIC X.
+          02 SEG2 REDEFINES SEG1.
+            03 COUNT2 PIC 9(1).
+            03 GROUP2 OCCURS 0 TO 2 TIMES DEPENDING ON COUNT2.
+               04 INNER-COUNT2 PIC 9(1).
+               04 INNER-GROUP2 OCCURS 0 TO 3 TIMES
+                                DEPENDING ON INNER-COUNT2.
+                  05 FIELD2 PIC X.
+    """
+
+    DATA = bytes([
+        0x00, 0x00, 0x02, 0x00, 0xF1, 0xF0,
+        0x00, 0x00, 0x03, 0x00, 0xF1, 0xF1, 0xF0,
+        0x00, 0x00, 0x04, 0x00, 0xF1, 0xF1, 0xF1, 0xC1,
+        0x00, 0x00, 0x05, 0x00, 0xF1, 0xF1, 0xF2, 0xC1, 0xC2,
+        0x00, 0x00, 0x08, 0x00, 0xF1, 0xF2, 0xF2, 0xC3, 0xC4, 0xF2,
+        0xC5, 0xC6,
+        0x00, 0x00, 0x08, 0x00, 0xF2, 0xF2, 0xF2, 0xC7, 0xC8, 0xF2,
+        0xC9, 0xD1,
+    ])
+
+    def test_hierarchical_var_occurs(self, tmp_path):
+        path = write(tmp_path, "h.dat", self.DATA)
+        res = read_cobol(
+            path, copybook_contents=self.COPYBOOK, pedantic="true",
+            is_record_sequence="true",
+            schema_retention_policy="collapse_root",
+            generate_record_id="true", variable_size_occurs="true",
+            segment_field="SEG",
+            **{"redefine_segment_id_map:1": "SEG1 => 1",
+               "redefine-segment-id-map:2": "SEG2 => 2",
+               "segment-children:1": "SEG1 => SEG2"})
+        rows = [json.loads(line) for line in res.to_json_lines()]
+        assert [r["Record_Id"] for r in rows] == [1, 2, 3, 4, 6]
+        assert rows[0]["SEG1"] == {"COUNT1": 0, "GROUP1": [], "SEG2": []}
+        assert rows[1]["SEG1"] == {
+            "COUNT1": 1, "GROUP1": [{"INNER_COUNT1": 0, "INNER_GROUP1": []}],
+            "SEG2": []}
+        assert rows[3]["SEG1"]["GROUP1"] == [
+            {"INNER_COUNT1": 2,
+             "INNER_GROUP1": [{"FIELD1": "A"}, {"FIELD1": "B"}]}]
+        assert rows[4]["SEG1"] == {
+            "COUNT1": 2,
+            "GROUP1": [
+                {"INNER_COUNT1": 2,
+                 "INNER_GROUP1": [{"FIELD1": "C"}, {"FIELD1": "D"}]},
+                {"INNER_COUNT1": 2,
+                 "INNER_GROUP1": [{"FIELD1": "E"}, {"FIELD1": "F"}]}],
+            "SEG2": [{
+                "COUNT2": 2,
+                "GROUP2": [
+                    {"INNER_COUNT2": 2,
+                     "INNER_GROUP2": [{"FIELD2": "G"}, {"FIELD2": "H"}]},
+                    {"INNER_COUNT2": 2,
+                     "INNER_GROUP2": [{"FIELD2": "I"}, {"FIELD2": "J"}]}]}]}
+
+
+class TestNationalType:
+    """Reference Test23NationalTypeSpec: PIC N UTF-16 strings."""
+
+    COPYBOOK = """      01 RECORD.
+          02 X PIC X(3).
+          02 N PIC N(3).
+    """
+    BE = bytes([0xF1, 0xF2, 0xF3, 0, 0x31, 0, 0x32, 0, 0x33,
+                0x81, 0x82, 0x83, 0, 0x61, 0, 0x62, 0, 0x63])
+    LE = bytes([0xF1, 0xF2, 0xF3, 0x31, 0, 0x32, 0, 0x33, 0,
+                0x81, 0x82, 0x83, 0x61, 0, 0x62, 0, 0x63, 0])
+
+    def test_sizes(self):
+        cb = parse_copybook(self.COPYBOOK)
+        record = cb.ast.children[0]
+        assert record.children[0].binary_properties.actual_size == 3
+        assert record.children[1].binary_properties.actual_size == 6
+
+    @pytest.mark.parametrize("payload,opts", [
+        (BE, {}), (LE, {"is_utf16_big_endian": "false"})],
+        ids=["big_endian", "little_endian"])
+    def test_decode(self, tmp_path, payload, opts):
+        path = write(tmp_path, "n.dat", payload)
+        res = read_cobol(path, copybook_contents=self.COPYBOOK,
+                         pedantic="true",
+                         schema_retention_policy="collapse_root", **opts)
+        assert res.to_json_lines() == ['{"X":"123","N":"123"}',
+                                       '{"X":"abc","N":"abc"}']
+
+
+from cobrix_tpu.reader.raw_extractors import RawRecordExtractor  # noqa: E402
+
+
+class AlternatingRecordExtractor(RawRecordExtractor):
+    """Replica of the reference's CustomRecordExtractorMock: records
+    alternate between 2 and 3 bytes."""
+
+    additional_info = ""
+
+    def __init__(self, ctx):
+        AlternatingRecordExtractor.additional_info = ctx.additional_info
+        self.ctx = ctx
+        self.record_number = ctx.starting_record_number
+
+    @property
+    def offset(self):
+        return self.ctx.input_stream.offset
+
+    def has_next(self):
+        return self.ctx.input_stream.offset < self.ctx.input_stream.size()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self.has_next():
+            raise StopIteration
+        n = 2 if self.record_number % 2 == 0 else 3
+        self.record_number += 1
+        return self.ctx.input_stream.next(n)
+
+
+class TestCustomRecordExtractor:
+    """Reference Test26CustomRecordExtractor."""
+
+    COPYBOOK = """      01  R.
+                03 A        PIC X(3).
+      """
+
+    def _read(self, path, **extra):
+        return read_cobol(
+            path, copybook_contents=self.COPYBOOK, encoding="ascii",
+            schema_retention_policy="collapse_root",
+            record_extractor=f"{__name__}.AlternatingRecordExtractor",
+            re_additional_info="re info", **extra)
+
+    def test_extractor_applied(self, tmp_path):
+        path = write(tmp_path, "re.dat", b"AABBBCCDDDEEFFF")
+        res = self._read(path)
+        assert res.to_json_lines() == [
+            '{"A":"AA"}', '{"A":"BBB"}', '{"A":"CC"}', '{"A":"DDD"}',
+            '{"A":"EE"}', '{"A":"FFF"}']
+        assert AlternatingRecordExtractor.additional_info == "re info"
+
+    @pytest.mark.parametrize("opt,value", [
+        ("record_length", "2"), ("is_record_sequence", "true"),
+        ("is_rdw_big_endian", "true"),
+        ("is_rdw_part_of_record_length", "true"), ("rdw_adjustment", "-1"),
+        ("record_length_field", "A"),
+        ("record_header_parser", "com.example.parser"),
+        ("rhp_additional_info", "info")])
+    def test_incompatible_options(self, opt, value):
+        with pytest.raises(ValueError):
+            self._read("/dummy", **{opt: value})
+
+
+class TestRecordLengthOverride:
+    """Reference Test27RecordLengthSpec."""
+
+    COPYBOOK = """      01  R.
+                03 A        PIC X(2).
+                03 B        PIC X(1).
+      """
+    DATA = b"AABBBCCDDDEEFFFZYY"
+
+    def _read(self, path, **opts):
+        return read_cobol(path, copybook_contents=self.COPYBOOK,
+                          encoding="ascii",
+                          schema_retention_policy="collapse_root", **opts)
+
+    def test_smaller_than_copybook(self, tmp_path):
+        path = write(tmp_path, "r2.dat", self.DATA)
+        res = self._read(path, record_length="2")
+        assert len(res) == 9
+        assert res.to_json_lines()[:3] == [
+            '{"A":"AA","B":""}', '{"A":"BB","B":""}', '{"A":"BC","B":""}']
+
+    def test_same_as_copybook(self, tmp_path):
+        path = write(tmp_path, "r3.dat", self.DATA)
+        res = self._read(path, record_length="3")
+        assert res.to_json_lines() == [
+            '{"A":"AA","B":"B"}', '{"A":"BB","B":"C"}', '{"A":"CD","B":"D"}',
+            '{"A":"DE","B":"E"}', '{"A":"FF","B":"F"}', '{"A":"ZY","B":"Y"}']
+
+    def test_bigger_than_copybook(self, tmp_path):
+        path = write(tmp_path, "r6.dat", self.DATA)
+        res = self._read(path, record_length="6")
+        assert res.to_json_lines() == [
+            '{"A":"AA","B":"B"}', '{"A":"CD","B":"D"}', '{"A":"FF","B":"F"}']
+
+    def test_non_divisible_raises(self, tmp_path):
+        path = write(tmp_path, "r7.dat", self.DATA)
+        with pytest.raises(ValueError, match="does not divide"):
+            self._read(path, record_length="7")
+
+    def test_incompatible_with_record_sequence(self):
+        with pytest.raises(ValueError):
+            self._read("/dummy", record_length="2",
+                       is_record_sequence="true")
+
+
+class TestInputFileNameColumn:
+    """Reference Test20InputFileNameSpec (golden-data based scenarios)."""
+
+    def test_fixed_len_directory_rejected(self):
+        with pytest.raises(ValueError, match="with_input_file_name_col"):
+            read_cobol(os.path.join(REFERENCE_DATA, "test2_data"),
+                       copybook=os.path.join(REFERENCE_DATA,
+                                             "test1_copybook.cob"),
+                       with_input_file_name_col="file_name")
+
+    def test_var_len_file_name_column(self):
+        res = read_cobol(
+            os.path.join(REFERENCE_DATA,
+                         "test4_data/COMP.DETAILS.SEP30.DATA.dat"),
+            copybook=os.path.join(REFERENCE_DATA, "test4_copybook.cob"),
+            is_record_sequence="true", encoding="ascii",
+            with_input_file_name_col="F")
+        assert res.schema.field_names()[0] == "F"
+        first = json.loads(res.to_json_lines()[0])
+        assert first["F"].endswith("COMP.DETAILS.SEP30.DATA.dat")

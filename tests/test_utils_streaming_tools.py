@@ -226,3 +226,17 @@ class TestReplication:
         assert sum(os.path.getsize(f) for f in created) == 500
         assert sorted(os.path.basename(f) for f in created) == [
             f"src_{i}.bin" for i in range(5)]
+
+
+def test_streamer_allows_input_file_name_column(tmp_path):
+    """The var-len-only gate on with_input_file_name_col must not apply to
+    the streamer, which tracks file names per micro-batch (review
+    regression)."""
+    (tmp_path / "x.bin").write_bytes(_simple_records(2))
+    streamer = CobolStreamer(SIMPLE, encoding="ascii",
+                             schema_retention_policy="collapse_root",
+                             with_input_file_name_col="F")
+    batches = list(streamer.stream_directory(
+        str(tmp_path), poll_interval=0.01, max_batches=1))
+    assert batches[0].schema.field_names()[0] == "F"
+    assert batches[0].to_rows()[0][0].endswith("x.bin")
