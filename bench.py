@@ -76,7 +76,9 @@ def run(backend: str, mb_target: float) -> dict:
     _log(f"native framing: {native.available()}")
 
     def decode_all():
-        # native RDW scan (VRLRecordReader loop in C++) + per-segment pack
+        # native RDW scan (VRLRecordReader loop in C++) + in-place decode
+        # of numeric groups from the file image (decode_raw skips the
+        # wide-record pack copy; only the narrow string prefix is packed)
         offsets, lengths = native.rdw_scan(raw, big_endian=False)
         out = []
         for seg_len in np.unique(lengths):
@@ -85,11 +87,7 @@ def run(backend: str, mb_target: float) -> dict:
             pos = np.nonzero(lengths == seg_len)[0]
             active = "CONTACTS" if seg_len < 1000 else "STATIC_DETAILS"
             dec = reader._decoder_for_segment(active, backend)
-            extent = dec.plan.max_extent
-            batch = native.pack_records(
-                raw, offsets[pos], lengths[pos], extent)
-            out.append(dec.decode(
-                batch, lengths=np.minimum(lengths[pos], extent)))
+            out.append(dec.decode_raw(raw, offsets[pos], lengths[pos]))
         return out
 
     # warmup (jit compile; excluded from timing)

@@ -97,6 +97,15 @@ def _load() -> Optional[ctypes.CDLL]:
             _U8P, ctypes.c_int64, ctypes.c_int64, _I64P, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
             ctypes.c_int32, _I64P, _U8P, _I64P]
+        lib.decode_binary_cols_raw.restype = None
+        lib.decode_binary_cols_raw.argtypes = [
+            _U8P, _I64P, _I64P, ctypes.c_int64, _I64P, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, _U8P]
+        lib.decode_bcd_cols_raw.restype = None
+        lib.decode_bcd_cols_raw.argtypes = [
+            _U8P, _I64P, _I64P, ctypes.c_int64, _I64P, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p, _U8P]
         _lib = lib
         return _lib
 
@@ -324,6 +333,59 @@ def decode_display_cols(batch: np.ndarray, col_offsets: np.ndarray,
                             int(signed), int(allow_dot), int(require_digits),
                             values, valid, dots)
     return values, valid.view(bool), dots
+
+
+def _raw_args(data, rec_offsets, rec_lengths, col_offsets,
+              start_offset: int):
+    buf = _as_u8(data)
+    offs = np.ascontiguousarray(rec_offsets, dtype=np.int64)
+    lens = np.ascontiguousarray(rec_lengths, dtype=np.int64)
+    if start_offset:
+        offs = offs + start_offset
+        lens = lens - start_offset
+    cols = np.ascontiguousarray(col_offsets, dtype=np.int64)
+    return buf, offs, lens, cols
+
+
+def decode_binary_cols_raw(data, rec_offsets, rec_lengths,
+                           col_offsets, width: int, signed: bool,
+                           big_endian: bool, start_offset: int = 0,
+                           fits32: bool = False
+                           ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Same as decode_binary_cols but reading records in place from the
+    framed file image (no [n, extent] pack copy). Columns past a record's
+    end are invalid. `fits32`: int32 output (declared precision <= 9)."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf, offs, lens, cols = _raw_args(data, rec_offsets, rec_lengths,
+                                      col_offsets, start_offset)
+    n, ncols = offs.shape[0], cols.shape[0]
+    values = np.empty((n, ncols), dtype=np.int32 if fits32 else np.int64)
+    valid = np.empty((n, ncols), dtype=np.uint8)
+    lib.decode_binary_cols_raw(buf, offs, lens, n, cols, ncols, width,
+                               int(signed), int(big_endian), int(fits32),
+                               values.ctypes.data, valid)
+    return values, valid.view(bool)
+
+
+def decode_bcd_cols_raw(data, rec_offsets, rec_lengths, col_offsets,
+                        width: int, start_offset: int = 0,
+                        fits32: bool = False
+                        ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Same as decode_bcd_cols but reading records in place from the
+    framed file image. `fits32`: int32 output (precision <= 9)."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf, offs, lens, cols = _raw_args(data, rec_offsets, rec_lengths,
+                                      col_offsets, start_offset)
+    n, ncols = offs.shape[0], cols.shape[0]
+    values = np.empty((n, ncols), dtype=np.int32 if fits32 else np.int64)
+    valid = np.empty((n, ncols), dtype=np.uint8)
+    lib.decode_bcd_cols_raw(buf, offs, lens, n, cols, ncols, width,
+                            int(fits32), values.ctypes.data, valid)
+    return values, valid.view(bool)
 
 
 def pack_records(data, offsets: np.ndarray, lengths: np.ndarray,

@@ -278,6 +278,142 @@ void decode_bcd_cols(const uint8_t* batch, int64_t n, int64_t extent,
   }
 }
 
+// Raw-buffer variants: decode straight from the framed file image via
+// per-record offsets, skipping the [batch, extent] pack copy entirely
+// (the pack is pure memory traffic — for wide records it costs as much
+// as the decode itself). A column wholly or partly past a record's end
+// decodes as invalid, matching the packed path's zero padding + length
+// masking.
+
+// BCD pair LUT: value = hi*10+lo per byte (255 marks an invalid digit
+// nibble). Shared by the raw COMP-3 kernel's all-but-last-byte loop.
+static uint8_t kBcdPair[256];
+static bool InitBcdPair() {
+  for (int b = 0; b < 256; ++b) {
+    int hi = b >> 4, lo = b & 0x0F;
+    kBcdPair[b] = (hi >= 10 || lo >= 10) ? 255 : (uint8_t)(hi * 10 + lo);
+  }
+  return true;
+}
+static const bool kBcdPairInit = InitBcdPair();
+
+// out_i32: write int32 values (halves the output traffic; callers pass 1
+// only when the declared precision fits 9 digits / int32).
+void decode_binary_cols_raw(const uint8_t* data,
+                            const int64_t* rec_offsets,
+                            const int64_t* rec_lengths, int64_t n,
+                            const int64_t* col_offsets, int64_t ncols,
+                            int32_t width, int32_t is_signed,
+                            int32_t big_endian, int32_t out_i32,
+                            void* values, uint8_t* valid) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const uint8_t* row = data + rec_offsets[r];
+    const int64_t len = rec_lengths[r];
+    int64_t* vrow64 = out_i32 ? nullptr : (int64_t*)values + r * ncols;
+    int32_t* vrow32 = out_i32 ? (int32_t*)values + r * ncols : nullptr;
+    uint8_t* okrow = valid + r * ncols;
+    for (int64_t c = 0; c < ncols; ++c) {
+      uint8_t ok = 1;
+      int64_t v = 0;
+      if (col_offsets[c] + width > len) {
+        ok = 0;
+      } else {
+        const uint8_t* p = row + col_offsets[c];
+        uint64_t acc;
+        if (width == 4 && big_endian) {
+          uint32_t u;
+          std::memcpy(&u, p, 4);
+          acc = __builtin_bswap32(u);
+        } else if (width == 4 && !big_endian) {
+          uint32_t u;
+          std::memcpy(&u, p, 4);
+          acc = u;
+        } else if (big_endian) {
+          acc = 0;
+          for (int32_t i = 0; i < width; ++i) acc = (acc << 8) | p[i];
+        } else {
+          acc = 0;
+          for (int32_t i = width - 1; i >= 0; --i) acc = (acc << 8) | p[i];
+        }
+        if (is_signed) {
+          if (width < 8) {
+            uint64_t sign_bit = 1ULL << (8 * width - 1);
+            v = (acc & sign_bit)
+                    ? (int64_t)acc - (int64_t)(1ULL << (8 * width))
+                    : (int64_t)acc;
+          } else {
+            v = (int64_t)acc;
+          }
+        } else {
+          if ((width == 4 || width == 8) &&
+              (acc & (1ULL << (8 * width - 1)))) {
+            ok = 0;
+          } else {
+            v = (int64_t)acc;
+          }
+        }
+      }
+      if (out_i32) {
+        vrow32[c] = ok ? (int32_t)v : 0;
+      } else {
+        vrow64[c] = ok ? v : 0;
+      }
+      okrow[c] = ok;
+    }
+  }
+}
+
+void decode_bcd_cols_raw(const uint8_t* data,
+                         const int64_t* rec_offsets,
+                         const int64_t* rec_lengths, int64_t n,
+                         const int64_t* col_offsets, int64_t ncols,
+                         int32_t width, int32_t out_i32,
+                         void* values, uint8_t* valid) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const uint8_t* row = data + rec_offsets[r];
+    const int64_t len = rec_lengths[r];
+    int64_t* vrow64 = out_i32 ? nullptr : (int64_t*)values + r * ncols;
+    int32_t* vrow32 = out_i32 ? (int32_t*)values + r * ncols : nullptr;
+    uint8_t* okrow = valid + r * ncols;
+    for (int64_t c = 0; c < ncols; ++c) {
+      uint8_t ok = 1;
+      int64_t v = 0;
+      if (col_offsets[c] + width > len) {
+        ok = 0;
+      } else {
+        const uint8_t* p = row + col_offsets[c];
+        uint64_t acc = 0;
+        for (int32_t i = 0; i + 1 < width; ++i) {
+          uint8_t pair = kBcdPair[p[i]];
+          if (pair == 255) {
+            ok = 0;
+            pair = 0;
+          }
+          acc = acc * 100 + pair;
+        }
+        uint8_t last = p[width - 1];
+        uint8_t hi = last >> 4, sign = last & 0x0F;
+        if (hi >= 10) ok = 0;
+        acc = acc * 10 + (hi >= 10 ? 0 : hi);
+        if (sign != 0x0C && sign != 0x0D && sign != 0x0F) ok = 0;
+        v = (sign == 0x0D) ? (int64_t)(0 - acc) : (int64_t)acc;
+      }
+      if (out_i32) {
+        vrow32[c] = ok ? (int32_t)v : 0;
+      } else {
+        vrow64[c] = ok ? v : 0;
+      }
+      okrow[c] = ok;
+    }
+  }
+}
+
 // Zoned decimal DISPLAY numerics, EBCDIC (kind=0) and ASCII (kind=1)
 // (StringDecoders.decodeEbcdicNumber :154 / decodeAsciiNumber state
 // machines). dot_scale = digit count right of the single decimal point.

@@ -360,6 +360,67 @@ class ColumnarDecoder:
         self._decode_host_fallback(arr, outputs)
         return DecodedBatch(self, arr, outputs, lengths=lengths)
 
+    def decode_raw(self, data, rec_offsets, rec_lengths,
+                   start_offset: int = 0) -> DecodedBatch:
+        """Decode framed records in place from the file image: numeric
+        groups read straight through the native raw kernels (no
+        [batch, extent] pack copy — for wide records the pack costs as
+        much as the decode), and only the narrow prefix covering the
+        remaining groups is packed. Falls back to pack + `decode` when the
+        native library or numpy backend is unavailable."""
+        from .. import native
+
+        rec_lengths = np.asarray(rec_lengths, dtype=np.int64)
+        extent_full = self.plan.max_extent
+        lengths = np.minimum(rec_lengths - start_offset, extent_full)
+
+        def packed_fallback():
+            batch = native.pack_records(data, rec_offsets, rec_lengths,
+                                        extent_full,
+                                        start_offset=start_offset)
+            return self.decode(batch, lengths=lengths)
+
+        if self.backend != "numpy" or not native.available():
+            return packed_fallback()
+
+        # convert/adjust once — the per-group kernels receive ready arrays
+        # (their own ascontiguousarray checks become no-ops)
+        buf = (np.ascontiguousarray(data, dtype=np.uint8)
+               if isinstance(data, np.ndarray)
+               else np.frombuffer(data, dtype=np.uint8))
+        offs = np.ascontiguousarray(rec_offsets, dtype=np.int64)
+        if start_offset:
+            offs = offs + start_offset
+            rec_lengths = rec_lengths - start_offset
+
+        outputs: Dict[int, dict] = {}
+        narrow_groups = []
+        narrow_extent = 1
+        for g in self.kernel_groups:
+            res = None
+            if g.codec is Codec.BINARY:
+                signed, big_endian, fits32 = g.variant
+                res = native.decode_binary_cols_raw(
+                    buf, offs, rec_lengths, g.offsets, g.width,
+                    signed, big_endian, fits32=fits32)
+            elif g.codec is Codec.BCD:
+                (fits32,) = g.variant
+                res = native.decode_bcd_cols_raw(
+                    buf, offs, rec_lengths, g.offsets, g.width,
+                    fits32=fits32)
+            if res is not None:
+                self._store_numeric(g, outputs, *res)
+                continue
+            narrow_groups.append(g)
+            if len(g.columns):
+                narrow_extent = max(narrow_extent,
+                                    int(g.offsets.max()) + g.width)
+
+        batch = native.pack_records(buf, offs, rec_lengths, narrow_extent)
+        self._run_groups(narrow_groups, batch, outputs)
+        self._decode_host_fallback(batch, outputs)
+        return DecodedBatch(self, batch, outputs, lengths=lengths)
+
     @staticmethod
     def _bucket_size(n: int) -> int:
         """Round the batch size up to a power-of-two bucket (>= 256) so the
@@ -373,14 +434,20 @@ class ColumnarDecoder:
 
     def _decode_numpy(self, arr: np.ndarray) -> Dict[int, dict]:
         outputs: Dict[int, dict] = {}
-        for g in self.kernel_groups:
+        self._run_groups(self.kernel_groups, arr, outputs)
+        return outputs
+
+    def _run_groups(self, groups, arr: np.ndarray,
+                    outputs: Dict[int, dict]) -> None:
+        """Per-group numpy-path dispatch (native single-pass kernel when
+        available, else gather + vectorized numpy) over a packed batch."""
+        for g in groups:
             if g.codec is Codec.HOST_FALLBACK:
                 continue
             if self._run_group_native(g, arr, outputs):
                 continue
             slab = arr[:, g.offsets[:, None] + np.arange(g.width)[None, :]]
             self._run_group_numpy(g, slab, outputs)
-        return outputs
 
     def _run_group_native(self, g: _KernelGroup, arr: np.ndarray,
                           outputs: Dict[int, dict]) -> bool:

@@ -453,17 +453,13 @@ class VarLenReader:
             mask = keep & (active_arr == active)
             by_segment[active] = np.nonzero(mask)[0]
 
-        from .. import native
         start = params.start_offset
         rows_by_pos: Dict[int, List[object]] = {}
         for active, positions in by_segment.items():
             decoder = self._decoder_for_segment(active, backend)
-            extent = decoder.plan.max_extent
-            batch = native.pack_records(
-                data, offsets[positions], lengths[positions], extent,
+            decoded = decoder.decode_raw(
+                data, offsets[positions], lengths[positions],
                 start_offset=start)
-            seg_lengths = np.minimum(lengths[positions] - start, extent)
-            decoded = decoder.decode(batch, lengths=seg_lengths)
             seg_rows = decoded.to_rows(
                 policy=params.schema_policy,
                 generate_record_id=False,
