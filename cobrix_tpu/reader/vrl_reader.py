@@ -41,15 +41,22 @@ def decode_segment_id_bytes(field_bytes, seg_field: Primitive,
                             options) -> list:
     """Per-record segment-id strings from a [n, field_width] byte matrix,
     decoding each unique byte pattern once (shared by the fixed-length and
-    variable-length readers)."""
+    variable-length readers). The width-as-one-void-scalar view makes the
+    unique a 1-D sort instead of a row-wise lexicographic one — the
+    difference between ~1ms and ~1s at exp2's 600k narrow records."""
     import numpy as np
 
-    uniq, inverse = np.unique(field_bytes, axis=0, return_inverse=True)
-    decoded = []
-    for row in uniq:
+    fb = np.ascontiguousarray(field_bytes)
+    n, w = fb.shape
+    if n == 0:
+        return []
+    flat = fb.view(np.dtype((np.void, w))).ravel()
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    decoded = np.empty(len(uniq), dtype=object)
+    for i, row in enumerate(uniq):
         value = options.decode(seg_field.dtype, bytes(row))
-        decoded.append("" if value is None else str(value).strip())
-    return [decoded[i] for i in inverse]
+        decoded[i] = "" if value is None else str(value).strip()
+    return list(decoded[inverse])
 
 
 def resolve_segment_id_field(params: ReaderParameters,
