@@ -46,6 +46,9 @@ PyDecimal = _decimal.Decimal
 # strings
 # ---------------------------------------------------------------------------
 
+_JAVA_TRIM_CHARS = "".join(map(chr, range(0x21)))  # String.trim: all <= ' '
+
+
 def _trim(s: str, policy: TrimPolicy) -> str:
     if policy is TrimPolicy.NONE:
         return s
@@ -53,8 +56,7 @@ def _trim(s: str, policy: TrimPolicy) -> str:
         return s.lstrip(" \t")
     if policy is TrimPolicy.RIGHT:
         return s.rstrip(" \t")
-    # Scala String.trim strips all chars <= ' '
-    return s.strip("".join(chr(c) for c in range(0x21)))
+    return s.strip(_JAVA_TRIM_CHARS)
 
 
 def decode_ebcdic_string(data: bytes, trimming: TrimPolicy, table: str) -> str:

@@ -75,6 +75,22 @@ class _KernelGroup:
         self.offsets = np.array([c.offset for c in columns], dtype=np.int64)
 
 
+def _resolve_occurs(st: Statement, dep_value) -> int:
+    """DEPENDING ON value -> element count (clamp + string-handler rules,
+    reference RecordExtractors.scala:68-80). Shared by the per-cell and
+    compiled row-assembly paths."""
+    max_size = st.array_max_size
+    if dep_value is None:
+        return max_size
+    if isinstance(dep_value, str):
+        dep_value = st.depending_on_handlers.get(dep_value, max_size)
+    else:
+        dep_value = int(dep_value)
+    if st.array_min_size <= dep_value <= max_size:
+        return dep_value
+    return max_size
+
+
 def _pallas_group_spec(g: _KernelGroup):
     """StridedGroup for the fused Pallas kernel, or None if the group needs
     the XLA gather path (non-int32 lanes, irregular offsets, wide fields)."""
@@ -112,6 +128,9 @@ class DecodedBatch:
         self.data = data
         self.n_records = data.shape[0]
         self._out = outputs  # col index -> {"values","valid","dot_scale","bytes"}
+        self._str_cache: Dict[int, List[str]] = {}
+        self._col_cache: Dict[int, list] = {}
+        self._maker_cache: Dict[tuple, object] = {}
         # actual byte length of each record when shorter than the padded row
         # (variable-length files); columns past a record's end are null /
         # truncated like reference Primitive.decodeTypeValue (Primitive.scala:102)
@@ -171,26 +190,125 @@ class DecodedBatch:
         # (the digit-count-dependent PIC P semantics live in the oracle)
         return PyDecimal(mantissa).scaleb(-spec.params.scale)
 
+    def _vectorizable_string(self, spec: ColumnSpec) -> bool:
+        """EBCDIC columns always decode via the LUT code-point matrix;
+        ASCII only when the charset is plain US-ASCII (a custom charset
+        decodes per value through the scalar oracle)."""
+        return spec.codec is Codec.EBCDIC_STRING or (
+            spec.codec is Codec.ASCII_STRING
+            and not self.decoder.non_standard_ascii_charset)
+
     def _string_value(self, spec: ColumnSpec, out: dict, i: int):
+        if self._vectorizable_string(spec):
+            # whole-column decode on first access: one C-level bytes->str
+            # conversion + per-row slicing beats a per-value chr() join by
+            # ~50x at narrow-record row counts
+            cache = self._str_cache.get(spec.index)
+            if cache is None:
+                cache = self._decode_string_column(spec, out)
+                self._str_cache[spec.index] = cache
+            return cache[i]
         raw = out["bytes"][i]
         if spec.codec is Codec.RAW_BYTES:
             return bytes(raw.view(np.uint8))
         if spec.codec is Codec.HEX_STRING:
             return bytes(raw.view(np.uint8)).hex().upper()
         trimming = self.decoder.plan.trimming
-        if spec.codec is Codec.EBCDIC_STRING:
-            s = "".join(map(chr, raw))
-        elif spec.codec is Codec.ASCII_STRING:
-            if self.decoder.non_standard_ascii_charset:
-                return self.decoder.options.decode(spec.dtype,
-                                                   bytes(raw.view(np.uint8)))
-            s = bytes(raw.view(np.uint8)).decode("latin-1")
-        else:  # UTF16
-            enc = ("utf-16-be" if self.decoder.plan.is_utf16_big_endian
-                   else "utf-16-le")
-            s = bytes(raw.view(np.uint8)).decode(enc, errors="replace")
+        if spec.codec is Codec.ASCII_STRING:
+            return self.decoder.options.decode(spec.dtype,
+                                               bytes(raw.view(np.uint8)))
+        # UTF16
+        enc = ("utf-16-be" if self.decoder.plan.is_utf16_big_endian
+               else "utf-16-le")
+        s = bytes(raw.view(np.uint8)).decode(enc, errors="replace")
         from ..ops.scalar_decoders import _trim
         return _trim(s, trimming)
+
+    def _decode_string_column(self, spec: ColumnSpec,
+                              out: dict) -> List[str]:
+        from ..ops.scalar_decoders import _trim
+
+        arr = out["bytes"]
+        n = arr.shape[0]
+        w = arr.shape[1] if arr.ndim == 2 else 0
+        trimming = self.decoder.plan.trimming
+        if w == 0:
+            return [""] * n
+        if arr.dtype == np.uint16:  # EBCDIC LUT code points
+            blob = np.ascontiguousarray(arr).tobytes()
+            text = blob.decode("utf-16-le", errors="replace")
+        else:  # masked ASCII bytes (always < 0x80)
+            text = np.ascontiguousarray(arr).tobytes().decode("latin-1")
+        return [_trim(text[i * w:(i + 1) * w], trimming) for i in range(n)]
+
+    def column_values(self, col: int) -> list:
+        """Whole column as a Python value list (the vectorized form of
+        `value` — same null/decimal semantics, one pass per column instead
+        of one dynamic dispatch per cell)."""
+        lst = self._col_cache.get(col)
+        if lst is not None:
+            return lst
+        spec = self.decoder.plan.columns[col]
+        out = self._out[col]
+        n = self.n_records
+        if "host" in out:
+            lst = list(out["host"])
+        elif self._vectorizable_string(spec):
+            cached = self._str_cache.get(spec.index)
+            if cached is None:
+                cached = self._decode_string_column(spec, out)
+                self._str_cache[spec.index] = cached
+            # copy only when the truncation fixup below may mutate it
+            lst = list(cached) if self.lengths is not None else cached
+        elif spec.codec in _STRING_CODECS:
+            lst = [self._string_value(spec, out, i) for i in range(n)]
+        elif spec.codec in _FLOAT_CODECS:
+            vals = [float(v) for v in out["values"].tolist()]
+            valid = out["valid"]
+            if not valid.all():
+                vb = valid.tolist()
+                lst = [v if ok else None for v, ok in zip(vals, vb)]
+            else:
+                lst = vals
+        else:
+            valid = out["valid"]
+            mant = out["values"].tolist()
+            dt = spec.dtype
+            all_ok = bool(valid.all())
+            vb = None if all_ok else valid.tolist()
+            if isinstance(dt, Integral):
+                lst = (mant if all_ok
+                       else [v if ok else None for v, ok in zip(mant, vb)])
+            elif spec.params.explicit_decimal:
+                dots = out["dot_scale"].tolist()
+                if all_ok:
+                    lst = [PyDecimal(v).scaleb(-d)
+                           for v, d in zip(mant, dots)]
+                else:
+                    lst = [PyDecimal(v).scaleb(-d) if ok else None
+                           for v, d, ok in zip(mant, dots, vb)]
+            else:
+                # constant exponent per column (same branches as `value`)
+                sf = spec.params.scale_factor
+                if isinstance(dt, Decimal) and dt.usage is Usage.COMP3:
+                    n_digits = spec.width * 2 - 1
+                    e = (sf if sf > 0 else
+                         sf - n_digits if sf < 0 else -spec.params.scale)
+                else:
+                    e = -spec.params.scale
+                if all_ok:
+                    lst = [PyDecimal(v).scaleb(e) for v in mant]
+                else:
+                    lst = [PyDecimal(v).scaleb(e) if ok else None
+                           for v, ok in zip(mant, vb)]
+        if self.lengths is not None:
+            # columns (partly) past a record's end: re-derive through the
+            # scalar path, which owns the truncation rules
+            for i in np.nonzero(
+                    self.lengths < spec.offset + spec.width)[0]:
+                lst[int(i)] = self.value(col, int(i))
+        self._col_cache[col] = lst
+        return lst
 
     # -- row materialization ----------------------------------------------
 
@@ -209,19 +327,31 @@ class DecodedBatch:
         `record_ids` overrides the sequential first_record_id+i numbering
         (used when a batch holds non-contiguous records, e.g. one segment
         of a multisegment file)."""
+        uniform_active: Optional[str] = None
+        use_maker = active_segments is None or (
+            len(set(active_segments)) <= 1)
+        if use_maker and active_segments is not None and active_segments:
+            uniform_active = active_segments[0]
+        maker = (self._row_maker(uniform_active, policy)
+                 if use_maker else None)
+
         rows = []
         for i in range(self.n_records):
-            active = active_segments[i] if active_segments is not None else None
-            records = []
-            for root in self.decoder.copybook.ast.children:
-                if isinstance(root, Group):
-                    records.append(self._group_value(root, (), i, active))
-            if policy is SchemaRetentionPolicy.COLLAPSE_ROOT:
-                body: List[object] = []
-                for rec in records:
-                    body.extend(rec)
+            if maker is not None:
+                body = maker(i)
             else:
-                body = records
+                active = (active_segments[i]
+                          if active_segments is not None else None)
+                records = []
+                for root in self.decoder.copybook.ast.children:
+                    if isinstance(root, Group):
+                        records.append(self._group_value(root, (), i, active))
+                if policy is SchemaRetentionPolicy.COLLAPSE_ROOT:
+                    body = []
+                    for rec in records:
+                        body.extend(rec)
+                else:
+                    body = records
             seg = list(segment_level_ids[i]) if segment_level_ids else []
             rid = (record_ids[i] if record_ids is not None
                    else first_record_id + i)
@@ -236,23 +366,88 @@ class DecodedBatch:
             rows.append(row)
         return rows
 
+    # -- compiled row assembly ---------------------------------------------
+
+    def _row_maker(self, active: Optional[str],
+                   policy: SchemaRetentionPolicy):
+        """Compile the nested-row assembly into closures over the column
+        value lists: leaf access becomes list indexing instead of per-cell
+        dynamic dispatch (the difference between ~30us and ~3us per row on
+        narrow records). One maker per (active segment, policy) per batch."""
+        key = (active, policy)
+        maker = self._maker_cache.get(key)
+        if maker is not None:
+            return maker
+
+        def occurs_counts(st: Statement):
+            """Per-record element counts for an array statement (None when
+            the count is the constant max size)."""
+            dep_col = (self.decoder.dependee_columns.get(st.depending_on)
+                       if st.depending_on is not None else None)
+            if dep_col is None:
+                return None
+            return [_resolve_occurs(st, v)
+                    for v in self.column_values(dep_col)]
+
+        def build_group(group: Group, slot_path: Tuple[int, ...]):
+            makers = []
+            for st in group.children:
+                if st.is_array:
+                    counts = occurs_counts(st)
+                    if isinstance(st, Group):
+                        elems = [build_group(st, slot_path + (k,))
+                                 for k in range(st.array_max_size)]
+                    else:
+                        elems = [self._leaf_maker(st, slot_path + (k,))
+                                 for k in range(st.array_max_size)]
+                    if counts is None:
+                        m = (lambda i, e=elems:
+                             [mk(i) for mk in e])
+                    else:
+                        m = (lambda i, e=elems, c=counts:
+                             [e[k](i) for k in range(c[i])])
+                elif isinstance(st, Group):
+                    if st.is_segment_redefine and (
+                            active is None
+                            or st.name.upper() != active.upper()):
+                        m = lambda i: None
+                    else:
+                        m = build_group(st, slot_path)
+                else:
+                    m = self._leaf_maker(st, slot_path)
+                if not st.is_filler:
+                    makers.append(m)
+            return lambda i, ms=tuple(makers): tuple([mk(i) for mk in ms])
+
+        root_makers = [build_group(root, ())
+                       for root in self.decoder.copybook.ast.children
+                       if isinstance(root, Group)]
+        if policy is SchemaRetentionPolicy.COLLAPSE_ROOT:
+            def maker(i):
+                body: List[object] = []
+                for rm in root_makers:
+                    body.extend(rm(i))
+                return body
+        else:
+            def maker(i):
+                return [rm(i) for rm in root_makers]
+        self._maker_cache[key] = maker
+        return maker
+
+    def _leaf_maker(self, st: Primitive, slot_path: Tuple[int, ...]):
+        col = self.decoder.slot_map.get((id(st), slot_path))
+        if col is None:
+            return lambda i: None
+        values = self.column_values(col)
+        return values.__getitem__
+
     def _occurs_count(self, st: Statement, i: int) -> int:
-        max_size = st.array_max_size
         if st.depending_on is None:
-            return max_size
+            return st.array_max_size
         dep_col = self.decoder.dependee_columns.get(st.depending_on)
         if dep_col is None:
-            return max_size
-        dep_value = self.value(dep_col, i)
-        if dep_value is None:
-            return max_size
-        if isinstance(dep_value, str):
-            dep_value = st.depending_on_handlers.get(dep_value, max_size)
-        else:
-            dep_value = int(dep_value)
-        if st.array_min_size <= dep_value <= max_size:
-            return dep_value
-        return max_size
+            return st.array_max_size
+        return _resolve_occurs(st, self.value(dep_col, i))
 
     def _group_value(self, group: Group, slot_path: Tuple[int, ...], i: int,
                      active: Optional[str]) -> tuple:
@@ -284,7 +479,7 @@ class DecodedBatch:
         col = self.decoder.slot_map.get((id(st), slot_path))
         if col is None:
             return None
-        return self.value(col, i)
+        return self.column_values(col)[i]
 
 
 def decoder_for_segment(cache: Dict[str, "ColumnarDecoder"],
