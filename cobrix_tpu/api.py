@@ -198,8 +198,8 @@ def parse_options(options: Dict[str, object],
     params = ReaderParameters(
         is_ebcdic=is_ebcdic,
         is_text=opts.get_bool("is_text"),
-        ebcdic_code_page=opts.get("ebcdic_code_page_class")
-        or opts.get("ebcdic_code_page", "common"),
+        ebcdic_code_page=opts.get("ebcdic_code_page", "common"),
+        ebcdic_code_page_class=opts.get("ebcdic_code_page_class"),
         ascii_charset=opts.get("ascii_charset", "") or "us-ascii",
         is_utf16_big_endian=opts.get_bool("is_utf16_big_endian", True),
         floating_point_format=_parse_enum(opts, "floating_point_format", "ibm"),
@@ -386,7 +386,7 @@ def read_cobol(path=None,
     if not files:
         raise FileNotFoundError(f"No input files found for path {path}")
 
-    is_var_len = params.is_variable_length
+    is_var_len = params.needs_var_len_reader
 
     # Seg_Id columns exist only on the variable-length path (the reference
     # fixed-length reader never generates them)

@@ -9,7 +9,7 @@ kernels. Custom code pages register via `register_code_page`.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
@@ -118,13 +118,24 @@ def load_code_page_class(class_path: str) -> str:
     return table
 
 
+def resolve_code_page(name: str, class_path: Optional[str] = None) -> str:
+    """Effective code-page key for a reader configuration: an explicit
+    custom class path wins (loaded + registered on first use, reference
+    CodePage.getCodePageByClass), otherwise the plain name is returned for
+    the builtin-table lookup. Class loading is keyed ONLY off the explicit
+    `ebcdic_code_page_class` option — a dotted plain name is just an
+    unknown code page."""
+    if class_path:
+        if class_path not in _CUSTOM:  # load + register on first use only
+            load_code_page_class(class_path)
+        return class_path
+    return name
+
+
 def get_code_page_table(name: str) -> str:
-    """256-char Unicode string indexed by EBCDIC byte value. Dotted names
-    are treated as custom code-page class paths and loaded on first use."""
+    """256-char Unicode string indexed by EBCDIC byte value."""
     if name in _CUSTOM:
         return _CUSTOM[name]
-    if "." in name:
-        return load_code_page_class(name)
     try:
         return _TABLES[name]
     except KeyError:

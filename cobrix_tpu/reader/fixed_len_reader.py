@@ -12,6 +12,7 @@ from typing import Iterator, List, Optional
 import numpy as np
 
 from ..copybook.copybook import Copybook, merge_copybooks, parse_copybook
+from ..encoding.codepages import resolve_code_page
 from .columnar import ColumnarDecoder, DecodedBatch, decoder_for_segment
 from .extractors import DecodeOptions, extract_record
 from .parameters import ReaderParameters
@@ -36,7 +37,8 @@ class FixedLenReader:
                 field_parent_map=dict(seg.field_parent_map) if seg else None,
                 string_trimming_policy=params.string_trimming_policy,
                 comment_policy=params.comment_policy,
-                ebcdic_code_page=params.ebcdic_code_page,
+                ebcdic_code_page=resolve_code_page(
+                    params.ebcdic_code_page, params.ebcdic_code_page_class),
                 ascii_charset=params.ascii_charset,
                 is_utf16_big_endian=params.is_utf16_big_endian,
                 floating_point_format=params.floating_point_format,
@@ -137,14 +139,17 @@ class FixedLenReader:
             input_file_name=input_file_name)
 
     # -- multisegment fixed-length records ---------------------------------
-    # (reference FixedLenNestedRowIterator.scala:~55-66: per-record segment
-    # redefine choice + segment filter over fixed-size records)
+    # (reference FixedLenNestedRowIterator.scala:63-71: per-record segment
+    # redefine choice only — the fixed-length iterator has NO segment
+    # filter; segment_id_filter is honored only by VarLenNestedIterator, so
+    # a filter on a plain fixed-length read emits ALL records, matching the
+    # reference. A filtered read routes through the varlen reader only when
+    # generate_record_id makes variableLengthParams Some.)
 
     @property
     def _is_multisegment(self) -> bool:
         seg = self.params.multisegment
-        return bool(seg and seg.segment_id_field
-                    and (self.segment_redefine_map or seg.segment_id_filter))
+        return bool(seg and seg.segment_id_field and self.segment_redefine_map)
 
     def _decoder_for_segment(self, active: str,
                              backend: str) -> ColumnarDecoder:
@@ -166,15 +171,10 @@ class FixedLenReader:
                             first_record_id: int, input_file_name: str,
                             ignore_file_size: bool) -> List[List[object]]:
         params = self.params
-        seg = params.multisegment
         self.check_binary_data_validity(len(data), ignore_file_size)
         matrix = self.to_record_matrix(data, ignore_file_size)
         segment_ids = self._segment_values(matrix)
 
-        keep = np.ones(matrix.shape[0], dtype=bool)
-        if seg.segment_id_filter:
-            allowed = set(seg.segment_id_filter)
-            keep &= np.asarray([s in allowed for s in segment_ids], dtype=bool)
         actives = np.asarray(
             [self.segment_redefine_map.get(s, "") for s in segment_ids],
             dtype=object)
@@ -182,9 +182,8 @@ class FixedLenReader:
         trimmed, width = self._trimmed_matrix(matrix)
 
         rows_by_pos = {}
-        kept = np.nonzero(keep)[0]
-        for active in set(actives[kept].tolist()):
-            positions = np.nonzero(keep & (actives == active))[0]
+        for active in set(actives.tolist()):
+            positions = np.nonzero(actives == active)[0]
             decoder = self._decoder_for_segment(active, backend)
             lengths = (np.full(len(positions), width, dtype=np.int64)
                        if width < self.copybook.record_size else None)
@@ -210,16 +209,11 @@ class FixedLenReader:
         self.check_binary_data_validity(len(data), ignore_file_size)
         matrix = self.to_record_matrix(data, ignore_file_size)
         options = DecodeOptions.from_copybook(self.copybook)
-        seg = self.params.multisegment
         segment_ids = (self._segment_values(matrix)
                        if self._is_multisegment else None)
-        allowed = (set(seg.segment_id_filter)
-                   if seg and seg.segment_id_filter else None)
         for i in range(matrix.shape[0]):
             active = ""
             if segment_ids is not None:
-                if allowed is not None and segment_ids[i] not in allowed:
-                    continue
                 active = self.segment_redefine_map.get(segment_ids[i], "")
             yield extract_record(
                 self.copybook.ast,

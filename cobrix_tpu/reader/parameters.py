@@ -42,6 +42,11 @@ class ReaderParameters:
     is_ebcdic: bool = True
     is_text: bool = False
     ebcdic_code_page: str = "common"
+    # explicit custom code-page class path; only this field routes through
+    # class loading (reference: getCodePageByClass is used only when the
+    # codePageClass option is set), so a typo'd plain code-page name with a
+    # dot still gets the 'unknown code page' message
+    ebcdic_code_page_class: Optional[str] = None
     ascii_charset: str = "us-ascii"
     is_utf16_big_endian: bool = True
     floating_point_format: FloatingPointFormat = FloatingPointFormat.IBM
@@ -82,10 +87,22 @@ class ReaderParameters:
 
     @property
     def is_variable_length(self) -> bool:
-        """True when the configuration needs the variable-length reader
-        (also the gate for per-record input-file tracking). Shared by the
-        read dispatch and option validation so they cannot drift."""
+        """The option-validation predicate for per-record input-file
+        tracking (reference CobolParametersParser.scala:576-581 —
+        generate_record_id alone does NOT enable it)."""
         return bool(self.is_record_sequence or self.is_text
                     or self.variable_size_occurs or self.length_field_name
                     or self.record_extractor or self.file_start_offset > 0
                     or self.file_end_offset > 0)
+
+    @property
+    def needs_var_len_reader(self) -> bool:
+        """True when the configuration routes through the variable-length
+        reader. Wider than `is_variable_length`: generate_record_id alone
+        makes the reference's variableLengthParams Some(...), so the varlen
+        reader (with a fixed-length header parser) handles the read — which
+        is why Seg_Id generation and segment filtering work on fixed-size
+        records only when record ids are generated
+        (CobolParametersParser.parseVariableLengthParameters:~253-262,
+        DefaultSource.buildEitherReader:72-81)."""
+        return self.is_variable_length or self.generate_record_id

@@ -21,6 +21,7 @@ import numpy as np
 
 from ..copybook.ast import Group, Primitive
 from ..copybook.copybook import Copybook, merge_copybooks, parse_copybook
+from ..encoding.codepages import resolve_code_page
 from .columnar import ColumnarDecoder, decoder_for_segment
 from .extractors import (
     DecodeOptions,
@@ -141,7 +142,8 @@ class VarLenReader:
                 field_parent_map=dict(seg.field_parent_map) if seg else None,
                 string_trimming_policy=params.string_trimming_policy,
                 comment_policy=params.comment_policy,
-                ebcdic_code_page=params.ebcdic_code_page,
+                ebcdic_code_page=resolve_code_page(
+                    params.ebcdic_code_page, params.ebcdic_code_page_class),
                 ascii_charset=params.ascii_charset,
                 is_utf16_big_endian=params.is_utf16_big_endian,
                 floating_point_format=params.floating_point_format,
@@ -198,9 +200,15 @@ class VarLenReader:
                                      self.params.file_end_offset,
                                      adjustment)
         else:
+            # record_length override wins over the copybook size (same
+            # semantics as FixedLenReader.record_size: the override is the
+            # full on-disk record, offsets not re-added)
+            record_size = (self.params.record_length_override
+                           or self.copybook.record_size
+                           + self.params.start_offset
+                           + self.params.end_offset)
             parser = FixedLengthHeaderParser(
-                self.copybook.record_size + self.params.start_offset
-                + self.params.end_offset,
+                record_size,
                 self.params.file_start_offset, self.params.file_end_offset)
         if self.params.rhp_additional_info is not None:
             parser.on_receive_additional_info(self.params.rhp_additional_info)

@@ -55,9 +55,8 @@ class CobolStreamer:
             data, backend=self.backend, file_id=file_id,
             first_record_id=self._next_record_id,
             input_file_name=input_file_name)
-        # advance by records CONSUMED, not rows emitted — a segment filter
-        # drops rows but their record ids stay assigned by position; file
-        # header/footer regions are not records
+        # advance by records CONSUMED (file header/footer regions are not
+        # records), independent of rows emitted
         body = (len(data) - self.params.file_start_offset
                 - self.params.file_end_offset)
         self._next_record_id += max(body, 0) // self.record_size
@@ -68,6 +67,16 @@ class CobolStreamer:
     def stream_chunks(self, chunks: Iterable[bytes]) -> Iterator[CobolData]:
         """One decoded batch per incoming chunk (chunks need not align to
         record boundaries; partial records carry over)."""
+        if self.params.file_start_offset or self.params.file_end_offset:
+            # a chunk stream has no file boundaries: there is no "file
+            # header/footer" to trim, and _batch would subtract the offsets
+            # from every micro-batch (mis-sizing the divisibility check and
+            # the record-id advance). Offsets stay valid for
+            # stream_directory, where each file genuinely has them.
+            raise ValueError(
+                "Options 'file_start_offset'/'file_end_offset' cannot be "
+                "used with stream_chunks; use stream_directory for files "
+                "with headers/footers")
         rs = self.record_size
         pending = b""
         for chunk in chunks:

@@ -187,3 +187,95 @@ def test_var_occurs_shifts_following_root_group():
         [(0, []), ("ZZZ",)],
         [(5, ["a", "b", "c", "d", "e"]), ("WWW",)],
     ]
+
+
+# -- advisor round-1 pins ---------------------------------------------------
+
+SEG_FIXED_COPYBOOK = """
+       01 RECORD.
+          05 SEG-ID    PIC X(1).
+          05 COMPANY.
+             10 NAME   PIC X(5).
+          05 CONTACT REDEFINES COMPANY.
+             10 PHONE  PIC X(5).
+"""
+
+
+def _seg_fixed_file(tmp):
+    recs = [("C", "ACME "), ("P", "12345"), ("C", "GLOBX"), ("P", "67890")]
+    payload = b"".join(ebcdic_encode(sid + body) for sid, body in recs)
+    return _write(tmp, "seg.bin", payload)
+
+
+def test_fixed_length_read_ignores_segment_filter():
+    """Reference parity: FixedLenNestedRowIterator has no segment filter
+    (FixedLenNestedRowIterator.scala:63-71); a plain fixed-length read with
+    segment_id_filter emits ALL records."""
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _seg_fixed_file(tmp)
+        res = read_cobol(path, copybook_contents=SEG_FIXED_COPYBOOK,
+                         segment_field="SEG-ID", segment_filter="C",
+                         **{"redefine-segment-id-map:1": "COMPANY => C",
+                            "redefine-segment-id-map:2": "CONTACT => P"})
+        assert len(res) == 4  # filter NOT applied on the fixed-length path
+        host = read_cobol(path, copybook_contents=SEG_FIXED_COPYBOOK,
+                          backend="host",
+                          segment_field="SEG-ID", segment_filter="C",
+                          **{"redefine-segment-id-map:1": "COMPANY => C",
+                             "redefine-segment-id-map:2": "CONTACT => P"})
+        assert host.to_rows() == res.to_rows()
+
+
+def test_generate_record_id_routes_fixed_file_through_varlen_reader():
+    """Reference parity: generate_record_id alone makes variableLengthParams
+    Some(...), so the varlen reader handles the read and the segment filter
+    IS honored (CobolParametersParser.parseVariableLengthParameters)."""
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _seg_fixed_file(tmp)
+        res = read_cobol(path, copybook_contents=SEG_FIXED_COPYBOOK,
+                         generate_record_id="true",
+                         segment_field="SEG-ID", segment_filter="C",
+                         **{"redefine-segment-id-map:1": "COMPANY => C",
+                            "redefine-segment-id-map:2": "CONTACT => P"})
+        rows = res.to_rows()
+        assert len(rows) == 2  # varlen iterator honors the filter
+        # Record_Id keeps the by-position numbering of unfiltered records
+        assert [r[1] for r in rows] == [0, 2]
+
+
+def test_stream_chunks_rejects_file_offsets():
+    from cobrix_tpu.streaming import CobolStreamer
+
+    streamer = CobolStreamer("       01 R.\n          05 F PIC X(4).\n",
+                             file_start_offset="4")
+    with pytest.raises(ValueError, match="stream_chunks"):
+        list(streamer.stream_chunks([b"HEADabcd"]))
+
+
+def test_record_length_override_with_generate_record_id():
+    """The varlen route taken by generate_record_id must honor the
+    record_length override (review finding: FixedLengthHeaderParser was
+    built from copybook.record_size only)."""
+    copybook = "       01 R.\n          05 F PIC X(4).\n"
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "r.bin", ebcdic_encode("ABCDxxEFGHxxIJKLxx"))
+        res = read_cobol(path, copybook_contents=copybook,
+                         record_length="6", generate_record_id="true")
+        assert [r[2:] for r in res.to_rows()] == [
+            [("ABCD",)], [("EFGH",)], [("IJKL",)]]
+        assert [r[1] for r in res.to_rows()] == [0, 1, 2]
+
+
+def test_generate_record_id_drops_trailing_partial_record():
+    """Reference parity pin: the varlen reader (fixed-length header parser)
+    silently drops a trailing partial record, while the plain fixed path
+    raises a divisibility error (CobolScanners.scala:88 vs
+    RecordHeaderParserFixedLen.scala:22-52)."""
+    copybook = "       01 R.\n          05 F PIC X(4).\n"
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "p.bin", ebcdic_encode("ABCDEFGHXY"))
+        with pytest.raises(ValueError, match="does not divide"):
+            read_cobol(path, copybook_contents=copybook)
+        res = read_cobol(path, copybook_contents=copybook,
+                         generate_record_id="true")
+        assert [r[2:] for r in res.to_rows()] == [[("ABCD",)], [("EFGH",)]]

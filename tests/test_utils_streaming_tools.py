@@ -202,7 +202,11 @@ class TestCustomCodePage:
         from cobrix_tpu import parse_copybook
         from cobrix_tpu.reader.extractors import DecodeOptions, extract_record
 
+        from cobrix_tpu.encoding.codepages import resolve_code_page
+
         cls_path = f"{__name__}.FakeCodePage"
+        # class loading is keyed ONLY off the explicit class option
+        assert resolve_code_page("common", cls_path) == cls_path
         assert get_code_page_table(cls_path)[0xC1] == "A"
         cb = parse_copybook("        01  R.\n            05  F PIC X(3).\n",
                             ebcdic_code_page=cls_path)
@@ -211,8 +215,16 @@ class TestCustomCodePage:
         assert row == [("AB#",)]
 
     def test_bad_class_path(self):
+        from cobrix_tpu.encoding.codepages import load_code_page_class
+
         with pytest.raises(ValueError, match="Unable to load"):
-            get_code_page_table("no.such.module.Cls")
+            load_code_page_class("no.such.module.Cls")
+
+    def test_dotted_plain_name_is_not_an_import(self):
+        # a typo'd plain code-page name with a dot must produce the
+        # 'unknown code page' message, not an import attempt
+        with pytest.raises(ValueError, match="not one of the builtin"):
+            get_code_page_table("no.such.module.Cls2")
 
 
 class TestReplication:
