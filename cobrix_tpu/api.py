@@ -31,6 +31,7 @@ from .reader.parameters import (
     MultisegmentParameters,
     ReaderParameters,
 )
+from .reader.result import FileResult, rows_file_result
 from .reader.schema import CobolOutputSchema, StructType
 from .reader.stream import FSStream
 from .reader.var_len_reader import VarLenReader, default_segment_id_prefix
@@ -309,39 +310,64 @@ def list_input_files(path) -> List[str]:
 
 
 class CobolData:
-    """Decoded result: rows + schema, materializable as JSON lines, pandas,
-    or Arrow."""
+    """Decoded result: per-file columnar results + schema, materializable
+    as rows, JSON lines, pandas, or Arrow. Arrow tables are built straight
+    from the kernel output arrays (reader/arrow_out.py); Python rows are
+    materialized only when asked for."""
 
-    def __init__(self, rows: List[List[object]], schema: CobolOutputSchema):
+    def __init__(self, rows, schema: CobolOutputSchema,
+                 results: Optional[List["FileResult"]] = None):
         self._rows = rows
+        self._results = results
         self.output_schema = schema
+
+    @classmethod
+    def from_results(cls, results: List["FileResult"],
+                     schema: CobolOutputSchema) -> "CobolData":
+        return cls(None, schema, results)
 
     @property
     def schema(self) -> StructType:
         return self.output_schema.schema
 
     def __len__(self) -> int:
-        return len(self._rows)
+        if self._rows is not None:
+            return len(self._rows)
+        return sum(r.n_rows for r in self._results)
 
     def to_rows(self) -> List[List[object]]:
+        if self._rows is None:
+            rows: List[List[object]] = []
+            for r in self._results:
+                rows.extend(r.to_rows())
+            self._rows = rows
         return self._rows
 
     def to_dicts(self) -> List[dict]:
         names = self.schema.field_names()
-        return [dict(zip(names, row)) for row in self._rows]
+        return [dict(zip(names, row)) for row in self.to_rows()]
 
     def to_json_lines(self) -> List[str]:
-        return rows_to_json(self._rows, self.schema)
+        return rows_to_json(self.to_rows(), self.schema)
 
     def to_pandas(self):
-        import pandas as pd
-        return pd.DataFrame(self.to_dicts())
+        return self.to_arrow().to_pandas()
 
     def to_arrow(self):
+        """pyarrow Table with schema-declared types, built from the kernel
+        outputs without row materialization (the reference must feed Spark
+        rows, SparkCobolRowType.scala:24; a columnar framework emits
+        columns)."""
         import pyarrow as pa
-        names = self.schema.field_names()
-        columns = list(zip(*self._rows)) if self._rows else [[] for _ in names]
-        return pa.table({n: list(c) for n, c in zip(names, columns)})
+
+        from .reader.arrow_out import arrow_schema, rows_to_table
+
+        if self._results is None:
+            return rows_to_table(self._rows, self.schema)
+        tables = [r.to_arrow(self.output_schema) for r in self._results]
+        if not tables:
+            return arrow_schema(self.schema).empty_table()
+        return tables[0] if len(tables) == 1 else pa.concat_tables(tables)
 
 
 def read_cobol(path=None,
@@ -392,7 +418,7 @@ def read_cobol(path=None,
     # fixed-length reader never generates them)
     seg_count = (len(params.multisegment.segment_level_ids)
                  if params.multisegment and is_var_len else 0)
-    rows: List[List[object]] = []
+    results: List[FileResult] = []
     copybook_obj: Optional[Copybook] = None
 
     if is_var_len:
@@ -404,15 +430,14 @@ def read_cobol(path=None,
         for file_order, file_path in enumerate(files):
             with FSStream(file_path) as stream:
                 if backend == "host":
-                    file_rows = list(reader.iter_rows(
+                    results.append(rows_file_result(list(reader.iter_rows(
                         stream, file_id=file_order, segment_id_prefix=prefix,
-                        start_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT))
+                        start_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT))))
                 else:
-                    file_rows = reader.read_rows_columnar(
+                    results.append(reader.read_result_columnar(
                         stream, file_id=file_order, backend=backend,
                         segment_id_prefix=prefix,
-                        start_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT)
-            rows.extend(file_rows)
+                        start_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT))
     else:
         reader = FixedLenReader(copybook_contents, params)
         copybook_obj = reader.copybook
@@ -420,18 +445,17 @@ def read_cobol(path=None,
             with open(file_path, "rb") as f:
                 data = f.read()
             if backend == "host":
-                file_rows = list(reader.iter_rows_host(
+                results.append(rows_file_result(list(reader.iter_rows_host(
                     data, file_id=file_order,
                     first_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT,
                     input_file_name=file_path,
-                    ignore_file_size=debug_ignore_file_size))
+                    ignore_file_size=debug_ignore_file_size))))
             else:
-                file_rows = reader.read_rows(
+                results.append(reader.read_result(
                     data, backend=backend, file_id=file_order,
                     first_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT,
                     input_file_name=file_path,
-                    ignore_file_size=debug_ignore_file_size)
-            rows.extend(file_rows)
+                    ignore_file_size=debug_ignore_file_size))
 
     schema = CobolOutputSchema(
         copybook_obj,
@@ -440,4 +464,4 @@ def read_cobol(path=None,
         generate_record_id=params.generate_record_id,
         generate_seg_id_field_count=seg_count,
         segment_id_prefix="")
-    return CobolData(rows, schema)
+    return CobolData.from_results(results, schema)

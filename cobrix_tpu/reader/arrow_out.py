@@ -1,0 +1,407 @@
+"""Vectorized Arrow output: kernel column arrays -> pyarrow Table.
+
+The reference feeds Spark per-record GenericRows because a Spark source
+must (SparkCobolRowType.scala:24); a columnar framework emits Arrow arrays
+straight from the kernel outputs instead. Numeric columns become typed
+arrays from the (values, valid) numpy pairs without touching Python
+objects; Decimal columns are built as decimal128 buffers from the int
+mantissas; strings come from the LUT code-point matrix through one
+vectorized trim + mask gather; OCCURS arrays become ListArrays whose
+offsets derive from the DEPENDING-ON counts. Schema types follow the same
+mapping as the output StructType (spark-cobol schema/CobolSchema.scala:
+77-173): Decimal->decimal128(p,s), Integral->int32/int64 by precision
+bucket, COMP-1/2->float32/float64, RAW->binary, OCCURS->list.
+
+The fallback for anything the vectorized path can't express (host-fallback
+codecs, truncated variable-length tails, non-ASCII code points, custom
+charsets) is the per-column Python value list — same values, same nulls.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..copybook.ast import Group, Primitive, Statement
+from ..copybook.datatypes import SchemaRetentionPolicy, TrimPolicy
+from .columnar import (
+    _FLOAT_CODECS,
+    _STRING_CODECS,
+    _resolve_occurs,
+    DecodedBatch,
+    fixed_point_exponent,
+)
+from .schema import (
+    ArrayType,
+    Field,
+    SimpleType,
+    StructType,
+    primitive_data_type,
+)
+
+
+def _pa():
+    import pyarrow as pa
+    return pa
+
+
+def to_arrow_type(t):
+    """Our schema type -> pyarrow type (decimal(p,s) strings included)."""
+    pa = _pa()
+    if isinstance(t, SimpleType):
+        name = t.name
+        if name == "string":
+            return pa.string()
+        if name == "integer":
+            return pa.int32()
+        if name == "long":
+            return pa.int64()
+        if name == "float":
+            return pa.float32()
+        if name == "double":
+            return pa.float64()
+        if name == "binary":
+            return pa.binary()
+        if name.startswith("decimal("):
+            p, s = name[8:-1].split(",")
+            return pa.decimal128(int(p), int(s))
+        raise TypeError(f"Unknown simple type {name}")
+    if isinstance(t, StructType):
+        return pa.struct([(f.name, to_arrow_type(f.dtype)) for f in t.fields])
+    if isinstance(t, ArrayType):
+        return pa.list_(to_arrow_type(t.element))
+    raise TypeError(t)
+
+
+def arrow_schema(struct: StructType):
+    pa = _pa()
+    return pa.schema([(f.name, to_arrow_type(f.dtype)) for f in struct.fields])
+
+
+def _validity_buffer(valid: np.ndarray):
+    pa = _pa()
+    return pa.py_buffer(np.packbits(valid, bitorder="little").tobytes())
+
+
+def _decimal128_from_mantissa(mantissa: np.ndarray, valid: np.ndarray,
+                              pa_type):
+    """decimal128 array with the int64 mantissa as the unscaled value."""
+    pa = _pa()
+    n = len(mantissa)
+    le = np.zeros((n, 2), dtype="<i8")
+    le[:, 0] = mantissa
+    le[:, 1] = mantissa >> 63  # sign extension of the high limb
+    vbuf = None if valid.all() else _validity_buffer(valid)
+    return pa.Array.from_buffers(pa_type, n,
+                                 [vbuf, pa.py_buffer(le.tobytes())])
+
+
+# Java String.trim strips everything <= ' ' on both sides; left/right trim
+# strip " \t" (scalar_decoders._trim parity)
+_JAVA_TRIM = "".join(map(chr, range(0x21)))
+_LR_TRIM = " \t"
+
+
+def _string_from_codepoints(mat: np.ndarray, trimming: TrimPolicy):
+    """[n, w] code points (uint8 masked ASCII or uint16 LUT output) -> Arrow
+    string array. Requires every code point <= 0x7F so UTF-8 bytes == code
+    points (the caller falls back otherwise); the fixed-width matrix becomes
+    one zero-gather string buffer with uniform offsets, and trimming runs in
+    Arrow's C++ kernels."""
+    import pyarrow.compute as pc
+
+    pa = _pa()
+    n, w = mat.shape
+    data = np.ascontiguousarray(mat.astype(np.uint8, copy=False))
+    big = n * w > 2**31 - 8
+    off_t, s_t = ("<i8", pa.large_string()) if big else ("<i4", pa.string())
+    offsets = np.arange(n + 1, dtype=off_t) * w
+    arr = pa.Array.from_buffers(
+        s_t, n, [None, pa.py_buffer(offsets.tobytes()),
+                 pa.py_buffer(data.tobytes())])
+    if trimming is TrimPolicy.BOTH:
+        arr = pc.utf8_trim(arr, characters=_JAVA_TRIM)
+    elif trimming is TrimPolicy.LEFT:
+        arr = pc.utf8_ltrim(arr, characters=_LR_TRIM)
+    elif trimming is TrimPolicy.RIGHT:
+        arr = pc.utf8_rtrim(arr, characters=_LR_TRIM)
+    if big:
+        arr = arr.cast(pa.string())
+    return arr
+
+
+class ArrowBatchBuilder:
+    """Builds Arrow arrays for one DecodedBatch (one active segment)."""
+
+    def __init__(self, batch: DecodedBatch, active: Optional[str]):
+        self.batch = batch
+        self.decoder = batch.decoder
+        self.active = active
+        self.n = batch.n_records
+
+    # -- leaves ------------------------------------------------------------
+
+    def _python_fallback(self, col: int, pa_type):
+        pa = _pa()
+        return pa.array(self.batch.column_values(col), type=pa_type)
+
+    def _leaf_array(self, st: Primitive, slot_path):
+        pa = _pa()
+        pa_type = to_arrow_type(primitive_data_type(st))
+        col = self.decoder.slot_map.get((id(st), slot_path))
+        if col is None:
+            return pa.nulls(self.n, type=pa_type)
+        spec = self.decoder.plan.columns[col]
+        out = self.batch.column_arrays(col)
+        lengths = self.batch.lengths
+        if lengths is not None and bool(
+                (lengths < spec.offset + spec.width).any()):
+            # truncated variable-length tails: the scalar path owns the
+            # partial-field rules
+            return self._python_fallback(col, pa_type)
+        if "host" in out:
+            return self._python_fallback(col, pa_type)
+        if spec.codec in _STRING_CODECS:
+            return self._string_array(spec, out, pa_type)
+        if spec.codec in _FLOAT_CODECS:
+            values = np.asarray(out["values"])
+            valid = np.asarray(out["valid"])
+            np_t = np.float32 if pa.types.is_float32(pa_type) else np.float64
+            return pa.array(values.astype(np_t, copy=False),
+                            mask=~valid if not valid.all() else None)
+        # fixed-point
+        values = np.asarray(out["values"])
+        valid = np.asarray(out["valid"])
+        mask = None if valid.all() else ~valid
+        if pa.types.is_integer(pa_type):
+            np_t = np.int32 if pa.types.is_int32(pa_type) else np.int64
+            return pa.array(values.astype(np_t, copy=False), mask=mask)
+        if pa.types.is_decimal(pa_type):
+            if pa_type.precision > 18:
+                # int64 mantissa can't be widened safely past 18 digits
+                return self._python_fallback(col, pa_type)
+            mantissa = values.astype(np.int64, copy=False)
+            if spec.params.explicit_decimal:
+                shift = pa_type.scale - np.asarray(out["dot_scale"],
+                                                   dtype=np.int64)
+            else:
+                shift = pa_type.scale + fixed_point_exponent(spec)
+            if np.any(shift < 0) or np.any(shift > 18):
+                return self._python_fallback(col, pa_type)
+            mantissa = mantissa * 10 ** shift
+            return _decimal128_from_mantissa(mantissa, valid, pa_type)
+        return self._python_fallback(col, pa_type)
+
+    def _string_array(self, spec, out, pa_type):
+        pa = _pa()
+        if not self.batch._vectorizable_string(spec):
+            # UTF-16 / HEX / RAW / custom charsets: per-value host decode
+            return self._python_fallback(spec.index, pa_type)
+        mat = out["bytes"]
+        if mat.ndim != 2 or mat.shape[1] == 0:
+            return pa.array([""] * self.n, type=pa_type)
+        if mat.dtype == np.uint16 and bool((mat > 0x7F).any()):
+            # non-ASCII code points need real UTF-8 encoding
+            return self._python_fallback(spec.index, pa_type)
+        return _string_from_codepoints(mat, self.decoder.plan.trimming)
+
+    # -- arrays / groups ---------------------------------------------------
+
+    def _occurs_counts(self, st: Statement) -> Optional[np.ndarray]:
+        """Per-record element counts, or None when constant max size."""
+        if st.depending_on is None:
+            return None
+        dep_col = self.decoder.dependee_columns.get(st.depending_on)
+        if dep_col is None:
+            return None
+        values = self.batch.column_values(dep_col)
+        if st.depending_on_handlers or any(
+                not isinstance(v, (int, np.integer)) for v in values):
+            return np.asarray([_resolve_occurs(st, v) for v in values],
+                              dtype=np.int64)
+        v = np.asarray(values, dtype=np.int64)
+        return np.where((v >= st.array_min_size) & (v <= st.array_max_size),
+                        v, st.array_max_size)
+
+    def _list_array(self, st: Statement, slot_path):
+        """OCCURS -> ListArray: element slots interleaved via one take."""
+        pa = _pa()
+        n, max_size = self.n, st.array_max_size
+        elems = [self._statement_array(st, slot_path + (k,), as_element=True)
+                 for k in range(max_size)]
+        counts = self._occurs_counts(st)
+        if n == 0 or max_size == 0:
+            value_type = (elems[0].type if elems
+                          else to_arrow_type(self._element_schema_type(st)))
+            return pa.ListArray.from_arrays(
+                pa.array(np.zeros(n + 1, dtype=np.int32)),
+                pa.nulls(0, type=value_type))
+        # element k of record i sits at position k*n + i of the concatenation
+        idx = (np.arange(max_size)[None, :] * n
+               + np.arange(n)[:, None])
+        if counts is None:
+            lengths = np.full(n, max_size, dtype=np.int64)
+            indices = idx.ravel()
+        else:
+            mask = np.arange(max_size)[None, :] < counts[:, None]
+            lengths = counts
+            indices = idx[mask]
+        values = pa.concat_arrays(elems).take(indices)
+        offsets = np.zeros(n + 1, dtype=np.int32)
+        np.cumsum(lengths, out=offsets[1:])
+        return pa.ListArray.from_arrays(pa.array(offsets), values)
+
+    def _element_schema_type(self, st: Statement):
+        if isinstance(st, Group):
+            return StructType(self._group_fields(st))
+        return primitive_data_type(st)
+
+    def _group_fields(self, group: Group) -> List[Field]:
+        fields = []
+        for child in group.children:
+            if child.is_filler:
+                continue
+            if isinstance(child, Group):
+                if child.parent_segment is not None:
+                    continue
+                t = self._element_schema_type(child)
+                fields.append(Field(
+                    child.name, ArrayType(t) if child.is_array else t))
+            else:
+                t = primitive_data_type(child)
+                fields.append(Field(
+                    child.name, ArrayType(t) if child.is_array else t))
+        return fields
+
+    def _struct_array(self, group: Group, slot_path):
+        pa = _pa()
+        names, children = [], []
+        for child in group.children:
+            if child.is_filler:
+                continue
+            if isinstance(child, Group) and child.parent_segment is not None:
+                continue  # hierarchical child segments never reach this path
+            names.append(child.name)
+            children.append(self._statement_array(child, slot_path))
+        if not children:
+            return pa.nulls(self.n, type=pa.struct([]))
+        return pa.StructArray.from_arrays(children, names=names)
+
+    def _statement_array(self, st: Statement, slot_path,
+                         as_element: bool = False):
+        pa = _pa()
+        if st.is_array and not as_element:
+            return self._list_array(st, slot_path)
+        if isinstance(st, Group):
+            if st.is_segment_redefine and not as_element and (
+                    self.active is None
+                    or st.name.upper() != self.active.upper()):
+                t = to_arrow_type(StructType(self._group_fields(st)))
+                return pa.nulls(self.n, type=t)
+            return self._struct_array(st, slot_path)
+        return self._leaf_array(st, slot_path)
+
+    # -- top level ---------------------------------------------------------
+
+    def body_columns(self, policy: SchemaRetentionPolicy):
+        """(name, array) pairs for the record body, matching
+        CobolOutputSchema._create_schema ordering."""
+        out = []
+        for root in self.decoder.copybook.ast.children:
+            if not isinstance(root, Group):
+                continue
+            if policy is SchemaRetentionPolicy.COLLAPSE_ROOT:
+                for child in root.children:
+                    if child.is_filler:
+                        continue
+                    if isinstance(child, Group) and child.parent_segment is not None:
+                        continue
+                    out.append((child.name, self._statement_array(child, ())))
+            else:
+                out.append((root.name, self._statement_array(root, ())))
+        return out
+
+
+def segment_table(batch: DecodedBatch,
+                  active: Optional[str],
+                  output_schema,
+                  file_id: int,
+                  record_ids: Optional[np.ndarray],
+                  seg_level_ids: Optional[Sequence[Sequence[object]]],
+                  input_file_name: str = ""):
+    """One Arrow table for one decoded (single-active-segment) batch, with
+    generated columns prepended per the output schema."""
+    pa = _pa()
+    builder = ArrowBatchBuilder(batch, active)
+    n = batch.n_records
+    schema = output_schema.schema
+
+    def seg_arrays():
+        out = []
+        for lvl in range(output_schema.generate_seg_id_field_count):
+            vals = ([row[lvl] if row is not None and lvl < len(row) else None
+                     for row in seg_level_ids] if seg_level_ids is not None
+                    else [None] * n)
+            out.append(pa.array(vals, type=pa.string()))
+        return out
+
+    # Generated columns in ROW order (extractors._apply_post_processing /
+    # reference RecordExtractors.applyRecordPostProcessing): with record
+    # ids the file name goes before the Seg_Id levels; without, after.
+    # The declared schema prepends the file-name field before the Seg_Id
+    # fields in BOTH cases (CobolSchema.scala:99-103) — the reference binds
+    # Spark Rows positionally, so that (reference) misalignment is parity;
+    # columns here are therefore labeled positionally, exactly like rows.
+    cols: List[object] = []
+    if output_schema.generate_record_id:
+        cols.append(pa.array(np.full(n, file_id, dtype=np.int32)))
+        rids = (np.asarray(record_ids, dtype=np.int64) if record_ids is not None
+                else np.arange(n, dtype=np.int64))
+        cols.append(pa.array(rids))
+        if output_schema.input_file_name_field:
+            cols.append(pa.array([input_file_name] * n, type=pa.string()))
+        cols.extend(seg_arrays())
+    else:
+        cols.extend(seg_arrays())
+        if output_schema.input_file_name_field:
+            cols.append(pa.array([input_file_name] * n, type=pa.string()))
+    cols.extend(arr for _, arr in builder.body_columns(output_schema.policy))
+    target = arrow_schema(schema)
+    if len(cols) != len(target):
+        raise ValueError(
+            f"Arrow column count mismatch: built {len(cols)}, "
+            f"schema {len(target)}")
+    arrays = [c.cast(target.field(i).type)
+              if c.type != target.field(i).type else c
+              for i, c in enumerate(cols)]
+    return pa.Table.from_arrays(arrays, schema=target)
+
+
+def rows_to_table(rows: List[List[object]], struct: StructType):
+    """Fallback: build a typed table from materialized Python rows (host
+    backend, hierarchical assemblies). Same declared types as the fast
+    path, so both produce schema-identical tables."""
+    pa = _pa()
+    target = arrow_schema(struct)
+    arrays = []
+    for i, f in enumerate(struct.fields):
+        col = [row[i] for row in rows]
+        arrays.append(pa.array(_normalize_objects(col, f.dtype),
+                               type=target.field(i).type))
+    return pa.Table.from_arrays(arrays, schema=target)
+
+
+def _normalize_objects(values, dtype):
+    """Tuples (group values) -> dicts keyed by field name so pa.array can
+    build struct arrays from the nested row shape."""
+    if isinstance(dtype, StructType):
+        names = [f.name for f in dtype.fields]
+        return [None if v is None else
+                {nm: nv for nm, nv in zip(
+                    names, (_normalize_objects([x], f.dtype)[0]
+                            for x, f in zip(v, dtype.fields)))}
+                for v in values]
+    if isinstance(dtype, ArrayType):
+        return [None if v is None else _normalize_objects(list(v), dtype.element)
+                for v in values]
+    return list(values)

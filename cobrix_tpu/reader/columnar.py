@@ -75,6 +75,19 @@ class _KernelGroup:
         self.offsets = np.array([c.offset for c in columns], dtype=np.int64)
 
 
+def fixed_point_exponent(spec: ColumnSpec) -> int:
+    """Constant power-of-ten exponent for a non-explicit-decimal fixed-point
+    column: value = mantissa * 10**e. Shared by the row path and the Arrow
+    columnar output (same branches as the reference's decimal placement,
+    BCDNumberDecoders.scala:83-162 scale/scaleFactor rules)."""
+    dt = spec.dtype
+    sf = spec.params.scale_factor
+    if isinstance(dt, Decimal) and dt.usage is Usage.COMP3:
+        n_digits = spec.width * 2 - 1
+        return sf if sf > 0 else sf - n_digits if sf < 0 else -spec.params.scale
+    return -spec.params.scale
+
+
 def _resolve_occurs(st: Statement, dep_value) -> int:
     """DEPENDING ON value -> element count (clamp + string-handler rules,
     reference RecordExtractors.scala:68-80). Shared by the per-cell and
@@ -175,20 +188,12 @@ class DecodedBatch:
         if isinstance(dt, Integral):
             return mantissa
         # Decimal
-        sf = spec.params.scale_factor
         if spec.params.explicit_decimal:
             scale = int(out["dot_scale"][i])
             return PyDecimal(mantissa).scaleb(-scale)
-        if isinstance(dt, Decimal) and dt.usage is Usage.COMP3:
-            n_digits = spec.width * 2 - 1
-            if sf > 0:
-                return PyDecimal(mantissa).scaleb(sf)
-            if sf < 0:
-                return PyDecimal(mantissa).scaleb(sf - n_digits)
-            return PyDecimal(mantissa).scaleb(-spec.params.scale)
         # non-COMP3 decimals with scale_factor != 0 compile to HOST_FALLBACK
         # (the digit-count-dependent PIC P semantics live in the oracle)
-        return PyDecimal(mantissa).scaleb(-spec.params.scale)
+        return PyDecimal(mantissa).scaleb(fixed_point_exponent(spec))
 
     def _vectorizable_string(self, spec: ColumnSpec) -> bool:
         """EBCDIC columns always decode via the LUT code-point matrix;
@@ -288,14 +293,7 @@ class DecodedBatch:
                     lst = [PyDecimal(v).scaleb(-d) if ok else None
                            for v, d, ok in zip(mant, dots, vb)]
             else:
-                # constant exponent per column (same branches as `value`)
-                sf = spec.params.scale_factor
-                if isinstance(dt, Decimal) and dt.usage is Usage.COMP3:
-                    n_digits = spec.width * 2 - 1
-                    e = (sf if sf > 0 else
-                         sf - n_digits if sf < 0 else -spec.params.scale)
-                else:
-                    e = -spec.params.scale
+                e = fixed_point_exponent(spec)
                 if all_ok:
                     lst = [PyDecimal(v).scaleb(e) for v in mant]
                 else:

@@ -16,6 +16,7 @@ from ..encoding.codepages import resolve_code_page
 from .columnar import ColumnarDecoder, DecodedBatch, decoder_for_segment
 from .extractors import DecodeOptions, extract_record
 from .parameters import ReaderParameters
+from .result import FileResult, SegmentBatch
 from .vrl_reader import decode_segment_id_bytes, resolve_segment_id_field
 
 
@@ -125,18 +126,36 @@ class FixedLenReader:
                   first_record_id: int = 0,
                   input_file_name: str = "",
                   ignore_file_size: bool = False) -> List[List[object]]:
-        if self._is_multisegment:
-            return self._read_rows_multiseg(
-                data, backend, file_id, first_record_id, input_file_name,
-                ignore_file_size)
-        batch = self.decode_batch(data, backend, ignore_file_size)
-        return batch.to_rows(
-            policy=self.params.schema_policy,
-            generate_record_id=self.params.generate_record_id,
+        return self.read_result(
+            data, backend=backend, file_id=file_id,
+            first_record_id=first_record_id, input_file_name=input_file_name,
+            ignore_file_size=ignore_file_size).to_rows()
+
+    def read_result(self, data: bytes, backend: str = "numpy",
+                    file_id: int = 0, first_record_id: int = 0,
+                    input_file_name: str = "",
+                    ignore_file_size: bool = False) -> FileResult:
+        """Decode to a columnar FileResult (kernel outputs kept; rows and
+        Arrow tables are materialized lazily at the API boundary)."""
+        params = self.params
+        result = FileResult(
+            n_rows=0,
             file_id=file_id,
-            first_record_id=first_record_id,
-            generate_input_file_field=bool(self.params.input_file_name_column),
-            input_file_name=input_file_name)
+            input_file_name=input_file_name,
+            policy=params.schema_policy,
+            generate_record_id=params.generate_record_id,
+            generate_input_file_field=bool(params.input_file_name_column))
+        if self._is_multisegment:
+            self._read_multiseg_result(result, data, backend,
+                                       first_record_id, ignore_file_size)
+            return result
+        batch = self.decode_batch(data, backend, ignore_file_size)
+        n = batch.n_records
+        positions = np.arange(n, dtype=np.int64)
+        result.n_rows = n
+        result.segments.append(SegmentBatch(
+            batch, None, positions, first_record_id + positions))
+        return result
 
     # -- multisegment fixed-length records ---------------------------------
     # (reference FixedLenNestedRowIterator.scala:63-71: per-record segment
@@ -167,10 +186,9 @@ class FixedLenReader:
             matrix[:, off:off + w], seg_field,
             DecodeOptions.from_copybook(self.copybook))
 
-    def _read_rows_multiseg(self, data: bytes, backend: str, file_id: int,
-                            first_record_id: int, input_file_name: str,
-                            ignore_file_size: bool) -> List[List[object]]:
-        params = self.params
+    def _read_multiseg_result(self, result: FileResult, data: bytes,
+                              backend: str, first_record_id: int,
+                              ignore_file_size: bool) -> None:
         self.check_binary_data_validity(len(data), ignore_file_size)
         matrix = self.to_record_matrix(data, ignore_file_size)
         segment_ids = self._segment_values(matrix)
@@ -180,25 +198,16 @@ class FixedLenReader:
             dtype=object)
 
         trimmed, width = self._trimmed_matrix(matrix)
-
-        rows_by_pos = {}
+        result.n_rows = matrix.shape[0]
         for active in set(actives.tolist()):
-            positions = np.nonzero(actives == active)[0]
+            positions = np.nonzero(actives == active)[0].astype(np.int64)
             decoder = self._decoder_for_segment(active, backend)
             lengths = (np.full(len(positions), width, dtype=np.int64)
                        if width < self.copybook.record_size else None)
             decoded = decoder.decode(trimmed[positions], lengths=lengths)
-            seg_rows = decoded.to_rows(
-                policy=params.schema_policy,
-                generate_record_id=params.generate_record_id,
-                file_id=file_id,
-                record_ids=[first_record_id + int(p) for p in positions],
-                generate_input_file_field=bool(params.input_file_name_column),
-                input_file_name=input_file_name,
-                active_segments=[active or None] * len(positions))
-            for row_i, pos in enumerate(positions):
-                rows_by_pos[int(pos)] = seg_rows[row_i]
-        return [rows_by_pos[i] for i in sorted(rows_by_pos)]
+            result.segments.append(SegmentBatch(
+                decoded, active or None, positions,
+                first_record_id + positions))
 
     def iter_rows_host(self, data: bytes, file_id: int = 0,
                        first_record_id: int = 0,

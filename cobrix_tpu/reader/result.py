@@ -1,0 +1,114 @@
+"""Read results: decoded kernel outputs carried to the API boundary.
+
+The round-1 design materialized Python rows inside the readers and the API
+rebuilt columns from them — destroying the kernel's numpy columns at
+~tens of µs/row. Here the readers return `FileResult`s holding the
+`DecodedBatch`es themselves (plus the generated-column inputs), so
+`to_arrow`/`to_pandas` go straight from kernel outputs to Arrow buffers
+and rows are materialized only when actually asked for.
+
+A FileResult is either columnar (segments of DecodedBatches with record
+positions) or row-backed (host oracle path, hierarchical assemblies —
+shapes with no static columnar plan).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..copybook.datatypes import SchemaRetentionPolicy
+from .columnar import DecodedBatch
+
+
+@dataclass
+class SegmentBatch:
+    """One decoded batch (one active segment) of a file read."""
+
+    batch: DecodedBatch
+    active: Optional[str]                 # active segment redefine, or None
+    positions: np.ndarray                 # output position of each row
+    record_ids: Optional[np.ndarray]      # Record_Id per row (None: positions)
+    seg_level_ids: Optional[List[Sequence[object]]] = None  # per-row Seg_Id
+
+
+@dataclass
+class FileResult:
+    """Decoded result of one input file (or one shard of it)."""
+
+    n_rows: int
+    file_id: int = 0
+    input_file_name: str = ""
+    policy: SchemaRetentionPolicy = SchemaRetentionPolicy.KEEP_ORIGINAL
+    generate_record_id: bool = False
+    generate_input_file_field: bool = False
+    segments: List[SegmentBatch] = dc_field(default_factory=list)
+    rows: Optional[List[List[object]]] = None   # row-backed fallback
+
+    @property
+    def is_columnar(self) -> bool:
+        """Kernel outputs available (independent of row caching)."""
+        return bool(self.segments)
+
+    def to_rows(self) -> List[List[object]]:
+        if self.rows is not None:
+            return self.rows
+        keyed: List[tuple] = []
+        for seg in self.segments:
+            n = len(seg.positions)
+            record_ids = (seg.record_ids if seg.record_ids is not None
+                          else seg.positions)
+            seg_rows = seg.batch.to_rows(
+                policy=self.policy,
+                generate_record_id=self.generate_record_id,
+                file_id=self.file_id,
+                record_ids=[int(r) for r in record_ids],
+                generate_input_file_field=self.generate_input_file_field,
+                input_file_name=self.input_file_name,
+                segment_level_ids=seg.seg_level_ids,
+                active_segments=[seg.active] * n)
+            keyed.extend(zip((int(p) for p in seg.positions), seg_rows))
+        keyed.sort(key=lambda t: t[0])  # positions are sparse order keys
+        self.rows = [r for _, r in keyed]
+        return self.rows
+
+    def to_arrow(self, output_schema):
+        """pyarrow Table in record order (vectorized; no Python rows)."""
+        import pyarrow as pa
+
+        from .arrow_out import arrow_schema, rows_to_table, segment_table
+
+        # prefer the kernel outputs even when rows were also materialized
+        # (to_rows caching must not reroute to_arrow onto the row fallback)
+        if not self.segments:
+            if self.rows is not None:
+                return rows_to_table(self.rows, output_schema.schema)
+            return arrow_schema(output_schema.schema).empty_table()
+        tables = []
+        order = []
+        for seg in self.segments:
+            record_ids = (seg.record_ids if seg.record_ids is not None
+                          else seg.positions)
+            tables.append(segment_table(
+                seg.batch, seg.active, output_schema,
+                file_id=self.file_id,
+                record_ids=np.asarray(record_ids, dtype=np.int64),
+                seg_level_ids=seg.seg_level_ids,
+                input_file_name=self.input_file_name))
+            order.append(np.asarray(seg.positions, dtype=np.int64))
+        if len(tables) == 1:
+            table = tables[0]
+            pos = order[0]
+            if np.array_equal(pos, np.arange(len(pos))):
+                return table
+            return table.take(np.argsort(pos, kind="stable"))
+        table = pa.concat_tables(tables)
+        # rows currently ordered [seg0 rows..., seg1 rows...]; invert to
+        # record order
+        pos = np.concatenate(order)
+        return table.take(np.argsort(pos, kind="stable"))
+
+
+def rows_file_result(rows: List[List[object]]) -> FileResult:
+    return FileResult(n_rows=len(rows), rows=rows)

@@ -36,6 +36,7 @@ from .header_parsers import (
 )
 from .index import SparseIndexEntry, sparse_index_generator
 from .parameters import DEFAULT_FILE_RECORD_ID_INCREMENT, ReaderParameters
+from .result import FileResult, SegmentBatch
 from .raw_extractors import (
     RawRecordContext,
     TextRecordExtractor,
@@ -421,19 +422,18 @@ class VarLenReader:
             out[i] = "" if value is None else str(value).strip()
         return out
 
-    def _read_rows_columnar_fast(self, data, base: int, offsets, lengths,
-                                 segment_ids: Optional[List[str]],
-                                 file_id: int, backend: str,
-                                 prefix: str,
-                                 start_record_id: int,
-                                 input_file_name: str) -> List[List[object]]:
+    def _read_result_fast(self, result: "FileResult", data, base: int,
+                          offsets, lengths,
+                          segment_ids: Optional[List[str]],
+                          file_id: int, backend: str,
+                          prefix: str,
+                          start_record_id: int) -> None:
         params = self.params
         seg = params.multisegment
         n = len(offsets)
         level_count = len(seg.segment_level_ids) if seg else 0
         segment_filter = (set(seg.segment_id_filter)
                           if seg and seg.segment_id_filter else None)
-        generate_input_file = bool(params.input_file_name_column)
 
         keep = np.ones(n, dtype=bool)
         level_ids_per_record: Optional[List[List[Optional[str]]]] = None
@@ -462,65 +462,72 @@ class VarLenReader:
             by_segment[active] = np.nonzero(mask)[0]
 
         start = params.start_offset
-        rows_by_pos: Dict[int, List[object]] = {}
+        result.n_rows = int(keep.sum())
         for active, positions in by_segment.items():
             decoder = self._decoder_for_segment(active, backend)
             decoded = decoder.decode_raw(
                 data, offsets[positions], lengths[positions],
                 start_offset=start)
-            seg_rows = decoded.to_rows(
-                policy=params.schema_policy,
-                generate_record_id=False,
-                active_segments=[active or None] * len(positions))
-            for row_i, pos in enumerate(positions):
-                record_index = start_record_id + int(pos)
-                body = list(seg_rows[row_i])
-                seg_vals: List[object] = (
-                    list(level_ids_per_record[pos])
-                    if level_ids_per_record is not None else [])
-                if params.generate_record_id and generate_input_file:
-                    row = ([file_id, record_index, input_file_name]
-                           + seg_vals + body)
-                elif params.generate_record_id:
-                    row = [file_id, record_index] + seg_vals + body
-                elif generate_input_file:
-                    row = seg_vals + [input_file_name] + body
-                else:
-                    row = seg_vals + body
-                rows_by_pos[int(pos)] = row
-        return [rows_by_pos[i] for i in sorted(rows_by_pos)]
+            result.segments.append(SegmentBatch(
+                decoded, active or None,
+                positions.astype(np.int64),
+                start_record_id + positions.astype(np.int64),
+                seg_level_ids=(
+                    [level_ids_per_record[int(p)] for p in positions]
+                    if level_ids_per_record is not None else None)))
 
     def read_rows_columnar(self, stream: SimpleStream, file_id: int = 0,
                            backend: str = "numpy",
                            segment_id_prefix: Optional[str] = None,
                            start_record_id: int = 0,
                            starting_file_offset: int = 0) -> List[List[object]]:
+        return self.read_result_columnar(
+            stream, file_id=file_id, backend=backend,
+            segment_id_prefix=segment_id_prefix,
+            start_record_id=start_record_id,
+            starting_file_offset=starting_file_offset).to_rows()
+
+    def read_result_columnar(self, stream: SimpleStream, file_id: int = 0,
+                             backend: str = "numpy",
+                             segment_id_prefix: Optional[str] = None,
+                             start_record_id: int = 0,
+                             starting_file_offset: int = 0) -> FileResult:
         """Frame all records, pack per-active-segment padded batches, decode
-        with the batched kernels, and reassemble rows in file order."""
+        with the batched kernels; rows/Arrow are materialized lazily from
+        the FileResult."""
+        params = self.params
+        result = FileResult(
+            n_rows=0,
+            file_id=file_id,
+            input_file_name=stream.input_file_name,
+            policy=params.schema_policy,
+            generate_record_id=params.generate_record_id,
+            generate_input_file_field=bool(params.input_file_name_column))
         if self.copybook.is_hierarchical or self.dynamic_occurs_layout:
             # hierarchical assembly and dynamic variable-OCCURS layouts are
             # host-side: nesting / per-record offset shifts have no static
             # columnar plan (reference extractHierarchicalRecord,
             # RecordExtractors.scala:211; VarOccursRecordExtractor)
-            return list(self.iter_rows(
+            result.rows = list(self.iter_rows(
                 stream, file_id=file_id, start_record_id=start_record_id,
                 starting_file_offset=starting_file_offset,
                 segment_id_prefix=segment_id_prefix))
+            result.n_rows = len(result.rows)
+            return result
         fast = self._frame_fast(stream)
         if fast is not None:
             data, base, offsets, lengths, segment_ids = fast
-            return self._read_rows_columnar_fast(
-                data, base, offsets, lengths, segment_ids, file_id, backend,
-                segment_id_prefix or default_segment_id_prefix(),
-                start_record_id, stream.input_file_name)
-        params = self.params
+            self._read_result_fast(
+                result, data, base, offsets, lengths, segment_ids, file_id,
+                backend, segment_id_prefix or default_segment_id_prefix(),
+                start_record_id)
+            return result
         seg = params.multisegment
         prefix = segment_id_prefix or default_segment_id_prefix()
         accumulator = (SegmentIdAccumulator(seg.segment_level_ids, prefix, file_id)
                        if seg else None)
         level_count = len(seg.segment_level_ids) if seg else 0
         segment_filter = set(seg.segment_id_filter) if seg and seg.segment_id_filter else None
-        generate_input_file = bool(params.input_file_name_column)
 
         framed = []   # (record_index, active_redefine, data, level_ids)
         for record_index, segment_id, data in self.frame_records(
@@ -538,11 +545,11 @@ class VarLenReader:
             framed.append((record_index, active, data, level_ids))
 
         start = params.start_offset
-        rows_by_pos: Dict[int, List[object]] = {}
         by_segment: Dict[str, List[int]] = {}
         for pos, (_, active, _, _) in enumerate(framed):
             by_segment.setdefault(active, []).append(pos)
 
+        result.n_rows = len(framed)
         for active, positions in by_segment.items():
             decoder = self._decoder_for_segment(active, backend)
             # pack to the plan's byte extent, not the full record size —
@@ -555,26 +562,14 @@ class VarLenReader:
                 batch[row_i, :len(payload)] = np.frombuffer(payload, np.uint8)
                 lengths[row_i] = len(payload)
             decoded = decoder.decode(batch, lengths=lengths)
-            seg_rows = decoded.to_rows(
-                policy=params.schema_policy,
-                generate_record_id=False,
-                active_segments=[active or None] * len(positions))
-            for row_i, pos in enumerate(positions):
-                record_index, _, _, level_ids = framed[pos]
-                body = list(seg_rows[row_i])
-                seg_vals: List[object] = list(level_ids)
-                # same ordering quirk as extractors._apply_post_processing
-                if params.generate_record_id and generate_input_file:
-                    row = ([file_id, record_index, stream.input_file_name]
-                           + seg_vals + body)
-                elif params.generate_record_id:
-                    row = [file_id, record_index] + seg_vals + body
-                elif generate_input_file:
-                    row = seg_vals + [stream.input_file_name] + body
-                else:
-                    row = seg_vals + body
-                rows_by_pos[pos] = row
-        return [rows_by_pos[i] for i in range(len(framed))]
+            has_levels = level_count > 0
+            result.segments.append(SegmentBatch(
+                decoded, active or None,
+                np.asarray(positions, dtype=np.int64),
+                np.asarray([framed[p][0] for p in positions], dtype=np.int64),
+                seg_level_ids=([framed[p][3] for p in positions]
+                               if has_levels else None)))
+        return result
 
 
 def file_record_id_base(file_order: int) -> int:

@@ -302,7 +302,7 @@ def test_golden(case_id, copybook, data, expected_txt, expected_schema,
         # the reference spec goldens rows of df.orderBy(cols...)
         cols = ((order_by,) if isinstance(order_by, str) else order_by)
         idxs = [result.schema.field_names().index(c) for c in cols]
-        result._rows.sort(
+        result.to_rows().sort(
             key=lambda r: tuple((r[i] is not None, r[i]) for i in idxs))
 
     with open(ref(expected_schema), encoding="utf-8") as f:
