@@ -237,6 +237,7 @@ def parse_options(options: Dict[str, object],
     # recognized keys consumed later by read_cobol — mark used before the
     # pedantic unused-key audit runs
     opts.get_bool("debug_ignore_file_size")
+    opts.get_int("parallelism", 0)
     _validate_options(opts, params, streaming)
     return params, opts
 
@@ -370,6 +371,75 @@ class CobolData:
         return tables[0] if len(tables) == 1 else pa.concat_tables(tables)
 
 
+def _index_entries(reader, file_path: str, file_order: int, params):
+    """Sparse index for one file, or None when a single shard suffices.
+    The vectorized RDW index is used when the configuration allows it;
+    otherwise the generic per-record generator (the reference's only mode,
+    IndexGenerator.scala:33) runs."""
+    from .reader.parameters import DEFAULT_INDEX_ENTRY_SIZE_MB, MEGABYTE
+
+    size = os.path.getsize(file_path)
+    explicit = (params.input_split_records is not None
+                or params.input_split_size_mb is not None)
+    split_mb = params.input_split_size_mb or DEFAULT_INDEX_ENTRY_SIZE_MB
+    if not explicit and size <= split_mb * MEGABYTE:
+        return None  # the whole file is one shard anyway
+    if reader.supports_fast_framing:
+        # mmap, not read(): the scan touches the whole file once to find
+        # split offsets; materializing it would spike RSS by the file size
+        # on exactly the large files indexing targets
+        import mmap
+
+        with open(file_path, "rb") as f:
+            with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as mm:
+                entries = reader.generate_index_fast(mm, file_order)
+        if entries is not None:
+            return entries
+    with FSStream(file_path) as stream:
+        return reader.generate_index(stream, file_order)
+
+
+def _scan_var_len(reader, files, params, backend: str, prefix: str,
+                  parallelism: int) -> List["FileResult"]:
+    """The indexed parallel scan — the reference's flagship execution
+    strategy (CobolScanners.buildScanForVarLenIndex, CobolScanners.scala:
+    38-55 + IndexBuilder.buildIndex, IndexBuilder.scala:49-66): a sparse
+    index per file turns the sequential record stream into byte-range
+    shards; shards decode concurrently (each from its own bounded stream,
+    Record_Id seeded from the index entry) and results reassemble in
+    record order."""
+    shards = []  # (file_order, path, offset_from, max_bytes, start_record_id)
+    for file_order, file_path in enumerate(files):
+        base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
+        entries = None
+        if params.is_index_generation_needed:
+            entries = _index_entries(reader, file_path, file_order, params)
+        if entries is not None and len(entries) > 1:
+            size = os.path.getsize(file_path)
+            for e in entries:
+                end = e.offset_to if e.offset_to >= 0 else size
+                shards.append((file_order, file_path, e.offset_from,
+                               end - e.offset_from, base + e.record_index))
+        else:
+            shards.append((file_order, file_path, 0, 0, base))
+
+    def scan(shard) -> "FileResult":
+        file_order, path, offset, max_bytes, start_id = shard
+        with FSStream(path, start_offset=offset,
+                      maximum_bytes=max_bytes) as stream:
+            return reader.read_result_columnar(
+                stream, file_id=file_order, backend=backend,
+                segment_id_prefix=prefix, start_record_id=start_id,
+                starting_file_offset=offset)
+
+    if len(shards) == 1 or parallelism <= 1:
+        return [scan(s) for s in shards]
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=min(parallelism, len(shards))) as ex:
+        return list(ex.map(scan, shards))
+
+
 def read_cobol(path=None,
                copybook: Optional[str] = None,
                copybook_contents=None,
@@ -408,6 +478,10 @@ def read_cobol(path=None,
 
     params, opts = parse_options(options)
     debug_ignore_file_size = opts.get_bool("debug_ignore_file_size")
+    # local concurrency for the indexed shard scan (the analogue of the
+    # reference's executor count; not a reference option)
+    parallelism = opts.get_int("parallelism", 0) or min(
+        16, os.cpu_count() or 1)
     files = list_input_files(path)
     if not files:
         raise FileNotFoundError(f"No input files found for path {path}")
@@ -427,17 +501,15 @@ def read_cobol(path=None,
         prefix = (params.multisegment.segment_id_prefix
                   if params.multisegment and params.multisegment.segment_id_prefix
                   else default_segment_id_prefix())
-        for file_order, file_path in enumerate(files):
-            with FSStream(file_path) as stream:
-                if backend == "host":
+        if backend == "host":
+            for file_order, file_path in enumerate(files):
+                with FSStream(file_path) as stream:
                     results.append(rows_file_result(list(reader.iter_rows(
                         stream, file_id=file_order, segment_id_prefix=prefix,
                         start_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT))))
-                else:
-                    results.append(reader.read_result_columnar(
-                        stream, file_id=file_order, backend=backend,
-                        segment_id_prefix=prefix,
-                        start_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT))
+        else:
+            results = _scan_var_len(reader, files, params, backend, prefix,
+                                    parallelism)
     else:
         reader = FixedLenReader(copybook_contents, params)
         copybook_obj = reader.copybook

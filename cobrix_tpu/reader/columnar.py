@@ -19,6 +19,7 @@ extractor and the reference goldens.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -480,16 +481,26 @@ class DecodedBatch:
         return self.column_values(col)[i]
 
 
+_decoder_build_lock = threading.Lock()
+
+
 def decoder_for_segment(cache: Dict[str, "ColumnarDecoder"],
                         copybook: Copybook, active: str,
                         backend: str) -> "ColumnarDecoder":
     """Shared per-(active segment, backend) decoder cache used by both the
-    fixed-length and variable-length readers."""
+    fixed-length and variable-length readers. Locked: the indexed parallel
+    scan hits a shared reader's cache from worker threads, and plan
+    compilation (or a jax jit) must not be duplicated per worker."""
     key = f"{active}|{backend}"
-    if key not in cache:
-        cache[key] = ColumnarDecoder(
-            copybook, active_segment=active or None, backend=backend)
-    return cache[key]
+    dec = cache.get(key)
+    if dec is None:
+        with _decoder_build_lock:
+            dec = cache.get(key)
+            if dec is None:
+                dec = ColumnarDecoder(
+                    copybook, active_segment=active or None, backend=backend)
+                cache[key] = dec
+    return dec
 
 
 class ColumnarDecoder:

@@ -17,6 +17,14 @@ class SimpleStream:
         raise NotImplementedError
 
     @property
+    def true_size(self) -> int:
+        """Size of the UNDERLYING file, independent of byte-range bounding.
+        File-footer rules must measure against this, not `size()` — a
+        byte-range shard of an indexed scan ends mid-file, and its tail is
+        ordinary data, not a footer."""
+        return self.size()
+
+    @property
     def offset(self) -> int:
         raise NotImplementedError
 
@@ -91,6 +99,10 @@ class FSStream(SimpleStream):
 
     def size(self) -> int:
         return self._limit
+
+    @property
+    def true_size(self) -> int:
+        return self._file_size
 
     @property
     def offset(self) -> int:

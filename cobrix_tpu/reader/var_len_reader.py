@@ -15,6 +15,7 @@ padded `[batch, max_len]` blocks and decoded by the TPU kernels
 from __future__ import annotations
 
 import time
+from dataclasses import replace
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -35,7 +36,12 @@ from .header_parsers import (
     create_record_header_parser,
 )
 from .index import SparseIndexEntry, sparse_index_generator
-from .parameters import DEFAULT_FILE_RECORD_ID_INCREMENT, ReaderParameters
+from .parameters import (
+    DEFAULT_FILE_RECORD_ID_INCREMENT,
+    DEFAULT_INDEX_ENTRY_SIZE_MB,
+    MEGABYTE,
+    ReaderParameters,
+)
 from .result import FileResult, SegmentBatch
 from .raw_extractors import (
     RawRecordContext,
@@ -217,17 +223,42 @@ class VarLenReader:
 
     # -- index -------------------------------------------------------------
 
+    def _index_split_config(self):
+        """Validated (records_per_entry, size_mb) + root-boundary config
+        (reference VarLenNestedReader.generateIndex :125-180: splits align
+        to root-segment boundaries whenever Seg_Id generation or a
+        parent-child segment map is requested, so per-shard Seg_Id
+        accumulators restart exactly at a root)."""
+        params = self.params
+        if params.input_split_records is not None and not (
+                1 <= params.input_split_records <= 1_000_000_000):
+            raise ValueError(
+                "Invalid input split size. The requested number of records "
+                f"is {params.input_split_records}.")
+        if params.input_split_size_mb is not None and not (
+                1 <= params.input_split_size_mb <= 2000):
+            raise ValueError(
+                f"Invalid input split size of {params.input_split_size_mb} MB.")
+        seg = params.multisegment
+        is_hierarchical = bool(seg and (seg.segment_level_ids
+                                        or seg.field_parent_map))
+        root_segment_id = ""
+        if seg:
+            if seg.field_parent_map and self.segment_redefine_map:
+                # every root id is a valid split boundary (multi-root files,
+                # reference Test12MultiRootSparseIndex)
+                root_segment_id = ",".join(self.copybook.get_root_segment_ids(
+                    self.segment_redefine_map, seg.field_parent_map))
+            elif seg.segment_level_ids:
+                root_segment_id = seg.segment_level_ids[0]
+        return is_hierarchical, root_segment_id
+
     def generate_index(self, stream: SimpleStream, file_id: int
                        ) -> List[SparseIndexEntry]:
         """reference VarLenNestedReader.generateIndex (:125-180)."""
         params = self.params
         seg_field = resolve_segment_id_field(params, self.copybook)
-        is_hierarchical = self.copybook.is_hierarchical
-        root_segment_id = ""
-        if params.multisegment and self.segment_redefine_map:
-            root_ids = self.copybook.get_root_segment_ids(
-                self.segment_redefine_map, params.multisegment.field_parent_map)
-            root_segment_id = ",".join(root_ids)
+        is_hierarchical, root_segment_id = self._index_split_config()
         return sparse_index_generator(
             file_id,
             stream,
@@ -239,6 +270,86 @@ class VarLenReader:
             segment_field=seg_field,
             is_hierarchical=is_hierarchical,
             root_segment_id=root_segment_id)
+
+    def generate_index_fast(self, data, file_id: int
+                            ) -> Optional[List[SparseIndexEntry]]:
+        """Vectorized sparse index for plain RDW files: one native scan of
+        the file image + split arithmetic over the offset arrays instead of
+        the per-record Python pass. Returns None when the configuration
+        needs the generic generator (custom extractors/parsers, text mode,
+        length fields, variable OCCURS). Split semantics (including the
+        invalid-record counting and size-drift quirks) mirror
+        sparse_index_generator exactly — pinned by tests against it."""
+        from .. import native
+
+        if not self.supports_fast_framing:
+            return None
+        p = self.params
+        adjustment = p.rdw_adjustment
+        if p.is_rdw_part_of_record_length:
+            adjustment -= 4
+        offsets, lengths = native.rdw_scan(
+            data, p.is_rdw_big_endian, adjustment,
+            p.file_start_offset, p.file_end_offset)
+        n = len(offsets)
+        starts = offsets - 4  # RDW header precedes the payload
+        # the file-header region is consumed as one counted invalid record
+        # (IndexGenerator.scala:117-120 counts unconditionally)
+        base = 1 if p.file_start_offset > 0 else 0
+
+        is_hierarchical, root_segment_id = self._index_split_config()
+        seg_field = resolve_segment_id_field(p, self.copybook)
+        root_indices: Optional[np.ndarray] = None
+        if is_hierarchical and seg_field is not None:
+            root_ids = set(root_segment_id.split(","))
+            sids = self._segment_ids_vectorized(data, offsets, lengths,
+                                                seg_field)
+            root_indices = np.nonzero(
+                np.asarray([s in root_ids for s in sids], dtype=bool))[0]
+
+        def next_root(i: int) -> Optional[int]:
+            if root_indices is None:
+                return i
+            k = np.searchsorted(root_indices, i, side="left")
+            if k >= len(root_indices):
+                return None
+            return int(root_indices[k])
+
+        entries = [SparseIndexEntry(0, -1, file_id, 0)]
+        if p.input_split_records is not None:
+            per = p.input_split_records
+        else:
+            per = None
+            mb = ((p.input_split_size_mb or DEFAULT_INDEX_ENTRY_SIZE_MB)
+                  * MEGABYTE)
+
+        # processing the last record ends the stream before the split check
+        # (IndexGenerator loop order) — unless a footer region follows it,
+        # which is consumed as one more counted iteration
+        last_candidate = n - 1 if p.file_end_offset > 0 else n - 2
+        subtracted = 0
+        chunk_start_counted = 0
+        i = -1  # a first-chunk split at record 0 is possible (header counted)
+        while True:
+            if per is not None:
+                cand = chunk_start_counted + per - base
+            else:
+                target = subtracted + mb
+                cand = int(np.searchsorted(starts, target, side="left"))
+            cand = max(cand, i + 1)
+            split_at = next_root(cand)
+            if split_at is None or split_at > last_candidate:
+                break
+            entries[-1] = replace(entries[-1],
+                                  offset_to=int(starts[split_at]))
+            entries.append(SparseIndexEntry(
+                int(starts[split_at]), -1, file_id, split_at + base))
+            if per is not None:
+                chunk_start_counted = split_at + base
+            else:
+                subtracted += mb
+            i = split_at
+        return entries
 
     # -- framing -----------------------------------------------------------
 
@@ -371,18 +482,26 @@ class VarLenReader:
 
     # -- vectorized fast framing (native scan) ------------------------------
 
+    @property
+    def supports_fast_framing(self) -> bool:
+        """True when whole-shard vectorized RDW framing applies (no custom
+        extractors/parsers, no text mode, no length fields, no variable
+        OCCURS)."""
+        p = self.params
+        return bool(p.is_record_sequence
+                    and not (p.record_extractor or p.record_header_parser
+                             or p.is_text or p.length_field_name
+                             or p.variable_size_occurs))
+
     def _frame_fast(self, stream: SimpleStream):
         """Whole-shard RDW framing via the native scanner. Returns
         (data, base_offset, offsets, lengths, segment_ids) or None when the
-        configuration needs the generic per-record reader (custom
-        extractors/parsers, text mode, length fields, variable OCCURS)."""
+        configuration needs the generic per-record reader."""
         from .. import native
 
-        p = self.params
-        if (p.record_extractor or p.record_header_parser or p.is_text
-                or p.length_field_name or p.variable_size_occurs
-                or not p.is_record_sequence):
+        if not self.supports_fast_framing:
             return None
+        p = self.params
         base = stream.offset
         data = stream.next(stream.size() - base)
         adjustment = p.rdw_adjustment
@@ -390,9 +509,12 @@ class VarLenReader:
             adjustment -= 4
         offsets, lengths = native.rdw_scan(
             data, p.is_rdw_big_endian, adjustment,
-            # the file-header region rule only applies at the file start
+            # the file-header region rule only applies at the file start,
+            # the footer rule only when this shard reaches the file's true
+            # end (an indexed shard ending mid-file has a data tail, not a
+            # footer)
             p.file_start_offset if base == 0 else 0,
-            p.file_end_offset)
+            p.file_end_offset if stream.size() >= stream.true_size else 0)
         seg_field = resolve_segment_id_field(p, self.copybook)
         segment_ids: Optional[List[str]] = None
         if seg_field is not None:

@@ -166,7 +166,7 @@ class VRLRecordReader:
         while not is_valid and not end_of_file:
             header = self.stream.next(header_block)
             meta = self.header_parser.get_record_metadata(
-                header, self.stream.offset, self.stream.size(),
+                header, self.stream.offset, self.stream.true_size,
                 self._record_index)
             self._byte_index += len(header)
             if meta.record_length > 0:
