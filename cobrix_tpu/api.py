@@ -233,6 +233,8 @@ def parse_options(options: Dict[str, object],
         rhp_additional_info=opts.get("rhp_additional_info"),
         re_additional_info=opts.get("re_additional_info", ""),
         input_file_name_column=opts.get("with_input_file_name_col", ""),
+        select=tuple(s.strip() for s in opts.get("select", "").split(",")
+                     if s.strip()) or None,
     )
     # recognized keys consumed later by read_cobol — mark used before the
     # pedantic unused-key audit runs

@@ -32,9 +32,10 @@ class ShardedColumnarDecoder(ColumnarDecoder):
 
     def __init__(self, copybook: Copybook,
                  mesh=None,
-                 active_segment: Optional[str] = None):
+                 active_segment: Optional[str] = None,
+                 select=None):
         super().__init__(copybook, active_segment=active_segment,
-                         backend="jax")
+                         backend="jax", select=select)
         self.mesh = mesh if mesh is not None else data_mesh()
         self._stats_fn = None
 
@@ -74,15 +75,18 @@ class ShardedColumnarDecoder(ColumnarDecoder):
             groups = self.kernel_groups
 
             def stats(data):
+                # int32 accumulators: TPUs have no native int64 — keep the
+                # Mosaic int32 discipline in the stats program too (counts
+                # stay well under 2^31 per call)
                 outs = decode_all(data)
-                total_valid = jnp.zeros((), dtype=jnp.int64)
+                total_valid = jnp.zeros((), dtype=jnp.int32)
                 per_group = {}
                 for g, out in zip(groups, outs):
                     if len(out) >= 2 and out[1].dtype == jnp.bool_:
-                        v = out[1].sum(dtype=jnp.int64)
+                        v = out[1].sum(dtype=jnp.int32)
                         per_group[f"{g.codec.value}_w{g.width}"] = v
                         total_valid = total_valid + v
-                return {"records": jnp.asarray(data.shape[0], jnp.int64),
+                return {"records": jnp.asarray(data.shape[0], jnp.int32),
                         "valid_values": total_valid, **per_group}
 
             sharding = batch_sharding(self.mesh)

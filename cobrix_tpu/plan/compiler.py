@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import enum
 from dataclasses import dataclass, field as dc_field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..copybook.ast import Group, Primitive, Statement
 from ..copybook.copybook import Copybook
@@ -197,12 +197,25 @@ def _classify(dtype, fp_format: FloatingPointFormat) -> Tuple[Codec, CodecParams
 
 
 def compile_plan(copybook: Copybook,
-                 active_segment: Optional[str] = None) -> FieldPlan:
+                 active_segment: Optional[str] = None,
+                 select: Optional[Sequence[str]] = None) -> FieldPlan:
     """Flatten the AST into columns. `active_segment`: compile only columns
     visible when that segment redefine is active (plus common columns);
-    None compiles everything (single-segment / fixed-length files)."""
+    None compiles everything (single-segment / fixed-length files).
+
+    `select`: column projection — only primitives whose name (or an
+    enclosing group's name) is listed are compiled; everything else decodes
+    to null. This is the decode-only-what's-asked lever the reference
+    cannot pull (its TableScan has no column pruning; every field decodes
+    per record, CobolScanners.scala:38-55) and the main D2H-volume control
+    for the device path. DEPENDING-ON dependees are always kept — array
+    sizing needs them even when unselected."""
+    from ..copybook.ast import transform_identifier
+
     columns: List[ColumnSpec] = []
     fp_format = copybook.floating_point_format
+    sel = (None if select is None else
+           {transform_identifier(str(s).strip()).upper() for s in select})
     # dependee statement name -> column index of its first compiled slot
     dependee_cols: Dict[str, int] = {}
 
@@ -218,6 +231,10 @@ def compile_plan(copybook: Copybook,
     def add_column(st: Primitive, path: Tuple[str, ...], offset: int,
                    slot_path: Tuple[int, ...], gates: Tuple[Gate, ...],
                    segment: Optional[str]) -> None:
+        if sel is not None and not st.is_dependee \
+                and st.name.upper() not in sel \
+                and not any(p.upper() in sel for p in path):
+            return
         codec, params = _classify(st.dtype, fp_format)
         spec = ColumnSpec(
             index=len(columns),

@@ -486,19 +486,22 @@ _decoder_build_lock = threading.Lock()
 
 def decoder_for_segment(cache: Dict[str, "ColumnarDecoder"],
                         copybook: Copybook, active: str,
-                        backend: str) -> "ColumnarDecoder":
+                        backend: str,
+                        select: Optional[Sequence[str]] = None
+                        ) -> "ColumnarDecoder":
     """Shared per-(active segment, backend) decoder cache used by both the
     fixed-length and variable-length readers. Locked: the indexed parallel
     scan hits a shared reader's cache from worker threads, and plan
     compilation (or a jax jit) must not be duplicated per worker."""
-    key = f"{active}|{backend}"
+    key = f"{active}|{backend}|{','.join(select) if select else ''}"
     dec = cache.get(key)
     if dec is None:
         with _decoder_build_lock:
             dec = cache.get(key)
             if dec is None:
                 dec = ColumnarDecoder(
-                    copybook, active_segment=active or None, backend=backend)
+                    copybook, active_segment=active or None, backend=backend,
+                    select=select)
                 cache[key] = dec
     return dec
 
@@ -506,9 +509,12 @@ def decoder_for_segment(cache: Dict[str, "ColumnarDecoder"],
 class ColumnarDecoder:
     def __init__(self, copybook: Copybook,
                  active_segment: Optional[str] = None,
-                 backend: str = "numpy"):
+                 backend: str = "numpy",
+                 select: Optional[Sequence[str]] = None):
         self.copybook = copybook
-        self.plan: FieldPlan = compile_plan(copybook, active_segment)
+        self.select = tuple(select) if select else None
+        self.plan: FieldPlan = compile_plan(copybook, active_segment,
+                                            select=self.select)
         self.backend = backend
         self.options = DecodeOptions.from_copybook(copybook)
         self.non_standard_ascii_charset = (

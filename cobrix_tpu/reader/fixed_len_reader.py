@@ -97,7 +97,8 @@ class FixedLenReader:
 
     def decoder(self, backend: str = "numpy") -> ColumnarDecoder:
         if self._decoder is None or self._decoder.backend != backend:
-            self._decoder = ColumnarDecoder(self.copybook, backend=backend)
+            self._decoder = ColumnarDecoder(self.copybook, backend=backend,
+                                            select=self.params.select)
         return self._decoder
 
     def _trimmed_matrix(self, matrix: np.ndarray):
@@ -173,7 +174,8 @@ class FixedLenReader:
     def _decoder_for_segment(self, active: str,
                              backend: str) -> ColumnarDecoder:
         return decoder_for_segment(self._seg_decoders, self.copybook,
-                                   active, backend)
+                                   active, backend,
+                                   select=self.params.select)
 
     def _segment_values(self, matrix: np.ndarray) -> List[str]:
         """Per-record segment-id strings (shared unique-pattern decode with

@@ -80,6 +80,9 @@ class ReaderParameters:
     rhp_additional_info: Optional[str] = None
     re_additional_info: str = ""
     input_file_name_column: str = ""
+    # column projection: decode only these fields (others emit null).
+    # A TPU-native extension — the reference decodes every field per record
+    select: Optional[Sequence[str]] = None
 
     @property
     def data_encoding(self) -> Encoding:

@@ -478,7 +478,8 @@ class VarLenReader:
     def _decoder_for_segment(self, active_segment: str,
                              backend: str) -> ColumnarDecoder:
         return decoder_for_segment(self._decoders, self.copybook,
-                                   active_segment, backend)
+                                   active_segment, backend,
+                                   select=self.params.select)
 
     # -- vectorized fast framing (native scan) ------------------------------
 
