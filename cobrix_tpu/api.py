@@ -381,6 +381,8 @@ def _index_entries(reader, file_path: str, file_order: int, params):
     from .reader.parameters import DEFAULT_INDEX_ENTRY_SIZE_MB, MEGABYTE
 
     size = os.path.getsize(file_path)
+    if size == 0:
+        return None  # nothing to index (and mmap rejects empty files)
     explicit = (params.input_split_records is not None
                 or params.input_split_size_mb is not None)
     split_mb = params.input_split_size_mb or DEFAULT_INDEX_ENTRY_SIZE_MB

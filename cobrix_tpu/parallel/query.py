@@ -26,7 +26,8 @@ import numpy as np
 
 from ..copybook.copybook import Copybook
 from ..plan.compiler import Codec
-from ..reader.columnar import _FLOAT_CODECS, _NUMERIC_CODECS
+from ..reader.columnar import (_FLOAT_CODECS, _NUMERIC_CODECS,
+                               fixed_point_exponent)
 from .mesh import batch_sharding, data_mesh, pad_batch_to_multiple
 from .sharded import ShardedColumnarDecoder
 
@@ -63,13 +64,19 @@ class DeviceAggregator:
     def _build(self):
         import jax
         import jax.numpy as jnp
+        from jax import lax
 
         decode_all = self.decoder.build_jax_decode_fn()
         groups = self.decoder.kernel_groups
         fields = self.fields
 
-        def agg(data):
+        def agg(data, n):
             outs = decode_all(data)
+            # padded rows are all-zero bytes, which decode as VALID zeros
+            # for the binary/float codecs — mask them out of every reduction
+            # (the normal decode path slices [:n] host-side; an aggregate
+            # has no post-hoc slice, so the mask must live in the program)
+            row_live = jnp.arange(data.shape[0], dtype=jnp.int32) < n
             res = {}
             for name, slots in fields.items():
                 total = jnp.zeros((), dtype=jnp.float64)
@@ -78,58 +85,80 @@ class DeviceAggregator:
                 vmax = jnp.asarray(-jnp.inf, dtype=jnp.float64)
                 for gi, pos in slots:
                     g = groups[gi]
-                    values = outs[gi][0][:, pos]
-                    valid = outs[gi][1][:, pos]
+                    out = outs[gi]
+                    values = out[0][:, pos]
+                    valid = out[1][:, pos] & row_live
                     if g.codec in (Codec.DOUBLE_IBM, Codec.DOUBLE_IEEE):
                         # device carries IEEE754 bit patterns (uint64);
-                        # aggregating doubles on-device would round through
-                        # the f64 emulation — count only
-                        count = count + valid.sum(dtype=jnp.int32)
-                        continue
-                    v = jnp.where(valid, values, 0).astype(jnp.float64)
-                    total = total + v.sum(dtype=jnp.float64)
+                        # reinterpret — a bitcast moves no bits through the
+                        # f64 emulation, only the reductions below do (exact
+                        # for sums within 2^53)
+                        values = lax.bitcast_convert_type(values, jnp.float64)
+                    v64 = values.astype(jnp.float64)
+                    # integer outputs are unscaled mantissas; apply the
+                    # decimal scale so aggregates are in field units (the
+                    # row path does this at materialization via Decimal)
+                    if (g.codec in (Codec.DISPLAY_NUM,
+                                    Codec.DISPLAY_NUM_ASCII)
+                            and g.columns[pos].params.explicit_decimal):
+                        # per-value scale from the literal '.' position
+                        dots = out[2][:, pos].astype(jnp.float64)
+                        v64 = v64 * jnp.power(jnp.float64(10.0), -dots)
+                    elif g.codec in (Codec.BINARY, Codec.BCD,
+                                     Codec.DISPLAY_NUM,
+                                     Codec.DISPLAY_NUM_ASCII):
+                        # static PIC scale (implied V / scale factor), the
+                        # same rule the row path applies at materialization
+                        e = fixed_point_exponent(g.columns[pos])
+                        if e:
+                            v64 = v64 * (10.0 ** e)
+                    total = total + jnp.where(valid, v64, 0.0).sum(
+                        dtype=jnp.float64)
                     count = count + valid.sum(dtype=jnp.int32)
-                    vkeep = jnp.where(valid, values.astype(jnp.float64),
-                                      jnp.inf)
-                    vmin = jnp.minimum(vmin, vkeep.min())
-                    vkeep = jnp.where(valid, values.astype(jnp.float64),
-                                      -jnp.inf)
-                    vmax = jnp.maximum(vmax, vkeep.max())
+                    vmin = jnp.minimum(
+                        vmin, jnp.where(valid, v64, jnp.inf).min())
+                    vmax = jnp.maximum(
+                        vmax, jnp.where(valid, v64, -jnp.inf).max())
                 res[name] = {"sum": total, "count": count,
                              "min": vmin, "max": vmax}
-            res["records"] = jnp.asarray(data.shape[0], dtype=jnp.int32)
+            res["records"] = n
             return res
 
         sharding = batch_sharding(self.mesh)
-        return jax.jit(agg, in_shardings=sharding)
+        return jax.jit(agg, in_shardings=(sharding, None))
 
     def aggregate(self, arr: np.ndarray) -> Dict[str, dict]:
-        """arr: [batch, extent] uint8 (padded). Returns per-field scalar
-        aggregates; the only D2H traffic is these scalars."""
+        """arr: [batch, extent] uint8. Returns per-field scalar aggregates;
+        the only D2H traffic is these scalars. Fields with zero valid
+        values report sum/min/max as None (never +-inf)."""
         from ..ops import batch_jax
 
         batch_jax.ensure_x64()
         if self._agg_fn is None:
             self._agg_fn = self._build()
+        n = arr.shape[0]
         padded = pad_batch_to_multiple(
-            arr, max(self.decoder._bucket_size(arr.shape[0]),
-                     self.decoder.n_devices))
-        out = self._agg_fn(padded)
+            arr, max(self.decoder._bucket_size(n), self.decoder.n_devices))
+        import jax
+
+        # ONE D2H transfer for the whole stat tree — per-scalar float()/
+        # int() would pay a round trip each over the high-latency tunnel
+        out = jax.device_get(self._agg_fn(padded, np.int32(n)))
         result: Dict[str, dict] = {}
         for name, stats in out.items():
             if name == "records":
                 continue
+            count = int(stats["count"])
             result[name] = {
-                "sum": float(stats["sum"]),
-                "count": int(stats["count"]),
-                "min": float(stats["min"]),
-                "max": float(stats["max"]),
+                "sum": float(stats["sum"]) if count else None,
+                "count": count,
+                "min": float(stats["min"]) if count else None,
+                "max": float(stats["max"]) if count else None,
             }
         return result
 
 
-def aggregate_file(copybook: Copybook, data, columns=None, mesh=None,
-                   segment_lengths_below: Optional[int] = None
+def aggregate_file(copybook: Copybook, data, columns=None, mesh=None
                    ) -> Dict[str, dict]:
     """One-shot helper over a fixed-length byte image."""
     agg = DeviceAggregator(copybook, columns=columns, mesh=mesh)

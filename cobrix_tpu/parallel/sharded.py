@@ -17,7 +17,8 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..copybook.copybook import Copybook
-from ..reader.columnar import ColumnarDecoder, DecodedBatch
+from ..reader.columnar import (ColumnarDecoder, DecodedBatch,
+                               _decoder_build_lock)
 from .mesh import batch_sharding, data_mesh, pad_batch_to_multiple
 
 
@@ -47,14 +48,16 @@ class ShardedColumnarDecoder(ColumnarDecoder):
         import jax
 
         if self._jax_fn is None:
-            sharding = batch_sharding(self.mesh)
-            self._jax_fn = jax.jit(
-                self.build_jax_decode_fn(),
-                in_shardings=sharding,
-                # every output's leading axis is the record axis; keep the
-                # results distributed — transfers gather only what the host
-                # materializes
-                out_shardings=sharding)
+            with _decoder_build_lock:
+                if self._jax_fn is None:
+                    sharding = batch_sharding(self.mesh)
+                    self._jax_fn = jax.jit(
+                        self.build_jax_decode_fn(),
+                        in_shardings=sharding,
+                        # every output's leading axis is the record axis;
+                        # keep the results distributed — transfers gather
+                        # only what the host materializes
+                        out_shardings=sharding)
 
         n = arr.shape[0]
         bucket = max(self._bucket_size(n), self.n_devices)
@@ -74,27 +77,31 @@ class ShardedColumnarDecoder(ColumnarDecoder):
             decode_all = self.build_jax_decode_fn()
             groups = self.kernel_groups
 
-            def stats(data):
+            def stats(data, n):
                 # int32 accumulators: TPUs have no native int64 — keep the
                 # Mosaic int32 discipline in the stats program too (counts
                 # stay well under 2^31 per call)
                 outs = decode_all(data)
+                # mask batch padding: all-zero pad rows decode as VALID
+                # zeros for the binary codecs and would inflate the counts
+                live = jnp.arange(data.shape[0], dtype=jnp.int32) < n
                 total_valid = jnp.zeros((), dtype=jnp.int32)
                 per_group = {}
                 for g, out in zip(groups, outs):
                     if len(out) >= 2 and out[1].dtype == jnp.bool_:
-                        v = out[1].sum(dtype=jnp.int32)
+                        v = (out[1] & live[:, None]).sum(dtype=jnp.int32)
                         per_group[f"{g.codec.value}_w{g.width}"] = v
                         total_valid = total_valid + v
-                return {"records": jnp.asarray(data.shape[0], jnp.int32),
+                return {"records": n,
                         "valid_values": total_valid, **per_group}
 
             sharding = batch_sharding(self.mesh)
-            self._stats_fn = jax.jit(stats, in_shardings=sharding)
+            self._stats_fn = jax.jit(stats, in_shardings=(sharding, None))
 
+        n = arr.shape[0]
         padded = pad_batch_to_multiple(
-            arr, max(self._bucket_size(arr.shape[0]), self.n_devices))
-        out = self._stats_fn(padded)
+            arr, max(self._bucket_size(n), self.n_devices))
+        out = jax.device_get(self._stats_fn(padded, np.int32(n)))
         return {k: int(v) for k, v in out.items()}
 
 

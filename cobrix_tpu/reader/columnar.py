@@ -807,7 +807,12 @@ class ColumnarDecoder:
         import jax
 
         if self._jax_fn is None:
-            self._jax_fn = jax.jit(self.build_jax_decode_fn())
+            # double-checked: indexed-scan shards share one decoder across
+            # ThreadPoolExecutor workers; an unguarded build would trace
+            # and compile the same program once per shard
+            with _decoder_build_lock:
+                if self._jax_fn is None:
+                    self._jax_fn = jax.jit(self.build_jax_decode_fn())
 
         n = arr.shape[0]
         bucket = self._bucket_size(n)

@@ -46,7 +46,7 @@ def test_sharded_stats_reduce_over_mesh(mesh8):
     data = generate_exp1(64, seed=4)
     dec = ShardedColumnarDecoder(cb, mesh=mesh8)
     stats = dec.decode_stats(data)
-    assert stats["records"] >= 64  # padded bucket
+    assert stats["records"] == 64  # padding masked out
     assert stats["valid_values"] > 0
 
 
