@@ -5,12 +5,12 @@ TPU-native replacement for the reference's Spark distribution stack
 """
 from .mesh import batch_sharding, data_mesh, pad_batch_to_multiple
 from .planner import WorkShard, balance, plan_files, shards_from_index
-from .query import DeviceAggregator, aggregate_file
+from .query import DeviceAggregator, aggregate_file, merge_aggregates
 from .sharded import ShardedColumnarDecoder, sharded_decode
 
 __all__ = [
     "batch_sharding", "data_mesh", "pad_batch_to_multiple",
     "WorkShard", "balance", "plan_files", "shards_from_index",
-    "DeviceAggregator", "aggregate_file",
+    "DeviceAggregator", "aggregate_file", "merge_aggregates",
     "ShardedColumnarDecoder", "sharded_decode",
 ]

@@ -48,14 +48,18 @@ class DeviceAggregator:
             copybook, mesh=mesh, active_segment=active_segment,
             select=columns)
         self._agg_fn = None
-        # (field name, group index, positions within the group's columns)
-        per_field: Dict[str, List[tuple]] = {}
+        # field name -> [(group index, positions within the group)]; one
+        # entry PER GROUP, not per column — the traced program reduces a
+        # whole [batch, positions] plane at once, so an OCCURS 2000 field
+        # adds a handful of HLO reductions instead of 2000 scalar chains
+        per_field: Dict[str, Dict[int, List[int]]] = {}
         for gi, g in enumerate(self.decoder.kernel_groups):
             if g.codec not in _NUMERIC_CODECS and g.codec not in _FLOAT_CODECS:
                 continue
             for pos, c in enumerate(g.columns):
-                per_field.setdefault(c.name, []).append((gi, pos))
-        self.fields = per_field
+                per_field.setdefault(c.name, {}).setdefault(gi, []).append(pos)
+        self.fields = {name: [(gi, tuple(ps)) for gi, ps in by_group.items()]
+                       for name, by_group in per_field.items()}
 
     @property
     def mesh(self):
@@ -83,33 +87,43 @@ class DeviceAggregator:
                 count = jnp.zeros((), dtype=jnp.int32)
                 vmin = jnp.asarray(jnp.inf, dtype=jnp.float64)
                 vmax = jnp.asarray(-jnp.inf, dtype=jnp.float64)
-                for gi, pos in slots:
+                for gi, poss in slots:
                     g = groups[gi]
                     out = outs[gi]
-                    values = out[0][:, pos]
-                    valid = out[1][:, pos] & row_live
+                    if len(poss) == len(g.columns):
+                        sel = slice(None)  # whole group: skip the gather
+                    else:
+                        sel = jnp.asarray(poss)
+                    values = out[0][:, sel]
+                    valid = out[1][:, sel] & row_live[:, None]
                     if g.codec in (Codec.DOUBLE_IBM, Codec.DOUBLE_IEEE):
-                        # device carries IEEE754 bit patterns (uint64);
-                        # reinterpret — a bitcast moves no bits through the
-                        # f64 emulation, only the reductions below do (exact
-                        # for sums within 2^53)
+                        # device carries IEEE754 bit patterns (uint64); on
+                        # TPU the bitcast + reductions run through the f64
+                        # emulation and may drift a last ULP from the
+                        # host-decoded values (batch_jax.decode_ieee_float)
+                        # — acceptable for float aggregates, which round by
+                        # construction; the DECODE path keeps bit-exactness
+                        # by shipping patterns to the host instead
                         values = lax.bitcast_convert_type(values, jnp.float64)
                     v64 = values.astype(jnp.float64)
                     # integer outputs are unscaled mantissas; apply the
                     # decimal scale so aggregates are in field units (the
-                    # row path does this at materialization via Decimal)
+                    # row path does this at materialization via Decimal).
+                    # All slots of one field share one ColumnSpec dtype, so
+                    # the static exponent is uniform across the plane.
+                    spec = g.columns[poss[0]]
                     if (g.codec in (Codec.DISPLAY_NUM,
                                     Codec.DISPLAY_NUM_ASCII)
-                            and g.columns[pos].params.explicit_decimal):
+                            and spec.params.explicit_decimal):
                         # per-value scale from the literal '.' position
-                        dots = out[2][:, pos].astype(jnp.float64)
+                        dots = out[2][:, sel].astype(jnp.float64)
                         v64 = v64 * jnp.power(jnp.float64(10.0), -dots)
                     elif g.codec in (Codec.BINARY, Codec.BCD,
                                      Codec.DISPLAY_NUM,
                                      Codec.DISPLAY_NUM_ASCII):
                         # static PIC scale (implied V / scale factor), the
                         # same rule the row path applies at materialization
-                        e = fixed_point_exponent(g.columns[pos])
+                        e = fixed_point_exponent(spec)
                         if e:
                             v64 = v64 * (10.0 ** e)
                     total = total + jnp.where(valid, v64, 0.0).sum(
@@ -127,23 +141,44 @@ class DeviceAggregator:
         sharding = batch_sharding(self.mesh)
         return jax.jit(agg, in_shardings=(sharding, None))
 
-    def aggregate(self, arr: np.ndarray) -> Dict[str, dict]:
-        """arr: [batch, extent] uint8. Returns per-field scalar aggregates;
-        the only D2H traffic is these scalars. Fields with zero valid
-        values report sum/min/max as None (never +-inf)."""
+    def put(self, arr: np.ndarray, block: Optional[int] = None):
+        """Pad `arr` ([n, extent] uint8) and transfer it H2D with the mesh
+        sharding (explicit device_put: the implicit transfer inside jit
+        dispatch is far slower on remote-attached devices). Returns
+        (device_array, n). `block`: pad to this fixed batch so a streaming
+        loop reuses one compiled program."""
+        import jax
+
+        n = arr.shape[0]
+        nd = self.decoder.n_devices
+        if block is not None:
+            # round up so the padded batch stays shardable over the mesh
+            multiple = -(-block // nd) * nd
+        else:
+            multiple = max(self.decoder._bucket_size(n), nd)
+        padded = pad_batch_to_multiple(arr, multiple)
+        return jax.device_put(padded, batch_sharding(self.mesh)), n
+
+    def submit(self, x, n: int):
+        """Dispatch the aggregate program on a device-resident padded batch
+        (from `put`) WITHOUT synchronizing — returns the device-side scalar
+        tree. A streaming loop that submits every block before fetching
+        lets the runtime overlap H2D transfers with compute."""
         from ..ops import batch_jax
 
         batch_jax.ensure_x64()
         if self._agg_fn is None:
             self._agg_fn = self._build()
-        n = arr.shape[0]
-        padded = pad_batch_to_multiple(
-            arr, max(self.decoder._bucket_size(n), self.decoder.n_devices))
+        return self._agg_fn(x, np.int32(n))
+
+    def fetch(self, tree) -> Dict[str, dict]:
+        """Transfer a submitted scalar tree to host and shape the result.
+        This is the ONLY D2H transfer and the synchronization point."""
         import jax
 
         # ONE D2H transfer for the whole stat tree — per-scalar float()/
         # int() would pay a round trip each over the high-latency tunnel
-        out = jax.device_get(self._agg_fn(padded, np.int32(n)))
+        out = jax.device_get(tree)
         result: Dict[str, dict] = {}
         for name, stats in out.items():
             if name == "records":
@@ -156,6 +191,41 @@ class DeviceAggregator:
                 "max": float(stats["max"]) if count else None,
             }
         return result
+
+    def aggregate_device(self, x, n: int) -> Dict[str, dict]:
+        """Aggregate an already-device-resident padded batch (from `put`).
+        Wall-clocking this call times dispatch + decode + reduce + scalar
+        fetch."""
+        return self.fetch(self.submit(x, n))
+
+    def aggregate(self, arr: np.ndarray) -> Dict[str, dict]:
+        """arr: [batch, extent] uint8. Returns per-field scalar aggregates;
+        the only D2H traffic is these scalars. Fields with zero valid
+        values report sum/min/max as None (never +-inf)."""
+        x, n = self.put(arr)
+        return self.aggregate_device(x, n)
+
+
+def merge_aggregates(parts: Sequence[Dict[str, dict]]) -> Dict[str, dict]:
+    """Combine per-block partial aggregates from a streaming loop (the
+    host-side DCN-style reduction: scalars only, SURVEY.md §2.5)."""
+    result: Dict[str, dict] = {}
+    for part in parts:
+        for name, s in part.items():
+            if name not in result:
+                result[name] = dict(s)
+                continue
+            r = result[name]
+            r["count"] += s["count"]
+            if s["sum"] is not None:
+                r["sum"] = s["sum"] + (r["sum"] or 0.0)
+            if s["min"] is not None:
+                r["min"] = s["min"] if r["min"] is None \
+                    else min(r["min"], s["min"])
+            if s["max"] is not None:
+                r["max"] = s["max"] if r["max"] is None \
+                    else max(r["max"], s["max"])
+    return result
 
 
 def aggregate_file(copybook: Copybook, data, columns=None, mesh=None

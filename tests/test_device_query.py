@@ -15,7 +15,8 @@ import pytest
 from cobrix_tpu import read_cobol
 from cobrix_tpu.copybook.copybook import parse_copybook
 from cobrix_tpu.copybook.datatypes import FloatingPointFormat
-from cobrix_tpu.parallel import DeviceAggregator, aggregate_file
+from cobrix_tpu.parallel import (DeviceAggregator, aggregate_file,
+                                 merge_aggregates)
 from cobrix_tpu.testing.generators import (
     encode_comp3_unsigned,
     encode_comp_be,
@@ -129,6 +130,28 @@ def test_aggregate_projects_to_selected_columns(copybook, dataset):
     assert set(res) == {"A"}
     assert res["A"]["sum"] == v["a"].sum()
     assert res["A"]["count"] == N
+
+
+def test_streamed_blocks_merge_to_single_shot(copybook, dataset):
+    """The bench's streaming loop: fixed-size padded blocks H2D, partial
+    aggregates merged host-side — must equal the one-shot aggregate."""
+    data, _ = dataset
+    agg = DeviceAggregator(copybook)
+    one = agg.aggregate(data)
+    block = 16
+    parts = []
+    for i in range(0, N, block):
+        x, n = agg.put(data[i:i + block], block=block)
+        parts.append(agg.aggregate_device(x, n))
+    merged = merge_aggregates(parts)
+    for name in one:
+        assert merged[name]["count"] == one[name]["count"], name
+        for k in ("min", "max"):
+            assert merged[name][k] == one[name][k], (name, k)
+        if one[name]["sum"] is None:
+            assert merged[name]["sum"] is None
+        else:
+            assert merged[name]["sum"] == pytest.approx(one[name]["sum"])
 
 
 def test_aggregate_file_helper(copybook, dataset):
