@@ -235,6 +235,42 @@ def run(backend: str, mb_target: float) -> dict:
     }
 
 
+def run_exp1_side_metric(mb_target: float) -> dict:
+    """exp1 fixed-length type-variety profile (195 fields / 1,493 B per
+    record, data/test6_copybook.cob layout): the string/DISPLAY-heaviest
+    baseline workload. Reference single-core: ~6.3 MB/s
+    (performance/exp1_raw_records.csv). Timed: columnar kernel decode of
+    the [N, 1493] record matrix into typed column arrays."""
+    from cobrix_tpu.copybook import parse_copybook
+    from cobrix_tpu.reader.columnar import ColumnarDecoder
+    from cobrix_tpu.testing.generators import EXP1_COPYBOOK, generate_exp1
+
+    baseline = 6.3
+    n_records = max(64, int(mb_target * 1024 * 1024) // 1493)
+    t0 = time.perf_counter()
+    data = generate_exp1(n_records, seed=100)
+    mb = data.nbytes / (1024 * 1024)
+    _log(f"exp1: generated {mb:.1f} MB, {n_records} records "
+         f"in {time.perf_counter() - t0:.1f}s")
+    dec = ColumnarDecoder(parse_copybook(EXP1_COPYBOOK), backend="numpy")
+    dec.decode(data[:64])  # warmup
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        dec.decode(data)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    result = {
+        "metric": "exp1_fixed_length_decode",
+        "value": round(mb / best, 1),
+        "unit": "MB/s",
+        "vs_baseline": round(mb / best / baseline, 1),
+        "records_per_s": int(n_records / best),
+    }
+    _log(f"side metric exp1_fixed_length: {result}")
+    return result
+
+
 def run_exp2_side_metric(mb_target: float) -> None:
     """exp2 narrow-record profile (64-68 B/rec) as a stderr side metric:
     framing/segment-id bound rather than decode bound. Reference exp2
@@ -336,28 +372,37 @@ def main():
             backend = max(scores, key=scores.get)
             _log(f"calibration: {scores}; running full bench on {backend}")
             if cal_mb == mb_target and backend in results:
-                _exp2_side_metric(mb_target)
                 _emit(results[backend], device_status, probe_error,
-                      device_query)
+                      device_query, _side_metrics(mb_target))
                 return
-    _exp2_side_metric(mb_target)
+    side = _side_metrics(mb_target)
     result = run(backend, mb_target)
-    _emit(result, device_status, probe_error, device_query)
+    _emit(result, device_status, probe_error, device_query, side)
 
 
-def _emit(result: dict, device_status: str, probe_error, device_query):
+def _emit(result: dict, device_status: str, probe_error, device_query,
+          side_metrics: dict):
     result = dict(result)
     result["device"] = device_status
     result["probe_error"] = probe_error
     result["device_query"] = device_query
+    result.update(side_metrics)
     print(json.dumps(result), flush=True)
 
 
-def _exp2_side_metric(mb_target: float) -> None:
+def _side_metrics(mb_target: float) -> dict:
+    """exp1/exp2 profiles as named JSON fields; a side-metric failure must
+    never break the headline bench."""
+    side = {}
+    try:
+        side["exp1"] = run_exp1_side_metric(min(mb_target, 40.0))
+    except Exception as exc:
+        _log(f"exp1 side metric failed: {exc}")
     try:
         run_exp2_side_metric(min(mb_target, 40.0))
-    except Exception as exc:  # side metric must never break the bench
+    except Exception as exc:
         _log(f"exp2 side metric failed: {exc}")
+    return side
 
 
 if __name__ == "__main__":
