@@ -98,19 +98,13 @@ def decode_bcd(data: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 # zoned decimal (DISPLAY, EBCDIC)
 # ---------------------------------------------------------------------------
 
-def decode_display_ebcdic(data: np.ndarray, signed: bool,
-                          allow_dot: bool,
-                          require_digits: bool = True) -> Tuple[np.ndarray, np.ndarray,
-                                                    np.ndarray]:
-    """[..., W] uint8 EBCDIC zoned numeric -> (mantissa, valid, dot_scale).
-
-    Vectorizes the reference state machine (StringDecoders.decodeEbcdicNumber):
-      0xF0-0xF9 digit; 0xC0-0xC9 digit + '+' sign; 0xD0-0xD9 digit + '-';
-      0x60 '-'; 0x4E '+'; 0x4B/0x6B decimal point; 0x40/0x00 skipped;
-      anything else malformed. At most one sign byte; a '-' on an unsigned
-      field is null. `dot_scale` = number of digits right of the dot
-      (0 when no dot); only meaningful when allow_dot.
-    """
+def _classify_display_ebcdic(data: np.ndarray):
+    """Shared byte classification of the reference zoned-decimal state
+    machine (StringDecoders.decodeEbcdicNumber): 0xF0-0xF9 digit; 0xC0-0xC9
+    digit + '+'; 0xD0-0xD9 digit + '-'; 0x60 '-'; 0x4E '+'; 0x4B/0x6B
+    decimal point; 0x40/0x00 skipped; anything else malformed.
+    Returns (is_digit, digit_val, negative, dot_right, n_dots, valid_base)
+    where valid_base folds the known-bytes and single-sign rules."""
     b = data.astype(np.uint8)
     is_f_digit = (b >= 0xF0) & (b <= 0xF9)
     is_c_digit = (b >= 0xC0) & (b <= 0xC9)
@@ -124,49 +118,25 @@ def decode_display_ebcdic(data: np.ndarray, signed: bool,
     sign_marks = is_c_digit | is_d_digit | is_minus | is_plus
     n_signs = sign_marks.sum(axis=-1)
     n_dots = is_dot.sum(axis=-1)
-    n_digits = is_digit.sum(axis=-1)
 
     digit_val = np.where(is_f_digit, b - 0xF0,
                          np.where(is_c_digit, b - 0xC0,
                                   np.where(is_d_digit, b - 0xD0, 0))).astype(np.int64)
-    # positional weight: 10^(number of digit bytes strictly to the right)
-    digits_right = (np.cumsum(is_digit[..., ::-1], axis=-1)[..., ::-1]
-                    - is_digit.astype(np.int64))
-    with np.errstate(over="ignore"):
-        mantissa = np.sum(digit_val * _pow10(digits_right), axis=-1)
-
     negative = (is_d_digit | is_minus).any(axis=-1)
-    mantissa = np.where(negative, -mantissa, mantissa)
-
     # digits to the right of the (single) dot
     dot_right = np.where(
         n_dots > 0,
         np.sum(np.where(np.cumsum(is_dot, axis=-1) > 0, is_digit, False), axis=-1),
         0).astype(np.int64)
-
-    # empty (no digits) is null for integrals and explicit-dot decimals
-    # (JVM toInt/BigDecimal("") fail) but decodes to 0 for V-decimals, where
-    # the reference wraps the empty digit string via addDecimalPoint.
-    valid = np.all(known, axis=-1) & (n_signs <= 1)
-    if require_digits:
-        valid &= n_digits >= 1
-    if allow_dot:
-        valid &= n_dots <= 1
-    else:
-        valid &= n_dots == 0
-    if not signed:
-        valid &= ~negative
-    return np.where(valid, mantissa, 0), valid, np.where(valid, dot_right, 0)
+    valid_base = np.all(known, axis=-1) & (n_signs <= 1)
+    return is_digit, digit_val, negative, dot_right, n_dots, valid_base
 
 
-def decode_display_ascii(data: np.ndarray, signed: bool,
-                         allow_dot: bool,
-                         require_digits: bool = True) -> Tuple[np.ndarray, np.ndarray,
-                                                   np.ndarray]:
-    """ASCII DISPLAY numeric (reference decodeAsciiNumber + toInt/BigDecimal):
-    digits '0'-'9', one +/- anywhere, '.'/',' as decimal point; space-class
-    bytes (<= 0x20) allowed only at the edges (they survive into the parsed
-    string when interior, which makes the JVM parse fail -> null)."""
+def _classify_display_ascii(data: np.ndarray):
+    """ASCII DISPLAY classification (reference decodeAsciiNumber +
+    toInt/BigDecimal): digits '0'-'9', one +/- anywhere, '.'/',' as decimal
+    point; space-class bytes (<= 0x20) allowed only at the edges (interior
+    ones survive into the parsed string and fail the JVM parse -> null)."""
     b = data.astype(np.uint8)
     is_digit = (b >= 0x30) & (b <= 0x39)
     is_minus = b == 0x2D
@@ -176,9 +146,8 @@ def decode_display_ascii(data: np.ndarray, signed: bool,
     known = is_digit | is_minus | is_plus | is_dot | is_space
     n_signs = (is_minus | is_plus).sum(axis=-1)
     n_dots = is_dot.sum(axis=-1)
-    n_digits = is_digit.sum(axis=-1)
 
-    # interior spaces: a space byte with a non-space meaningful byte on both sides
+    # interior spaces: a space byte with a meaningful byte on both sides
     meaningful = is_digit | is_dot  # signs are stripped out of the buffer
     left_has = np.cumsum(meaningful, axis=-1) - meaningful.astype(np.int64) > 0
     right_has = (np.cumsum(meaningful[..., ::-1], axis=-1)[..., ::-1]
@@ -186,18 +155,21 @@ def decode_display_ascii(data: np.ndarray, signed: bool,
     interior_space = (is_space & left_has & right_has).any(axis=-1)
 
     digit_val = np.where(is_digit, b - 0x30, 0).astype(np.int64)
-    digits_right = (np.cumsum(is_digit[..., ::-1], axis=-1)[..., ::-1]
-                    - is_digit.astype(np.int64))
-    with np.errstate(over="ignore"):
-        mantissa = np.sum(digit_val * _pow10(digits_right), axis=-1)
     negative = is_minus.any(axis=-1)
-    mantissa = np.where(negative, -mantissa, mantissa)
     dot_right = np.where(
         n_dots > 0,
         np.sum(np.where(np.cumsum(is_dot, axis=-1) > 0, is_digit, False), axis=-1),
         0).astype(np.int64)
+    valid_base = np.all(known, axis=-1) & (n_signs <= 1) & ~interior_space
+    return is_digit, digit_val, negative, dot_right, n_dots, valid_base
 
-    valid = np.all(known, axis=-1) & (n_signs <= 1) & ~interior_space
+
+def _display_valid(valid_base, n_digits, n_dots, negative, signed: bool,
+                   allow_dot: bool, require_digits: bool) -> np.ndarray:
+    # empty (no digits) is null for integrals and explicit-dot decimals
+    # (JVM toInt/BigDecimal("") fail) but decodes to 0 for V-decimals, where
+    # the reference wraps the empty digit string via addDecimalPoint.
+    valid = valid_base.copy()
     if require_digits:
         valid &= n_digits >= 1
     if allow_dot:
@@ -206,7 +178,210 @@ def decode_display_ascii(data: np.ndarray, signed: bool,
         valid &= n_dots == 0
     if not signed:
         valid &= ~negative
+    return valid
+
+
+def _decode_display(classify, data, signed, allow_dot, require_digits,
+                    dyn_sf: int = 0):
+    is_digit, digit_val, negative, dot_right, n_dots, valid_base = \
+        classify(data)
+    n_digits = is_digit.sum(axis=-1)
+    digits_right = (np.cumsum(is_digit[..., ::-1], axis=-1)[..., ::-1]
+                    - is_digit.astype(np.int64))
+    with np.errstate(over="ignore"):
+        mantissa = np.sum(digit_val * _pow10(digits_right), axis=-1)
+    mantissa = np.where(negative, -mantissa, mantissa)
+    valid = _display_valid(valid_base, n_digits, n_dots, negative,
+                           signed, allow_dot, require_digits)
+    if dyn_sf < 0:
+        # PIC P: value = digits * 10^-(|sf| + digit-char count); the
+        # per-value exponent rides the dot_scale plane
+        # (addDecimalPoint, BinaryUtils.scala:208-211)
+        dot_right = -dyn_sf + n_digits
     return np.where(valid, mantissa, 0), valid, np.where(valid, dot_right, 0)
+
+
+def decode_display_ebcdic(data: np.ndarray, signed: bool,
+                          allow_dot: bool,
+                          require_digits: bool = True,
+                          dyn_sf: int = 0) -> Tuple[np.ndarray, np.ndarray,
+                                                    np.ndarray]:
+    """[..., W] uint8 EBCDIC zoned numeric -> (mantissa, valid, dot_scale).
+    At most one sign byte; a '-' on an unsigned field is null. `dot_scale` =
+    number of digits right of the dot (0 when no dot), or the dynamic PIC P
+    exponent when dyn_sf < 0."""
+    return _decode_display(_classify_display_ebcdic, data, signed,
+                           allow_dot, require_digits, dyn_sf)
+
+
+def decode_display_ascii(data: np.ndarray, signed: bool,
+                         allow_dot: bool,
+                         require_digits: bool = True,
+                         dyn_sf: int = 0) -> Tuple[np.ndarray, np.ndarray,
+                                                   np.ndarray]:
+    return _decode_display(_classify_display_ascii, data, signed,
+                           allow_dot, require_digits, dyn_sf)
+
+
+# ---------------------------------------------------------------------------
+# wide (>18-digit) exact numerics: uint128 magnitude as two uint64 limbs
+# ---------------------------------------------------------------------------
+# The reference routes >18-digit fields through BigDecimal string building
+# (BCDNumberDecoders.decodeBigBCDNumber, BinaryNumberDecoders.
+# decodeBinaryAribtraryPrecision, StringDecoders.decodeEbcdicBigNumber).
+# Columnar equivalent: decode the exact mantissa magnitude into (hi, lo)
+# uint64 limb planes + a sign plane — device-friendly fixed-width arrays
+# (Arrow decimal128 layout) with Decimal objects built only at
+# materialization. Exact for <= 38 digits (10^38 < 2^127).
+
+def _mul64to128(a: np.ndarray, c: int) -> Tuple[np.ndarray, np.ndarray]:
+    """uint64 array * uint64 constant -> (hi, lo) uint64 limbs."""
+    a = a.astype(np.uint64)
+    m32 = np.uint64(0xFFFFFFFF)
+    a_lo, a_hi = a & m32, a >> np.uint64(32)
+    c_lo, c_hi = np.uint64(c & 0xFFFFFFFF), np.uint64(c >> 32)
+    ll = a_lo * c_lo
+    lh = a_lo * c_hi
+    hl = a_hi * c_lo
+    hh = a_hi * c_hi
+    t = (lh & m32) + (hl & m32) + (ll >> np.uint64(32))
+    lo = (ll & m32) | ((t & m32) << np.uint64(32))
+    hi = hh + (lh >> np.uint64(32)) + (hl >> np.uint64(32)) + (t >> np.uint64(32))
+    return hi, lo
+
+
+def _add128(hi, lo, add_hi, add_lo) -> Tuple[np.ndarray, np.ndarray]:
+    l = lo + add_lo
+    carry = (l < lo).astype(np.uint64)
+    return hi + add_hi + carry, l
+
+
+def _chunks_to_u128(chunks) -> Tuple[np.ndarray, np.ndarray]:
+    """Combine base-10^18 chunks (most significant first, each < 10^18 in
+    uint64) into a uint128 (hi, lo) pair."""
+    chunk_base = 10 ** 18
+    hi = np.zeros_like(chunks[0], dtype=np.uint64)
+    lo = chunks[0].astype(np.uint64)
+    for c in chunks[1:]:
+        # (hi, lo) * 10^18 + c; hi*10^18 stays < 2^64 for <= 38 digits
+        mul_hi, mul_lo = _mul64to128(lo, chunk_base)
+        hi = mul_hi + hi * np.uint64(chunk_base)
+        lo = mul_lo
+        hi, lo = _add128(hi, lo, np.uint64(0), c.astype(np.uint64))
+    return hi, lo
+
+
+def _digit_chunks(digit_val: np.ndarray, digits_right: np.ndarray,
+                  max_digits: int):
+    """Split a dynamic-position digit plane into base-10^18 chunks by the
+    digit's position from the right (0-17, 18-35, 36+)."""
+    chunks = []
+    n_chunks = (max_digits + 17) // 18
+    for k in range(n_chunks - 1, -1, -1):
+        in_chunk = (digits_right >= 18 * k) & (digits_right < 18 * (k + 1))
+        rel = np.where(in_chunk, digits_right - 18 * k, 0)
+        with np.errstate(over="ignore"):
+            part = np.sum(np.where(in_chunk, digit_val, 0) * _pow10(rel),
+                          axis=-1).astype(np.uint64)
+        chunks.append(part)
+    return chunks
+
+
+def decode_bcd_wide(data: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
+                                               np.ndarray, np.ndarray]:
+    """[..., W] packed decimal, 19-38 digit slots -> (hi, lo, negative,
+    valid) with (hi, lo) the uint128 magnitude limbs. Same null rules as
+    `decode_bcd` (digit nibbles < 10; sign nibble C/D/F)."""
+    w = data.shape[-1]
+    high = ((data >> 4) & 0x0F).astype(np.int64)
+    low = (data & 0x0F).astype(np.int64)
+    sign_nibble = low[..., -1]
+    digit_ok = np.all(high < 10, axis=-1) & np.all(low[..., :-1] < 10, axis=-1)
+    sign_ok = (sign_nibble == 0x0C) | (sign_nibble == 0x0D) | (sign_nibble == 0x0F)
+    # digit sequence: high0 low0 high1 low1 ... high_{w-1}; D = 2w-1 slots
+    digits = np.concatenate(
+        [np.stack([high[..., :-1], low[..., :-1]], axis=-1).reshape(
+            data.shape[:-1] + (2 * (w - 1),)),
+         high[..., -1:]], axis=-1)
+    d_total = 2 * w - 1
+    pos_right = np.broadcast_to(
+        np.arange(d_total - 1, -1, -1, dtype=np.int64), digits.shape)
+    chunks = _digit_chunks(digits, pos_right, d_total)
+    hi, lo = _chunks_to_u128(chunks)
+    negative = sign_nibble == 0x0D
+    valid = digit_ok & sign_ok
+    zero = np.uint64(0)
+    return (np.where(valid, hi, zero), np.where(valid, lo, zero),
+            negative & valid, valid)
+
+
+def decode_binary_wide(data: np.ndarray, signed: bool,
+                       big_endian: bool) -> Tuple[np.ndarray, np.ndarray,
+                                                  np.ndarray, np.ndarray]:
+    """[..., W] uint8, W in 9..16 -> (hi, lo, negative, valid) uint128
+    magnitude limbs. Mirrors decodeBinaryAribtraryPrecision: BigInt
+    semantics, always valid (no unsigned-overflow rule at arbitrary
+    precision)."""
+    w = data.shape[-1]
+    b = data.astype(np.uint64)
+    order = range(w) if big_endian else range(w - 1, -1, -1)
+    hi = np.zeros(data.shape[:-1], dtype=np.uint64)
+    lo = np.zeros(data.shape[:-1], dtype=np.uint64)
+    first = True
+    for i in order:
+        byte = b[..., i]
+        if first and signed:
+            # arithmetic sign extension of the most significant byte
+            ext = np.where(byte & np.uint64(0x80),
+                           np.uint64(0xFFFFFFFFFFFFFFFF), np.uint64(0))
+            hi = ext
+            lo = ext
+        hi = (hi << np.uint64(8)) | (lo >> np.uint64(56))
+        lo = (lo << np.uint64(8)) | byte
+        first = False
+    negative = (hi >> np.uint64(63)) != 0 if signed else \
+        np.zeros(data.shape[:-1], dtype=bool)
+    # two's complement -> magnitude
+    neg_lo = (~lo) + np.uint64(1)
+    neg_hi = (~hi) + (neg_lo == 0).astype(np.uint64)
+    hi = np.where(negative, neg_hi, hi)
+    lo = np.where(negative, neg_lo, lo)
+    valid = np.ones(data.shape[:-1], dtype=bool)
+    return hi, lo, negative, valid
+
+
+def _decode_display_wide(classify, data, signed, allow_dot, require_digits,
+                         dyn_sf: int = 0):
+    is_digit, digit_val, negative, dot_right, n_dots, valid_base = \
+        classify(data)
+    n_digits = is_digit.sum(axis=-1)
+    digits_right = (np.cumsum(is_digit[..., ::-1], axis=-1)[..., ::-1]
+                    - is_digit.astype(np.int64))
+    chunks = _digit_chunks(digit_val, digits_right, data.shape[-1])
+    hi, lo = _chunks_to_u128(chunks)
+    valid = _display_valid(valid_base, n_digits, n_dots, negative,
+                           signed, allow_dot, require_digits)
+    if dyn_sf < 0:
+        dot_right = -dyn_sf + n_digits
+    zero = np.uint64(0)
+    return (np.where(valid, hi, zero), np.where(valid, lo, zero),
+            negative & valid, valid, np.where(valid, dot_right, 0))
+
+
+def decode_display_ebcdic_wide(data: np.ndarray, signed: bool,
+                               allow_dot: bool, require_digits: bool = True,
+                               dyn_sf: int = 0):
+    """Wide (19-38 digit) zoned decimal -> (hi, lo, negative, valid,
+    dot_scale); same state machine/null rules as decode_display_ebcdic."""
+    return _decode_display_wide(_classify_display_ebcdic, data, signed,
+                                allow_dot, require_digits, dyn_sf)
+
+
+def decode_display_ascii_wide(data: np.ndarray, signed: bool,
+                              allow_dot: bool, require_digits: bool = True,
+                              dyn_sf: int = 0):
+    return _decode_display_wide(_classify_display_ascii, data, signed,
+                                allow_dot, require_digits, dyn_sf)
 
 
 # ---------------------------------------------------------------------------

@@ -26,7 +26,7 @@ import numpy as np
 
 from ..copybook.copybook import Copybook
 from ..plan.compiler import Codec
-from ..reader.columnar import (_FLOAT_CODECS, _NUMERIC_CODECS,
+from ..reader.columnar import (_FLOAT_CODECS, _NUMERIC_CODECS, _dyn_scale,
                                fixed_point_exponent)
 from .mesh import batch_sharding, data_mesh, pad_batch_to_multiple
 from .sharded import ShardedColumnarDecoder
@@ -94,38 +94,67 @@ class DeviceAggregator:
                         sel = slice(None)  # whole group: skip the gather
                     else:
                         sel = jnp.asarray(poss)
-                    values = out[0][:, sel]
-                    valid = out[1][:, sel] & row_live[:, None]
-                    if g.codec in (Codec.DOUBLE_IBM, Codec.DOUBLE_IEEE):
-                        # device carries IEEE754 bit patterns (uint64); on
-                        # TPU the bitcast + reductions run through the f64
-                        # emulation and may drift a last ULP from the
-                        # host-decoded values (batch_jax.decode_ieee_float)
-                        # — acceptable for float aggregates, which round by
-                        # construction; the DECODE path keeps bit-exactness
-                        # by shipping patterns to the host instead
-                        values = lax.bitcast_convert_type(values, jnp.float64)
-                    v64 = values.astype(jnp.float64)
-                    # integer outputs are unscaled mantissas; apply the
-                    # decimal scale so aggregates are in field units (the
-                    # row path does this at materialization via Decimal).
-                    # All slots of one field share one ColumnSpec dtype, so
-                    # the static exponent is uniform across the plane.
                     spec = g.columns[poss[0]]
-                    if (g.codec in (Codec.DISPLAY_NUM,
-                                    Codec.DISPLAY_NUM_ASCII)
-                            and spec.params.explicit_decimal):
-                        # per-value scale from the literal '.' position
-                        dots = out[2][:, sel].astype(jnp.float64)
-                        v64 = v64 * jnp.power(jnp.float64(10.0), -dots)
-                    elif g.codec in (Codec.BINARY, Codec.BCD,
-                                     Codec.DISPLAY_NUM,
-                                     Codec.DISPLAY_NUM_ASCII):
-                        # static PIC scale (implied V / scale factor), the
-                        # same rule the row path applies at materialization
-                        e = fixed_point_exponent(spec)
-                        if e:
-                            v64 = v64 * (10.0 ** e)
+                    is_display = g.codec in (Codec.DISPLAY_NUM,
+                                             Codec.DISPLAY_NUM_ASCII)
+                    if g.wide:
+                        # uint128-limb plane: aggregate the f64 approximation
+                        # (sums/min/max of >18-digit values round by nature)
+                        hi, lo = out[0][:, sel], out[1][:, sel]
+                        mag = (hi.astype(jnp.float64) * jnp.float64(2.0 ** 64)
+                               + lo.astype(jnp.float64))
+                        v64 = jnp.where(out[2][:, sel], -mag, mag)
+                        valid = out[3][:, sel] & row_live[:, None]
+                        if is_display and (spec.params.explicit_decimal
+                                           or _dyn_scale(spec)):
+                            dots = out[4][:, sel].astype(jnp.float64)
+                            v64 = v64 * jnp.power(jnp.float64(10.0), -dots)
+                        elif _dyn_scale(spec):
+                            # wide binary PIC P: exact digit count from the
+                            # integer limbs, not the rounded f64 value
+                            v64 = v64 * _dyn_pow10_limbs(
+                                hi, lo, spec.params.scale_factor, jnp)
+                        else:
+                            e = fixed_point_exponent(spec)
+                            if e:
+                                v64 = v64 * (10.0 ** e)
+                    else:
+                        values = out[0][:, sel]
+                        valid = out[1][:, sel] & row_live[:, None]
+                        if g.codec in (Codec.DOUBLE_IBM, Codec.DOUBLE_IEEE):
+                            # device carries IEEE754 bit patterns (uint64);
+                            # on TPU a device-side bitcast + reduction runs
+                            # through the f64 emulation and may drift a last
+                            # ULP from the host-decoded values — acceptable
+                            # for float aggregates, which round by
+                            # construction; the DECODE path keeps
+                            # bit-exactness by shipping patterns to the host
+                            values = lax.bitcast_convert_type(values,
+                                                              jnp.float64)
+                        v64 = values.astype(jnp.float64)
+                        # integer outputs are unscaled mantissas; apply the
+                        # decimal scale so aggregates are in field units
+                        # (the row path does this at materialization via
+                        # Decimal). All slots of one field share one
+                        # ColumnSpec dtype, so the exponent rule is uniform
+                        # across the plane.
+                        if is_display and (spec.params.explicit_decimal
+                                           or _dyn_scale(spec)):
+                            # per-value exponent plane ('.' position or the
+                            # PIC P digit count)
+                            dots = out[2][:, sel].astype(jnp.float64)
+                            v64 = v64 * jnp.power(jnp.float64(10.0), -dots)
+                        elif _dyn_scale(spec):
+                            # narrow binary PIC P: exact digit count from
+                            # the integer values, not the rounded f64
+                            v64 = v64 * _dyn_pow10_int(
+                                values, spec.params.scale_factor, jnp)
+                        elif g.codec in (Codec.BINARY, Codec.BCD,
+                                         Codec.DISPLAY_NUM,
+                                         Codec.DISPLAY_NUM_ASCII):
+                            e = fixed_point_exponent(spec)
+                            if e:
+                                v64 = v64 * (10.0 ** e)
                     total = total + jnp.where(valid, v64, 0.0).sum(
                         dtype=jnp.float64)
                     count = count + valid.sum(dtype=jnp.int32)
@@ -204,6 +233,33 @@ class DeviceAggregator:
         values report sum/min/max as None (never +-inf)."""
         x, n = self.put(arr)
         return self.aggregate_device(x, n)
+
+
+def _dyn_pow10_int(values, sf: int, jnp):
+    """10^-(|sf| + decimal digit count of |value|) for narrow binary PIC P
+    aggregation — digit count from the exact integer plane (a rounded f64
+    compare would miscount at 10^k boundaries), traced in-program
+    (the mirror of columnar._binary_dyn_dots)."""
+    absv = jnp.abs(values.astype(jnp.int64))
+    nd = jnp.ones(absv.shape, dtype=jnp.int32)
+    for k in range(1, 19):
+        nd = nd + (absv >= 10 ** k)
+    nd = jnp.where(absv < 0, 19, nd)  # int64 min
+    return jnp.power(jnp.float64(10.0),
+                     -(nd.astype(jnp.float64) + jnp.float64(-sf)))
+
+
+def _dyn_pow10_limbs(hi, lo, sf: int, jnp):
+    """Same for wide binary PIC P: exact digit count from the uint128
+    magnitude limbs (columnar._wide_dyn_dots, traced)."""
+    nd = jnp.ones(hi.shape, dtype=jnp.int32)
+    for k in range(1, 39):
+        p = 10 ** k
+        ph = jnp.uint64(p >> 64)
+        pl = jnp.uint64(p & 0xFFFFFFFFFFFFFFFF)
+        nd = nd + ((hi > ph) | ((hi == ph) & (lo >= pl)))
+    return jnp.power(jnp.float64(10.0),
+                     -(nd.astype(jnp.float64) + jnp.float64(-sf)))
 
 
 def merge_aggregates(parts: Sequence[Dict[str, dict]]) -> Dict[str, dict]:

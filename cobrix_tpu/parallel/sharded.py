@@ -88,8 +88,12 @@ class ShardedColumnarDecoder(ColumnarDecoder):
                 total_valid = jnp.zeros((), dtype=jnp.int32)
                 per_group = {}
                 for g, out in zip(groups, outs):
-                    if len(out) >= 2 and out[1].dtype == jnp.bool_:
-                        v = (out[1] & live[:, None]).sum(dtype=jnp.int32)
+                    # wide (uint128-limb) groups carry valid at index 3;
+                    # narrow numeric/float groups at index 1
+                    valid = (out[3] if g.wide and len(out) >= 4
+                             else out[1] if len(out) >= 2 else None)
+                    if valid is not None and valid.dtype == jnp.bool_:
+                        v = (valid & live[:, None]).sum(dtype=jnp.int32)
                         per_group[f"{g.codec.value}_w{g.width}"] = v
                         total_valid = total_valid + v
                 return {"records": n,

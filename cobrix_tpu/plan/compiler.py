@@ -168,17 +168,24 @@ def _classify(dtype, fp_format: FloatingPointFormat) -> Tuple[Codec, CodecParams
         is_sign_separate=dtype.is_sign_separate,
     )
     if usage is None:
-        # scale_factor (PIC P) semantics depend on the decoded digit-char
-        # count, which only the scalar oracle reproduces exactly
-        if dtype.precision > MAX_LONG_PRECISION or sf != 0:
+        # Wide (19-38 digit) fields use the uint128-limb kernels, exact
+        # while every byte of the field could be a digit (<= 38 slots).
+        # PIC P (scale_factor<0) uses the per-value dot_scale plane: the
+        # exponent depends on the decoded digit-char count
+        # (BinaryUtils.addDecimalPoint, BinaryUtils.scala:194).
+        display_width = (dtype.precision + (1 if expl else 0)
+                         + (1 if dtype.is_sign_separate else 0))
+        if display_width > 38:
             return Codec.HOST_FALLBACK, params
         return (Codec.DISPLAY_NUM if is_ebcdic else Codec.DISPLAY_NUM_ASCII), params
     if usage is Usage.COMP3:
-        if dtype.precision > MAX_LONG_PRECISION:
+        # digit slots = 2*bytes - 1; > 38 slots would overflow uint128
+        if 2 * (dtype.precision // 2 + 1) - 1 > 38:
             return Codec.HOST_FALLBACK, params
         return Codec.BCD, params
     if usage in (Usage.COMP4, Usage.COMP5, Usage.COMP9):
-        if dtype.precision > MAX_LONG_PRECISION or sf != 0:
+        # 9-16 byte two's complement is exact in uint128 limbs
+        if dtype.precision > 38:
             return Codec.HOST_FALLBACK, params
         return Codec.BINARY, params
     if usage is Usage.COMP1:

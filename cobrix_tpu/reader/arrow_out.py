@@ -27,6 +27,7 @@ from ..copybook.datatypes import SchemaRetentionPolicy, TrimPolicy
 from .columnar import (
     _FLOAT_CODECS,
     _STRING_CODECS,
+    _dyn_scale,
     _resolve_occurs,
     DecodedBatch,
     fixed_point_exponent,
@@ -161,6 +162,10 @@ class ArrowBatchBuilder:
             return self._python_fallback(col, pa_type)
         if "host" in out:
             return self._python_fallback(col, pa_type)
+        if "values_hi" in out:
+            # wide uint128-limb columns: Decimal materialization owns the
+            # 128-bit sign/scale rules
+            return self._python_fallback(col, pa_type)
         if spec.codec in _STRING_CODECS:
             return self._string_array(spec, out, pa_type)
         if spec.codec in _FLOAT_CODECS:
@@ -181,7 +186,7 @@ class ArrowBatchBuilder:
                 # int64 mantissa can't be widened safely past 18 digits
                 return self._python_fallback(col, pa_type)
             mantissa = values.astype(np.int64, copy=False)
-            if spec.params.explicit_decimal:
+            if spec.params.explicit_decimal or _dyn_scale(spec):
                 shift = pa_type.scale - np.asarray(out["dot_scale"],
                                                    dtype=np.int64)
             else:

@@ -32,6 +32,7 @@ from ..copybook.datatypes import (
     Encoding,
     Integral,
     MAX_INTEGER_PRECISION,
+    MAX_LONG_PRECISION,
     SchemaRetentionPolicy,
     TrimPolicy,
     Usage,
@@ -46,24 +47,82 @@ PyDecimal = _decimal.Decimal
 
 _NUMERIC_CODECS = (Codec.BINARY, Codec.BCD, Codec.DISPLAY_NUM,
                    Codec.DISPLAY_NUM_ASCII)
+
+# wide (uint128) mantissas carry up to 39 digits; the default 28-digit
+# context would round them during scaleb
+_WIDE_CTX = _decimal.Context(prec=60)
+
+
+def _exact_scaleb(mantissa: int, e: int) -> "PyDecimal":
+    if e == 0:
+        return PyDecimal(mantissa)
+    return PyDecimal(mantissa).scaleb(e, _WIDE_CTX)
 _FLOAT_CODECS = (Codec.FLOAT_IBM, Codec.FLOAT_IEEE, Codec.DOUBLE_IBM,
                  Codec.DOUBLE_IEEE)
 _STRING_CODECS = (Codec.EBCDIC_STRING, Codec.ASCII_STRING, Codec.UTF16_STRING,
                   Codec.HEX_STRING, Codec.RAW_BYTES)
 
 
+def _is_wide(spec: ColumnSpec) -> bool:
+    """>18-digit fields decode through the uint128-limb kernels (the
+    reference's BigDecimal plane: BCDNumberDecoders.decodeBigBCDNumber,
+    decodeBinaryAribtraryPrecision, decodeEbcdicBigNumber)."""
+    if spec.codec is Codec.BINARY:
+        return spec.width > 8
+    return spec.params.precision > MAX_LONG_PRECISION
+
+
 def _variant_key(spec: ColumnSpec) -> tuple:
     p = spec.params
     if spec.codec is Codec.BINARY:
-        return (p.signed, p.big_endian, spec.width <= 4)
+        return (p.signed, p.big_endian, spec.width <= 4, _is_wide(spec))
     if spec.codec is Codec.BCD:
-        return (p.precision <= MAX_INTEGER_PRECISION,)
+        return (p.precision <= MAX_INTEGER_PRECISION, _is_wide(spec))
     if spec.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
         is_integral = isinstance(spec.dtype, Integral)
+        # only a NEGATIVE scale factor changes the kernel (the dyn_sf
+        # digit-count plane); positive sf is applied per column at
+        # materialization, so grouping on min(sf, 0) avoids splitting
+        # otherwise-identical columns into separate kernel launches
         return (p.signed, p.explicit_decimal,
                 is_integral or p.explicit_decimal,
-                p.precision <= MAX_INTEGER_PRECISION)
+                p.precision <= MAX_INTEGER_PRECISION,
+                min(p.scale_factor, 0),
+                _is_wide(spec))
     return ()
+
+
+def _dyn_scale(spec: ColumnSpec) -> bool:
+    """PIC P with a negative scale factor on a DISPLAY/BINARY field: the
+    reference's exponent depends on the decoded digit count
+    (addDecimalPoint, BinaryUtils.scala:208-211), so it rides the
+    per-value dot_scale plane instead of a static exponent."""
+    return (spec.params.scale_factor < 0
+            and spec.codec is not Codec.BCD)
+
+
+def _binary_dyn_dots(values: np.ndarray, sf: int) -> np.ndarray:
+    """dot_scale plane for a narrow binary PIC P column: |sf| + number of
+    decimal digits in str(|value|)."""
+    absv = np.abs(values.astype(np.int64))
+    nd = np.ones(absv.shape, dtype=np.int64)
+    for k in range(1, 19):
+        nd += absv >= 10 ** k
+    # int64 min has no positive abs; it carries 19 decimal digits
+    nd = np.where(absv < 0, 19, nd)
+    return nd - sf
+
+
+def _wide_dyn_dots(hi: np.ndarray, lo: np.ndarray, sf: int) -> np.ndarray:
+    """Same for a wide (uint128-limb magnitude) binary PIC P column."""
+    hi = hi.astype(np.uint64)
+    lo = lo.astype(np.uint64)
+    nd = np.ones(hi.shape, dtype=np.int64)
+    for k in range(1, 39):
+        p = 10 ** k
+        ph, pl = np.uint64(p >> 64), np.uint64(p & 0xFFFFFFFFFFFFFFFF)
+        nd += (hi > ph) | ((hi == ph) & (lo >= pl))
+    return nd - sf
 
 
 class _KernelGroup:
@@ -75,17 +134,28 @@ class _KernelGroup:
         self.columns = columns
         self.offsets = np.array([c.offset for c in columns], dtype=np.int64)
 
+    @property
+    def wide(self) -> bool:
+        """uint128-limb output layout (values_hi/values/negative planes)."""
+        return (self.codec in _NUMERIC_CODECS and self.variant
+                and self.variant[-1] is True)
+
 
 def fixed_point_exponent(spec: ColumnSpec) -> int:
     """Constant power-of-ten exponent for a non-explicit-decimal fixed-point
     column: value = mantissa * 10**e. Shared by the row path and the Arrow
     columnar output (same branches as the reference's decimal placement,
-    BCDNumberDecoders.scala:83-162 scale/scaleFactor rules)."""
+    BCDNumberDecoders.scala:83-162 scale/scaleFactor rules). Negative
+    scale factors on DISPLAY/BINARY are dynamic (see _dyn_scale) and never
+    reach this path."""
     dt = spec.dtype
     sf = spec.params.scale_factor
     if isinstance(dt, Decimal) and dt.usage is Usage.COMP3:
         n_digits = spec.width * 2 - 1
         return sf if sf > 0 else sf - n_digits if sf < 0 else -spec.params.scale
+    if sf > 0:
+        # addDecimalPoint appends sf zeros and ignores the scale
+        return sf
     return -spec.params.scale
 
 
@@ -111,13 +181,13 @@ def _pallas_group_spec(g: _KernelGroup):
     from ..ops import pallas_tpu
 
     if g.codec is Codec.BINARY:
-        signed, big_endian, fits32 = g.variant
-        if not fits32 or g.width > 4:
+        signed, big_endian, fits32, wide = g.variant
+        if wide or not fits32 or g.width > 4:
             return None
         kind, kw = "binary", {"signed": signed, "big_endian": big_endian}
     elif g.codec is Codec.BCD:
-        (fits32,) = g.variant
-        if not fits32 or g.width > 5:
+        fits32, wide = g.variant
+        if wide or not fits32 or g.width > 5:
             return None
         kind, kw = "bcd", {}
     else:
@@ -184,16 +254,23 @@ class DecodedBatch:
         # fixed-point
         if not out["valid"][i]:
             return None
+        if "values_hi" in out:
+            # wide (uint128-limb) mantissa; the oracle returns Decimal for
+            # these even when integral (the reference's BigDecimal plane)
+            mantissa = (int(out["values_hi"][i]) << 64) | int(out["values"][i])
+            if out["negative"][i]:
+                mantissa = -mantissa
+            if spec.params.explicit_decimal or _dyn_scale(spec):
+                return _exact_scaleb(mantissa, -int(out["dot_scale"][i]))
+            return _exact_scaleb(mantissa, fixed_point_exponent(spec))
         mantissa = int(out["values"][i])
         dt = spec.dtype
         if isinstance(dt, Integral):
             return mantissa
-        # Decimal
-        if spec.params.explicit_decimal:
+        # Decimal; explicit '.' and PIC P exponents are per-value planes
+        if spec.params.explicit_decimal or _dyn_scale(spec):
             scale = int(out["dot_scale"][i])
             return PyDecimal(mantissa).scaleb(-scale)
-        # non-COMP3 decimals with scale_factor != 0 compile to HOST_FALLBACK
-        # (the digit-count-dependent PIC P semantics live in the oracle)
         return PyDecimal(mantissa).scaleb(fixed_point_exponent(spec))
 
     def _vectorizable_string(self, spec: ColumnSpec) -> bool:
@@ -276,6 +353,26 @@ class DecodedBatch:
                 lst = [v if ok else None for v, ok in zip(vals, vb)]
             else:
                 lst = vals
+        elif "values_hi" in out:
+            valid = out["valid"]
+            all_ok = bool(valid.all())
+            vb = None if all_ok else valid.tolist()
+            his = out["values_hi"].tolist()
+            los = out["values"].tolist()
+            negs = out["negative"].tolist()
+            if spec.params.explicit_decimal or _dyn_scale(spec):
+                exps = out["dot_scale"].tolist()
+                es = [-e for e in exps]
+            else:
+                es = [fixed_point_exponent(spec)] * n
+            mk = (lambda h, l, ng, e:
+                  _exact_scaleb(-((h << 64) | l) if ng else (h << 64) | l, e))
+            if all_ok:
+                lst = [mk(h, l, ng, e)
+                       for h, l, ng, e in zip(his, los, negs, es)]
+            else:
+                lst = [mk(h, l, ng, e) if ok else None
+                       for h, l, ng, e, ok in zip(his, los, negs, es, vb)]
         else:
             valid = out["valid"]
             mant = out["values"].tolist()
@@ -285,7 +382,7 @@ class DecodedBatch:
             if isinstance(dt, Integral):
                 lst = (mant if all_ok
                        else [v if ok else None for v, ok in zip(mant, vb)])
-            elif spec.params.explicit_decimal:
+            elif spec.params.explicit_decimal or _dyn_scale(spec):
                 dots = out["dot_scale"].tolist()
                 if all_ok:
                     lst = [PyDecimal(v).scaleb(-d)
@@ -608,13 +705,13 @@ class ColumnarDecoder:
         narrow_extent = 1
         for g in self.kernel_groups:
             res = None
-            if g.codec is Codec.BINARY:
-                signed, big_endian, fits32 = g.variant
+            if g.codec is Codec.BINARY and not g.wide:
+                signed, big_endian, fits32, _ = g.variant
                 res = native.decode_binary_cols_raw(
                     buf, offs, rec_lengths, g.offsets, g.width,
                     signed, big_endian, fits32=fits32)
-            elif g.codec is Codec.BCD:
-                (fits32,) = g.variant
+            elif g.codec is Codec.BCD and not g.wide:
+                fits32, _ = g.variant
                 res = native.decode_bcd_cols_raw(
                     buf, offs, rec_lengths, g.offsets, g.width,
                     fits32=fits32)
@@ -665,8 +762,12 @@ class ColumnarDecoder:
         (no intermediate slab). False -> caller uses the numpy path."""
         from .. import native
 
+        if g.wide:
+            # the int64-accumulator C kernels would silently wrap >18-digit
+            # values; wide groups use the numpy uint128-limb path
+            return False
         if g.codec is Codec.BINARY:
-            signed, big_endian, _ = g.variant
+            signed, big_endian, _, _ = g.variant
             res = native.decode_binary_cols(
                 arr, g.offsets, g.width, signed, big_endian)
             if res is None:
@@ -680,7 +781,11 @@ class ColumnarDecoder:
             self._store_numeric(g, outputs, *res)
             return True
         if g.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
-            signed, allow_dot, require_digits, _ = g.variant
+            signed, allow_dot, require_digits, _, sf, _ = g.variant
+            if sf < 0:
+                # dynamic PIC P exponent needs the digit-count plane the
+                # C kernel does not emit
+                return False
             kind = (native.DISPLAY_EBCDIC if g.codec is Codec.DISPLAY_NUM
                     else native.DISPLAY_ASCII)
             res = native.decode_display_cols(
@@ -695,17 +800,37 @@ class ColumnarDecoder:
     def _run_group_numpy(self, g: _KernelGroup, slab: np.ndarray,
                          outputs: Dict[int, dict]) -> None:
         if g.codec is Codec.BINARY:
-            signed, big_endian, _ = g.variant
+            signed, big_endian, _, wide = g.variant
+            if wide:
+                hi, lo, neg, valid = batch_np.decode_binary_wide(
+                    slab, signed, big_endian)
+                self._store_wide(g, outputs, hi, lo, neg, valid)
+                return
             values, valid = batch_np.decode_binary(slab, signed, big_endian)
             self._store_numeric(g, outputs, values, valid)
         elif g.codec is Codec.BCD:
+            _, wide = g.variant
+            if wide:
+                hi, lo, neg, valid = batch_np.decode_bcd_wide(slab)
+                self._store_wide(g, outputs, hi, lo, neg, valid)
+                return
             values, valid = batch_np.decode_bcd(slab)
             self._store_numeric(g, outputs, values, valid)
         elif g.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
-            signed, allow_dot, require_digits, _ = g.variant
+            signed, allow_dot, require_digits, _, sf, wide = g.variant
+            dyn_sf = sf if sf < 0 else 0
+            if wide:
+                fn = (batch_np.decode_display_ebcdic_wide
+                      if g.codec is Codec.DISPLAY_NUM
+                      else batch_np.decode_display_ascii_wide)
+                hi, lo, neg, valid, dots = fn(slab, signed, allow_dot,
+                                              require_digits, dyn_sf)
+                self._store_wide(g, outputs, hi, lo, neg, valid, dots)
+                return
             fn = (batch_np.decode_display_ebcdic
                   if g.codec is Codec.DISPLAY_NUM else batch_np.decode_display_ascii)
-            values, valid, dots = fn(slab, signed, allow_dot, require_digits)
+            values, valid, dots = fn(slab, signed, allow_dot, require_digits,
+                                     dyn_sf)
             self._store_numeric(g, outputs, values, valid, dots)
         elif g.codec is Codec.FLOAT_IBM:
             s = slab if g.columns[0].params.big_endian else slab[..., ::-1]
@@ -748,6 +873,31 @@ class ColumnarDecoder:
             out = {"values": values[:, pos], "valid": valid[:, pos]}
             if dots is not None:
                 out["dot_scale"] = dots[:, pos]
+            elif c.params.scale_factor < 0 and g.codec is Codec.BINARY:
+                # binary PIC P: exponent = |sf| + decimal digit count of the
+                # value (addDecimalPoint over str(value)); the kernels have
+                # no digit-count plane for binary, so derive it here
+                out["dot_scale"] = _binary_dyn_dots(
+                    values[:, pos], c.params.scale_factor)
+            outputs[c.index] = out
+
+    def _store_wide(self, g: _KernelGroup, outputs: Dict[int, dict],
+                    hi, lo, negative, valid, dot_scale=None) -> None:
+        """uint128-limb layout: magnitude = (values_hi << 64) | values,
+        sign in `negative` (the columnar form of the BigDecimal plane)."""
+        hi = np.asarray(hi)
+        lo = np.asarray(lo)
+        negative = np.asarray(negative)
+        valid = np.asarray(valid)
+        dots = None if dot_scale is None else np.asarray(dot_scale)
+        for pos, c in enumerate(g.columns):
+            out = {"values": lo[:, pos], "values_hi": hi[:, pos],
+                   "negative": negative[:, pos], "valid": valid[:, pos]}
+            if dots is not None:
+                out["dot_scale"] = dots[:, pos]
+            elif c.params.scale_factor < 0 and g.codec is Codec.BINARY:
+                out["dot_scale"] = _wide_dyn_dots(
+                    hi[:, pos], lo[:, pos], c.params.scale_factor)
             outputs[c.index] = out
 
     # -- jax backend ------------------------------------------------------
@@ -837,39 +987,48 @@ class ColumnarDecoder:
                 chars = np.asarray(out[0])[:n]
                 for pos, c in enumerate(g.columns):
                     outputs[c.index] = {"bytes": chars[:, pos]}
+            elif g.wide:
+                arrs = [np.asarray(o)[:n] for o in out]
+                self._store_wide(g, outputs, *arrs)
             elif g.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
                 values, valid, dots = (np.asarray(o)[:n] for o in out)
-                for pos, c in enumerate(g.columns):
-                    outputs[c.index] = {"values": values[:, pos],
-                                        "valid": valid[:, pos],
-                                        "dot_scale": dots[:, pos]}
+                self._store_numeric(g, outputs, values, valid, dots)
             else:
                 values, valid = (np.asarray(o)[:n] for o in out)
                 if g.codec in (Codec.DOUBLE_IBM, Codec.DOUBLE_IEEE):
                     # device returns IEEE754 bit patterns (uint64); f64
                     # bitcasts on TPU round through the emulation path
                     values = values.view(np.float64)
-                for pos, c in enumerate(g.columns):
-                    outputs[c.index] = {"values": values[:, pos],
-                                        "valid": valid[:, pos]}
+                self._store_numeric(g, outputs, values, valid)
         return outputs
 
     def _run_group_jax(self, g: _KernelGroup, slab, jnp, batch_jax, lut):
         if g.codec is Codec.BINARY:
-            signed, big_endian, fits32 = g.variant
+            signed, big_endian, fits32, wide = g.variant
+            if wide:
+                return batch_jax.decode_binary_wide(slab, signed, big_endian)
             out_dtype = jnp.int32 if fits32 else jnp.int64
             return batch_jax.decode_binary(slab, signed, big_endian, out_dtype)
         if g.codec is Codec.BCD:
-            (fits32,) = g.variant
+            fits32, wide = g.variant
+            if wide:
+                return batch_jax.decode_bcd_wide(slab)
             out_dtype = jnp.int32 if fits32 else jnp.int64
             return batch_jax.decode_bcd(slab, out_dtype)
         if g.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
-            signed, allow_dot, require_digits, fits32 = g.variant
+            signed, allow_dot, require_digits, fits32, sf, wide = g.variant
+            dyn_sf = sf if sf < 0 else 0
+            if wide:
+                fn = (batch_jax.decode_display_ebcdic_wide
+                      if g.codec is Codec.DISPLAY_NUM
+                      else batch_jax.decode_display_ascii_wide)
+                return fn(slab, signed, allow_dot, require_digits, dyn_sf)
             out_dtype = jnp.int32 if fits32 else jnp.int64
             fn = (batch_jax.decode_display_ebcdic
                   if g.codec is Codec.DISPLAY_NUM
                   else batch_jax.decode_display_ascii)
-            return fn(slab, signed, allow_dot, require_digits, out_dtype)
+            return fn(slab, signed, allow_dot, require_digits, out_dtype,
+                      dyn_sf)
         if g.codec is Codec.FLOAT_IBM:
             s = slab if g.columns[0].params.big_endian else slab[..., ::-1]
             return batch_jax.decode_ibm_float32(s)

@@ -82,9 +82,11 @@ def test_scale_factor_display_columnar_matches_host():
     cb = parse_copybook(copybook)
     plan = compile_plan(cb)
     codecs = {c.name: c.codec for c in plan.columns}
-    assert codecs["A"] is Codec.HOST_FALLBACK
-    assert codecs["B"] is Codec.HOST_FALLBACK
-    assert codecs["C"] is Codec.HOST_FALLBACK
+    # PIC P fields are vectorized since round 3: the digit-count-dependent
+    # exponent rides the per-value dot_scale plane (columnar._dyn_scale)
+    assert codecs["A"] is Codec.DISPLAY_NUM
+    assert codecs["B"] is Codec.DISPLAY_NUM
+    assert codecs["C"] is Codec.BINARY
     rows_data = [ebcdic_encode("012345") + (77).to_bytes(2, "big"),
                  ebcdic_encode("900001") + (0x8000).to_bytes(2, "big")]
     data = np.frombuffer(b"".join(rows_data), dtype=np.uint8).reshape(2, -1)
