@@ -1,35 +1,40 @@
-"""Fused Pallas TPU kernel for the numeric decode hot plane.
+"""Fused Pallas TPU kernel for the decode hot plane.
 
 The decode of a record batch has two parts: byte *layout* (pulling each
 field's bytes out of the `[batch, record_len]` byte matrix) and byte
 *arithmetic* (turning those bytes into typed values + validity — the
 reference's per-field hot loop, RecordExtractors.scala:49 +
-BinaryNumberDecoders.scala:21, BCDNumberDecoders.scala:29).
+BinaryNumberDecoders.scala:21, BCDNumberDecoders.scala:29,
+StringDecoders.scala:154).
 
-Layout stays in XLA: for a column group whose offsets form an arithmetic
-progression (the layout OCCURS arrays compile to — e.g. exp3's
-`STRATEGY-DETAIL OCCURS 2000` of `9(7) COMP` + `9(7) COMP-3`,
-TestDataGen4CompaniesWide.scala:37-54), byte ``j`` of every field is one
-strided slice `data[:, base+j::stride]` — a regular layout op XLA lowers
-well on TPU. Mosaic (the Pallas TPU compiler) does not currently support
-strided lane slices, minor-dim int8 reshapes, or u8 lane gathers inside a
-kernel, so doing the layout in-kernel is not expressible; the byte planes
-are computed in XLA and flow into the kernel.
+Layout stays in XLA: byte ``j`` of every field in a group is one strided
+slice `data[:, base+j::stride]` when the group's offsets form an
+arithmetic progression (OCCURS arrays — e.g. exp3's `STRATEGY-DETAIL
+OCCURS 2000`, TestDataGen4CompaniesWide.scala:37-54), or one gather
+`data[:, offsets + j]` for irregular layouts (exp1's 195 heterogeneous
+fields). Mosaic (the Pallas TPU compiler) does not support strided lane
+slices or u8 lane gathers inside a kernel, so the byte planes are
+computed in XLA and flow into the kernel.
 
-Arithmetic is the Pallas kernel: ONE launch decodes every eligible group —
-place-value accumulation, sign handling, digit/sign-nibble validity — as
-2D int32/bool VPU math over `[BATCH_TILE, count]` tiles, instead of one
-XLA op-chain per group. Groups must fit int32 lanes (the reference's Int
-precision bucket, Constants.scala:21-79); wide columns stay on the XLA
-gather path since TPUs have no native int64 lanes.
+Arithmetic is the Pallas kernel: ONE launch decodes every numeric group —
+binary two's complement, packed BCD, and zoned DISPLAY (the overpunch
+state machine as int32 VPU compare/select math) — over `[BATCH_TILE,
+count]` tiles. Values wider than 32 bits (10-18 digit fields, and the
+19-38 digit BigDecimal plane) are accumulated in base-2^16 limbs held in
+int32 lanes — TPUs have no native int64 — and assembled into int64 /
+uint64-pair outputs by XLA after the kernel, so every fused group returns
+exactly the tuples the XLA gather path produces (`columnar.
+_run_group_jax` contracts). String groups keep the XLA LUT-gather path
+(a 256-entry transcode XLA already lowers well); floats and host-fallback
+columns are the only other non-fused planes.
 
-Both paths produce identical (values, valid) pairs; parity is pinned by
-tests/test_pallas_kernels.py against the numpy blueprint kernels.
+Parity is pinned by tests/test_pallas_kernels.py against the numpy
+blueprint kernels, on both the interpreter and real TPU.
 """
 from __future__ import annotations
 
 import functools
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,35 +43,49 @@ import jax.numpy as jnp
 
 BATCH_TILE = 32  # uint8 sublane tile
 
+# 16-bit limbs in int32 lanes: 4 limbs = one 64-bit value, 8 = 128-bit
+_LIMBS = {"i32": 1, "i64": 4, "wide": 8}
+
 
 class StridedGroup:
-    """Static decode spec for one eligible kernel group.
+    """Static decode spec for one fused kernel group.
 
-    base/stride/count describe the offset progression; width is the field
-    byte width; kind is "binary" or "bcd"; signed/big_endian apply to
-    binary only.
+    base/stride/count describe the offset progression when regular;
+    `offsets` carries the raw offsets for irregular groups (the byte
+    planes are then XLA gathers). width is the field byte width; kind is
+    "binary", "bcd", "display_ebcdic" or "display_ascii"; `out` selects
+    the value plane: "i32" (native int32 lanes), "i64" (4x16-bit limbs),
+    or "wide" (8x16-bit limbs, the uint128 BigDecimal plane).
     """
 
-    def __init__(self, base: int, stride: int, count: int, width: int,
-                 kind: str, signed: bool = False, big_endian: bool = True):
-        if count > 1 and stride < width:
-            raise ValueError("columns overlap: stride < width")
-        self.base = base
-        self.stride = stride
-        self.count = count
+    def __init__(self, offsets: Sequence[int], width: int, kind: str,
+                 out: str = "i32", signed: bool = False,
+                 big_endian: bool = True, allow_dot: bool = False,
+                 require_digits: bool = True, dyn_sf: int = 0):
+        self.offsets = [int(o) for o in offsets]
+        self.count = len(self.offsets)
         self.width = width
         self.kind = kind
+        self.out = out
         self.signed = signed
         self.big_endian = big_endian
+        self.allow_dot = allow_dot
+        self.require_digits = require_digits
+        self.dyn_sf = dyn_sf
+        self.progression = offsets_progression(self.offsets)
 
     @property
     def end(self) -> int:
-        return self.base + (self.count - 1) * self.stride + self.width
+        return max(self.offsets) + self.width
+
+    @property
+    def is_display(self) -> bool:
+        return self.kind.startswith("display")
 
 
-def offsets_progression(offsets: Sequence[int]) -> Tuple[int, int] | None:
-    """(base, stride) if `offsets` is a non-decreasing arithmetic
-    progression, else None. A single column is a progression of stride 0."""
+def offsets_progression(offsets: Sequence[int]) -> Optional[Tuple[int, int]]:
+    """(base, stride) if `offsets` is an increasing arithmetic progression,
+    else None. A single column is a progression of stride 0."""
     offs = list(int(o) for o in offsets)
     if not offs:
         return None
@@ -82,22 +101,62 @@ def offsets_progression(offsets: Sequence[int]) -> Tuple[int, int] | None:
 
 
 def _byte_planes(data, g: StridedGroup):
-    """XLA-side layout: byte j of every field in the group, j = 0..width-1.
-    Each plane is a [batch, count] strided slice of the byte matrix."""
+    """XLA-side layout: byte j of every field in the group, j=0..width-1.
+    Strided slice for regular layouts, gather for irregular ones."""
     planes = []
-    for j in range(g.width):
-        start = g.base + j
-        if g.count == 1:
-            planes.append(jax.lax.slice_in_dim(data, start, start + 1, axis=1))
-        else:
-            limit = start + (g.count - 1) * g.stride + 1
-            planes.append(jax.lax.slice_in_dim(
-                data, start, limit, stride=g.stride, axis=1))
+    if g.progression is not None:
+        base, stride = g.progression
+        for j in range(g.width):
+            start = base + j
+            if g.count == 1:
+                planes.append(jax.lax.slice_in_dim(
+                    data, start, start + 1, axis=1))
+            else:
+                limit = start + (g.count - 1) * stride + 1
+                planes.append(jax.lax.slice_in_dim(
+                    data, start, limit, stride=stride, axis=1))
+    else:
+        offs = jnp.asarray(g.offsets, dtype=jnp.int32)
+        for j in range(g.width):
+            planes.append(jnp.take(data, offs + j, axis=1))
     return planes
 
 
-def _decode_binary_planes(planes, g: StridedGroup):
-    """W x [TB, K] uint8 -> ([TB, K] int32 values, [TB, K] bool valid)."""
+# ---------------------------------------------------------------------------
+# in-kernel limb arithmetic (base 2^16 in int32 lanes)
+# ---------------------------------------------------------------------------
+
+def _limbs_zero(n, shape):
+    return [jnp.zeros(shape, dtype=jnp.int32) for _ in range(n)]
+
+
+def _limbs_mul10_add(limbs, digit, cond=None):
+    """limbs <- limbs * 10 + digit, optionally only where `cond`."""
+    out = []
+    carry = digit
+    for l in limbs:
+        t = l * 10 + carry          # <= 655350 + 9: fits int32 exactly
+        nl = t & 0xFFFF
+        carry = t >> 16
+        out.append(jnp.where(cond, nl, l) if cond is not None else nl)
+    return out
+
+
+def _limbs_shl8_or(limbs, byte):
+    """limbs <- (limbs << 8) | byte (LSB-first limb order)."""
+    out = []
+    carry = byte
+    for l in limbs:
+        out.append(((l << 8) | carry) & 0xFFFF)
+        carry = l >> 8
+    return out
+
+
+# ---------------------------------------------------------------------------
+# in-kernel decode per kind
+# ---------------------------------------------------------------------------
+
+def _decode_binary_i32(planes, g: StridedGroup):
     w = g.width
     order = range(w) if g.big_endian else range(w - 1, -1, -1)
     acc = None
@@ -120,66 +179,307 @@ def _decode_binary_planes(planes, g: StridedGroup):
         if w == 4:
             valid = (acc >> 31) == 0
         # bitcast + typed zero: keeps Mosaic off the x64-promoted int64
-        # conversion path (which recurses in its lowering); valid values
-        # have the top bit clear so the bitcast equals the value
+        # conversion path; valid values have the top bit clear
         values = jnp.where(valid, jax.lax.bitcast_convert_type(
             acc, jnp.int32), jnp.int32(0))
-    return values, valid
+    return [values, valid]
 
 
-def _decode_bcd_planes(planes, g: StridedGroup):
-    """COMP-3: two digits per byte, trailing sign nibble
-    (BCDNumberDecoders.scala:29 semantics, int32 lanes)."""
+def _decode_binary_limbs(planes, g: StridedGroup):
+    """Two's complement in 16-bit limbs; sign extension at init."""
+    n = _LIMBS[g.out]
     w = g.width
-    acc = jnp.zeros(planes[0].shape, dtype=jnp.int32)
-    digit_ok = jnp.ones(acc.shape, dtype=jnp.bool_)
+    order = range(w) if g.big_endian else range(w - 1, -1, -1)
+    first = True
+    limbs = _limbs_zero(n, planes[0].shape)
+    for j in order:
+        b = planes[j].astype(jnp.int32)
+        if first and g.signed:
+            ext = jnp.where((b & 0x80) != 0, jnp.int32(0xFFFF),
+                            jnp.int32(0))
+            limbs = [ext for _ in range(n)]
+        limbs = _limbs_shl8_or(limbs, b)
+        first = False
+    valid = jnp.ones(planes[0].shape, dtype=jnp.bool_)
+    return limbs + [valid]
+
+
+def _decode_bcd(planes, g: StridedGroup):
+    w = g.width
+    shape = planes[0].shape
+    if g.out == "i32":
+        acc = jnp.zeros(shape, dtype=jnp.int32)
+    else:
+        limbs = _limbs_zero(_LIMBS[g.out], shape)
+    digit_ok = jnp.ones(shape, dtype=jnp.bool_)
     sign = None
     for j in range(w):
         b = planes[j].astype(jnp.int32)
         high = (b >> 4) & 0x0F
         low = b & 0x0F
         digit_ok &= high < 10
-        acc = acc * 10 + high
+        if g.out == "i32":
+            acc = acc * 10 + high
+        else:
+            limbs = _limbs_mul10_add(limbs, high)
         if j + 1 < w:
             digit_ok &= low < 10
-            acc = acc * 10 + low
+            if g.out == "i32":
+                acc = acc * 10 + low
+            else:
+                limbs = _limbs_mul10_add(limbs, low)
         else:
             sign = low
     sign_ok = (sign == 0x0C) | (sign == 0x0D) | (sign == 0x0F)
-    values = jnp.where(sign == 0x0D, -acc, acc)
     valid = digit_ok & sign_ok
-    return jnp.where(valid, values, jnp.int32(0)), valid
+    negative = (sign == 0x0D) & valid
+    if g.out == "i32":
+        values = jnp.where(sign == 0x0D, -acc, acc)
+        return [jnp.where(valid, values, jnp.int32(0)), valid]
+    limbs = [jnp.where(valid, l, jnp.int32(0)) for l in limbs]
+    return limbs + [negative, valid]
 
 
-def _fused_kernel(groups: List[StridedGroup], *refs):
-    n_in = sum(g.width for g in groups)
-    in_refs, out_refs = refs[:n_in], refs[n_in:]
-    pos = 0
-    for i, g in enumerate(groups):
-        planes = [in_refs[pos + j][:] for j in range(g.width)]
-        pos += g.width
-        if g.kind == "binary":
-            values, valid = _decode_binary_planes(planes, g)
+def _classify_display_byte(b, ascii_mode: bool):
+    """One byte plane -> (is_digit, digit_val, is_sign, is_neg_mark,
+    is_dot, is_space, known) as int32/bool lanes (the per-byte rules of
+    StringDecoders.decodeEbcdicNumber / decodeAsciiNumber)."""
+    # typed zeros throughout: a weak Python 0 inside jnp.where traces as
+    # an i64 literal under x64 and Mosaic's convert lowering recurses
+    if ascii_mode:
+        is_digit = (b >= 0x30) & (b <= 0x39)
+        dv = jnp.where(is_digit, b - 0x30, jnp.int32(0))
+        is_minus = b == 0x2D
+        is_plus = b == 0x2B
+        is_dot = (b == 0x2E) | (b == 0x2C)
+        is_space = b <= 0x20
+        neg_mark = is_minus
+        sign_mark = is_minus | is_plus
+    else:
+        is_f = (b >= 0xF0) & (b <= 0xF9)
+        is_c = (b >= 0xC0) & (b <= 0xC9)
+        is_d = (b >= 0xD0) & (b <= 0xD9)
+        is_digit = is_f | is_c | is_d
+        dv = jnp.where(is_f, b - 0xF0,
+                       jnp.where(is_c, b - 0xC0,
+                                 jnp.where(is_d, b - 0xD0, jnp.int32(0))))
+        is_minus = b == 0x60
+        is_plus = b == 0x4E
+        is_dot = (b == 0x4B) | (b == 0x6B)
+        is_space = (b == 0x40) | (b == 0x00)
+        neg_mark = is_d | is_minus
+        sign_mark = is_c | is_d | is_minus | is_plus
+    known = is_digit | sign_mark | is_dot | is_space
+    return is_digit, dv, sign_mark, neg_mark, is_dot, is_space, known
+
+
+def _decode_display(planes, g: StridedGroup):
+    """Zoned DISPLAY numeric as VPU compare/select math — the in-kernel
+    form of StringDecoders.scala:154 (overpunched signs, separate +/-,
+    explicit '.', space skipping, malformed -> null)."""
+    ascii_mode = g.kind == "display_ascii"
+    shape = planes[0].shape
+    zero = jnp.zeros(shape, dtype=jnp.int32)
+    if g.out == "i32":
+        acc = zero
+    else:
+        limbs = _limbs_zero(_LIMBS[g.out], shape)
+    n_digits = zero
+    n_signs = zero
+    n_dots = zero
+    dots_right = zero
+    seen_dot = jnp.zeros(shape, dtype=jnp.bool_)
+    negative = jnp.zeros(shape, dtype=jnp.bool_)
+    known_all = jnp.ones(shape, dtype=jnp.bool_)
+
+    if ascii_mode:
+        # interior-space rule needs lookahead: a space with meaningful
+        # bytes on both sides survives into the JVM parse and nulls it
+        meaningful = []
+        for j in range(g.width):
+            b = planes[j].astype(jnp.int32)
+            is_digit, _, _, _, is_dot, _, _ = _classify_display_byte(
+                b, ascii_mode=True)
+            meaningful.append(is_digit | is_dot)
+        suffix = [None] * g.width
+        later = jnp.zeros(shape, dtype=jnp.bool_)
+        for j in range(g.width - 1, -1, -1):
+            suffix[j] = later
+            later = later | meaningful[j]
+        seen_meaningful = jnp.zeros(shape, dtype=jnp.bool_)
+        interior_space = jnp.zeros(shape, dtype=jnp.bool_)
+
+    for j in range(g.width):
+        b = planes[j].astype(jnp.int32)
+        is_digit, dv, sign_mark, neg_mark, is_dot, is_space, known = \
+            _classify_display_byte(b, ascii_mode)
+        if ascii_mode:
+            interior_space |= is_space & seen_meaningful & suffix[j]
+            seen_meaningful |= meaningful[j]
+        known_all &= known
+        seen_dot |= is_dot
+        dots_right += (is_digit & seen_dot).astype(jnp.int32)
+        n_digits += is_digit.astype(jnp.int32)
+        n_dots += is_dot.astype(jnp.int32)
+        n_signs += sign_mark.astype(jnp.int32)
+        negative |= neg_mark
+        if g.out == "i32":
+            acc = jnp.where(is_digit, acc * 10 + dv, acc)
         else:
-            values, valid = _decode_bcd_planes(planes, g)
-        out_refs[2 * i][:] = values
-        out_refs[2 * i + 1][:] = valid
+            limbs = _limbs_mul10_add(limbs, dv, cond=is_digit)
+
+    valid = known_all & (n_signs <= 1)
+    if ascii_mode:
+        valid &= ~interior_space
+    if g.require_digits:
+        valid &= n_digits >= 1
+    valid &= (n_dots <= 1) if g.allow_dot else (n_dots == 0)
+    if not g.signed:
+        valid &= ~negative
+    dots = dots_right if g.dyn_sf >= 0 else (-g.dyn_sf + n_digits)
+    dots = jnp.where(valid, dots, zero)
+    if g.out == "i32":
+        values = jnp.where(negative, -acc, acc)
+        return [jnp.where(valid, values, zero), valid, dots]
+    limbs = [jnp.where(valid, l, zero) for l in limbs]
+    return limbs + [negative & valid, valid, dots]
+
+
+def _decode_group(planes, g: StridedGroup):
+    if g.kind == "binary":
+        return (_decode_binary_i32(planes, g) if g.out == "i32"
+                else _decode_binary_limbs(planes, g))
+    if g.kind == "bcd":
+        return _decode_bcd(planes, g)
+    return _decode_display(planes, g)
+
+
+def _out_dtypes(g: StridedGroup):
+    """Kernel output dtypes for a group, in _decode_group order."""
+    limbs = _LIMBS[g.out]
+    if g.kind == "binary":
+        return [jnp.int32] * limbs + [jnp.bool_]
+    if g.kind == "bcd":
+        return ([jnp.int32, jnp.bool_] if g.out == "i32"
+                else [jnp.int32] * limbs + [jnp.bool_, jnp.bool_])
+    return ([jnp.int32, jnp.bool_, jnp.int32] if g.out == "i32"
+            else [jnp.int32] * limbs + [jnp.bool_, jnp.bool_, jnp.int32])
+
+
+def _fused_kernel(layout, in_ref, o32_ref, obool_ref):
+    """ONE kernel for every group: reads each group's byte planes from the
+    packed input buffer and writes its outputs into column segments of the
+    packed int32 / bool output buffers. Packing matters on TPU: separate
+    [batch, count] buffers with tiny counts would each pad to the 128-lane
+    tile (a 128x memory blowup for exp1's 1-2 column groups)."""
+    for g, in_base, slots in layout:
+        planes = [in_ref[:, in_base + j * g.count:
+                         in_base + (j + 1) * g.count]
+                  for j in range(g.width)]
+        for (space, start), arr in zip(slots, _decode_group(planes, g)):
+            ref = o32_ref if space == "i32" else obool_ref
+            ref[:, start:start + g.count] = arr
+
+
+# ---------------------------------------------------------------------------
+# XLA-side assembly of kernel outputs into the _run_group_jax contracts
+# ---------------------------------------------------------------------------
+
+def _assemble_u64(limbs):
+    v = jnp.zeros(limbs[0].shape, dtype=jnp.uint64)
+    for k in range(3, -1, -1):
+        v = (v << 16) | limbs[k].astype(jnp.uint64)
+    return v
+
+
+def _assemble_u128(limbs):
+    lo = _assemble_u64(limbs[:4])
+    hi = _assemble_u64(limbs[4:8])
+    return hi, lo
+
+
+def _assemble_group(outs, g: StridedGroup):
+    """Kernel buffers -> the exact tuple the XLA gather path returns for
+    this group (int64 values via x64, uint64 limb pairs for wide)."""
+    if g.out == "i32":
+        return tuple(outs)
+    limbs = outs[:_LIMBS[g.out]]
+    rest = outs[_LIMBS[g.out]:]
+    if g.kind == "binary":
+        (valid,) = rest
+        if g.out == "i64":
+            v = jax.lax.bitcast_convert_type(_assemble_u64(limbs), jnp.int64)
+            if not g.signed and g.width == 8:
+                # unsigned 8-byte overflow -> null (JVM Long bucket)
+                valid = valid & (v >= 0)
+                v = jnp.where(valid, v, jnp.int64(0))
+            return v, valid
+        hi, lo = _assemble_u128(limbs)
+        if g.signed:
+            negative = (hi >> 63) != 0
+            neg_lo = (~lo) + jnp.uint64(1)
+            neg_hi = (~hi) + (neg_lo == 0).astype(jnp.uint64)
+            hi = jnp.where(negative, neg_hi, hi)
+            lo = jnp.where(negative, neg_lo, lo)
+        else:
+            negative = jnp.zeros(hi.shape, dtype=jnp.bool_)
+        return hi, lo, negative, valid
+    # bcd / display carry the magnitude in the limbs and sign separately
+    if g.kind == "bcd":
+        negative, valid = rest
+        tail = ()
+    else:
+        negative, valid, dots = rest
+        tail = (dots,)
+    if g.out == "i64":
+        # int64 multiply-add wrap semantics == mod-2^64 limb accumulation
+        v = jax.lax.bitcast_convert_type(_assemble_u64(limbs), jnp.int64)
+        v = jnp.where(negative, -v, v)
+        return (v, valid) + tail
+    hi, lo = _assemble_u128(limbs)
+    return (hi, lo, negative, valid) + tail
 
 
 def build_fused_decode(groups: Sequence[StridedGroup], record_len: int,
                        interpret: bool | None = None):
-    """Returns fn(data: [B, record_len] uint8) -> [(values, valid), ...]
-    (one int32/bool pair per group, batch-aligned with the input).
+    """Returns fn(data: [B, record_len] uint8) -> [group tuples, ...] in
+    the `columnar._run_group_jax` output format for each group.
 
     jit-traceable; pads the batch to the tile size, extracts the byte
-    planes in XLA, and runs the single fused pallas_call over batch tiles.
+    planes in XLA, runs the single fused pallas_call over batch tiles,
+    and assembles limb outputs into int64 / uint64-pair planes.
     """
     from jax.experimental import pallas as pl
 
+    from .batch_jax import ensure_x64
+
+    ensure_x64()  # the limb assembly builds int64/uint64 planes
     groups = list(groups)
     need_len = max([record_len] + [g.end for g in groups])
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+
+    # static layout: each group's byte planes occupy a column range of the
+    # packed uint8 input; each output occupies a range of the packed int32
+    # or bool output buffer
+    layout = []
+    in_base = 0
+    i32_base = 0
+    bool_base = 0
+    for g in groups:
+        slots = []
+        for dtype in _out_dtypes(g):
+            if dtype is jnp.bool_:
+                slots.append(("bool", bool_base))
+                bool_base += g.count
+            else:
+                slots.append(("i32", i32_base))
+                i32_base += g.count
+        layout.append((g, in_base, slots))
+        in_base += g.width * g.count
+    total_in = max(in_base, 1)
+    total_i32 = max(i32_base, 1)
+    total_bool = max(bool_base, 1)
 
     def fn(data):
         b = data.shape[0]
@@ -194,29 +494,30 @@ def build_fused_decode(groups: Sequence[StridedGroup], record_len: int,
             # and Mosaic rejects the (i32, i64) index tuple
             return (i, jnp.int32(0))
 
-        inputs = []
-        in_specs = []
-        out_shapes = []
-        out_specs = []
+        planes = []
         for g in groups:
-            inputs.extend(_byte_planes(data, g))
-            in_specs.extend(
-                pl.BlockSpec((BATCH_TILE, g.count), batch_row)
-                for _ in range(g.width))
-            for dtype in (jnp.int32, jnp.bool_):
-                out_shapes.append(jax.ShapeDtypeStruct(
-                    (b + bpad, g.count), dtype))
-                out_specs.append(pl.BlockSpec(
-                    (BATCH_TILE, g.count), batch_row))
-        outs = pl.pallas_call(
-            functools.partial(_fused_kernel, groups),
+            planes.extend(_byte_planes(data, g))
+        packed = (jnp.concatenate(planes, axis=1) if planes
+                  else data[:, :1])
+        o32, obool = pl.pallas_call(
+            functools.partial(_fused_kernel, layout),
             grid=(n_tiles,),
-            in_specs=in_specs,
-            out_specs=out_specs,
-            out_shape=out_shapes,
+            in_specs=[pl.BlockSpec((BATCH_TILE, total_in), batch_row)],
+            out_specs=[pl.BlockSpec((BATCH_TILE, total_i32), batch_row),
+                       pl.BlockSpec((BATCH_TILE, total_bool), batch_row)],
+            out_shape=[jax.ShapeDtypeStruct((b + bpad, total_i32),
+                                            jnp.int32),
+                       jax.ShapeDtypeStruct((b + bpad, total_bool),
+                                            jnp.bool_)],
             interpret=interpret,
-        )(*inputs)
-        return [(outs[2 * i][:b], outs[2 * i + 1][:b])
-                for i in range(len(groups))]
+        )(packed)
+        results = []
+        for g, _, slots in layout:
+            bufs = []
+            for space, start in slots:
+                src = o32 if space == "i32" else obool
+                bufs.append(src[:b, start:start + g.count])
+            results.append(tuple(_assemble_group(bufs, g)))
+        return results
 
     return fn

@@ -237,27 +237,22 @@ class DeviceAggregator:
 
 def _dyn_pow10_int(values, sf: int, jnp):
     """10^-(|sf| + decimal digit count of |value|) for narrow binary PIC P
-    aggregation — digit count from the exact integer plane (a rounded f64
-    compare would miscount at 10^k boundaries), traced in-program
-    (the mirror of columnar._binary_dyn_dots)."""
-    absv = jnp.abs(values.astype(jnp.int64))
-    nd = jnp.ones(absv.shape, dtype=jnp.int32)
-    for k in range(1, 19):
-        nd = nd + (absv >= 10 ** k)
-    nd = jnp.where(absv < 0, 19, nd)  # int64 min
+    aggregation — the exact integer digit count (a rounded f64 compare
+    would miscount at 10^k boundaries), traced in-program through the same
+    helper the row path uses (columnar._digit_count)."""
+    from ..reader.columnar import _digit_count
+
+    nd = _digit_count(values, xp=jnp)
     return jnp.power(jnp.float64(10.0),
                      -(nd.astype(jnp.float64) + jnp.float64(-sf)))
 
 
 def _dyn_pow10_limbs(hi, lo, sf: int, jnp):
     """Same for wide binary PIC P: exact digit count from the uint128
-    magnitude limbs (columnar._wide_dyn_dots, traced)."""
-    nd = jnp.ones(hi.shape, dtype=jnp.int32)
-    for k in range(1, 39):
-        p = 10 ** k
-        ph = jnp.uint64(p >> 64)
-        pl = jnp.uint64(p & 0xFFFFFFFFFFFFFFFF)
-        nd = nd + ((hi > ph) | ((hi == ph) & (lo >= pl)))
+    magnitude limbs (columnar._digit_count_limbs, traced)."""
+    from ..reader.columnar import _digit_count_limbs
+
+    nd = _digit_count_limbs(hi, lo, xp=jnp)
     return jnp.power(jnp.float64(10.0),
                      -(nd.astype(jnp.float64) + jnp.float64(-sf)))
 

@@ -66,9 +66,13 @@ _STRING_CODECS = (Codec.EBCDIC_STRING, Codec.ASCII_STRING, Codec.UTF16_STRING,
 def _is_wide(spec: ColumnSpec) -> bool:
     """>18-digit fields decode through the uint128-limb kernels (the
     reference's BigDecimal plane: BCDNumberDecoders.decodeBigBCDNumber,
-    decodeBinaryAribtraryPrecision, decodeEbcdicBigNumber)."""
+    decodeBinaryAribtraryPrecision, decodeEbcdicBigNumber). DISPLAY
+    classifies by byte width, not PIC precision: every byte of the field
+    could be a digit, and the oracle decodes whatever digits are there."""
     if spec.codec is Codec.BINARY:
         return spec.width > 8
+    if spec.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
+        return spec.width > MAX_LONG_PRECISION
     return spec.params.precision > MAX_LONG_PRECISION
 
 
@@ -86,7 +90,7 @@ def _variant_key(spec: ColumnSpec) -> tuple:
         # otherwise-identical columns into separate kernel launches
         return (p.signed, p.explicit_decimal,
                 is_integral or p.explicit_decimal,
-                p.precision <= MAX_INTEGER_PRECISION,
+                spec.width <= MAX_INTEGER_PRECISION,
                 min(p.scale_factor, 0),
                 _is_wide(spec))
     return ()
@@ -101,28 +105,40 @@ def _dyn_scale(spec: ColumnSpec) -> bool:
             and spec.codec is not Codec.BCD)
 
 
+def _digit_count(values, xp=np):
+    """Decimal digit count of |value| for int64 planes; array-module
+    parameterized so the host path (numpy) and the traced DeviceAggregator
+    program (jnp) share the exact 10^k boundary logic."""
+    absv = xp.abs(values.astype(xp.int64))
+    nd = xp.ones(absv.shape, dtype=xp.int32)
+    for k in range(1, 19):
+        nd = nd + (absv >= 10 ** k)
+    # int64 min has no positive abs; it carries 19 decimal digits
+    return xp.where(absv < 0, 19, nd)
+
+
+def _digit_count_limbs(hi, lo, xp=np):
+    """Same for uint128 magnitudes held as (hi, lo) uint64 limb planes."""
+    hi = hi.astype(xp.uint64)
+    lo = lo.astype(xp.uint64)
+    nd = xp.ones(hi.shape, dtype=xp.int32)
+    for k in range(1, 39):
+        p = 10 ** k
+        ph = xp.uint64(p >> 64)
+        pl = xp.uint64(p & 0xFFFFFFFFFFFFFFFF)
+        nd = nd + ((hi > ph) | ((hi == ph) & (lo >= pl)))
+    return nd
+
+
 def _binary_dyn_dots(values: np.ndarray, sf: int) -> np.ndarray:
     """dot_scale plane for a narrow binary PIC P column: |sf| + number of
     decimal digits in str(|value|)."""
-    absv = np.abs(values.astype(np.int64))
-    nd = np.ones(absv.shape, dtype=np.int64)
-    for k in range(1, 19):
-        nd += absv >= 10 ** k
-    # int64 min has no positive abs; it carries 19 decimal digits
-    nd = np.where(absv < 0, 19, nd)
-    return nd - sf
+    return _digit_count(values).astype(np.int64) - sf
 
 
 def _wide_dyn_dots(hi: np.ndarray, lo: np.ndarray, sf: int) -> np.ndarray:
     """Same for a wide (uint128-limb magnitude) binary PIC P column."""
-    hi = hi.astype(np.uint64)
-    lo = lo.astype(np.uint64)
-    nd = np.ones(hi.shape, dtype=np.int64)
-    for k in range(1, 39):
-        p = 10 ** k
-        ph, pl = np.uint64(p >> 64), np.uint64(p & 0xFFFFFFFFFFFFFFFF)
-        nd += (hi > ph) | ((hi == ph) & (lo >= pl))
-    return nd - sf
+    return _digit_count_limbs(hi, lo).astype(np.int64) - sf
 
 
 class _KernelGroup:
@@ -176,30 +192,33 @@ def _resolve_occurs(st: Statement, dep_value) -> int:
 
 
 def _pallas_group_spec(g: _KernelGroup):
-    """StridedGroup for the fused Pallas kernel, or None if the group needs
-    the XLA gather path (non-int32 lanes, irregular offsets, wide fields)."""
+    """StridedGroup for the fused Pallas kernel, or None if the group stays
+    on the XLA path (strings via the LUT gather, floats, host fallback).
+    Every numeric plane is fused: int32 lanes natively, 10-18-digit and
+    wide (BigDecimal) fields via base-2^16 limb arithmetic in int32
+    lanes; irregular offsets feed the kernel through XLA gathers."""
     from ..ops import pallas_tpu
 
     if g.codec is Codec.BINARY:
         signed, big_endian, fits32, wide = g.variant
-        if wide or not fits32 or g.width > 4:
-            return None
-        kind, kw = "binary", {"signed": signed, "big_endian": big_endian}
-    elif g.codec is Codec.BCD:
+        out = "i32" if fits32 else "wide" if wide else "i64"
+        return pallas_tpu.StridedGroup(
+            g.offsets, g.width, "binary", out,
+            signed=signed, big_endian=big_endian)
+    if g.codec is Codec.BCD:
         fits32, wide = g.variant
-        if wide or not fits32 or g.width > 5:
-            return None
-        kind, kw = "bcd", {}
-    else:
-        return None
-    prog = pallas_tpu.offsets_progression(g.offsets)
-    if prog is None:
-        return None
-    base, stride = prog
-    if 0 < stride < g.width:
-        return None
-    return pallas_tpu.StridedGroup(base, stride, len(g.columns), g.width,
-                                   kind, **kw)
+        out = "i32" if fits32 else "wide" if wide else "i64"
+        return pallas_tpu.StridedGroup(g.offsets, g.width, "bcd", out)
+    if g.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
+        signed, allow_dot, require_digits, fits32, sf, wide = g.variant
+        out = "i32" if fits32 else "wide" if wide else "i64"
+        kind = ("display_ebcdic" if g.codec is Codec.DISPLAY_NUM
+                else "display_ascii")
+        return pallas_tpu.StridedGroup(
+            g.offsets, g.width, kind, out, signed=signed,
+            allow_dot=allow_dot, require_digits=require_digits,
+            dyn_sf=min(sf, 0))
+    return None
 
 
 class DecodedBatch:
