@@ -240,6 +240,7 @@ def parse_options(options: Dict[str, object],
     # pedantic unused-key audit runs
     opts.get_bool("debug_ignore_file_size")
     opts.get_int("parallelism", 0)
+    opts.get_int("hosts", 0)
     _validate_options(opts, params, streaming)
     return params, opts
 
@@ -319,26 +320,46 @@ class CobolData:
     materialized only when asked for."""
 
     def __init__(self, rows, schema: CobolOutputSchema,
-                 results: Optional[List["FileResult"]] = None):
+                 results: Optional[List["FileResult"]] = None,
+                 parallelism: int = 1):
         self._rows = rows
         self._results = results
+        self._arrow_tables = None
         self.output_schema = schema
+        self.parallelism = parallelism
 
     @classmethod
     def from_results(cls, results: List["FileResult"],
-                     schema: CobolOutputSchema) -> "CobolData":
-        return cls(None, schema, results)
+                     schema: CobolOutputSchema,
+                     parallelism: int = 1) -> "CobolData":
+        return cls(None, schema, results, parallelism=parallelism)
+
+    @classmethod
+    def from_arrow_tables(cls, tables, schema: CobolOutputSchema
+                          ) -> "CobolData":
+        """Multi-host results: the columnar product arrived as Arrow
+        tables (one per shard, already in record order)."""
+        data = cls(None, schema, None)
+        data._arrow_tables = tables
+        return data
 
     @property
     def schema(self) -> StructType:
         return self.output_schema.schema
 
     def __len__(self) -> int:
+        if self._arrow_tables is not None:
+            return sum(t.num_rows for t in self._arrow_tables)
         if self._rows is not None:
             return len(self._rows)
         return sum(r.n_rows for r in self._results)
 
     def to_rows(self) -> List[List[object]]:
+        if self._arrow_tables is not None:
+            raise NotImplementedError(
+                "multi-host (hosts=N) results are Arrow-backed; use "
+                "to_arrow()/to_pandas(), or read without `hosts` for "
+                "Python row materialization")
         if self._rows is None:
             rows: List[List[object]] = []
             for r in self._results:
@@ -365,9 +386,26 @@ class CobolData:
 
         from .reader.arrow_out import arrow_schema, rows_to_table
 
+        if self._arrow_tables is not None:
+            if not self._arrow_tables:
+                return arrow_schema(self.schema).empty_table()
+            return (self._arrow_tables[0] if len(self._arrow_tables) == 1
+                    else pa.concat_tables(self._arrow_tables))
         if self._results is None:
             return rows_to_table(self._rows, self.schema)
-        tables = [r.to_arrow(self.output_schema) for r in self._results]
+        if self.parallelism > 1 and len(self._results) > 1:
+            # per-shard table builds release the GIL inside Arrow; shard
+            # order preserves record order, so concat needs no reordering
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(self.parallelism,
+                                    len(self._results))) as ex:
+                tables = list(ex.map(
+                    lambda r: r.to_arrow(self.output_schema),
+                    self._results))
+        else:
+            tables = [r.to_arrow(self.output_schema) for r in self._results]
         if not tables:
             return arrow_schema(self.schema).empty_table()
         return tables[0] if len(tables) == 1 else pa.concat_tables(tables)
@@ -403,16 +441,14 @@ def _index_entries(reader, file_path: str, file_order: int, params):
         return reader.generate_index(stream, file_order)
 
 
-def _scan_var_len(reader, files, params, backend: str, prefix: str,
-                  parallelism: int) -> List["FileResult"]:
-    """The indexed parallel scan — the reference's flagship execution
-    strategy (CobolScanners.buildScanForVarLenIndex, CobolScanners.scala:
-    38-55 + IndexBuilder.buildIndex, IndexBuilder.scala:49-66): a sparse
-    index per file turns the sequential record stream into byte-range
-    shards; shards decode concurrently (each from its own bounded stream,
-    Record_Id seeded from the index entry) and results reassemble in
-    record order."""
-    shards = []  # (file_order, path, offset_from, max_bytes, start_record_id)
+def _plan_var_len_shards(reader, files, params) -> List["WorkShard"]:
+    """Byte-range shard plan for a variable-length read: the sparse index
+    per file turns the sequential record stream into shards; files without
+    a useful index become one whole-file shard. Shared by the in-process
+    threaded scan and the multi-host (process) executor."""
+    from .parallel.planner import WorkShard
+
+    shards: List[WorkShard] = []
     for file_order, file_path in enumerate(files):
         base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
         entries = None
@@ -422,19 +458,35 @@ def _scan_var_len(reader, files, params, backend: str, prefix: str,
             size = os.path.getsize(file_path)
             for e in entries:
                 end = e.offset_to if e.offset_to >= 0 else size
-                shards.append((file_order, file_path, e.offset_from,
-                               end - e.offset_from, base + e.record_index))
+                shards.append(WorkShard(file_path, file_order,
+                                        e.offset_from, end,
+                                        base + e.record_index))
         else:
-            shards.append((file_order, file_path, 0, 0, base))
+            shards.append(WorkShard(file_path, file_order, 0, -1, base))
+    return shards
+
+
+def _scan_var_len(reader, files, params, backend: str, prefix: str,
+                  parallelism: int) -> List["FileResult"]:
+    """The indexed parallel scan — the reference's flagship execution
+    strategy (CobolScanners.buildScanForVarLenIndex, CobolScanners.scala:
+    38-55 + IndexBuilder.buildIndex, IndexBuilder.scala:49-66): a sparse
+    index per file turns the sequential record stream into byte-range
+    shards; shards decode concurrently (each from its own bounded stream,
+    Record_Id seeded from the index entry) and results reassemble in
+    record order."""
+    shards = _plan_var_len_shards(reader, files, params)
 
     def scan(shard) -> "FileResult":
-        file_order, path, offset, max_bytes, start_id = shard
-        with FSStream(path, start_offset=offset,
+        max_bytes = (0 if shard.offset_to < 0
+                     else shard.offset_to - shard.offset_from)
+        with FSStream(shard.file_path, start_offset=shard.offset_from,
                       maximum_bytes=max_bytes) as stream:
             return reader.read_result_columnar(
-                stream, file_id=file_order, backend=backend,
-                segment_id_prefix=prefix, start_record_id=start_id,
-                starting_file_offset=offset)
+                stream, file_id=shard.file_order, backend=backend,
+                segment_id_prefix=prefix,
+                start_record_id=shard.record_index,
+                starting_file_offset=shard.offset_from)
 
     if len(shards) == 1 or parallelism <= 1:
         return [scan(s) for s in shards]
@@ -486,6 +538,10 @@ def read_cobol(path=None,
     # reference's executor count; not a reference option)
     parallelism = opts.get_int("parallelism", 0) or min(
         16, os.cpu_count() or 1)
+    # hosts > 1: fork one worker process per host and run the shard plan
+    # there (parallel/hosts.py — the executor-process analogue); the
+    # result is Arrow-backed
+    hosts = opts.get_int("hosts", 0)
     files = list_input_files(path)
     if not files:
         raise FileNotFoundError(f"No input files found for path {path}")
@@ -498,6 +554,16 @@ def read_cobol(path=None,
                  if params.multisegment and is_var_len else 0)
     results: List[FileResult] = []
     copybook_obj: Optional[Copybook] = None
+
+    if hosts > 1:
+        if backend != "numpy":
+            raise ValueError(
+                f"hosts={hosts} runs worker processes on the native/numpy "
+                f"kernels; backend={backend!r} is not supported there "
+                f"(drop `hosts` for the {backend!r} backend)")
+        return _read_cobol_multihost(files, copybook_contents, params,
+                                     hosts, seg_count,
+                                     debug_ignore_file_size)
 
     if is_var_len:
         reader = VarLenReader(copybook_contents, params)
@@ -540,4 +606,36 @@ def read_cobol(path=None,
         generate_record_id=params.generate_record_id,
         generate_seg_id_field_count=seg_count,
         segment_id_prefix="")
-    return CobolData.from_results(results, schema)
+    return CobolData.from_results(results, schema, parallelism=parallelism)
+
+
+def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
+                          seg_count: int,
+                          debug_ignore_file_size: bool) -> "CobolData":
+    """The multi-host execution path: plan + fork + reassemble
+    (parallel/hosts.multihost_scan). Output is Arrow-backed; row order and
+    Record_Ids are byte-identical to the single-process read."""
+    from .parallel.hosts import multihost_scan, plan_fixed_len_shards
+
+    is_var_len = params.needs_var_len_reader
+    if is_var_len:
+        reader = VarLenReader(copybook_contents, params)
+        prefix = (params.multisegment.segment_id_prefix
+                  if params.multisegment
+                  and params.multisegment.segment_id_prefix
+                  else default_segment_id_prefix())
+        shards = _plan_var_len_shards(reader, files, params)
+    else:
+        reader = FixedLenReader(copybook_contents, params)
+        prefix = ""
+        shards = plan_fixed_len_shards(reader, files, params, hosts)
+    schema = CobolOutputSchema(
+        reader.copybook,
+        policy=params.schema_policy,
+        input_file_name_field=params.input_file_name_column,
+        generate_record_id=params.generate_record_id,
+        generate_seg_id_field_count=seg_count,
+        segment_id_prefix="")
+    tables = multihost_scan(reader, shards, is_var_len, schema, hosts,
+                            prefix, ignore_file_size=debug_ignore_file_size)
+    return CobolData.from_arrow_tables(tables, schema)

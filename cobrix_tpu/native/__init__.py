@@ -31,6 +31,7 @@ MAX_RDW_RECORD_SIZE = 100 * 1024 * 1024
 
 _I64P = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
 _U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+_U16P = np.ctypeslib.ndpointer(dtype=np.uint16, flags="C_CONTIGUOUS")
 
 
 def _build() -> bool:
@@ -106,6 +107,14 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.decode_bcd_cols_raw.argtypes = [
             _U8P, _I64P, _I64P, ctypes.c_int64, _I64P, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p, _U8P]
+        lib.transcode_string_cols.restype = None
+        lib.transcode_string_cols.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_int64, _I64P, ctypes.c_int64,
+            ctypes.c_int64, _U16P, _U16P]
+        lib.transcode_string_cols_raw.restype = None
+        lib.transcode_string_cols_raw.argtypes = [
+            _U8P, _I64P, _I64P, ctypes.c_int64, _I64P, ctypes.c_int64,
+            ctypes.c_int64, _U16P, _U16P]
         _lib = lib
         return _lib
 
@@ -333,6 +342,44 @@ def decode_display_cols(batch: np.ndarray, col_offsets: np.ndarray,
                             int(signed), int(allow_dot), int(require_digits),
                             values, valid, dots)
     return values, valid.view(bool), dots
+
+
+def transcode_string_cols(batch: np.ndarray, col_offsets: np.ndarray,
+                          width: int, lut_u16: np.ndarray
+                          ) -> Optional[np.ndarray]:
+    """All same-width EBCDIC string columns of a packed [n, extent] batch
+    -> [n, ncols, width] uint16 code points in one native gather+LUT pass
+    (ops/batch_np.transcode_ebcdic semantics)."""
+    lib = _load()
+    if lib is None:
+        return None
+    b, offs = _batch_and_offsets(batch, col_offsets)
+    n, extent = b.shape
+    ncols = offs.shape[0]
+    lut = np.ascontiguousarray(lut_u16, dtype=np.uint16)
+    out = np.empty((n, ncols, width), dtype=np.uint16)
+    lib.transcode_string_cols(b, n, extent, offs, ncols, width, lut, out)
+    return out
+
+
+def transcode_string_cols_raw(data, rec_offsets, rec_lengths, col_offsets,
+                              width: int, lut_u16: np.ndarray,
+                              start_offset: int = 0
+                              ) -> Optional[np.ndarray]:
+    """Raw-image variant reading straight from the framed file; bytes past
+    a record's end transcode like the packed batch's zero padding."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf, offs, lens, cols = _raw_args(data, rec_offsets, rec_lengths,
+                                      col_offsets, start_offset)
+    n = offs.shape[0]
+    ncols = cols.shape[0]
+    lut = np.ascontiguousarray(lut_u16, dtype=np.uint16)
+    out = np.empty((n, ncols, width), dtype=np.uint16)
+    lib.transcode_string_cols_raw(buf, offs, lens, n, cols, ncols, width,
+                                  lut, out)
+    return out
 
 
 def _raw_args(data, rec_offsets, rec_lengths, col_offsets,

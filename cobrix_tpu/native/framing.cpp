@@ -297,6 +297,54 @@ static bool InitBcdPair() {
 }
 static const bool kBcdPairInit = InitBcdPair();
 
+// EBCDIC -> Unicode code-point transcode of all same-width string columns
+// in one gather+LUT pass (the numpy path pays two GIL-bound fancy-index
+// passes: the slab gather and lut[data]). out: [n, ncols, width] uint16.
+void transcode_string_cols(const uint8_t* batch, int64_t n, int64_t extent,
+                           const int64_t* col_offsets, int64_t ncols,
+                           int64_t width, const uint16_t* lut,
+                           uint16_t* out) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const uint8_t* row = batch + r * extent;
+    uint16_t* orow = out + r * ncols * width;
+    for (int64_t c = 0; c < ncols; ++c) {
+      const uint8_t* p = row + col_offsets[c];
+      uint16_t* o = orow + c * width;
+      for (int64_t k = 0; k < width; ++k) o[k] = lut[p[k]];
+    }
+  }
+}
+
+// Raw-image variant: reads straight from the framed file image; bytes past
+// a record's end behave like the packed batch's zero padding (lut[0]).
+void transcode_string_cols_raw(const uint8_t* data,
+                               const int64_t* rec_offsets,
+                               const int64_t* rec_lengths, int64_t n,
+                               const int64_t* col_offsets, int64_t ncols,
+                               int64_t width, const uint16_t* lut,
+                               uint16_t* out) {
+  const uint16_t pad = lut[0];
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const uint8_t* row = data + rec_offsets[r];
+    const int64_t len = rec_lengths[r];
+    uint16_t* orow = out + r * ncols * width;
+    for (int64_t c = 0; c < ncols; ++c) {
+      const int64_t off = col_offsets[c];
+      uint16_t* o = orow + c * width;
+      const int64_t avail =
+          off >= len ? 0 : (off + width <= len ? width : len - off);
+      for (int64_t k = 0; k < avail; ++k) o[k] = lut[row[off + k]];
+      for (int64_t k = avail; k < width; ++k) o[k] = pad;
+    }
+  }
+}
+
 // out_i32: write int32 values (halves the output traffic; callers pass 1
 // only when the declared precision fits 9 digits / int32).
 void decode_binary_cols_raw(const uint8_t* data,

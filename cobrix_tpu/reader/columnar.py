@@ -734,6 +734,21 @@ class ColumnarDecoder:
                 res = native.decode_bcd_cols_raw(
                     buf, offs, rec_lengths, g.offsets, g.width,
                     fits32=fits32)
+            elif g.codec is Codec.EBCDIC_STRING:
+                chars = native.transcode_string_cols_raw(
+                    buf, offs, rec_lengths, g.offsets, g.width, self.lut)
+                if chars is not None:
+                    for pos, c in enumerate(g.columns):
+                        outputs[c.index] = {"bytes": chars[:, pos]}
+                    if len(g.columns):
+                        # truncated varchar tails re-decode through the
+                        # packed batch (DecodedBatch.value); keep the pack
+                        # covering this group's bytes when any record is
+                        # short of them
+                        g_end = int(g.offsets.max()) + g.width
+                        if bool((rec_lengths < g_end).any()):
+                            narrow_extent = max(narrow_extent, g_end)
+                    continue
             if res is not None:
                 self._store_numeric(g, outputs, *res)
                 continue
@@ -813,6 +828,14 @@ class ColumnarDecoder:
             if res is None:
                 return False
             self._store_numeric(g, outputs, *res)
+            return True
+        if g.codec is Codec.EBCDIC_STRING:
+            chars = native.transcode_string_cols(arr, g.offsets, g.width,
+                                                 self.lut)
+            if chars is None:
+                return False
+            for pos, c in enumerate(g.columns):
+                outputs[c.index] = {"bytes": chars[:, pos]}
             return True
         return False
 

@@ -102,12 +102,22 @@ class FileResult:
             pos = order[0]
             if np.array_equal(pos, np.arange(len(pos))):
                 return table
-            return table.take(np.argsort(pos, kind="stable"))
+            return table.take(_record_order_indices(pos))
         table = pa.concat_tables(tables)
         # rows currently ordered [seg0 rows..., seg1 rows...]; invert to
         # record order
         pos = np.concatenate(order)
-        return table.take(np.argsort(pos, kind="stable"))
+        return table.take(_record_order_indices(pos))
+
+
+def _record_order_indices(pos: np.ndarray) -> np.ndarray:
+    """Take-indices that order rows by their (unique) record positions:
+    an O(n) scatter instead of an argsort."""
+    if not len(pos):
+        return pos
+    slots = np.full(int(pos.max()) + 1, -1, dtype=np.int64)
+    slots[pos] = np.arange(len(pos), dtype=np.int64)
+    return slots[slots >= 0]
 
 
 def rows_file_result(rows: List[List[object]]) -> FileResult:

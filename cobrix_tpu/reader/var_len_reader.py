@@ -573,16 +573,24 @@ class VarLenReader:
             keep &= np.asarray(
                 [sid in segment_filter for sid in segment_ids], dtype=bool)
 
-        actives = (["" if segment_ids is None else
-                    self.segment_redefine_map.get(sid, "")
-                    for sid in segment_ids] if segment_ids is not None
-                   else [""] * n)
+        # map segment ids -> active redefines per UNIQUE id (a per-record
+        # dict lookup costs more than the whole numeric decode on narrow
+        # profiles); same-active ids merge into one sorted position set
         by_segment: Dict[str, np.ndarray] = {}
-        kept = np.nonzero(keep)[0]
-        active_arr = np.asarray(actives, dtype=object)
-        for active in set(active_arr[kept].tolist()):
-            mask = keep & (active_arr == active)
-            by_segment[active] = np.nonzero(mask)[0]
+        if segment_ids is None:
+            by_segment[""] = np.nonzero(keep)[0]
+        else:
+            sid_arr = np.asarray(segment_ids, dtype=object)
+            by_active_mask: Dict[str, np.ndarray] = {}
+            for sid in set(segment_ids):
+                active = self.segment_redefine_map.get(sid, "")
+                mask = sid_arr == sid
+                prev = by_active_mask.get(active)
+                by_active_mask[active] = mask if prev is None else prev | mask
+            for active, mask in by_active_mask.items():
+                positions = np.nonzero(keep & mask)[0]
+                if positions.size:
+                    by_segment[active] = positions
 
         start = params.start_offset
         result.n_rows = int(keep.sum())
