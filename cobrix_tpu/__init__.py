@@ -8,6 +8,10 @@ byte-transcoding kernels over `[batch, record_len]` uint8 arrays.
 """
 from .api import CobolData, read_cobol
 from .copybook.copybook import Copybook, merge_copybooks, parse_copybook
+from .reader.handlers import (DictHandler, JsonHandler, RecordHandler,
+                              TupleHandler)
+from .reader.stream import (ByteRangeSource, open_stream,
+                            register_stream_backend)
 from .copybook.datatypes import (
     CommentPolicy,
     DebugFieldsPolicy,
@@ -33,4 +37,11 @@ __all__ = [
     "SchemaRetentionPolicy",
     "TrimPolicy",
     "Usage",
+    "RecordHandler",
+    "TupleHandler",
+    "DictHandler",
+    "JsonHandler",
+    "ByteRangeSource",
+    "open_stream",
+    "register_stream_backend",
 ]

@@ -33,7 +33,7 @@ from .reader.parameters import (
 )
 from .reader.result import FileResult, rows_file_result
 from .reader.schema import CobolOutputSchema, StructType
-from .reader.stream import FSStream
+from .reader.stream import open_stream
 from .reader.var_len_reader import VarLenReader, default_segment_id_prefix
 
 
@@ -293,9 +293,19 @@ def _validate_options(opts: Options, params: ReaderParameters,
 def list_input_files(path) -> List[str]:
     """Recursive globbed listing skipping hidden files, stable order
     (reference FileUtils.scala:54-228, getListFilesWithOrder)."""
+    from .reader.stream import normalize_local, path_scheme
+
     paths = [path] if isinstance(path, str) else list(path)
     out: List[str] = []
     for p in paths:
+        if path_scheme(p) not in (None, "file"):
+            # registry-backed storage: the path is passed through verbatim
+            # (listing/globbing is the backend's concern)
+            out.append(p)
+            continue
+        # file:// never propagates past listing: downstream os.path
+        # consumers see plain local paths
+        p = normalize_local(p)
         if os.path.isdir(p):
             for root, dirs, files in os.walk(p):
                 dirs[:] = sorted(d for d in dirs if not d.startswith((".", "_")))
@@ -417,27 +427,40 @@ def _index_entries(reader, file_path: str, file_order: int, params):
     otherwise the generic per-record generator (the reference's only mode,
     IndexGenerator.scala:33) runs."""
     from .reader.parameters import DEFAULT_INDEX_ENTRY_SIZE_MB, MEGABYTE
+    from .reader.stream import path_scheme
 
-    size = os.path.getsize(file_path)
-    if size == 0:
-        return None  # nothing to index (and mmap rejects empty files)
     explicit = (params.input_split_records is not None
                 or params.input_split_size_mb is not None)
     split_mb = params.input_split_size_mb or DEFAULT_INDEX_ENTRY_SIZE_MB
-    if not explicit and size <= split_mb * MEGABYTE:
-        return None  # the whole file is one shard anyway
-    if reader.supports_fast_framing:
-        # mmap, not read(): the scan touches the whole file once to find
-        # split offsets; materializing it would spike RSS by the file size
-        # on exactly the large files indexing targets
-        import mmap
 
-        with open(file_path, "rb") as f:
-            with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as mm:
-                entries = reader.generate_index_fast(mm, file_order)
-        if entries is not None:
-            return entries
-    with FSStream(file_path) as stream:
+    def too_small(size: int) -> bool:
+        if size == 0:
+            return True  # nothing to index (and mmap rejects empty files)
+        # the whole file is one shard anyway
+        return not explicit and size <= split_mb * MEGABYTE
+
+    if path_scheme(file_path) in (None, "file"):
+        if too_small(os.path.getsize(file_path)):
+            return None
+        if reader.supports_fast_framing:
+            # mmap, not read(): the scan touches the whole file once to
+            # find split offsets; materializing it would spike RSS by the
+            # file size on exactly the large files indexing targets
+            import mmap
+
+            with open(file_path, "rb") as f:
+                with mmap.mmap(f.fileno(), 0,
+                               access=mmap.ACCESS_READ) as mm:
+                    entries = reader.generate_index_fast(mm, file_order)
+            if entries is not None:
+                return entries
+        with open_stream(file_path) as stream:
+            return reader.generate_index(stream, file_order)
+    # registry-backed storage: one stream serves both the size probe and
+    # the index scan (a backend open is typically a network round trip)
+    with open_stream(file_path) as stream:
+        if too_small(stream.size()):
+            return None
         return reader.generate_index(stream, file_order)
 
 
@@ -455,11 +478,12 @@ def _plan_var_len_shards(reader, files, params) -> List["WorkShard"]:
         if params.is_index_generation_needed:
             entries = _index_entries(reader, file_path, file_order, params)
         if entries is not None and len(entries) > 1:
-            size = os.path.getsize(file_path)
+            # an open-ended last entry (-1) flows into the shard unchanged:
+            # streams bound it to the file end themselves, so no extra
+            # size round trip is needed for registry-backed storage
             for e in entries:
-                end = e.offset_to if e.offset_to >= 0 else size
                 shards.append(WorkShard(file_path, file_order,
-                                        e.offset_from, end,
+                                        e.offset_from, e.offset_to,
                                         base + e.record_index))
         else:
             shards.append(WorkShard(file_path, file_order, 0, -1, base))
@@ -480,8 +504,8 @@ def _scan_var_len(reader, files, params, backend: str, prefix: str,
     def scan(shard) -> "FileResult":
         max_bytes = (0 if shard.offset_to < 0
                      else shard.offset_to - shard.offset_from)
-        with FSStream(shard.file_path, start_offset=shard.offset_from,
-                      maximum_bytes=max_bytes) as stream:
+        with open_stream(shard.file_path, start_offset=shard.offset_from,
+                         maximum_bytes=max_bytes) as stream:
             return reader.read_result_columnar(
                 stream, file_id=shard.file_order, backend=backend,
                 segment_id_prefix=prefix,
@@ -573,7 +597,7 @@ def read_cobol(path=None,
                   else default_segment_id_prefix())
         if backend == "host":
             for file_order, file_path in enumerate(files):
-                with FSStream(file_path) as stream:
+                with open_stream(file_path) as stream:
                     results.append(rows_file_result(list(reader.iter_rows(
                         stream, file_id=file_order, segment_id_prefix=prefix,
                         start_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT))))
@@ -584,20 +608,18 @@ def read_cobol(path=None,
         reader = FixedLenReader(copybook_contents, params)
         copybook_obj = reader.copybook
         for file_order, file_path in enumerate(files):
-            with open(file_path, "rb") as f:
-                data = f.read()
+            base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
             if backend == "host":
+                data = _read_file_bytes(file_path)
                 results.append(rows_file_result(list(reader.iter_rows_host(
                     data, file_id=file_order,
-                    first_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT,
+                    first_record_id=base,
                     input_file_name=file_path,
                     ignore_file_size=debug_ignore_file_size))))
             else:
-                results.append(reader.read_result(
-                    data, backend=backend, file_id=file_order,
-                    first_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT,
-                    input_file_name=file_path,
-                    ignore_file_size=debug_ignore_file_size))
+                results.extend(_read_fixed_len_chunked(
+                    reader, file_path, params, backend, file_order, base,
+                    debug_ignore_file_size))
 
     schema = CobolOutputSchema(
         copybook_obj,
@@ -607,6 +629,59 @@ def read_cobol(path=None,
         generate_seg_id_field_count=seg_count,
         segment_id_prefix="")
     return CobolData.from_results(results, schema, parallelism=parallelism)
+
+
+# fixed-length files stream through bounded chunk reads instead of one
+# whole-file read(): peak memory stays ~one chunk + its decoded columns
+# (FileStreamer.scala:37-130's buffered role on the fixed path)
+FIXED_READ_CHUNK_BYTES = 64 * 1024 * 1024
+
+
+def _read_file_bytes(path: str) -> bytes:
+    from .reader.stream import open_stream
+
+    with open_stream(path) as stream:
+        return stream.next(stream.size())
+
+
+def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
+                            file_order: int, base_record_id: int,
+                            ignore_file_size: bool) -> List["FileResult"]:
+    from .reader.stream import open_stream, path_scheme
+
+    rs = reader.record_size
+    if path_scheme(file_path) in (None, "file"):
+        size = os.path.getsize(file_path)
+    else:
+        with open_stream(file_path) as s:
+            size = s.size()
+    payload = size - params.file_start_offset - params.file_end_offset
+    chunkable = (size > FIXED_READ_CHUNK_BYTES
+                 and not params.file_start_offset
+                 and not params.file_end_offset
+                 and (payload % rs == 0 or ignore_file_size))
+    if not chunkable:
+        return [reader.read_result(
+            _read_file_bytes(file_path), backend=backend,
+            file_id=file_order, first_record_id=base_record_id,
+            input_file_name=file_path, ignore_file_size=ignore_file_size)]
+    chunk_bytes = max(rs, (FIXED_READ_CHUNK_BYTES // rs) * rs)
+    results: List[FileResult] = []
+    done = 0
+    with open_stream(file_path) as stream:
+        while done < size:
+            data = stream.next(min(chunk_bytes, size - done))
+            if not data:
+                break
+            if len(data) % rs and done + len(data) < size:
+                raise IOError(f"Short read from {file_path} at {done}")
+            results.append(reader.read_result(
+                data, backend=backend, file_id=file_order,
+                first_record_id=base_record_id + done // rs,
+                input_file_name=file_path,
+                ignore_file_size=ignore_file_size))
+            done += len(data)
+    return results
 
 
 def _read_cobol_multihost(files, copybook_contents, params, hosts: int,

@@ -36,7 +36,7 @@ def _worker_scan(host_shards: List[WorkShard]) -> List[Tuple[tuple, bytes]]:
     [(shard_key, arrow_ipc_bytes), ...]."""
     import pyarrow as pa
 
-    from ..reader.stream import FSStream
+    from ..reader.stream import open_stream
 
     ctx = _CTX
     reader = ctx["reader"]
@@ -47,18 +47,21 @@ def _worker_scan(host_shards: List[WorkShard]) -> List[Tuple[tuple, bytes]]:
         if ctx["is_var_len"]:
             max_bytes = (0 if shard.offset_to < 0
                          else shard.offset_to - shard.offset_from)
-            with FSStream(shard.file_path, start_offset=shard.offset_from,
-                          maximum_bytes=max_bytes) as stream:
+            with open_stream(shard.file_path,
+                             start_offset=shard.offset_from,
+                             maximum_bytes=max_bytes) as stream:
                 result = reader.read_result_columnar(
                     stream, file_id=shard.file_order, backend="numpy",
                     segment_id_prefix=ctx["prefix"],
                     start_record_id=shard.record_index,
                     starting_file_offset=shard.offset_from)
         else:
-            with open(shard.file_path, "rb") as f:
-                f.seek(shard.offset_from)
-                data = (f.read() if shard.offset_to < 0
-                        else f.read(shard.offset_to - shard.offset_from))
+            max_bytes = (0 if shard.offset_to < 0
+                         else shard.offset_to - shard.offset_from)
+            with open_stream(shard.file_path,
+                             start_offset=shard.offset_from,
+                             maximum_bytes=max_bytes) as stream:
+                data = stream.next(stream.size() - shard.offset_from)
             result = reader.read_result(
                 data, backend="numpy", file_id=shard.file_order,
                 first_record_id=shard.record_index,
@@ -80,13 +83,16 @@ def plan_fixed_len_shards(reader, files: Sequence[str], params,
     divide by the record stride (the divisibility error must fire exactly
     as in a single-process read), or sub-record files — stay whole."""
     from ..reader.parameters import DEFAULT_FILE_RECORD_ID_INCREMENT
+    from ..reader.stream import path_scheme
 
     shards: List[WorkShard] = []
     rs = reader.record_size  # effective stride: overrides + start/end pad
     for file_order, file_path in enumerate(files):
         base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
-        size = os.path.getsize(file_path)
-        splittable = (hosts > 1 and size >= 2 * rs and size % rs == 0
+        is_local = path_scheme(file_path) in (None, "file")
+        size = os.path.getsize(file_path) if is_local else -1
+        splittable = (is_local and hosts > 1 and size >= 2 * rs
+                      and size % rs == 0
                       and not params.file_start_offset
                       and not params.file_end_offset)
         if not splittable:

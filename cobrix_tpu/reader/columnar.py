@@ -175,6 +175,27 @@ def fixed_point_exponent(spec: ColumnSpec) -> int:
     return -spec.params.scale
 
 
+def _rebuild_with_handler(value, group: Group, handler):
+    """Re-materialize a nested tuple record (from the per-cell path)
+    through a RecordHandler — the non-compiled twin of _row_maker's
+    handler.create calls."""
+    if value is None:
+        return None
+    out = []
+    non_filler = [st for st in group.children if not st.is_filler]
+    for st, v in zip(non_filler, value):
+        if isinstance(st, Group):
+            if st.is_array:
+                out.append(None if v is None else
+                           [_rebuild_with_handler(e, st, handler)
+                            for e in v])
+            else:
+                out.append(_rebuild_with_handler(v, st, handler))
+        else:
+            out.append(v)
+    return handler.create(out, group)
+
+
 def _resolve_occurs(st: Statement, dep_value) -> int:
     """DEPENDING ON value -> element count (clamp + string-handler rules,
     reference RecordExtractors.scala:68-80). Shared by the per-cell and
@@ -436,18 +457,19 @@ class DecodedBatch:
                 input_file_name: str = "",
                 segment_level_ids: Optional[List[List[object]]] = None,
                 active_segments: Optional[Sequence[Optional[str]]] = None,
-                record_ids: Optional[Sequence[int]] = None
-                ) -> List[List[object]]:
+                record_ids: Optional[Sequence[int]] = None,
+                handler=None) -> List[List[object]]:
         """Assemble nested rows (same shape as reader.extractors.extract_record).
         `record_ids` overrides the sequential first_record_id+i numbering
         (used when a batch holds non-contiguous records, e.g. one segment
-        of a multisegment file)."""
+        of a multisegment file). `handler`: the RecordHandler seam — group
+        records materialize through handler.create instead of tuples."""
         uniform_active: Optional[str] = None
         use_maker = active_segments is None or (
             len(set(active_segments)) <= 1)
         if use_maker and active_segments is not None and active_segments:
             uniform_active = active_segments[0]
-        maker = (self._row_maker(uniform_active, policy)
+        maker = (self._row_maker(uniform_active, policy, handler)
                  if use_maker else None)
 
         rows = []
@@ -460,11 +482,15 @@ class DecodedBatch:
                 records = []
                 for root in self.decoder.copybook.ast.children:
                     if isinstance(root, Group):
-                        records.append(self._group_value(root, (), i, active))
+                        rec = self._group_value(root, (), i, active)
+                        if handler is not None:
+                            rec = _rebuild_with_handler(rec, root, handler)
+                        records.append(rec)
                 if policy is SchemaRetentionPolicy.COLLAPSE_ROOT:
                     body = []
                     for rec in records:
-                        body.extend(rec)
+                        body.extend(handler.to_seq(rec)
+                                    if handler is not None else rec)
                 else:
                     body = records
             seg = list(segment_level_ids[i]) if segment_level_ids else []
@@ -484,12 +510,14 @@ class DecodedBatch:
     # -- compiled row assembly ---------------------------------------------
 
     def _row_maker(self, active: Optional[str],
-                   policy: SchemaRetentionPolicy):
+                   policy: SchemaRetentionPolicy, handler=None):
         """Compile the nested-row assembly into closures over the column
         value lists: leaf access becomes list indexing instead of per-cell
         dynamic dispatch (the difference between ~30us and ~3us per row on
-        narrow records). One maker per (active segment, policy) per batch."""
-        key = (active, policy)
+        narrow records). One maker per (active segment, policy, handler)
+        per batch; group records materialize through handler.create when a
+        RecordHandler is supplied (tuples otherwise)."""
+        key = (active, policy, id(handler) if handler is not None else None)
         maker = self._maker_cache.get(key)
         if maker is not None:
             return maker
@@ -532,6 +560,9 @@ class DecodedBatch:
                     m = self._leaf_maker(st, slot_path)
                 if not st.is_filler:
                     makers.append(m)
+            if handler is not None:
+                return (lambda i, ms=tuple(makers), g=group:
+                        handler.create([mk(i) for mk in ms], g))
             return lambda i, ms=tuple(makers): tuple([mk(i) for mk in ms])
 
         root_makers = [build_group(root, ())
@@ -541,7 +572,9 @@ class DecodedBatch:
             def maker(i):
                 body: List[object] = []
                 for rm in root_makers:
-                    body.extend(rm(i))
+                    rec = rm(i)
+                    body.extend(handler.to_seq(rec) if handler is not None
+                                else rec)
                 return body
         else:
             def maker(i):

@@ -85,9 +85,15 @@ def extract_record(ast: Group,
                    active_segment_redefine: str = "",
                    generate_input_file_field: bool = False,
                    input_file_name: str = "",
-                   options: Optional[DecodeOptions] = None) -> List[object]:
+                   options: Optional[DecodeOptions] = None,
+                   handler: Optional["RecordHandler"] = None) -> List[object]:
     """Decode one record into a flat list of root-level values
-    (each root group -> tuple of its non-filler field values)."""
+    (each root group -> handler-created record of its non-filler field
+    values; the default handler builds tuples). `handler` is the
+    target-agnostic seam of the reference (RecordHandler.scala:21)."""
+    from .handlers import DEFAULT_HANDLER
+
+    handler = handler or DEFAULT_HANDLER
     options = options or DecodeOptions()
     depend_fields: Dict[str, object] = {}
 
@@ -137,7 +143,7 @@ def extract_record(ast: Group,
                     f"should be integral, found {type(value).__name__}.")
         return field.binary_properties.actual_size, value
 
-    def get_group_values(offset: int, group: Group) -> Tuple[int, tuple]:
+    def get_group_values(offset: int, group: Group) -> Tuple[int, object]:
         bit_offset = offset
         fields = []
         for field in group.children:
@@ -152,7 +158,7 @@ def extract_record(ast: Group,
                                    if field.redefines is not None else size)
             if not field.is_filler:
                 fields.append(value)
-        return bit_offset - offset, tuple(fields)
+        return bit_offset - offset, handler.create(fields, group)
 
     next_offset = offset_bytes
     records = []
@@ -163,7 +169,8 @@ def extract_record(ast: Group,
             records.append(values)
     return _apply_post_processing(
         records, policy, generate_record_id, list(segment_level_ids),
-        file_id, record_id, generate_input_file_field, input_file_name)
+        file_id, record_id, generate_input_file_field, input_file_name,
+        handler=handler)
 
 
 def extract_hierarchical_record(
@@ -179,10 +186,14 @@ def extract_hierarchical_record(
         record_id: int = 0,
         generate_input_file_field: bool = False,
         input_file_name: str = "",
-        options: Optional[DecodeOptions] = None) -> List[object]:
+        options: Optional[DecodeOptions] = None,
+        handler: Optional["RecordHandler"] = None) -> List[object]:
     """Assemble one hierarchical row from a buffered root record and its child
     segment records (reference extractHierarchicalRecord,
     RecordExtractors.scala:211-385)."""
+    from .handlers import DEFAULT_HANDLER
+
+    handler = handler or DEFAULT_HANDLER
     options = options or DecodeOptions()
     depend_fields: Dict[str, object] = {}
 
@@ -265,7 +276,15 @@ def extract_hierarchical_record(
             for child in parent_child_map.get(group.name, ()):
                 fields.append(extract_children(child, current_index + 1,
                                                parent_segment_ids))
-        return bit_offset - offset, tuple(fields)
+        # value order differs from declaration order here (child-segment
+        # records append after the parent's own fields) — hand the handler
+        # the matching names so dict-like targets stay aligned
+        names = [f.name for f in group.children
+                 if not f.is_filler and not f.is_child_segment]
+        if group.is_segment_redefine:
+            names += [c.name for c in parent_child_map.get(group.name, ())]
+        return bit_offset - offset, handler.create_named(fields, names,
+                                                         group)
 
     next_offset = offset_bytes
     records = []
@@ -277,17 +296,18 @@ def extract_hierarchical_record(
             records.append(values)
     return _apply_post_processing(
         records, policy, generate_record_id, [], file_id, record_id,
-        generate_input_file_field, input_file_name)
+        generate_input_file_field, input_file_name, handler=handler)
 
 
-def _apply_post_processing(records: List[tuple],
+def _apply_post_processing(records: List[object],
                            policy: SchemaRetentionPolicy,
                            generate_record_id: bool,
                            segment_level_ids: List[object],
                            file_id: int,
                            record_id: int,
                            generate_input_file_field: bool,
-                           input_file_name: str) -> List[object]:
+                           input_file_name: str,
+                           handler=None) -> List[object]:
     """reference applyRecordPostProcessing (RecordExtractors.scala:409-451).
 
     NB: the reference places the file-name field *after* segment ids when
@@ -296,7 +316,8 @@ def _apply_post_processing(records: List[tuple],
     if policy is SchemaRetentionPolicy.COLLAPSE_ROOT:
         body: List[object] = []
         for record in records:
-            body.extend(record)
+            body.extend(handler.to_seq(record) if handler is not None
+                        else record)
     else:
         body = list(records)
     seg = list(segment_level_ids)

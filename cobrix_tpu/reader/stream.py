@@ -4,12 +4,20 @@ Mirrors the reference SimpleStream contract (stream/SimpleStream.scala:21:
 size/offset/next(n)/close + inputFileName) with local-file and in-memory
 implementations (FSStream.scala:21, spark FileStreamer byte-range semantics:
 seek to a partition offset and serve at most `maximum_bytes`).
+
+Non-local storage plugs in through the scheme registry
+(`register_stream_backend` + `open_stream`): a backend supplies random-
+access byte reads and `BufferedSourceStream` turns them into the
+reference's buffered bounded stream (FileStreamer.scala:37-130 +
+BufferedFSDataInputStream.scala:21-115 — seek to the partition offset,
+serve at most maximumBytes, fetch in large chunks so record-sized reads
+never hit storage).
 """
 from __future__ import annotations
 
 import io
 import os
-from typing import Optional
+from typing import Callable, Dict, Optional
 
 
 class SimpleStream:
@@ -122,3 +130,174 @@ class FSStream(SimpleStream):
 
     def close(self) -> None:
         self._f.close()
+
+
+# ---------------------------------------------------------------------------
+# pluggable storage backends
+# ---------------------------------------------------------------------------
+
+class ByteRangeSource:
+    """Random-access byte source a storage backend provides: the minimal
+    surface a remote filesystem needs (the Hadoop FSDataInputStream role
+    in FileStreamer.scala:37)."""
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def read(self, offset: int, n: int) -> bytes:
+        """Up to `n` bytes at `offset` (short reads allowed; b'' at EOF)."""
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return ""
+
+    def close(self) -> None:
+        pass
+
+
+DEFAULT_CHUNK_SIZE = 30 * 1024 * 1024  # reference 30MB buffer
+# (reader/common/Constants.scala defaultStreamBufferInMB)
+
+
+class BufferedSourceStream(SimpleStream):
+    """SimpleStream over a ByteRangeSource with chunked buffering: storage
+    is hit once per DEFAULT_CHUNK_SIZE, not once per record, and short
+    reads are re-issued until the chunk is full (the readFully loop of
+    BufferedFSDataInputStream.scala:51)."""
+
+    def __init__(self, source: ByteRangeSource, start_offset: int = 0,
+                 maximum_bytes: int = 0,
+                 chunk_size: int = DEFAULT_CHUNK_SIZE):
+        self._source = source
+        self._file_size = source.size()
+        self._pos = start_offset
+        if maximum_bytes > 0:
+            self._limit = min(self._file_size, start_offset + maximum_bytes)
+        else:
+            self._limit = self._file_size
+        self._chunk_size = max(chunk_size, 1)
+        self._buf = b""
+        self._buf_start = start_offset
+
+    def size(self) -> int:
+        return self._limit
+
+    @property
+    def true_size(self) -> int:
+        return self._file_size
+
+    @property
+    def offset(self) -> int:
+        return self._pos
+
+    @property
+    def input_file_name(self) -> str:
+        return self._source.name
+
+    def _fill(self, offset: int) -> None:
+        want = min(self._chunk_size, self._limit - offset)
+        parts = []
+        got = 0
+        while got < want:
+            chunk = self._source.read(offset + got, want - got)
+            if not chunk:
+                break  # storage EOF short of the logical limit
+            parts.append(chunk)
+            got += len(chunk)
+        self._buf = b"".join(parts)
+        self._buf_start = offset
+
+    def next(self, n: int) -> bytes:
+        n = min(n, self._limit - self._pos)
+        if n <= 0:
+            return b""
+        out = []
+        remaining = n
+        while remaining > 0:
+            rel = self._pos - self._buf_start
+            if not (0 <= rel < len(self._buf)):
+                self._fill(self._pos)
+                rel = 0
+                if not self._buf:
+                    break
+            piece = self._buf[rel:rel + remaining]
+            out.append(piece)
+            self._pos += len(piece)
+            remaining -= len(piece)
+        return b"".join(out)
+
+    def close(self) -> None:
+        self._source.close()
+
+
+class _LocalFileSource(ByteRangeSource):
+    def __init__(self, path: str):
+        self._path = path
+        self._f = open(path, "rb")
+        self._size = os.path.getsize(path)
+
+    def size(self) -> int:
+        return self._size
+
+    def read(self, offset: int, n: int) -> bytes:
+        self._f.seek(offset)
+        return self._f.read(n)
+
+    @property
+    def name(self) -> str:
+        return self._path
+
+    def close(self) -> None:
+        self._f.close()
+
+
+# scheme -> factory(path_without_scheme) -> ByteRangeSource
+_STREAM_BACKENDS: Dict[str, Callable[[str], ByteRangeSource]] = {}
+
+
+def register_stream_backend(scheme: str,
+                            factory: Callable[[str], ByteRangeSource]
+                            ) -> None:
+    """Register a storage backend for `scheme://...` paths (the pluggable
+    role of the reference's Hadoop FileSystem resolution,
+    FileNameUtils/FileStreamer). The factory receives the full path and
+    returns a ByteRangeSource."""
+    _STREAM_BACKENDS[scheme.lower()] = factory
+
+
+def path_scheme(path: str) -> Optional[str]:
+    """'s3://bucket/key' -> 's3'; None for plain local paths (a Windows
+    drive letter is not a scheme)."""
+    head, sep, _ = path.partition("://")
+    if not sep or len(head) <= 1:
+        return None
+    return head.lower()
+
+
+def normalize_local(path: str) -> str:
+    """Strip the `file://` prefix so every os.path consumer downstream
+    sees a plain local path; other paths pass through unchanged."""
+    if path_scheme(path) == "file":
+        return path[len("file://"):]
+    return path
+
+
+def open_stream(path: str, start_offset: int = 0, maximum_bytes: int = 0,
+                chunk_size: int = DEFAULT_CHUNK_SIZE) -> SimpleStream:
+    """Open `path` as a SimpleStream: local files use the OS-buffered
+    FSStream; `scheme://` paths resolve through the backend registry and
+    read through the 30MB chunked buffer. `file://` is local."""
+    scheme = path_scheme(path)
+    if scheme in (None, "file"):
+        local = path[len("file://"):] if scheme == "file" else path
+        return FSStream(local, start_offset=start_offset,
+                        maximum_bytes=maximum_bytes)
+    factory = _STREAM_BACKENDS.get(scheme)
+    if factory is None:
+        raise ValueError(
+            f"No stream backend registered for scheme {scheme!r} "
+            f"(register one with cobrix_tpu.register_stream_backend)")
+    return BufferedSourceStream(factory(path), start_offset=start_offset,
+                                maximum_bytes=maximum_bytes,
+                                chunk_size=chunk_size)
