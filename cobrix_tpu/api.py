@@ -531,11 +531,21 @@ def read_cobol(path=None,
     `copybook_contents` passes the text directly. Remaining keyword options
     use the reference's option names (README.md:1070-1155).
     """
-    if "copybook" in options and copybook is None:
-        copybook = options.pop("copybook")
-    if "copybook_contents" in options and copybook_contents is None:
-        copybook_contents = options.pop("copybook_contents")
-    if "copybooks" in options and copybook is None:
+    # exclusive-source validation before any option is consumed
+    # ('copybook'/'copybook_contents' are named parameters and can never
+    # reach **options; only 'copybooks' arrives as an option key —
+    # reference CobolParametersValidator.checkSanity combination rules)
+    has_multi = "copybooks" in options
+    if copybook is not None and copybook_contents is not None:
+        raise ValueError("Both 'copybook' and 'copybook_contents' options "
+                         "cannot be specified at the same time")
+    if has_multi and copybook_contents is not None:
+        raise ValueError("Both 'copybooks' and 'copybook_contents' options "
+                         "cannot be specified at the same time")
+    if copybook is not None and has_multi:
+        raise ValueError("Both 'copybook' and 'copybooks' options "
+                         "cannot be specified at the same time")
+    if has_multi:
         copybook = options.pop("copybooks").split(",")
     if isinstance(options.get("occurs_mappings"), (dict, list)):
         # Python-native callers pass the mapping directly; the option layer
@@ -550,6 +560,8 @@ def read_cobol(path=None,
         books = [copybook] if isinstance(copybook, str) else list(copybook)
         contents = []
         for b in books:
+            if os.path.exists(b) and not os.path.isfile(b):
+                raise ValueError(f"The copybook path '{b}' is not a file.")
             with open(b, encoding="utf-8") as f:
                 contents.append(f.read())
         copybook_contents = contents if len(contents) > 1 else contents[0]

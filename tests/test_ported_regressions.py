@@ -1,0 +1,386 @@
+"""Ported reference regression tier (source/regression/Test01-Test11).
+
+Each test pins the behavior of the corresponding reference spec with the
+same copybooks, the same handcrafted bytes, and the same expected values
+(JSON goldens compared through the Spark-toJSON-compatible renderer).
+Test12 lives in test_indexed_scan.py.
+"""
+import json
+import os
+
+import pytest
+
+from cobrix_tpu import read_cobol
+
+BE = "big"
+
+
+def _write(tmp_path, name, data: bytes) -> str:
+    p = tmp_path / name
+    p.write_bytes(data)
+    return str(p)
+
+
+def _json(out) -> str:
+    return "[" + ",".join(out.to_json_lines()) + "]"
+
+
+def _rdw(payload: bytes) -> bytes:
+    return bytes([0, 0, len(payload), 0]) + payload
+
+
+# -- Test01RecordIdSequence -------------------------------------------------
+
+T1_COPYBOOK = """      01  R.
+                03 I        PIC 9(1).
+                03 D        PIC 9(1).
+"""
+
+
+@pytest.fixture
+def t1_file(tmp_path):
+    data = _rdw(bytes([0xF0, 0xF0]))
+    for i in range(1, 10):
+        data += _rdw(bytes([0xF1, 0xF0 + i]))
+    return _write(tmp_path, "recorddata.dat", data)
+
+
+def test_01_record_id_sequence(t1_file):
+    """Record_Ids stay consistent across the indexed scan and survive
+    segment filtering (Test01RecordIdSequence.scala)."""
+    base = dict(copybook_contents=T1_COPYBOOK, generate_record_id="true",
+                input_split_records="5", is_xcom="true",
+                schema_retention_policy="collapse_root")
+    rows = read_cobol(t1_file, **base).to_dicts()
+    assert [r["Record_Id"] for r in rows] == list(range(10))
+    assert [r["I"] for r in rows] == [0] + [1] * 9
+    assert [r["D"] for r in rows] == list(range(10))
+
+    rows = read_cobol(t1_file, segment_field="I", segment_filter="1",
+                      **base).to_dicts()
+    assert [r["Record_Id"] for r in rows] == list(range(1, 10))
+    assert [r["D"] for r in rows] == list(range(1, 10))
+
+    rows = read_cobol(t1_file, segment_field="I", segment_filter="1",
+                      segment_id_root="1", segment_id_prefix="i",
+                      **base).to_dicts()
+    assert [r["Record_Id"] for r in rows] == list(range(1, 10))
+    assert [r["Seg_Id0"] for r in rows] == [f"i_0_{i}" for i in range(1, 10)]
+
+
+# -- Test02SparseIndexGenerator ---------------------------------------------
+
+def test_02_sparse_index_generator(tmp_path):
+    """Split counts and record counts for header/no-header/header-only
+    variable-length files (Test02SparseIndexGenerator.scala)."""
+    with_header = _rdw(bytes([0xF0]))
+    for i in range(1, 10):
+        with_header += _rdw(bytes([0xF1, 0xF0 + i]))
+    no_header = b"".join(_rdw(bytes([0xF1, 0xF0 + i]))
+                         for i in range(1, 10))
+    header_only = _rdw(bytes([0xF0]))
+
+    base = dict(copybook_contents=T1_COPYBOOK, generate_record_id="true",
+                input_split_records="5", is_xcom="true")
+    out = read_cobol(_write(tmp_path, "h.dat", with_header), **base)
+    assert len(out) == 10
+    assert len(out._results) == 2  # two index splits
+
+    out = read_cobol(_write(tmp_path, "nh.dat", no_header), **base)
+    assert len(out) == 9
+    assert len(out._results) == 2
+
+    out = read_cobol(_write(tmp_path, "ho.dat", header_only), **base)
+    assert len(out) == 1
+
+    # root-boundary splits: with a segment root, splits only land at roots
+    out = read_cobol(_write(tmp_path, "h2.dat", with_header),
+                     segment_field="I", segment_filter="1",
+                     segment_id_root="1", **base)
+    assert len(out) == 9
+
+
+# -- Test03IbmFloats --------------------------------------------------------
+
+T3_COPYBOOK = """       01  R.
+                03 F       COMP-1.
+                03 D       COMP-2.
+"""
+
+T3_CASES = [
+    ("IBM", bytes([0x43, 0x14, 0x2E, 0xFC]),
+     bytes([0x43, 0x14, 0x2E, 0xFC, 0xCA, 0xF7, 0x09, 0xB7]),
+     5.045883, 322.936717),
+    ("IBM_little_endian", bytes([0xFC, 0x2E, 0x14, 0x43]),
+     bytes([0xB7, 0x09, 0xF7, 0xCA, 0xFC, 0x2E, 0x14, 0x43]),
+     5.045883, 322.936717),
+    ("IEEE754", bytes([0x40, 0x49, 0x0F, 0xDA]),
+     bytes([0x40, 0x09, 0x21, 0xFB, 0x54, 0x44, 0x2E, 0xEA]),
+     3.1415925, 3.14159265359),
+    ("IEEE754_little_endian", bytes([0xDA, 0x0F, 0x49, 0x40]),
+     bytes([0xEA, 0x2E, 0x44, 0x54, 0xFB, 0x21, 0x09, 0x40]),
+     3.1415925, 3.14159265359),
+]
+
+
+@pytest.mark.parametrize("fmt,fbytes,dbytes,f_exp,d_exp", T3_CASES)
+def test_03_ibm_and_ieee_floats(tmp_path, fmt, fbytes, dbytes, f_exp, d_exp):
+    data = _rdw(fbytes + dbytes) * 10
+    path = _write(tmp_path, f"fp_{fmt}.dat", data)
+    rows = read_cobol(path, copybook_contents=T3_COPYBOOK,
+                      generate_record_id="true", is_xcom="true",
+                      schema_retention_policy="collapse_root",
+                      floating_point_format=fmt).to_dicts()
+    assert len(rows) == 10
+    assert abs(rows[0]["F"] - f_exp) < 0.00001
+    assert abs(rows[0]["D"] - d_exp) < 0.0000000001
+
+
+# -- Test04VarcharFields ----------------------------------------------------
+
+T4_COPYBOOK = """      01  R.
+                03 N     PIC X(1).
+                03 V     PIC X(10).
+"""
+
+
+def test_04_varchar_tail_fields(tmp_path):
+    """Truncated trailing varchar fields decode the available bytes
+    (Test04VarcharFields.scala)."""
+    recs = [bytes([0xF0]) + bytes([0xF1, 0xF2, 0xF3, 0xF4, 0xF5, 0xF6,
+                                   0xF7, 0xF8, 0xF9, 0xF0]),
+            bytes([0xF1]) + bytes([0xF2, 0xF3, 0xF4, 0xF5, 0xF6, 0xF7,
+                                   0xF8, 0x40, 0x40, 0x40]),
+            bytes([0xF2]) + bytes([0xF2, 0xF3, 0xF4, 0xF5, 0xF6, 0xF7,
+                                   0xF8, 0x40, 0x40]),
+            bytes([0xF3]) + bytes([0xF1, 0xF2, 0xF3]),
+            bytes([0xF4]) + bytes([0xF1]),
+            bytes([0xF5])]
+    data = b"".join(_rdw(r) for r in recs)
+    path = _write(tmp_path, "varchar.dat", data)
+    base = dict(copybook_contents=T4_COPYBOOK, generate_record_id="true",
+                is_xcom="true", schema_retention_policy="collapse_root")
+    rows = read_cobol(path, **base).to_dicts()
+    assert [r["N"] for r in rows] == ["0", "1", "2", "3", "4", "5"]
+    assert [r["V"] for r in rows] == ["1234567890", "2345678", "2345678",
+                                      "123", "1", ""]
+    # trimming off keeps the partial bytes verbatim
+    rows = read_cobol(path, string_trimming_policy="none", **base).to_dicts()
+    assert rows[1]["V"] == "2345678   "
+    assert rows[3]["V"] == "123"
+    assert rows[5]["V"] == ""
+
+
+# -- Test05CommaDecimals ----------------------------------------------------
+
+def test_05_comma_decimals(tmp_path):
+    """PIC +999,99 — comma as the decimal separator
+    (Test05CommaDecimals.scala)."""
+    copybook = """      01  R.
+                03 N     PIC +999,99 USAGE DISPLAY.
+"""
+    recs = [bytes([0x4E, 0xF1, 0xF1, 0xF2, 0x6B, 0xF3, 0xF4]),
+            bytes([0x40, 0x60, 0xF2, 0xF3, 0x6B, 0xF4, 0xF5]),
+            bytes([0x4E, 0xF0, 0xF0, 0xF5, 0x6B, 0xF0, 0xF0])]
+    path = _write(tmp_path, "comma.dat", b"".join(recs))
+    out = read_cobol(path, copybook_contents=copybook,
+                     schema_retention_policy="collapse_root")
+    assert _json(out) == '[{"N":112.34},{"N":-23.45},{"N":5.00}]'
+
+
+def test_05b_fixed_length_var_occurs(tmp_path):
+    """variable_size_occurs on the fixed-length ASCII path shortens
+    records to the actual OCCURS count
+    (Test05FixedLengthVarOccurs.scala)."""
+    copybook = """      01  RECORD.
+              02 COUNT PIC 9(4).
+              02 GROUP OCCURS 0 TO 5 TIMES DEPENDING ON COUNT.
+                  03 TEXT   PIC X(3).
+                  03 FIELD  PIC 9.
+"""
+    text = "   5ABC1ABC2ABC3ABC4ABC5   5DEF1DEF2DEF3DEF4DEF5"
+    path = _write(tmp_path, "varocc.dat", text.encode())
+    rows = read_cobol(path, copybook_contents=copybook,
+                      schema_retention_policy="collapse_root",
+                      variable_size_occurs="true",
+                      encoding="ascii").to_dicts()
+    assert len(rows) == 2
+    assert rows[0]["COUNT"] == 5
+    assert [g[0] for g in rows[0]["GROUP"]] == ["ABC"] * 5
+    assert [g[1] for g in rows[1]["GROUP"]] == [1, 2, 3, 4, 5]
+
+
+# -- Test06EmptySegmentIds --------------------------------------------------
+
+T6_COPYBOOK = """         01  ENTITY.
+           05  SEGMENT-ID           PIC X(1).
+           05  SEG1.
+              10  A                 PIC X(1).
+           05  SEG2 REDEFINES SEG1.
+              10  B                 PIC X(1).
+           05  SEG3 REDEFINES SEG1.
+              10  E                 PIC X(1).
+"""
+
+
+def test_06_empty_segment_ids(tmp_path):
+    recs = [bytes([0xC1, 0x81]), bytes([0xC2, 0x82]), bytes([0x40, 0x85])]
+    path = _write(tmp_path, "seg.dat", b"".join(_rdw(r) for r in recs))
+    base = dict(copybook_contents=T6_COPYBOOK, pedantic="true",
+                is_record_sequence="true",
+                schema_retention_policy="collapse_root",
+                segment_field="SEGMENT_ID")
+    out = read_cobol(path, **{**base,
+                              "redefine_segment_id_map:1": "SEG1 => A",
+                              "redefine-segment-id-map:2": "SEG2 => B",
+                              "redefine-segment-id-map:3": "SEG3 => "})
+    assert _json(out) == (
+        '[{"SEGMENT_ID":"A","SEG1":{"A":"a"}},'
+        '{"SEGMENT_ID":"B","SEG2":{"B":"b"}},'
+        '{"SEGMENT_ID":"","SEG3":{"E":"e"}}]')
+
+    recs.append(bytes([0xC4, 0x84]))
+    path = _write(tmp_path, "seg2.dat", b"".join(_rdw(r) for r in recs))
+    out = read_cobol(path, **{**base,
+                              "redefine_segment_id_map:1": "SEG1 => A",
+                              "redefine-segment-id-map:2": "SEG2 => B",
+                              "redefine-segment-id-map:3": "SEG3 => ,D"})
+    assert _json(out) == (
+        '[{"SEGMENT_ID":"A","SEG1":{"A":"a"}},'
+        '{"SEGMENT_ID":"B","SEG2":{"B":"b"}},'
+        '{"SEGMENT_ID":"","SEG3":{"E":"e"}},'
+        '{"SEGMENT_ID":"D","SEG3":{"E":"d"}}]')
+
+
+# -- Test07IgnoreHiddenFiles ------------------------------------------------
+
+def test_07_hidden_files_ignored(tmp_path):
+    copybook = """      01  R.
+                03 A     PIC X(2).
+"""
+    d = tmp_path / "data"
+    nested = d / "nested"
+    nested.mkdir(parents=True)
+    (d / "a.dat").write_bytes(bytes([0xF1, 0xF2, 0xF3, 0xF4]))
+    (d / ".hidden").write_bytes(b"\xF1")           # non-divisible, hidden
+    (d / "_hidden2").write_bytes(b"\xF1")
+    (nested / ".hidden3").write_bytes(b"\xF1")
+    rows = read_cobol(str(d), copybook_contents=copybook,
+                      schema_retention_policy="collapse_root").to_dicts()
+    assert [r["A"] for r in rows] == ["12", "34"]
+
+
+# -- Test08InputFileName ----------------------------------------------------
+
+def test_08_input_file_name_and_offsets(tmp_path):
+    copybook = """      01  R.
+                03 A     PIC X(1).
+                03 B     PIC X(2).
+"""
+    data = (bytes([0, 0, 0, 0])
+            + bytes([0xF0, 0xF1, 0xF2, 0xF3, 0xF4, 0xF5, 0xF6, 0xF7, 0xF8])
+            + bytes([0, 0, 0, 0, 0]))
+    path = _write(tmp_path, "bin_file.dat", data)
+    out = read_cobol(path, copybook_contents=copybook,
+                     with_input_file_name_col="file",
+                     file_start_offset="4", file_end_offset="5",
+                     schema_retention_policy="collapse_root")
+    rows = out.to_dicts()
+    assert len(rows) == 3
+    assert all(r["file"].endswith("bin_file.dat") for r in rows)
+    assert [r["A"] for r in rows] == ["0", "3", "6"]
+
+    # the reference rejects the column on a plain fixed-length read
+    # (its test name says retention policy; the rule is variable-length)
+    with pytest.raises(ValueError, match="with_input_file_name_col"):
+        read_cobol(path, copybook_contents=copybook,
+                   with_input_file_name_col="file",
+                   schema_retention_policy="collapse_root")
+
+
+# -- Test09PrimitiveOccurs --------------------------------------------------
+
+def test_09_primitive_occurs(tmp_path):
+    copybook = """      01  R.
+           05  CNT    PIC 9(1).
+           05  A      PIC 9(2) OCCURS 0 TO 5 DEPENDING ON CNT.
+"""
+    data = bytes([0xF0,
+                  0xF1, 0xF2, 0xF3,
+                  0xF3, 0xF2, 0xF3, 0xF0, 0xF1, 0xF5, 0xF6,
+                  0xF5, 0xF1, 0xF2, 0xF3, 0xF4, 0xF5, 0xF6, 0xF7, 0xF8,
+                  0xF9, 0xF0])
+    path = _write(tmp_path, "occurs.dat", data)
+    out = read_cobol(path, copybook_contents=copybook, pedantic="true",
+                     schema_retention_policy="collapse_root",
+                     variable_size_occurs="true")
+    assert _json(out) == ('[{"CNT":0,"A":[]},{"CNT":1,"A":[23]},'
+                          '{"CNT":3,"A":[23,1,56]},'
+                          '{"CNT":5,"A":[12,34,56,78,90]}]')
+
+    out = read_cobol(path, copybook_contents=copybook, pedantic="true",
+                     schema_retention_policy="collapse_root",
+                     variable_size_occurs="true", debug="true")
+    assert _json(out) == (
+        '[{"CNT":0,"CNT_debug":"F0","A":[],"A_debug":[]},'
+        '{"CNT":1,"CNT_debug":"F1","A":[23],"A_debug":["F2F3"]},'
+        '{"CNT":3,"CNT_debug":"F3","A":[23,1,56],'
+        '"A_debug":["F2F3","F0F1","F5F6"]},'
+        '{"CNT":5,"CNT_debug":"F5","A":[12,34,56,78,90],'
+        '"A_debug":["F1F2","F3F4","F5F6","F7F8","F9F0"]}]')
+
+
+# -- Test10DeepSegmentRedefines ---------------------------------------------
+
+def test_10_deep_segment_redefines(tmp_path):
+    copybook = """         01  ENTITY.
+        02 NESTED1.
+           03 NESTED2.
+              05  ID                      PIC X(1).
+           03 NESTED3.
+              04 NESTED4.
+                 05  SEG1.
+                    10  A                 PIC X(1).
+                 05  SEG2 REDEFINES SEG1.
+                    10  B                 PIC X(1).
+                 05  SEG3 REDEFINES SEG1.
+                    10  C                 PIC X(1).
+"""
+    recs = [bytes([0xC1, 0x81]), bytes([0xC2, 0x82]),
+            bytes([0xC3, 0x83]), bytes([0xC4, 0x84])]
+    path = _write(tmp_path, "deep.dat", b"".join(_rdw(r) for r in recs))
+    out = read_cobol(path, copybook_contents=copybook, pedantic="true",
+                     is_record_sequence="true",
+                     schema_retention_policy="collapse_root",
+                     segment_field="ID",
+                     **{"redefine_segment_id_map:1": "SEG1 => A",
+                        "redefine-segment-id-map:2": "SEG2 => B",
+                        "redefine-segment-id-map:3": "SEG3 => C"})
+    assert _json(out) == (
+        '[{"NESTED1":{"NESTED2":{"ID":"A"},"NESTED3":{"NESTED4":'
+        '{"SEG1":{"A":"a"}}}}},'
+        '{"NESTED1":{"NESTED2":{"ID":"B"},"NESTED3":{"NESTED4":'
+        '{"SEG2":{"B":"b"}}}}},'
+        '{"NESTED1":{"NESTED2":{"ID":"C"},"NESTED3":{"NESTED4":'
+        '{"SEG3":{"C":"c"}}}}},'
+        '{"NESTED1":{"NESTED2":{"ID":"D"},"NESTED3":{"NESTED4":{}}}}]')
+
+
+# -- Test11NoCopybookErrMsg -------------------------------------------------
+
+def test_11_copybook_option_errors(tmp_path):
+    copybook = """      01  R.
+                03 A     PIC X(1).
+                03 B     PIC X(2).
+"""
+    path = _write(tmp_path, "data.dat", bytes([0xF0, 0xF1, 0xF2]))
+    out = read_cobol(path, copybook_contents=copybook,
+                     schema_retention_policy="collapse_root")
+    assert len(out) == 1
+
+    with pytest.raises(Exception, match="COPYBOOK"):
+        read_cobol(path)
+    with pytest.raises(Exception, match="copybook"):
+        read_cobol(path, copybook="dummy", copybook_contents=copybook)
+    with pytest.raises(Exception):
+        read_cobol(path, copybook=str(tmp_path))  # a dir, not a file
