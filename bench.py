@@ -148,6 +148,20 @@ def run_device_query(mb_target: float, platform: str) -> dict:
     e2e = min(times)
     d2h_bytes = len(parts) * sum(28 + len(k) for k in parts[0]) + 4
 
+    # one profiler trace artifact of a single aggregate step (SURVEY.md §5
+    # tracing row): loadable in TensorBoard/XProf; recorded in the JSON
+    trace_dir = os.environ.get("BENCH_TRACE_DIR", "bench_trace")
+    trace_status = trace_dir
+    try:
+        from cobrix_tpu.profiling import profile_trace
+
+        with profile_trace(trace_dir):
+            x, n = agg.put(mats[0], block=block)
+            agg.aggregate_device(x, n)
+    except Exception as exc:  # the trace must never sink the bench
+        trace_status = f"unavailable: {str(exc)[:200]}"
+        _log(f"profiler trace failed: {exc}")
+
     result = {
         "metric": "exp3_device_aggregate_jax",
         "platform": platform,
@@ -159,6 +173,7 @@ def run_device_query(mb_target: float, platform: str) -> dict:
         "d2h_bytes": d2h_bytes,
         "records": int(sum(p["NUM1"]["count"] for p in parts) / 2000),
         "total_MB": round(total_mb, 1),
+        "trace": trace_status,
     }
     _log(f"device query: {result}")
     _log(f"aggregate sample: NUM1 sum={merged['NUM1']['sum']:.0f} "

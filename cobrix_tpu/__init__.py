@@ -10,6 +10,7 @@ from .api import CobolData, read_cobol
 from .copybook.copybook import Copybook, merge_copybooks, parse_copybook
 from .reader.handlers import (DictHandler, JsonHandler, RecordHandler,
                               TupleHandler)
+from .profiling import ReadMetrics, profile_trace
 from .reader.stream import (ByteRangeSource, open_stream,
                             register_stream_backend)
 from .copybook.datatypes import (
@@ -44,4 +45,6 @@ __all__ = [
     "ByteRangeSource",
     "open_stream",
     "register_stream_backend",
+    "ReadMetrics",
+    "profile_trace",
 ]

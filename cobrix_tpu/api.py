@@ -31,9 +31,10 @@ from .reader.parameters import (
     MultisegmentParameters,
     ReaderParameters,
 )
+from .profiling import ReadMetrics, stage
 from .reader.result import FileResult, rows_file_result
 from .reader.schema import CobolOutputSchema, StructType
-from .reader.stream import open_stream
+from .reader.stream import open_stream, path_scheme
 from .reader.var_len_reader import VarLenReader, default_segment_id_prefix
 
 
@@ -337,6 +338,9 @@ class CobolData:
         self._arrow_tables = None
         self.output_schema = schema
         self.parallelism = parallelism
+        # structured per-read metrics (profiling.ReadMetrics); populated by
+        # read_cobol
+        self.metrics: Optional[ReadMetrics] = None
 
     @classmethod
     def from_results(cls, results: List["FileResult"],
@@ -491,7 +495,7 @@ def _plan_var_len_shards(reader, files, params) -> List["WorkShard"]:
 
 
 def _scan_var_len(reader, files, params, backend: str, prefix: str,
-                  parallelism: int) -> List["FileResult"]:
+                  parallelism: int, metrics=None) -> List["FileResult"]:
     """The indexed parallel scan — the reference's flagship execution
     strategy (CobolScanners.buildScanForVarLenIndex, CobolScanners.scala:
     38-55 + IndexBuilder.buildIndex, IndexBuilder.scala:49-66): a sparse
@@ -499,7 +503,10 @@ def _scan_var_len(reader, files, params, backend: str, prefix: str,
     shards; shards decode concurrently (each from its own bounded stream,
     Record_Id seeded from the index entry) and results reassemble in
     record order."""
-    shards = _plan_var_len_shards(reader, files, params)
+    with stage(metrics, "plan_index"):
+        shards = _plan_var_len_shards(reader, files, params)
+    if metrics is not None:
+        metrics.shards = len(shards)
 
     def scan(shard) -> "FileResult":
         max_bytes = (0 if shard.offset_to < 0
@@ -590,6 +597,11 @@ def read_cobol(path=None,
                  if params.multisegment and is_var_len else 0)
     results: List[FileResult] = []
     copybook_obj: Optional[Copybook] = None
+    metrics = ReadMetrics(files=len(files), backend=backend,
+                          hosts=max(hosts, 1))
+    metrics.bytes_read = sum(
+        os.path.getsize(f) for f in files
+        if path_scheme(f) in (None, "file") and os.path.exists(f))
 
     if hosts > 1:
         if backend != "numpy":
@@ -599,39 +611,49 @@ def read_cobol(path=None,
                 f"(drop `hosts` for the {backend!r} backend)")
         return _read_cobol_multihost(files, copybook_contents, params,
                                      hosts, seg_count,
-                                     debug_ignore_file_size)
+                                     debug_ignore_file_size, metrics)
 
-    if is_var_len:
-        reader = VarLenReader(copybook_contents, params)
-        copybook_obj = reader.copybook
-        prefix = (params.multisegment.segment_id_prefix
-                  if params.multisegment and params.multisegment.segment_id_prefix
-                  else default_segment_id_prefix())
-        if backend == "host":
-            for file_order, file_path in enumerate(files):
-                with open_stream(file_path) as stream:
-                    results.append(rows_file_result(list(reader.iter_rows(
-                        stream, file_id=file_order, segment_id_prefix=prefix,
-                        start_record_id=file_order * DEFAULT_FILE_RECORD_ID_INCREMENT))))
+    with stage(metrics, "parse_copybook"):
+        if is_var_len:
+            reader = VarLenReader(copybook_contents, params)
         else:
-            results = _scan_var_len(reader, files, params, backend, prefix,
-                                    parallelism)
-    else:
-        reader = FixedLenReader(copybook_contents, params)
+            reader = FixedLenReader(copybook_contents, params)
         copybook_obj = reader.copybook
-        for file_order, file_path in enumerate(files):
-            base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
+
+    with stage(metrics, "scan"):
+        if is_var_len:
+            prefix = (params.multisegment.segment_id_prefix
+                      if params.multisegment
+                      and params.multisegment.segment_id_prefix
+                      else default_segment_id_prefix())
             if backend == "host":
-                data = _read_file_bytes(file_path)
-                results.append(rows_file_result(list(reader.iter_rows_host(
-                    data, file_id=file_order,
-                    first_record_id=base,
-                    input_file_name=file_path,
-                    ignore_file_size=debug_ignore_file_size))))
+                for file_order, file_path in enumerate(files):
+                    with open_stream(file_path) as stream:
+                        results.append(rows_file_result(list(
+                            reader.iter_rows(
+                                stream, file_id=file_order,
+                                segment_id_prefix=prefix,
+                                start_record_id=file_order
+                                * DEFAULT_FILE_RECORD_ID_INCREMENT))))
             else:
-                results.extend(_read_fixed_len_chunked(
-                    reader, file_path, params, backend, file_order, base,
-                    debug_ignore_file_size))
+                results = _scan_var_len(reader, files, params, backend,
+                                        prefix, parallelism,
+                                        metrics=metrics)
+        else:
+            for file_order, file_path in enumerate(files):
+                base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
+                if backend == "host":
+                    data = _read_file_bytes(file_path)
+                    results.append(rows_file_result(list(
+                        reader.iter_rows_host(
+                            data, file_id=file_order,
+                            first_record_id=base,
+                            input_file_name=file_path,
+                            ignore_file_size=debug_ignore_file_size))))
+                else:
+                    results.extend(_read_fixed_len_chunked(
+                        reader, file_path, params, backend, file_order,
+                        base, debug_ignore_file_size))
 
     schema = CobolOutputSchema(
         copybook_obj,
@@ -640,7 +662,9 @@ def read_cobol(path=None,
         generate_record_id=params.generate_record_id,
         generate_seg_id_field_count=seg_count,
         segment_id_prefix="")
-    return CobolData.from_results(results, schema, parallelism=parallelism)
+    data = CobolData.from_results(results, schema, parallelism=parallelism)
+    metrics.finalize(data, len(results))
+    return data
 
 
 # fixed-length files stream through bounded chunk reads instead of one
@@ -697,25 +721,30 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
 
 
 def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
-                          seg_count: int,
-                          debug_ignore_file_size: bool) -> "CobolData":
+                          seg_count: int, debug_ignore_file_size: bool,
+                          metrics: Optional[ReadMetrics] = None
+                          ) -> "CobolData":
     """The multi-host execution path: plan + fork + reassemble
     (parallel/hosts.multihost_scan). Output is Arrow-backed; row order and
     Record_Ids are byte-identical to the single-process read."""
     from .parallel.hosts import multihost_scan, plan_fixed_len_shards
 
     is_var_len = params.needs_var_len_reader
-    if is_var_len:
-        reader = VarLenReader(copybook_contents, params)
-        prefix = (params.multisegment.segment_id_prefix
-                  if params.multisegment
-                  and params.multisegment.segment_id_prefix
-                  else default_segment_id_prefix())
-        shards = _plan_var_len_shards(reader, files, params)
-    else:
-        reader = FixedLenReader(copybook_contents, params)
-        prefix = ""
-        shards = plan_fixed_len_shards(reader, files, params, hosts)
+    with stage(metrics, "parse_copybook"):
+        if is_var_len:
+            reader = VarLenReader(copybook_contents, params)
+            prefix = (params.multisegment.segment_id_prefix
+                      if params.multisegment
+                      and params.multisegment.segment_id_prefix
+                      else default_segment_id_prefix())
+        else:
+            reader = FixedLenReader(copybook_contents, params)
+            prefix = ""
+    with stage(metrics, "plan_index"):
+        if is_var_len:
+            shards = _plan_var_len_shards(reader, files, params)
+        else:
+            shards = plan_fixed_len_shards(reader, files, params, hosts)
     schema = CobolOutputSchema(
         reader.copybook,
         policy=params.schema_policy,
@@ -723,6 +752,11 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
         generate_record_id=params.generate_record_id,
         generate_seg_id_field_count=seg_count,
         segment_id_prefix="")
-    tables = multihost_scan(reader, shards, is_var_len, schema, hosts,
-                            prefix, ignore_file_size=debug_ignore_file_size)
-    return CobolData.from_arrow_tables(tables, schema)
+    with stage(metrics, "scan"):
+        tables = multihost_scan(reader, shards, is_var_len, schema, hosts,
+                                prefix,
+                                ignore_file_size=debug_ignore_file_size)
+    data = CobolData.from_arrow_tables(tables, schema)
+    if metrics is not None:
+        metrics.finalize(data, len(shards))
+    return data

@@ -26,6 +26,7 @@ import numpy as np
 
 from ..copybook.copybook import Copybook
 from ..plan.compiler import Codec
+from ..profiling import annotate
 from ..reader.columnar import (_FLOAT_CODECS, _NUMERIC_CODECS, _dyn_scale,
                                fixed_point_exponent)
 from .mesh import batch_sharding, data_mesh, pad_batch_to_multiple
@@ -198,7 +199,8 @@ class DeviceAggregator:
         batch_jax.ensure_x64()
         if self._agg_fn is None:
             self._agg_fn = self._build()
-        return self._agg_fn(x, np.int32(n))
+        with annotate("cobrix_device_aggregate"):
+            return self._agg_fn(x, np.int32(n))
 
     def fetch(self, tree) -> Dict[str, dict]:
         """Transfer a submitted scalar tree to host and shape the result.

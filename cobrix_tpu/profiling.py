@@ -1,0 +1,108 @@
+"""Profiling hooks: JAX profiler traces + structured read metrics.
+
+The reference's observability is SLF4J logging around the scan
+(CobolScanners.scala:51, IndexBuilder.scala:216 — per-partition offsets
+and index counts). The TPU-native equivalents here:
+
+- `profile_trace(dir)`: a context manager wrapping any read/decode in a
+  `jax.profiler.trace` session — the artifact opens in TensorBoard/XProf
+  and shows the fused kernel, transfers, and collectives on the device
+  timeline. The bench writes one such artifact per run.
+- `annotate(name)`: named TraceAnnotation spans used inside the decode
+  paths (visible on the profiler timeline; ~free when no trace is on).
+- `ReadMetrics`: per-read structured counters (files, shards, records,
+  bytes, per-stage timings) attached to every CobolData as `.metrics`.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+
+@contextlib.contextmanager
+def profile_trace(output_dir: str):
+    """Capture a JAX profiler trace of everything inside the block into
+    `output_dir` (TensorBoard-loadable). Falls back to a no-op if the
+    profiler is unavailable (e.g. numpy-only environments)."""
+    try:
+        import jax
+    except Exception:  # pragma: no cover - jax is a hard dep in practice
+        yield
+        return
+    with jax.profiler.trace(output_dir):
+        yield
+
+
+_TRACE_ANNOTATION = None
+
+
+def annotate(name: str):
+    """Named span on the profiler timeline; no-op outside a trace. The
+    TraceAnnotation class resolves once — this sits on per-block decode
+    hot paths."""
+    global _TRACE_ANNOTATION
+    if _TRACE_ANNOTATION is None:
+        try:
+            import jax
+
+            _TRACE_ANNOTATION = jax.profiler.TraceAnnotation
+        except Exception:  # pragma: no cover
+            _TRACE_ANNOTATION = False
+    if _TRACE_ANNOTATION is False:  # pragma: no cover
+        return contextlib.nullcontext()
+    return _TRACE_ANNOTATION(name)
+
+
+@dataclass
+class ReadMetrics:
+    """Structured per-read metrics (the IndexBuilder/CobolScanners log
+    lines as data instead of log text)."""
+
+    files: int = 0
+    shards: int = 0
+    records: int = 0
+    bytes_read: int = 0
+    backend: str = ""
+    hosts: int = 1
+    timings_s: Dict[str, float] = field(default_factory=dict)
+
+    def finalize(self, data, shards: int) -> None:
+        """Attach this metrics object to a finished CobolData."""
+        self.shards = max(self.shards, shards)
+        self.records = len(data)
+        data.metrics = self
+
+    def as_dict(self) -> dict:
+        return {
+            "files": self.files,
+            "shards": self.shards,
+            "records": self.records,
+            "bytes_read": self.bytes_read,
+            "backend": self.backend,
+            "hosts": self.hosts,
+            "timings_s": {k: round(v, 6) for k, v in self.timings_s.items()},
+        }
+
+
+class _Stage:
+    def __init__(self, metrics: ReadMetrics, name: str):
+        self.metrics = metrics
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.metrics.timings_s[self.name] = (
+            self.metrics.timings_s.get(self.name, 0.0)
+            + time.perf_counter() - self._t0)
+
+
+def stage(metrics: Optional[ReadMetrics], name: str):
+    """Accumulating wall-clock timer for one pipeline stage."""
+    if metrics is None:
+        return contextlib.nullcontext()
+    return _Stage(metrics, name)

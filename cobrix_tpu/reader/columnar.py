@@ -39,6 +39,7 @@ from ..copybook.datatypes import (
 )
 from ..encoding.codepages import code_page_lut_u16
 from ..ops import batch_np
+from ..profiling import annotate
 from ..plan.compiler import Codec, ColumnSpec, FieldPlan, compile_plan
 from .extractors import DecodeOptions
 import decimal as _decimal
@@ -1048,7 +1049,8 @@ class ColumnarDecoder:
             padded = arr
         # explicit H2D: the implicit transfer inside jit dispatch is far
         # slower than device_put on remote-attached (tunneled) devices
-        device_outs = self._jax_fn(jax.device_put(padded))
+        with annotate("cobrix_decode"):
+            device_outs = self._jax_fn(jax.device_put(padded))
         return self.collect_outputs(device_outs, n)
 
     def collect_outputs(self, device_outs, n: int) -> Dict[int, dict]:
