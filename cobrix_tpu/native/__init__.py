@@ -32,6 +32,7 @@ MAX_RDW_RECORD_SIZE = 100 * 1024 * 1024
 _I64P = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
 _U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
 _U16P = np.ctypeslib.ndpointer(dtype=np.uint16, flags="C_CONTIGUOUS")
+_U64P = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
 
 
 def _build() -> bool:
@@ -97,7 +98,22 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.decode_display_cols.argtypes = [
             _U8P, ctypes.c_int64, ctypes.c_int64, _I64P, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
-            ctypes.c_int32, _I64P, _U8P, _I64P]
+            ctypes.c_int32, ctypes.c_int32, _I64P, _U8P, _I64P]
+        lib.decode_bcd_wide_cols.restype = None
+        lib.decode_bcd_wide_cols.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_int64, _I64P, ctypes.c_int64,
+            ctypes.c_int32, _U64P, _U64P, _U8P, _U8P]
+        lib.decode_binary_wide_cols.restype = None
+        lib.decode_binary_wide_cols.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_int64, _I64P, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            _U64P, _U64P, _U8P, _U8P]
+        lib.decode_display_wide_cols.restype = None
+        lib.decode_display_wide_cols.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_int64, _I64P, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
+            _U64P, _U64P, _U8P, _U8P, _I64P]
         lib.decode_binary_cols_raw.restype = None
         lib.decode_binary_cols_raw.argtypes = [
             _U8P, _I64P, _I64P, ctypes.c_int64, _I64P, ctypes.c_int64,
@@ -325,10 +341,11 @@ def decode_bcd_cols(batch: np.ndarray, col_offsets: np.ndarray, width: int
 
 def decode_display_cols(batch: np.ndarray, col_offsets: np.ndarray,
                         width: int, kind: int, signed: bool, allow_dot: bool,
-                        require_digits: bool
+                        require_digits: bool, dyn_sf: int = 0
                         ) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """All same-shaped DISPLAY numeric columns in one native pass
-    (ops/batch_np.decode_display_{ebcdic,ascii} semantics)."""
+    (ops/batch_np.decode_display_{ebcdic,ascii} semantics incl. the
+    PIC P dynamic exponent plane)."""
     lib = _load()
     if lib is None:
         return None
@@ -340,8 +357,69 @@ def decode_display_cols(batch: np.ndarray, col_offsets: np.ndarray,
     dots = np.empty((n, ncols), dtype=np.int64)
     lib.decode_display_cols(b, n, extent, offs, ncols, width, kind,
                             int(signed), int(allow_dot), int(require_digits),
-                            values, valid, dots)
+                            int(dyn_sf), values, valid, dots)
     return values, valid.view(bool), dots
+
+
+def _wide_outputs(n: int, ncols: int):
+    return (np.empty((n, ncols), dtype=np.uint64),
+            np.empty((n, ncols), dtype=np.uint64),
+            np.empty((n, ncols), dtype=np.uint8),
+            np.empty((n, ncols), dtype=np.uint8))
+
+
+def decode_bcd_wide_cols(batch: np.ndarray, col_offsets: np.ndarray,
+                         width: int):
+    """Wide (19-38 digit) COMP-3 columns -> uint128 magnitude limb pairs
+    (ops/batch_np.decode_bcd_wide semantics)."""
+    lib = _load()
+    if lib is None:
+        return None
+    b, offs = _batch_and_offsets(batch, col_offsets)
+    n, extent = b.shape
+    ncols = offs.shape[0]
+    hi, lo, neg, valid = _wide_outputs(n, ncols)
+    lib.decode_bcd_wide_cols(b, n, extent, offs, ncols, width,
+                             hi, lo, neg, valid)
+    return hi, lo, neg.view(bool), valid.view(bool)
+
+
+def decode_binary_wide_cols(batch: np.ndarray, col_offsets: np.ndarray,
+                            width: int, signed: bool, big_endian: bool):
+    """9-16 byte two's complement columns -> uint128 limb pairs
+    (ops/batch_np.decode_binary_wide semantics)."""
+    lib = _load()
+    if lib is None:
+        return None
+    b, offs = _batch_and_offsets(batch, col_offsets)
+    n, extent = b.shape
+    ncols = offs.shape[0]
+    hi, lo, neg, valid = _wide_outputs(n, ncols)
+    lib.decode_binary_wide_cols(b, n, extent, offs, ncols, width,
+                                int(signed), int(big_endian),
+                                hi, lo, neg, valid)
+    return hi, lo, neg.view(bool), valid.view(bool)
+
+
+def decode_display_wide_cols(batch: np.ndarray, col_offsets: np.ndarray,
+                             width: int, kind: int, signed: bool,
+                             allow_dot: bool, require_digits: bool,
+                             dyn_sf: int = 0):
+    """Wide DISPLAY numeric columns -> uint128 limb pairs + dots plane
+    (ops/batch_np.decode_display_*_wide semantics)."""
+    lib = _load()
+    if lib is None:
+        return None
+    b, offs = _batch_and_offsets(batch, col_offsets)
+    n, extent = b.shape
+    ncols = offs.shape[0]
+    hi, lo, neg, valid = _wide_outputs(n, ncols)
+    dots = np.empty((n, ncols), dtype=np.int64)
+    lib.decode_display_wide_cols(b, n, extent, offs, ncols, width, kind,
+                                 int(signed), int(allow_dot),
+                                 int(require_digits), int(dyn_sf),
+                                 hi, lo, neg, valid, dots)
+    return hi, lo, neg.view(bool), valid.view(bool), dots
 
 
 def transcode_string_cols(batch: np.ndarray, col_offsets: np.ndarray,

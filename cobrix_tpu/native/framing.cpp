@@ -462,13 +462,82 @@ void decode_bcd_cols_raw(const uint8_t* data,
   }
 }
 
-// Zoned decimal DISPLAY numerics, EBCDIC (kind=0) and ASCII (kind=1)
-// (StringDecoders.decodeEbcdicNumber :154 / decodeAsciiNumber state
-// machines). dot_scale = digit count right of the single decimal point.
+}  // extern "C" (reopened below; the display helper is a C++ template)
+
+// One zoned-decimal field: the shared DISPLAY byte-classification state
+// machine (StringDecoders.decodeEbcdicNumber :154 / decodeAsciiNumber),
+// templated over the accumulator so the narrow (uint64) and wide
+// (unsigned __int128) kernels cannot diverge.
+template <typename AccT>
+static inline void decode_display_field(
+    const uint8_t* p, int32_t width, int32_t kind, int32_t is_signed,
+    int32_t allow_dot, int32_t require_digits, int32_t dyn_sf,
+    AccT* acc_out, uint8_t* ok_out, bool* negative_out,
+    int64_t* dots_out) {
+  AccT acc = 0;
+  int32_t n_signs = 0, n_dots = 0, n_digits = 0, digits_after_dot = 0;
+  bool negative = false, unknown = false, interior_space = false;
+  bool seen_meaningful = false, space_after_meaningful = false;
+  for (int32_t i = 0; i < width; ++i) {
+    uint8_t b = p[i];
+    int32_t d = -1;
+    bool dot = false, space = false;
+    if (kind == 0) {  // EBCDIC
+      if (b >= 0xF0 && b <= 0xF9) d = b - 0xF0;
+      else if (b >= 0xC0 && b <= 0xC9) { d = b - 0xC0; ++n_signs; }
+      else if (b >= 0xD0 && b <= 0xD9) { d = b - 0xD0; ++n_signs; negative = true; }
+      else if (b == 0x60) { ++n_signs; negative = true; }
+      else if (b == 0x4E) { ++n_signs; }
+      else if (b == 0x4B || b == 0x6B) dot = true;
+      else if (b == 0x40 || b == 0x00) space = true;
+      else unknown = true;
+    } else {  // ASCII
+      if (b >= 0x30 && b <= 0x39) d = b - 0x30;
+      else if (b == 0x2D) { ++n_signs; negative = true; }
+      else if (b == 0x2B) { ++n_signs; }
+      else if (b == 0x2E || b == 0x2C) dot = true;
+      else if (b <= 0x20) space = true;
+      else unknown = true;
+    }
+    if (d >= 0) {
+      acc = acc * 10 + (uint32_t)d;
+      ++n_digits;
+      if (n_dots > 0) ++digits_after_dot;
+    }
+    if (dot) ++n_dots;
+    if (kind == 1) {  // ASCII edge-space rule
+      bool meaningful = (d >= 0) || dot;
+      if (meaningful) {
+        if (space_after_meaningful) interior_space = true;
+        seen_meaningful = true;
+      } else if (space && seen_meaningful) {
+        space_after_meaningful = true;
+      }
+    }
+  }
+  uint8_t ok = !unknown && n_signs <= 1;
+  if (kind == 1 && interior_space) ok = 0;
+  if (require_digits && n_digits < 1) ok = 0;
+  if (allow_dot) { if (n_dots > 1) ok = 0; }
+  else if (n_dots != 0) ok = 0;
+  if (!is_signed && negative) ok = 0;
+  *acc_out = acc;
+  *ok_out = ok;
+  *negative_out = negative;
+  *dots_out = dyn_sf < 0 ? (int64_t)(-dyn_sf) + n_digits
+                         : (int64_t)digits_after_dot;
+}
+
+extern "C" {
+
+// Zoned decimal DISPLAY numerics, EBCDIC (kind=0) and ASCII (kind=1).
+// dot_scale = digit count right of the single decimal point, or
+// |dyn_sf| + digit count for PIC P columns (dyn_sf < 0).
 void decode_display_cols(const uint8_t* batch, int64_t n, int64_t extent,
                          const int64_t* col_offsets, int64_t ncols,
                          int32_t width, int32_t kind, int32_t is_signed,
                          int32_t allow_dot, int32_t require_digits,
+                         int32_t dyn_sf,
                          int64_t* values, uint8_t* valid,
                          int64_t* dot_scale) {
 #ifdef _OPENMP
@@ -480,58 +549,121 @@ void decode_display_cols(const uint8_t* batch, int64_t n, int64_t extent,
     uint8_t* okrow = valid + r * ncols;
     int64_t* dotrow = dot_scale + r * ncols;
     for (int64_t c = 0; c < ncols; ++c) {
-      const uint8_t* p = row + col_offsets[c];
-      uint64_t acc = 0;
-      int32_t n_signs = 0, n_dots = 0, n_digits = 0, digits_after_dot = 0;
-      bool negative = false, unknown = false, interior_space = false;
-      bool seen_meaningful = false, space_after_meaningful = false;
-      for (int32_t i = 0; i < width; ++i) {
-        uint8_t b = p[i];
-        int32_t d = -1;
-        bool dot = false, space = false;
-        if (kind == 0) {  // EBCDIC
-          if (b >= 0xF0 && b <= 0xF9) d = b - 0xF0;
-          else if (b >= 0xC0 && b <= 0xC9) { d = b - 0xC0; ++n_signs; }
-          else if (b >= 0xD0 && b <= 0xD9) { d = b - 0xD0; ++n_signs; negative = true; }
-          else if (b == 0x60) { ++n_signs; negative = true; }
-          else if (b == 0x4E) { ++n_signs; }
-          else if (b == 0x4B || b == 0x6B) dot = true;
-          else if (b == 0x40 || b == 0x00) space = true;
-          else unknown = true;
-        } else {  // ASCII
-          if (b >= 0x30 && b <= 0x39) d = b - 0x30;
-          else if (b == 0x2D) { ++n_signs; negative = true; }
-          else if (b == 0x2B) { ++n_signs; }
-          else if (b == 0x2E || b == 0x2C) dot = true;
-          else if (b <= 0x20) space = true;
-          else unknown = true;
-        }
-        if (d >= 0) {
-          acc = acc * 10 + (uint32_t)d;
-          ++n_digits;
-          if (n_dots > 0) ++digits_after_dot;
-        }
-        if (dot) ++n_dots;
-        if (kind == 1) {  // ASCII edge-space rule
-          bool meaningful = (d >= 0) || dot;
-          if (meaningful) {
-            if (space_after_meaningful) interior_space = true;
-            seen_meaningful = true;
-          } else if (space && seen_meaningful) {
-            space_after_meaningful = true;
-          }
-        }
-      }
-      uint8_t ok = !unknown && n_signs <= 1;
-      if (kind == 1 && interior_space) ok = 0;
-      if (require_digits && n_digits < 1) ok = 0;
-      if (allow_dot) { if (n_dots > 1) ok = 0; }
-      else if (n_dots != 0) ok = 0;
-      if (!is_signed && negative) ok = 0;
+      uint64_t acc;
+      uint8_t ok;
+      bool negative;
+      int64_t dots;
+      decode_display_field<uint64_t>(
+          row + col_offsets[c], width, kind, is_signed, allow_dot,
+          require_digits, dyn_sf, &acc, &ok, &negative, &dots);
       int64_t v = negative ? (int64_t)(0 - acc) : (int64_t)acc;
       vrow[c] = ok ? v : 0;
       okrow[c] = ok;
-      dotrow[c] = ok ? digits_after_dot : 0;
+      dotrow[c] = ok ? dots : 0;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Wide (19-38 digit) planes: unsigned __int128 accumulation, output as
+// uint64 magnitude limb pairs + sign plane (the BigDecimal plane of
+// BCDNumberDecoders.decodeBigBCDNumber / decodeBinaryAribtraryPrecision /
+// decodeEbcdicBigNumber; same layout as ops/batch_np decode_*_wide).
+// ---------------------------------------------------------------------------
+
+typedef unsigned __int128 u128;
+
+void decode_bcd_wide_cols(const uint8_t* batch, int64_t n, int64_t extent,
+                          const int64_t* col_offsets, int64_t ncols,
+                          int32_t width, uint64_t* hi, uint64_t* lo,
+                          uint8_t* negative, uint8_t* valid) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const uint8_t* row = batch + r * extent;
+    for (int64_t c = 0; c < ncols; ++c) {
+      const uint8_t* p = row + col_offsets[c];
+      u128 acc = 0;
+      uint8_t ok = 1;
+      for (int32_t i = 0; i + 1 < width; ++i) {
+        uint8_t pair = kBcdPair[p[i]];
+        if (pair == 255) { ok = 0; pair = 0; }
+        acc = acc * 100 + pair;
+      }
+      uint8_t last = p[width - 1];
+      uint8_t hnib = last >> 4, sign = last & 0x0F;
+      if (hnib >= 10) { ok = 0; hnib = 0; }
+      acc = acc * 10 + hnib;
+      if (sign != 0x0C && sign != 0x0D && sign != 0x0F) ok = 0;
+      int64_t idx = r * ncols + c;
+      hi[idx] = ok ? (uint64_t)(acc >> 64) : 0;
+      lo[idx] = ok ? (uint64_t)acc : 0;
+      negative[idx] = ok && sign == 0x0D;
+      valid[idx] = ok;
+    }
+  }
+}
+
+void decode_binary_wide_cols(const uint8_t* batch, int64_t n,
+                             int64_t extent, const int64_t* col_offsets,
+                             int64_t ncols, int32_t width,
+                             int32_t is_signed, int32_t big_endian,
+                             uint64_t* hi, uint64_t* lo, uint8_t* negative,
+                             uint8_t* valid) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const uint8_t* row = batch + r * extent;
+    for (int64_t c = 0; c < ncols; ++c) {
+      const uint8_t* p = row + col_offsets[c];
+      u128 acc = 0;
+      uint8_t first = big_endian ? p[0] : p[width - 1];
+      if (is_signed && (first & 0x80)) acc = ~(u128)0;
+      if (big_endian) {
+        for (int32_t i = 0; i < width; ++i) acc = (acc << 8) | p[i];
+      } else {
+        for (int32_t i = width - 1; i >= 0; --i) acc = (acc << 8) | p[i];
+      }
+      bool neg = is_signed && (acc >> 127);
+      u128 mag = neg ? (u128)(0 - acc) : acc;
+      int64_t idx = r * ncols + c;
+      hi[idx] = (uint64_t)(mag >> 64);
+      lo[idx] = (uint64_t)mag;
+      negative[idx] = neg;
+      valid[idx] = 1;
+    }
+  }
+}
+
+void decode_display_wide_cols(const uint8_t* batch, int64_t n,
+                              int64_t extent, const int64_t* col_offsets,
+                              int64_t ncols, int32_t width, int32_t kind,
+                              int32_t is_signed, int32_t allow_dot,
+                              int32_t require_digits, int32_t dyn_sf,
+                              uint64_t* hi, uint64_t* lo,
+                              uint8_t* negative_out, uint8_t* valid,
+                              int64_t* dot_scale) {
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    const uint8_t* row = batch + r * extent;
+    for (int64_t c = 0; c < ncols; ++c) {
+      u128 acc;
+      uint8_t ok;
+      bool negative;
+      int64_t dots;
+      decode_display_field<u128>(
+          row + col_offsets[c], width, kind, is_signed, allow_dot,
+          require_digits, dyn_sf, &acc, &ok, &negative, &dots);
+      int64_t idx = r * ncols + c;
+      hi[idx] = ok ? (uint64_t)(acc >> 64) : 0;
+      lo[idx] = ok ? (uint64_t)acc : 0;
+      negative_out[idx] = ok && negative;
+      valid[idx] = ok;
+      dot_scale[idx] = ok ? dots : 0;
     }
   }
 }

@@ -291,7 +291,11 @@ def decode_bcd_wide(data: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
                                                np.ndarray, np.ndarray]:
     """[..., W] packed decimal, 19-38 digit slots -> (hi, lo, negative,
     valid) with (hi, lo) the uint128 magnitude limbs. Same null rules as
-    `decode_bcd` (digit nibbles < 10; sign nibble C/D/F)."""
+    `decode_bcd` (digit nibbles < 10; sign nibble C/D/F).
+
+    Digit positions are static here, so the base-10^18 chunks are direct
+    weighted slices — no dynamic position masks (unlike the DISPLAY wide
+    kernel, where the digit layout depends on the data)."""
     w = data.shape[-1]
     high = ((data >> 4) & 0x0F).astype(np.int64)
     low = (data & 0x0F).astype(np.int64)
@@ -304,9 +308,16 @@ def decode_bcd_wide(data: np.ndarray) -> Tuple[np.ndarray, np.ndarray,
             data.shape[:-1] + (2 * (w - 1),)),
          high[..., -1:]], axis=-1)
     d_total = 2 * w - 1
-    pos_right = np.broadcast_to(
-        np.arange(d_total - 1, -1, -1, dtype=np.int64), digits.shape)
-    chunks = _digit_chunks(digits, pos_right, d_total)
+    chunks = []
+    n_chunks = (d_total + 17) // 18
+    for k in range(n_chunks - 1, -1, -1):
+        # digits whose position-from-right is in [18k, 18(k+1))
+        lo_idx = max(d_total - 18 * (k + 1), 0)
+        hi_idx = d_total - 18 * k
+        width_k = hi_idx - lo_idx
+        weights = 10 ** np.arange(width_k - 1, -1, -1, dtype=np.int64)
+        part = (digits[..., lo_idx:hi_idx] * weights).sum(axis=-1)
+        chunks.append(part.astype(np.uint64))
     hi, lo = _chunks_to_u128(chunks)
     negative = sign_nibble == 0x0D
     valid = digit_ok & sign_ok

@@ -830,12 +830,15 @@ class ColumnarDecoder:
         (no intermediate slab). False -> caller uses the numpy path."""
         from .. import native
 
-        if g.wide:
-            # the int64-accumulator C kernels would silently wrap >18-digit
-            # values; wide groups use the numpy uint128-limb path
-            return False
         if g.codec is Codec.BINARY:
-            signed, big_endian, _, _ = g.variant
+            signed, big_endian, _, wide = g.variant
+            if wide:
+                res = native.decode_binary_wide_cols(
+                    arr, g.offsets, g.width, signed, big_endian)
+                if res is None:
+                    return False
+                self._store_wide(g, outputs, *res)
+                return True
             res = native.decode_binary_cols(
                 arr, g.offsets, g.width, signed, big_endian)
             if res is None:
@@ -843,22 +846,32 @@ class ColumnarDecoder:
             self._store_numeric(g, outputs, *res)
             return True
         if g.codec is Codec.BCD:
+            if g.wide:
+                res = native.decode_bcd_wide_cols(arr, g.offsets, g.width)
+                if res is None:
+                    return False
+                self._store_wide(g, outputs, *res)
+                return True
             res = native.decode_bcd_cols(arr, g.offsets, g.width)
             if res is None:
                 return False
             self._store_numeric(g, outputs, *res)
             return True
         if g.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
-            signed, allow_dot, require_digits, _, sf, _ = g.variant
-            if sf < 0:
-                # dynamic PIC P exponent needs the digit-count plane the
-                # C kernel does not emit
-                return False
+            signed, allow_dot, require_digits, _, sf, wide = g.variant
             kind = (native.DISPLAY_EBCDIC if g.codec is Codec.DISPLAY_NUM
                     else native.DISPLAY_ASCII)
+            if wide:
+                res = native.decode_display_wide_cols(
+                    arr, g.offsets, g.width, kind, signed, allow_dot,
+                    require_digits, dyn_sf=min(sf, 0))
+                if res is None:
+                    return False
+                self._store_wide(g, outputs, *res)
+                return True
             res = native.decode_display_cols(
                 arr, g.offsets, g.width, kind, signed, allow_dot,
-                require_digits)
+                require_digits, dyn_sf=min(sf, 0))
             if res is None:
                 return False
             self._store_numeric(g, outputs, *res)
