@@ -413,6 +413,20 @@ class VarLenReader:
                 input_file_name=stream.input_file_name,
                 options=options)
 
+    def _hierarchy_maps(self):
+        """(segment id -> redefine group, parent -> child groups, root
+        group names) — shared by the scalar and columnar hierarchical
+        paths so they cannot disagree on the hierarchy."""
+        segment_redefines = {g.name: g
+                             for g in self.copybook.get_all_segment_redefines()}
+        sid_map = {sid: segment_redefines[name]
+                   for sid, name in self.segment_redefine_map.items()
+                   if name in segment_redefines}
+        parent_child_map = self.copybook.get_parent_children_segment_map()
+        root_names = {g.name for g in segment_redefines.values()
+                      if g.parent_segment is None}
+        return sid_map, parent_child_map, root_names
+
     def _iter_rows_hierarchical(self, stream: SimpleStream, file_id: int,
                                 start_record_id: int,
                                 starting_file_offset: int
@@ -420,16 +434,8 @@ class VarLenReader:
         """Buffer one root record plus its children, then assemble
         (reference VarLenHierarchicalIterator.fetchNext :99)."""
         params = self.params
-        seg = params.multisegment
-        segment_redefines = {g.name: g
-                             for g in self.copybook.get_all_segment_redefines()}
-        segment_id_redefine_map = {
-            sid: segment_redefines[name]
-            for sid, name in self.segment_redefine_map.items()
-            if name in segment_redefines}
-        parent_child_map = self.copybook.get_parent_children_segment_map()
-        root_names = {g.name for g in segment_redefines.values()
-                      if g.parent_segment is None}
+        segment_id_redefine_map, parent_child_map, root_names = \
+            self._hierarchy_maps()
         options = DecodeOptions.from_copybook(self.copybook)
         generate_input_file = bool(params.input_file_name_column)
 
@@ -472,6 +478,167 @@ class VarLenReader:
         if buffer:
             root_record_index = last_index + 1
             yield flush()
+
+    def _read_rows_hierarchical_columnar(self, stream: SimpleStream,
+                                         file_id: int, backend: str,
+                                         start_record_id: int,
+                                         starting_file_offset: int
+                                         ) -> Optional[List[List[object]]]:
+        """Hierarchical rows with batched value decode: every record's
+        fields come from ONE full-plan columnar batch (kernels, not the
+        per-field scalar walk); only the parent/child nesting assembly
+        runs per record, mirroring extract_hierarchical_record's scan
+        semantics exactly (forward scan per child segment, stop when a
+        parent id reappears, flush-trigger Record_Id). Returns None when
+        the configuration needs the generic scalar path."""
+        from .extractors import _apply_post_processing
+        from .columnar import _resolve_occurs
+
+        params = self.params
+        # every bail below happens BEFORE framing consumes the stream: the
+        # caller's scalar fallback must still be able to read it
+        if resolve_segment_id_field(params, self.copybook) is None:
+            return None
+        if params.select:
+            # the scalar oracle ignores column projection; a projected
+            # columnar decode would silently change hierarchical rows
+            return None
+        if params.start_offset:
+            # the oracle reads CHILD records at the field's plain offset,
+            # without the record start offset (extract_children /
+            # reference extractChildren) — the uniform decode_raw shift
+            # cannot reproduce that
+            return None
+        fast = self._frame_fast(stream)
+        if fast is None:
+            return None
+        data, _base, offsets, rec_lengths, segment_ids = fast
+        assert segment_ids is not None  # guaranteed by the seg-field guard
+        n = len(offsets)
+        if n == 0:
+            return []
+
+        sid_map, parent_child_map, root_names = self._hierarchy_maps()
+
+        decoder = self._decoder_for_segment("", backend)
+        batch = decoder.decode_raw(data, offsets, rec_lengths)
+        slot_map = decoder.slot_map
+        col_values: Dict[int, list] = {}
+
+        def values_of(col):
+            lst = col_values.get(col)
+            if lst is None:
+                lst = batch.column_values(col)
+                col_values[col] = lst
+            return lst
+
+        # the walk compiles once per (group, slot_path) into closures over
+        # the column value lists — per-record work is list indexing, not
+        # slot-map dict lookups per element (the hierarchical twin of
+        # ColumnarDecoder._row_maker)
+        maker_cache: Dict[tuple, object] = {}
+
+        def build_group(group, slot_path):
+            key = (id(group), slot_path)
+            maker = maker_cache.get(key)
+            if maker is not None:
+                return maker
+            parts = []  # (emit, fn) — fn(i, scan_i, span_end, pids, depend)
+            for st in group.children:
+                emit = not st.is_filler and not st.is_child_segment
+                if st.is_array:
+                    if isinstance(st, Group):
+                        elems = [build_group(st, slot_path + (k,))
+                                 for k in range(st.array_max_size)]
+                        fn = (lambda i, s, e, pd, dep, st=st, el=elems:
+                              [mk(i, s, e, pd, dep)
+                               for mk in el[:_resolve_occurs(
+                                   st, dep.get(st.depending_on))]])
+                    else:
+                        cols = [slot_map.get((id(st), slot_path + (k,)))
+                                for k in range(st.array_max_size)]
+                        lists = [None if c is None else values_of(c)
+                                 for c in cols]
+                        fn = (lambda i, s, e, pd, dep, st=st, ls=lists:
+                              [None if l is None else l[i]
+                               for l in ls[:_resolve_occurs(
+                                   st, dep.get(st.depending_on))]])
+                elif isinstance(st, Group):
+                    fn = build_group(st, slot_path)
+                else:
+                    col = slot_map.get((id(st), slot_path))
+                    if col is None:
+                        fn = lambda i, s, e, pd, dep: None
+                    elif st.is_dependee:
+                        lst = values_of(col)
+                        name = st.name
+                        def fn(i, s, e, pd, dep, lst=lst, name=name):
+                            value = lst[i]
+                            if value is not None:
+                                dep[name] = (value if isinstance(value, str)
+                                             else int(value))
+                            return value
+                    else:
+                        lst = values_of(col)
+                        fn = lambda i, s, e, pd, dep, lst=lst: lst[i]
+                parts.append((emit, fn))
+            children_groups = (tuple(parent_child_map.get(group.name, ()))
+                               if group.is_segment_redefine else ())
+
+            def maker(i, scan_i, span_end, parent_ids, depend,
+                      parts=tuple(parts), children_groups=children_groups):
+                # declaration order throughout: dependees must register
+                # before any later OCCURS resolves, emitted or not
+                fields = []
+                for emit, fn in parts:
+                    value = fn(i, scan_i, span_end, parent_ids, depend)
+                    if emit:
+                        fields.append(value)
+                for child in children_groups:
+                    fields.append(extract_children(
+                        child, scan_i + 1, span_end, parent_ids, depend))
+                return tuple(fields)
+
+            maker_cache[key] = maker
+            return maker
+
+        def extract_children(field, from_i, span_end, parent_ids, depend):
+            child_maker = build_group(field, ())
+            children = []
+            j = from_i
+            while j < span_end:
+                sid_j = segment_ids[j]
+                redefine = sid_map.get(sid_j)
+                if redefine is not None and redefine.name == field.name:
+                    children.append(child_maker(
+                        j, j, span_end, [sid_j] + parent_ids, depend))
+                elif sid_j in parent_ids:
+                    break
+                j += 1
+            return children
+
+        roots = [p for p in range(n)
+                 if (g := sid_map.get(segment_ids[p])) is not None
+                 and g.name in root_names]
+        generate_input_file = bool(params.input_file_name_column)
+        ast_roots = [r for r in self.copybook.ast.children
+                     if isinstance(r, Group) and r.parent_segment is None]
+        rows = []
+        for ri, p in enumerate(roots):
+            span_end = roots[ri + 1] if ri + 1 < len(roots) else n
+            # Record_Id parity quirk: the id of the record that TRIGGERS
+            # the flush — the next root, or one past the last record at
+            # end of stream (VarLenHierarchicalIterator.scala:99-135)
+            trigger_id = start_record_id + span_end
+            depend: Dict[str, object] = {}
+            records = [build_group(root, ())(p, p, span_end,
+                                             [segment_ids[p]], depend)
+                       for root in ast_roots]
+            rows.append(_apply_post_processing(
+                records, params.schema_policy, params.generate_record_id,
+                [], file_id, trigger_id, generate_input_file,
+                stream.input_file_name))
+        return rows
 
     # -- columnar batch path -------------------------------------------------
 
@@ -635,15 +802,27 @@ class VarLenReader:
             generate_record_id=params.generate_record_id,
             generate_input_file_field=bool(params.input_file_name_column))
         if self.copybook.is_hierarchical or self.dynamic_occurs_layout:
-            # hierarchical assembly and dynamic variable-OCCURS layouts are
-            # host-side: nesting / per-record offset shifts have no static
-            # columnar plan (reference extractHierarchicalRecord,
-            # RecordExtractors.scala:211; VarOccursRecordExtractor)
-            result.rows = list(self.iter_rows(
-                stream, file_id=file_id, start_record_id=start_record_id,
-                starting_file_offset=starting_file_offset,
-                segment_id_prefix=segment_id_prefix))
-            result.n_rows = len(result.rows)
+            # hierarchical nesting / per-record offset shifts have no
+            # static columnar plan (reference extractHierarchicalRecord,
+            # RecordExtractors.scala:211; VarOccursRecordExtractor) — but
+            # hierarchical VALUES can still come from batched kernels: the
+            # per-segment batches decode natively and only the nesting
+            # assembly walks per record
+            rows = None
+            if (self.copybook.is_hierarchical
+                    and not self.dynamic_occurs_layout
+                    and not params.variable_size_occurs):
+                rows = self._read_rows_hierarchical_columnar(
+                    stream, file_id, backend, start_record_id,
+                    starting_file_offset)
+            if rows is None:
+                rows = list(self.iter_rows(
+                    stream, file_id=file_id,
+                    start_record_id=start_record_id,
+                    starting_file_offset=starting_file_offset,
+                    segment_id_prefix=segment_id_prefix))
+            result.rows = rows
+            result.n_rows = len(rows)
             return result
         fast = self._frame_fast(stream)
         if fast is not None:
