@@ -342,11 +342,21 @@ def segment_table(batch: DecodedBatch,
     schema = output_schema.schema
 
     def seg_arrays():
+        from .result import SegLevelColumns
+
         out = []
         for lvl in range(output_schema.generate_seg_id_field_count):
-            vals = ([row[lvl] if row is not None and lvl < len(row) else None
-                     for row in seg_level_ids] if seg_level_ids is not None
-                    else [None] * n)
+            if isinstance(seg_level_ids, SegLevelColumns):
+                # per-level object column straight into Arrow (no
+                # per-row list materialization)
+                vals = (seg_level_ids.levels[lvl]
+                        if lvl < len(seg_level_ids.levels)
+                        else [None] * n)
+            elif seg_level_ids is not None:
+                vals = [row[lvl] if row is not None and lvl < len(row)
+                        else None for row in seg_level_ids]
+            else:
+                vals = [None] * n
             out.append(pa.array(vals, type=pa.string()))
         return out
 

@@ -22,6 +22,32 @@ from ..copybook.datatypes import SchemaRetentionPolicy
 from .columnar import DecodedBatch
 
 
+class SegLevelColumns:
+    """Seg_Id0..N as per-LEVEL object arrays (None = level not shown)
+    with a lazy per-row view: the Arrow path reads whole level columns,
+    the row path indexes rows — no 600k-element list-of-lists build."""
+
+    def __init__(self, levels: List[np.ndarray]):
+        self.levels = levels
+
+    def __len__(self) -> int:
+        return len(self.levels[0]) if self.levels else 0
+
+    def __bool__(self) -> bool:
+        return len(self) > 0
+
+    def __getitem__(self, i: int) -> List[object]:
+        return [lvl[i] for lvl in self.levels]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, SegLevelColumns):
+            other = [other[i] for i in range(len(other))]
+        return [self[i] for i in range(len(self))] == other
+
+    def take(self, positions: np.ndarray) -> "SegLevelColumns":
+        return SegLevelColumns([lvl[positions] for lvl in self.levels])
+
+
 @dataclass
 class SegmentBatch:
     """One decoded batch (one active segment) of a file read."""
@@ -30,7 +56,8 @@ class SegmentBatch:
     active: Optional[str]                 # active segment redefine, or None
     positions: np.ndarray                 # output position of each row
     record_ids: Optional[np.ndarray]      # Record_Id per row (None: positions)
-    seg_level_ids: Optional[List[Sequence[object]]] = None  # per-row Seg_Id
+    # per-row Seg_Id lists, or a SegLevelColumns view
+    seg_level_ids: Optional[Sequence[Sequence[object]]] = None
 
 
 @dataclass

@@ -100,6 +100,61 @@ def default_segment_id_prefix() -> str:
     return time.strftime("%Y%m%d%H%M%S")
 
 
+def _segment_level_ids_vectorized(segment_ids: Sequence[str],
+                                  level_defs: Sequence[str], prefix: str,
+                                  file_id: int, start_record_id: int):
+    """Vectorized SegmentIdAccumulator over a framed shard: Seg_Id0..N as
+    per-level columns with the exact state semantics of the per-record
+    accumulator (forward-filled current level/root, per-level counters
+    reset at roots, empty root prefix before the first root). Returns
+    (SegLevelColumns, no_match_yet_mask)."""
+    from .result import SegLevelColumns
+
+    n = len(segment_ids)
+    level_lists = [s.split(",") for s in level_defs]
+    level_count = len(level_lists)
+    sid_level = {}
+    for i, ids in enumerate(level_lists):
+        for sid in ids:
+            sid_level.setdefault(sid, i)
+    get_level = sid_level.get
+    lvl = np.fromiter((get_level(s, -1) for s in segment_ids),
+                      dtype=np.int64, count=n)
+
+    idx = np.arange(n, dtype=np.int64)
+    # forward-filled current level (last matched record's level; -1 = none)
+    last_match = np.where(lvl >= 0, idx, -1)
+    np.maximum.accumulate(last_match, out=last_match)
+    cur_level = np.where(last_match >= 0, lvl[np.maximum(last_match, 0)], -1)
+    no_match_yet = last_match < 0
+    # forward-filled root position (-1 before the first root)
+    root_pos = np.where(lvl == 0, idx, -1)
+    np.maximum.accumulate(root_pos, out=root_pos)
+    # root id strings, one per ROOT record, broadcast by rank (the [-1]
+    # rank before the first root wraps to the "" tail — the accumulator's
+    # empty pre-root prefix)
+    roots = np.nonzero(lvl == 0)[0]
+    per_root = np.array(
+        [f"{prefix}_{file_id}_{start_record_id + int(p)}" for p in roots]
+        + [""], dtype="U")
+    root_rank = np.cumsum(lvl == 0) - 1
+    root_u = per_root[root_rank]
+
+    levels: List[np.ndarray] = []
+    level0 = root_u.astype(object)
+    level0[no_match_yet] = None
+    levels.append(level0)
+    for k in range(1, level_count):
+        c = np.cumsum(lvl == k)
+        at_root = np.where(root_pos >= 0, c[np.maximum(root_pos, 0)], 0)
+        cnt_str = (c - at_root).astype("U20")
+        col = np.char.add(np.char.add(root_u, f"_L{k}_"),
+                          cnt_str).astype(object)
+        col[cur_level < k] = None
+        levels.append(col)
+    return SegLevelColumns(levels), no_match_yet
+
+
 def _has_dynamic_occurs_layout(root: Group) -> bool:
     """True when a variable-size OCCURS makes later field offsets
     record-dependent: a DEPENDING ON array followed by any other field, or
@@ -728,14 +783,10 @@ class VarLenReader:
         keep = np.ones(n, dtype=bool)
         level_ids_per_record: Optional[List[List[Optional[str]]]] = None
         if level_count and segment_ids is not None:
-            acc = SegmentIdAccumulator(seg.segment_level_ids, prefix, file_id)
-            level_ids_per_record = []
-            for i in range(n):
-                acc.acquired_segment_id(segment_ids[i], start_record_id + i)
-                ids = [acc.get_segment_level_id(lv) for lv in range(level_count)]
-                level_ids_per_record.append(ids)
-                if ids and ids[0] is None:
-                    keep[i] = False  # before the first root segment
+            level_ids_per_record, no_root = _segment_level_ids_vectorized(
+                segment_ids, seg.segment_level_ids, prefix, file_id,
+                start_record_id)
+            keep[no_root] = False  # before the first matched segment
         if segment_filter is not None and segment_ids is not None:
             keep &= np.asarray(
                 [sid in segment_filter for sid in segment_ids], dtype=bool)
@@ -771,7 +822,7 @@ class VarLenReader:
                 positions.astype(np.int64),
                 start_record_id + positions.astype(np.int64),
                 seg_level_ids=(
-                    [level_ids_per_record[int(p)] for p in positions]
+                    level_ids_per_record.take(positions)
                     if level_ids_per_record is not None else None)))
 
     def read_rows_columnar(self, stream: SimpleStream, file_id: int = 0,
