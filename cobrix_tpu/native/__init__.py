@@ -29,6 +29,7 @@ _build_failed = False
 
 MAX_RDW_RECORD_SIZE = 100 * 1024 * 1024
 
+_I32P = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
 _I64P = np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS")
 _U8P = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
 _U16P = np.ctypeslib.ndpointer(dtype=np.uint16, flags="C_CONTIGUOUS")
@@ -131,6 +132,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.transcode_string_cols_raw.argtypes = [
             _U8P, _I64P, _I64P, ctypes.c_int64, _I64P, ctypes.c_int64,
             ctypes.c_int64, _U16P, _U16P]
+        lib.transcode_string_cols_arrow.restype = None
+        lib.transcode_string_cols_arrow.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, _I64P, _I64P, ctypes.c_int64, ctypes.c_void_p,
+            _U16P, ctypes.c_int32, _I32P, _U8P, _I64P, _I64P, _I64P]
         _lib = lib
         return _lib
 
@@ -458,6 +464,91 @@ def transcode_string_cols_raw(data, rec_offsets, rec_lengths, col_offsets,
     lib.transcode_string_cols_raw(buf, offs, lens, n, cols, ncols, width,
                                   lut, out)
     return out
+
+
+TRIM_NONE = 0
+TRIM_BOTH = 1
+TRIM_LEFT = 2
+TRIM_RIGHT = 3
+
+
+def _string_cols_arrow(buf, extent_or_size, rec_offsets, rec_lengths, n,
+                       col_offsets, col_widths, lut_u16, trim_mode: int,
+                       col_masks=None):
+    lib = _load()
+    if lib is None:
+        return None
+    cols = np.ascontiguousarray(col_offsets, dtype=np.int64)
+    widths = np.ascontiguousarray(col_widths, dtype=np.int64)
+    ncols = cols.shape[0]
+    lut = np.ascontiguousarray(lut_u16, dtype=np.uint16)
+    # per-column capacity sized for all-ASCII output (the overwhelmingly
+    # common case); columns whose UTF-8 output outgrows it fall back
+    data_caps = n * widths + 16
+    data_starts = np.zeros(ncols, dtype=np.int64)
+    np.cumsum(data_caps[:-1], out=data_starts[1:])
+    total = int(data_caps.sum())
+    if ncols * (n + 1) > 2**31 - 16 or bool((data_caps > 2**31 - 16).any()):
+        return None  # int32 offsets can't address this batch
+    out_offsets = np.empty((ncols, n + 1), dtype=np.int32)
+    out_data = np.empty(total, dtype=np.uint8)
+    data_lens = np.empty(ncols, dtype=np.int64)
+    mask_ptrs_arg = None
+    if col_masks is not None and any(m is not None for m in col_masks):
+        mask_arrs = [None if m is None
+                     else np.ascontiguousarray(m, dtype=np.uint8)
+                     for m in col_masks]
+        mask_ptrs = np.asarray(
+            [0 if m is None else m.ctypes.data for m in mask_arrs],
+            dtype=np.uintp)
+        mask_ptrs_arg = mask_ptrs.ctypes.data
+    lib.transcode_string_cols_arrow(
+        buf, extent_or_size,
+        None if rec_offsets is None else rec_offsets.ctypes.data,
+        None if rec_lengths is None else rec_lengths.ctypes.data,
+        n, cols, widths, ncols, mask_ptrs_arg, lut, trim_mode,
+        out_offsets, out_data, data_starts, data_caps, data_lens)
+    result = []
+    for c in range(ncols):
+        ln = int(data_lens[c])
+        if ln < 0:
+            result.append(None)  # non-ASCII expansion outgrew the buffer
+            continue
+        start = int(data_starts[c])
+        result.append((out_offsets[c], out_data[start:start + ln].copy()))
+    return result
+
+
+def string_cols_arrow_packed(batch: np.ndarray, col_offsets, col_widths,
+                             lut_u16, trim_mode: int, col_masks=None):
+    """String columns (mixed widths) of a packed [n, extent] batch ->
+    per-column (int32 offsets [n+1], trimmed UTF-8 bytes) Arrow buffers in
+    one native transcode+trim pass. None when the library is unavailable;
+    a None entry for a column whose output outgrew the all-ASCII-sized
+    buffer. `col_masks`: optional per-column row-visibility masks (rows
+    with 0 emit empty strings without transcoding)."""
+    lib = _load()
+    if lib is None:
+        return None
+    b = np.ascontiguousarray(batch, dtype=np.uint8)
+    n, extent = b.shape
+    return _string_cols_arrow(b, extent, None, None, n, col_offsets,
+                              col_widths, lut_u16, trim_mode, col_masks)
+
+
+def string_cols_arrow_raw(data, rec_offsets, rec_lengths, col_offsets,
+                          col_widths, lut_u16, trim_mode: int,
+                          start_offset: int = 0, col_masks=None):
+    """Raw-image variant of string_cols_arrow_packed: reads framed records
+    in place; bytes past a record's end behave like zero padding."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf, offs, lens, cols = _raw_args(data, rec_offsets, rec_lengths,
+                                      col_offsets, start_offset)
+    return _string_cols_arrow(buf, buf.size, offs, lens, offs.shape[0],
+                              cols, col_widths, lut_u16, trim_mode,
+                              col_masks)
 
 
 def _raw_args(data, rec_offsets, rec_lengths, col_offsets,

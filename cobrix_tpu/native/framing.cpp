@@ -345,6 +345,102 @@ void transcode_string_cols_raw(const uint8_t* data,
   }
 }
 
+// Transcode + trim string columns straight into Arrow string-array
+// buffers: per column an int32 offsets vector [n+1] and a UTF-8 data
+// buffer — the layout pyarrow's StringArray.from_buffers consumes
+// zero-copy. Collapses the three passes the Python path pays (LUT
+// transcode to a code-point matrix, bytes copy, Arrow trim kernel) into
+// one, and UTF-8-encodes non-ASCII code points instead of falling back.
+//
+//   rec_offsets == nullptr: packed [n, extent_or_size] batch rows
+//   rec_offsets != nullptr: framed records in the raw file image; bytes
+//                           past a record's end behave like zero padding
+//                           (code point lut[0])
+//   trim_mode: 0 = none, 1 = both (Java String.trim: cp <= 0x20),
+//              2 = left (" \t"), 3 = right (" \t")
+//   col_widths: per-column byte width (mixed-width columns share the one
+//               pass over the record bytes)
+//   col_masks: per-column row-visibility masks (nullable array of nullable
+//              uint8[n] pointers): rows with mask 0 emit an empty string
+//              without transcoding — decode-once batches skip the rows a
+//              null parent struct hides anyway
+//   out_offsets: [ncols, n+1] int32; out_data: column c writes at
+//                out_data + data_starts[c], capacity data_caps[c]
+//   data_lens[c]: UTF-8 bytes written for column c, or -1 when the
+//                 capacity was too small (caller falls back per column)
+void transcode_string_cols_arrow(
+    const uint8_t* data, int64_t extent_or_size, const int64_t* rec_offsets,
+    const int64_t* rec_lengths, int64_t n, const int64_t* col_offsets,
+    const int64_t* col_widths, int64_t ncols,
+    const uint8_t* const* col_masks, const uint16_t* lut,
+    int32_t trim_mode, int32_t* out_offsets, uint8_t* out_data,
+    const int64_t* data_starts, const int64_t* data_caps,
+    int64_t* data_lens) {
+  const uint16_t pad = lut[0];
+#ifdef _OPENMP
+#pragma omp parallel for schedule(dynamic)
+#endif
+  for (int64_t c = 0; c < ncols; ++c) {
+    const int64_t col = col_offsets[c];
+    const int64_t width = col_widths[c];
+    const int64_t data_cap = data_caps[c];
+    const uint8_t* mask = col_masks ? col_masks[c] : nullptr;
+    int32_t* offs = out_offsets + c * (n + 1);
+    uint8_t* dst = out_data + data_starts[c];
+    int64_t pos = 0;
+    offs[0] = 0;
+    bool overflow = false;
+    for (int64_t r = 0; r < n; ++r) {
+      if (mask && !mask[r]) {
+        offs[r + 1] = (int32_t)pos;
+        continue;
+      }
+      const uint8_t* p;
+      int64_t avail;
+      if (rec_offsets) {
+        const int64_t len = rec_lengths[r];
+        p = data + rec_offsets[r] + col;
+        avail = col >= len ? 0 : (col + width <= len ? width : len - col);
+      } else {
+        p = data + r * extent_or_size + col;
+        avail = width;
+      }
+      // code point k of this value (zero padding past the record's end)
+      auto cp = [&](int64_t k) -> uint16_t {
+        return k < avail ? lut[p[k]] : pad;
+      };
+      int64_t s = 0, e = width;
+      if (trim_mode == 1) {
+        while (s < e && cp(s) <= 0x20) ++s;
+        while (e > s && cp(e - 1) <= 0x20) --e;
+      } else if (trim_mode == 2) {
+        while (s < e && (cp(s) == 0x20 || cp(s) == 0x09)) ++s;
+      } else if (trim_mode == 3) {
+        while (e > s && (cp(e - 1) == 0x20 || cp(e - 1) == 0x09)) --e;
+      }
+      if (pos + (e - s) * 3 > data_cap) {
+        overflow = true;
+        break;
+      }
+      for (int64_t k = s; k < e; ++k) {
+        uint16_t u = cp(k);
+        if (u < 0x80) {
+          dst[pos++] = (uint8_t)u;
+        } else if (u < 0x800) {
+          dst[pos++] = (uint8_t)(0xC0 | (u >> 6));
+          dst[pos++] = (uint8_t)(0x80 | (u & 0x3F));
+        } else {
+          dst[pos++] = (uint8_t)(0xE0 | (u >> 12));
+          dst[pos++] = (uint8_t)(0x80 | ((u >> 6) & 0x3F));
+          dst[pos++] = (uint8_t)(0x80 | (u & 0x3F));
+        }
+      }
+      offs[r + 1] = (int32_t)pos;
+    }
+    data_lens[c] = overflow ? -1 : pos;
+  }
+}
+
 // out_i32: write int32 values (halves the output traffic; callers pass 1
 // only when the declared precision fits 9 digits / int32).
 void decode_binary_cols_raw(const uint8_t* data,

@@ -132,18 +132,37 @@ def _string_from_codepoints(mat: np.ndarray, trimming: TrimPolicy):
 
 
 class ArrowBatchBuilder:
-    """Builds Arrow arrays for one DecodedBatch (one active segment)."""
+    """Builds Arrow arrays for one DecodedBatch — either a single active
+    segment (`active`), or a decode-once whole-plan batch where
+    `redefine_masks` gates each segment redefine per row via the struct
+    validity bitmap (inactive rows' decoded bytes are garbage, but a null
+    parent struct masks its children by Arrow semantics)."""
 
-    def __init__(self, batch: DecodedBatch, active: Optional[str]):
+    def __init__(self, batch: DecodedBatch, active: Optional[str],
+                 redefine_masks: Optional[dict] = None):
         self.batch = batch
         self.decoder = batch.decoder
         self.active = active
+        self.redefine_masks = redefine_masks
         self.n = batch.n_records
 
     # -- leaves ------------------------------------------------------------
 
-    def _python_fallback(self, col: int, pa_type):
+    def _relevant_of(self, spec):
+        """Row-visibility mask for a column of a decode-once batch (None =
+        visible everywhere)."""
+        if self.redefine_masks is not None and spec.segment:
+            return self.redefine_masks.get(spec.segment.upper())
+        return None
+
+    def _python_fallback(self, col: int, pa_type, relevant=None):
         pa = _pa()
+        if relevant is not None:
+            # decode-once batch: per-value decode only where the value is
+            # visible (other rows sit under a null parent struct); the
+            # column-level cache would walk every truncated row instead
+            vals = self.batch.column_values_where(col, relevant)
+            return pa.array(vals, type=pa_type)
         return pa.array(self.batch.column_values(col), type=pa_type)
 
     def _leaf_array(self, st: Primitive, slot_path):
@@ -153,21 +172,42 @@ class ArrowBatchBuilder:
         if col is None:
             return pa.nulls(self.n, type=pa_type)
         spec = self.decoder.plan.columns[col]
-        out = self.batch.column_arrays(col)
+        # rows where this column is visible: in a decode-once batch a
+        # redefine-gated column only matters where its segment is active
+        # (elsewhere the parent struct is null and the decoded bytes are
+        # garbage by design)
+        relevant = None
+        if self.redefine_masks is not None and spec.segment:
+            relevant = self.redefine_masks.get(spec.segment.upper())
         lengths = self.batch.lengths
-        if lengths is not None and bool(
-                (lengths < spec.offset + spec.width).any()):
-            # truncated variable-length tails: the scalar path owns the
-            # partial-field rules
-            return self._python_fallback(col, pa_type)
+        if lengths is not None:
+            trunc = lengths < spec.offset + spec.width
+            if relevant is not None:
+                trunc = trunc & relevant
+            if bool(trunc.any()):
+                # truncated variable-length tails: the scalar path owns
+                # the partial-field rules
+                return self._python_fallback(col, pa_type, relevant)
+        if spec.codec in _STRING_CODECS:
+            # one-pass native transcode+trim straight into Arrow buffers
+            # (no code-point matrix, no Arrow trim kernel)
+            bufs = self.batch.string_arrow_buffers(
+                spec, relevant_of=self._relevant_of)
+            if bufs is not None:
+                offsets, data = bufs
+                return pa.Array.from_buffers(
+                    pa.string(), self.n,
+                    [None, pa.py_buffer(offsets), pa.py_buffer(data)])
+        out = self.batch.column_arrays(col)
         if "host" in out:
-            return self._python_fallback(col, pa_type)
+            return self._python_fallback(col, pa_type, relevant)
         if "values_hi" in out:
             # wide uint128-limb columns: Decimal materialization owns the
-            # 128-bit sign/scale rules
-            return self._python_fallback(col, pa_type)
+            # 128-bit sign/scale rules; hidden rows must stay None — their
+            # garbage magnitudes can exceed the declared decimal precision
+            return self._python_fallback(col, pa_type, relevant)
         if spec.codec in _STRING_CODECS:
-            return self._string_array(spec, out, pa_type)
+            return self._string_array(spec, out, pa_type, relevant)
         if spec.codec in _FLOAT_CODECS:
             values = np.asarray(out["values"])
             valid = np.asarray(out["valid"])
@@ -184,30 +224,38 @@ class ArrowBatchBuilder:
         if pa.types.is_decimal(pa_type):
             if pa_type.precision > 18:
                 # int64 mantissa can't be widened safely past 18 digits
-                return self._python_fallback(col, pa_type)
+                return self._python_fallback(col, pa_type, relevant)
             mantissa = values.astype(np.int64, copy=False)
             if spec.params.explicit_decimal or _dyn_scale(spec):
                 shift = pa_type.scale - np.asarray(out["dot_scale"],
                                                    dtype=np.int64)
             else:
                 shift = pa_type.scale + fixed_point_exponent(spec)
-            if np.any(shift < 0) or np.any(shift > 18):
-                return self._python_fallback(col, pa_type)
+            shift = np.broadcast_to(np.asarray(shift), mantissa.shape)
+            if relevant is not None:
+                # garbage dot-scale planes in hidden rows must neither
+                # force the fallback nor feed negative powers below
+                shift = np.where(relevant, shift, 0)
+            if np.any((shift < 0) | (shift > 18)):
+                return self._python_fallback(col, pa_type, relevant)
             mantissa = mantissa * 10 ** shift
             return _decimal128_from_mantissa(mantissa, valid, pa_type)
-        return self._python_fallback(col, pa_type)
+        return self._python_fallback(col, pa_type, relevant)
 
-    def _string_array(self, spec, out, pa_type):
+    def _string_array(self, spec, out, pa_type, relevant=None):
         pa = _pa()
         if not self.batch._vectorizable_string(spec):
             # UTF-16 / HEX / RAW / custom charsets: per-value host decode
-            return self._python_fallback(spec.index, pa_type)
+            return self._python_fallback(spec.index, pa_type, relevant)
         mat = out["bytes"]
         if mat.ndim != 2 or mat.shape[1] == 0:
             return pa.array([""] * self.n, type=pa_type)
-        if mat.dtype == np.uint16 and bool((mat > 0x7F).any()):
+        non_ascii = mat > 0x7F
+        if relevant is not None:
+            non_ascii = non_ascii & relevant[:, None]
+        if mat.dtype == np.uint16 and bool(non_ascii.any()):
             # non-ASCII code points need real UTF-8 encoding
-            return self._python_fallback(spec.index, pa_type)
+            return self._python_fallback(spec.index, pa_type, relevant)
         return _string_from_codepoints(mat, self.decoder.plan.trimming)
 
     # -- arrays / groups ---------------------------------------------------
@@ -278,7 +326,7 @@ class ArrowBatchBuilder:
                     child.name, ArrayType(t) if child.is_array else t))
         return fields
 
-    def _struct_array(self, group: Group, slot_path):
+    def _struct_array(self, group: Group, slot_path, null_mask=None):
         pa = _pa()
         names, children = [], []
         for child in group.children:
@@ -290,7 +338,9 @@ class ArrowBatchBuilder:
             children.append(self._statement_array(child, slot_path))
         if not children:
             return pa.nulls(self.n, type=pa.struct([]))
-        return pa.StructArray.from_arrays(children, names=names)
+        return pa.StructArray.from_arrays(
+            children, names=names,
+            mask=None if null_mask is None else pa.array(null_mask))
 
     def _statement_array(self, st: Statement, slot_path,
                          as_element: bool = False):
@@ -298,11 +348,18 @@ class ArrowBatchBuilder:
         if st.is_array and not as_element:
             return self._list_array(st, slot_path)
         if isinstance(st, Group):
-            if st.is_segment_redefine and not as_element and (
-                    self.active is None
-                    or st.name.upper() != self.active.upper()):
-                t = to_arrow_type(StructType(self._group_fields(st)))
-                return pa.nulls(self.n, type=t)
+            if st.is_segment_redefine and not as_element:
+                if self.redefine_masks is not None:
+                    mask = self.redefine_masks.get(st.name.upper())
+                    if mask is None or not mask.any():
+                        t = to_arrow_type(StructType(self._group_fields(st)))
+                        return pa.nulls(self.n, type=t)
+                    return self._struct_array(st, slot_path,
+                                              null_mask=~mask)
+                if (self.active is None
+                        or st.name.upper() != self.active.upper()):
+                    t = to_arrow_type(StructType(self._group_fields(st)))
+                    return pa.nulls(self.n, type=t)
             return self._struct_array(st, slot_path)
         return self._leaf_array(st, slot_path)
 
@@ -333,11 +390,13 @@ def segment_table(batch: DecodedBatch,
                   file_id: int,
                   record_ids: Optional[np.ndarray],
                   seg_level_ids: Optional[Sequence[Sequence[object]]],
-                  input_file_name: str = ""):
-    """One Arrow table for one decoded (single-active-segment) batch, with
-    generated columns prepended per the output schema."""
+                  input_file_name: str = "",
+                  redefine_masks: Optional[dict] = None):
+    """One Arrow table for one decoded batch (single active segment, or a
+    decode-once batch with per-row redefine masks), with generated columns
+    prepended per the output schema."""
     pa = _pa()
-    builder = ArrowBatchBuilder(batch, active)
+    builder = ArrowBatchBuilder(batch, active, redefine_masks)
     n = batch.n_records
     schema = output_schema.schema
 

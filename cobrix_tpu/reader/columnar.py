@@ -142,6 +142,22 @@ def _wide_dyn_dots(hi: np.ndarray, lo: np.ndarray, sf: int) -> np.ndarray:
     return _digit_count_limbs(hi, lo).astype(np.int64) - sf
 
 
+# TrimPolicy -> native transcode+trim kernel mode (framing.cpp
+# transcode_string_cols_arrow): BOTH is Java String.trim (cp <= 0x20),
+# LEFT/RIGHT strip " \t" (scalar_decoders._trim parity)
+_NATIVE_TRIM_MODES = {TrimPolicy.NONE: 0, TrimPolicy.BOTH: 1,
+                      TrimPolicy.LEFT: 2, TrimPolicy.RIGHT: 3}
+
+
+@functools.lru_cache(maxsize=1)
+def _ascii_mask_lut() -> np.ndarray:
+    """uint16 LUT expressing ops/batch_np.mask_ascii (control chars and
+    high bytes -> space) for the native string kernel."""
+    lut = np.arange(256, dtype=np.uint16)
+    lut[(lut < 32) | (lut >= 0x80)] = 0x20
+    return lut
+
+
 class _KernelGroup:
     def __init__(self, codec: Codec, width: int, variant: tuple,
                  columns: List[ColumnSpec]):
@@ -248,7 +264,8 @@ class DecodedBatch:
 
     def __init__(self, decoder: "ColumnarDecoder", data: np.ndarray,
                  outputs: Dict[int, dict],
-                 lengths: Optional[np.ndarray] = None):
+                 lengths: Optional[np.ndarray] = None,
+                 raw_source: Optional[tuple] = None):
         self.decoder = decoder
         self.data = data
         self.n_records = data.shape[0]
@@ -256,15 +273,132 @@ class DecodedBatch:
         self._str_cache: Dict[int, List[str]] = {}
         self._col_cache: Dict[int, list] = {}
         self._maker_cache: Dict[tuple, object] = {}
+        self._arrow_str_cache: Dict[int, list] = {}
         # actual byte length of each record when shorter than the padded row
         # (variable-length files); columns past a record's end are null /
         # truncated like reference Primitive.decodeTypeValue (Primitive.scala:102)
         self.lengths = lengths
+        # (buf, rec_offsets, rec_lengths) when decoded in place from the
+        # file image — the packed `data` matrix then covers only the narrow
+        # prefix, so lazy string columns transcode from here instead
+        self.raw_source = raw_source
 
     # -- vectorized access -------------------------------------------------
 
     def column_arrays(self, col: int) -> dict:
-        return self._out[col]
+        out = self._out[col]
+        if "lazy_string" in out:
+            self._materialize_strings(out["lazy_string"][0])
+            out = self._out[col]
+        return out
+
+    def _materialize_strings(self, g: "_KernelGroup") -> None:
+        """Resolve a lazily-deferred string kernel group into the code-point
+        ("bytes") matrices the row/value paths consume. Reads never pay this
+        when the Arrow path already emitted the column natively."""
+        from .. import native
+
+        dec = self.decoder
+        if g.codec is Codec.EBCDIC_STRING:
+            if self.raw_source is not None:
+                buf, offs, lens = self.raw_source
+                chars = native.transcode_string_cols_raw(
+                    buf, offs, lens, g.offsets, g.width, dec.lut)
+            else:
+                chars = native.transcode_string_cols(
+                    self.data, g.offsets, g.width, dec.lut)
+            if chars is None:  # no native library: numpy gather + LUT
+                slab = self._gather_slab(g)
+                chars = batch_np.transcode_ebcdic(slab, dec.lut)
+            for pos, c in enumerate(g.columns):
+                self._out[c.index] = {"bytes": chars[:, pos]}
+        else:  # ASCII
+            slab = self._gather_slab(g)
+            masked = batch_np.mask_ascii(slab)
+            for pos, c in enumerate(g.columns):
+                self._out[c.index] = {"bytes": masked[:, pos]}
+
+    def _gather_slab(self, g: "_KernelGroup") -> np.ndarray:
+        """[n, ncols, width] byte slab for a group, from the packed batch or
+        the raw file image."""
+        if self.raw_source is not None:
+            from .. import native
+
+            buf, offs, lens = self.raw_source
+            extent = int(g.offsets.max()) + g.width
+            if self.data.shape[1] >= extent:
+                src = self.data
+            else:
+                src = native.pack_records(buf, offs, lens, extent)
+            return src[:, g.offsets[:, None] + np.arange(g.width)[None, :]]
+        return self.data[:, g.offsets[:, None] + np.arange(g.width)[None, :]]
+
+    def string_arrow_buffers(self, spec: ColumnSpec, relevant_of=None):
+        """(int32 offsets [n+1], trimmed UTF-8 bytes) Arrow buffers for a
+        lazily-deferred string column via the native one-pass transcode+trim
+        kernel. None when the column is not in the lazy state (already
+        materialized, jax backend, host fallback) or the library/charset
+        can't express it — callers fall back to the code-point path.
+        `relevant_of(spec)`: optional per-column row-visibility masks
+        (decode-once batches skip rows hidden by a null parent struct)."""
+        from .. import native
+
+        out = self._out.get(spec.index)
+        if out is None or "lazy_string" not in out or not native.available():
+            return None
+        g, pos = out["lazy_string"]
+        cached = self._arrow_str_cache.get(id(g))
+        if cached is None:
+            self._build_arrow_strings(g.codec, relevant_of)
+            cached = self._arrow_str_cache.get(id(g))
+            if cached is None:
+                return None
+        return cached[pos]
+
+    def _build_arrow_strings(self, codec: Codec, relevant_of=None) -> None:
+        """Every lazily-deferred group of one string codec through ONE
+        native transcode+trim pass — mixed-width columns share the walk
+        over the record bytes."""
+        from .. import native
+
+        dec = self.decoder
+        seen: Dict[int, "_KernelGroup"] = {}
+        for col_out in self._out.values():
+            lz = col_out.get("lazy_string")
+            if lz is not None and lz[0].codec is codec:
+                if id(lz[0]) not in seen:
+                    seen[id(lz[0])] = lz[0]
+        if not seen:
+            return
+        gs = list(seen.values())
+        col_offs = np.concatenate([g.offsets for g in gs])
+        widths = np.concatenate(
+            [np.full(len(g.offsets), g.width, dtype=np.int64) for g in gs])
+        masks = None
+        if relevant_of is not None:
+            masks = [relevant_of(c) for g in gs for c in g.columns]
+            if all(m is None for m in masks):
+                masks = None
+        trim_mode = _NATIVE_TRIM_MODES.get(dec.plan.trimming)
+        res = None
+        if trim_mode is not None:
+            lut = (dec.lut if codec is Codec.EBCDIC_STRING
+                   else _ascii_mask_lut())
+            if self.raw_source is not None:
+                buf, offs, lens = self.raw_source
+                res = native.string_cols_arrow_raw(
+                    buf, offs, lens, col_offs, widths, lut, trim_mode,
+                    col_masks=masks)
+            else:
+                res = native.string_cols_arrow_packed(
+                    self.data, col_offs, widths, lut, trim_mode,
+                    col_masks=masks)
+        if res is None:
+            res = [None] * len(col_offs)
+        i = 0
+        for g in gs:
+            self._arrow_str_cache[id(g)] = res[i:i + len(g.offsets)]
+            i += len(g.offsets)
 
     # -- scalar access (row materialization / parity) ----------------------
 
@@ -272,7 +406,7 @@ class DecodedBatch:
         """Python value for column `col`, record `i` — same semantics as the
         scalar oracle (None for nulls)."""
         spec = self.decoder.plan.columns[col]
-        out = self._out[col]
+        out = self.column_arrays(col)
         if self.lengths is not None:
             length = int(self.lengths[i])
             if spec.codec in _STRING_CODECS:
@@ -365,6 +499,16 @@ class DecodedBatch:
             text = np.ascontiguousarray(arr).tobytes().decode("latin-1")
         return [_trim(text[i * w:(i + 1) * w], trimming) for i in range(n)]
 
+    def column_values_where(self, col: int, mask) -> list:
+        """Values at rows where `mask`; None elsewhere. Used by decode-once
+        batches whose other rows are hidden by a null parent struct — the
+        cached whole-column path would pay truncation fixups for rows
+        nobody can see."""
+        out: list = [None] * self.n_records
+        for i in np.nonzero(mask)[0]:
+            out[int(i)] = self.value(col, int(i))
+        return out
+
     def column_values(self, col: int) -> list:
         """Whole column as a Python value list (the vectorized form of
         `value` — same null/decimal semantics, one pass per column instead
@@ -373,7 +517,7 @@ class DecodedBatch:
         if lst is not None:
             return lst
         spec = self.decoder.plan.columns[col]
-        out = self._out[col]
+        out = self.column_arrays(col)
         n = self.n_records
         if "host" in out:
             lst = list(out["host"])
@@ -465,35 +609,27 @@ class DecodedBatch:
         (used when a batch holds non-contiguous records, e.g. one segment
         of a multisegment file). `handler`: the RecordHandler seam — group
         records materialize through handler.create instead of tuples."""
-        uniform_active: Optional[str] = None
-        use_maker = active_segments is None or (
-            len(set(active_segments)) <= 1)
-        if use_maker and active_segments is not None and active_segments:
-            uniform_active = active_segments[0]
-        maker = (self._row_maker(uniform_active, policy, handler)
-                 if use_maker else None)
+        # one compiled maker per DISTINCT active segment; mixed-active
+        # batches (decode-once) dispatch per row
+        if active_segments is None or not len(active_segments):
+            makers = {None: self._row_maker(None, policy, handler)}
+            actives = None
+        else:
+            distinct = (set(active_segments.uniq)
+                        if hasattr(active_segments, "uniq")
+                        else set(active_segments))
+            makers = {a: self._row_maker(a or None, policy, handler)
+                      for a in distinct}
+            actives = active_segments if len(makers) > 1 else None
+            if actives is None:
+                makers = {None: makers[next(iter(distinct))]}
 
         rows = []
+        the_maker = makers.get(None)
         for i in range(self.n_records):
-            if maker is not None:
-                body = maker(i)
-            else:
-                active = (active_segments[i]
-                          if active_segments is not None else None)
-                records = []
-                for root in self.decoder.copybook.ast.children:
-                    if isinstance(root, Group):
-                        rec = self._group_value(root, (), i, active)
-                        if handler is not None:
-                            rec = _rebuild_with_handler(rec, root, handler)
-                        records.append(rec)
-                if policy is SchemaRetentionPolicy.COLLAPSE_ROOT:
-                    body = []
-                    for rec in records:
-                        body.extend(handler.to_seq(rec)
-                                    if handler is not None else rec)
-                else:
-                    body = records
+            maker = (the_maker if actives is None
+                     else makers[actives[i]])
+            body = maker(i)
             seg = list(segment_level_ids[i]) if segment_level_ids else []
             rid = (record_ids[i] if record_ids is not None
                    else first_record_id + i)
@@ -589,47 +725,6 @@ class DecodedBatch:
             return lambda i: None
         values = self.column_values(col)
         return values.__getitem__
-
-    def _occurs_count(self, st: Statement, i: int) -> int:
-        if st.depending_on is None:
-            return st.array_max_size
-        dep_col = self.decoder.dependee_columns.get(st.depending_on)
-        if dep_col is None:
-            return st.array_max_size
-        return _resolve_occurs(st, self.value(dep_col, i))
-
-    def _group_value(self, group: Group, slot_path: Tuple[int, ...], i: int,
-                     active: Optional[str]) -> tuple:
-        fields = []
-        for st in group.children:
-            if st.is_array:
-                count = self._occurs_count(st, i)
-                items = []
-                for k in range(count):
-                    if isinstance(st, Group):
-                        items.append(self._group_value(st, slot_path + (k,), i,
-                                                       active))
-                    else:
-                        items.append(self._prim_value(st, slot_path + (k,), i))
-                value: object = items
-            elif isinstance(st, Group):
-                if st.is_segment_redefine and (
-                        active is None or st.name.upper() != active.upper()):
-                    value = None
-                else:
-                    value = self._group_value(st, slot_path, i, active)
-            else:
-                value = self._prim_value(st, slot_path, i)
-            if not st.is_filler:
-                fields.append(value)
-        return tuple(fields)
-
-    def _prim_value(self, st: Primitive, slot_path: Tuple[int, ...], i: int):
-        col = self.decoder.slot_map.get((id(st), slot_path))
-        if col is None:
-            return None
-        return self.column_values(col)[i]
-
 
 _decoder_build_lock = threading.Lock()
 
@@ -769,20 +864,20 @@ class ColumnarDecoder:
                     buf, offs, rec_lengths, g.offsets, g.width,
                     fits32=fits32)
             elif g.codec is Codec.EBCDIC_STRING:
-                chars = native.transcode_string_cols_raw(
-                    buf, offs, rec_lengths, g.offsets, g.width, self.lut)
-                if chars is not None:
-                    for pos, c in enumerate(g.columns):
-                        outputs[c.index] = {"bytes": chars[:, pos]}
-                    if len(g.columns):
-                        # truncated varchar tails re-decode through the
-                        # packed batch (DecodedBatch.value); keep the pack
-                        # covering this group's bytes when any record is
-                        # short of them
-                        g_end = int(g.offsets.max()) + g.width
-                        if bool((rec_lengths < g_end).any()):
-                            narrow_extent = max(narrow_extent, g_end)
-                    continue
+                # deferred: the Arrow path emits these columns straight from
+                # the raw image through the native transcode+trim kernel;
+                # the row path materializes the code-point matrix on demand
+                for pos, c in enumerate(g.columns):
+                    outputs[c.index] = {"lazy_string": (g, pos)}
+                if len(g.columns):
+                    # truncated varchar tails re-decode through the
+                    # packed batch (DecodedBatch.value); keep the pack
+                    # covering this group's bytes when any record is
+                    # short of them
+                    g_end = int(g.offsets.max()) + g.width
+                    if bool((rec_lengths < g_end).any()):
+                        narrow_extent = max(narrow_extent, g_end)
+                continue
             if res is not None:
                 self._store_numeric(g, outputs, *res)
                 continue
@@ -794,7 +889,8 @@ class ColumnarDecoder:
         batch = native.pack_records(buf, offs, rec_lengths, narrow_extent)
         self._run_groups(narrow_groups, batch, outputs)
         self._decode_host_fallback(batch, outputs)
-        return DecodedBatch(self, batch, outputs, lengths=lengths)
+        return DecodedBatch(self, batch, outputs, lengths=lengths,
+                            raw_source=(buf, offs, rec_lengths))
 
     @staticmethod
     def _bucket_size(n: int) -> int:
@@ -818,6 +914,14 @@ class ColumnarDecoder:
         available, else gather + vectorized numpy) over a packed batch."""
         for g in groups:
             if g.codec is Codec.HOST_FALLBACK:
+                continue
+            if g.codec is Codec.EBCDIC_STRING or (
+                    g.codec is Codec.ASCII_STRING
+                    and not self.non_standard_ascii_charset):
+                # deferred (see decode_raw): Arrow emits these natively,
+                # rows materialize the code-point matrix on first touch
+                for pos, c in enumerate(g.columns):
+                    outputs[c.index] = {"lazy_string": (g, pos)}
                 continue
             if self._run_group_native(g, arr, outputs):
                 continue
@@ -875,14 +979,6 @@ class ColumnarDecoder:
             if res is None:
                 return False
             self._store_numeric(g, outputs, *res)
-            return True
-        if g.codec is Codec.EBCDIC_STRING:
-            chars = native.transcode_string_cols(arr, g.offsets, g.width,
-                                                 self.lut)
-            if chars is None:
-                return False
-            for pos, c in enumerate(g.columns):
-                outputs[c.index] = {"bytes": chars[:, pos]}
             return True
         return False
 

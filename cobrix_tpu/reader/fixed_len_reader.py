@@ -177,9 +177,9 @@ class FixedLenReader:
                                    active, backend,
                                    select=self.params.select)
 
-    def _segment_values(self, matrix: np.ndarray) -> List[str]:
-        """Per-record segment-id strings (shared unique-pattern decode with
-        the variable-length reader)."""
+    def _segment_values(self, matrix: np.ndarray):
+        """Per-record segment ids, dictionary-coded (shared unique-pattern
+        decode with the variable-length reader)."""
         seg_field = resolve_segment_id_field(self.params, self.copybook)
         start = self.params.start_offset
         off = start + seg_field.binary_properties.offset
@@ -194,15 +194,14 @@ class FixedLenReader:
         self.check_binary_data_validity(len(data), ignore_file_size)
         matrix = self.to_record_matrix(data, ignore_file_size)
         segment_ids = self._segment_values(matrix)
-
-        actives = np.asarray(
-            [self.segment_redefine_map.get(s, "") for s in segment_ids],
-            dtype=object)
+        active_of_uniq = segment_ids.map_uniq(self.segment_redefine_map)
 
         trimmed, width = self._trimmed_matrix(matrix)
         result.n_rows = matrix.shape[0]
-        for active in set(actives.tolist()):
-            positions = np.nonzero(actives == active)[0].astype(np.int64)
+        for active in set(active_of_uniq):
+            ks = [k for k, a in enumerate(active_of_uniq) if a == active]
+            positions = np.nonzero(
+                np.isin(segment_ids.codes, ks))[0].astype(np.int64)
             decoder = self._decoder_for_segment(active, backend)
             lengths = (np.full(len(positions), width, dtype=np.int64)
                        if width < self.copybook.record_size else None)

@@ -50,7 +50,10 @@ class SegLevelColumns:
 
 @dataclass
 class SegmentBatch:
-    """One decoded batch (one active segment) of a file read."""
+    """One decoded batch of a file read: either one active segment
+    (`active` set), or a decode-once batch over every record with
+    per-row segment routing (`redefine_masks`/`row_actives` set) — the
+    shape that skips the interleave gather entirely."""
 
     batch: DecodedBatch
     active: Optional[str]                 # active segment redefine, or None
@@ -58,6 +61,10 @@ class SegmentBatch:
     record_ids: Optional[np.ndarray]      # Record_Id per row (None: positions)
     # per-row Seg_Id lists, or a SegLevelColumns view
     seg_level_ids: Optional[Sequence[Sequence[object]]] = None
+    # decode-once (whole-plan) batches: per-redefine boolean row masks
+    # (struct validity) and the per-row active redefine names
+    redefine_masks: Optional[dict] = None
+    row_actives: Optional[Sequence[Optional[str]]] = None
 
 
 @dataclass
@@ -94,7 +101,9 @@ class FileResult:
                 generate_input_file_field=self.generate_input_file_field,
                 input_file_name=self.input_file_name,
                 segment_level_ids=seg.seg_level_ids,
-                active_segments=[seg.active] * n)
+                active_segments=(seg.row_actives
+                                 if seg.row_actives is not None
+                                 else [seg.active] * n))
             keyed.extend(zip((int(p) for p in seg.positions), seg_rows))
         keyed.sort(key=lambda t: t[0])  # positions are sparse order keys
         self.rows = [r for _, r in keyed]
@@ -122,12 +131,15 @@ class FileResult:
                 file_id=self.file_id,
                 record_ids=np.asarray(record_ids, dtype=np.int64),
                 seg_level_ids=seg.seg_level_ids,
-                input_file_name=self.input_file_name))
+                input_file_name=self.input_file_name,
+                redefine_masks=seg.redefine_masks))
             order.append(np.asarray(seg.positions, dtype=np.int64))
         if len(tables) == 1:
             table = tables[0]
             pos = order[0]
-            if np.array_equal(pos, np.arange(len(pos))):
+            # ascending positions (all-records decode-once batches, or a
+            # filtered subset) are already in record order — no gather
+            if len(pos) == 0 or bool(np.all(np.diff(pos) > 0)):
                 return table
             return table.take(_record_order_indices(pos))
         table = pa.concat_tables(tables)

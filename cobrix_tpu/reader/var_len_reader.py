@@ -51,6 +51,7 @@ from .raw_extractors import (
 )
 from .stream import SimpleStream
 from .vrl_reader import (
+    SegmentIds,
     VRLRecordReader,
     decode_segment_id_bytes,
     resolve_segment_id_field,
@@ -117,9 +118,16 @@ def _segment_level_ids_vectorized(segment_ids: Sequence[str],
     for i, ids in enumerate(level_lists):
         for sid in ids:
             sid_level.setdefault(sid, i)
-    get_level = sid_level.get
-    lvl = np.fromiter((get_level(s, -1) for s in segment_ids),
-                      dtype=np.int64, count=n)
+    if isinstance(segment_ids, SegmentIds):
+        # one level lookup per DISTINCT id, broadcast by the codes
+        lvl_uniq = np.asarray([sid_level.get(u, -1)
+                               for u in segment_ids.uniq], dtype=np.int64)
+        lvl = (lvl_uniq[segment_ids.codes] if len(lvl_uniq)
+               else np.full(n, -1, dtype=np.int64))
+    else:
+        get_level = sid_level.get
+        lvl = np.fromiter((get_level(s, -1) for s in segment_ids),
+                          dtype=np.int64, count=n)
 
     idx = np.arange(n, dtype=np.int64)
     # forward-filled current level (last matched record's level; -1 = none)
@@ -359,8 +367,7 @@ class VarLenReader:
             root_ids = set(root_segment_id.split(","))
             sids = self._segment_ids_vectorized(data, offsets, lengths,
                                                 seg_field)
-            root_indices = np.nonzero(
-                np.asarray([s in root_ids for s in sids], dtype=bool))[0]
+            root_indices = np.nonzero(sids.mask_of(root_ids))[0]
 
         def next_root(i: int) -> Optional[int]:
             if root_indices is None:
@@ -569,6 +576,9 @@ class VarLenReader:
             return None
         data, _base, offsets, rec_lengths, segment_ids = fast
         assert segment_ids is not None  # guaranteed by the seg-field guard
+        # the nesting walk indexes ids per record; a plain list beats the
+        # coded sequence's __getitem__ there
+        segment_ids = segment_ids.tolist()
         n = len(offsets)
         if n == 0:
             return []
@@ -746,10 +756,10 @@ class VarLenReader:
         return data, base, offsets, lengths, segment_ids
 
     def _segment_ids_vectorized(self, data, offsets, lengths,
-                                seg_field: Primitive) -> List[str]:
-        """Per-record segment-id strings: gather just the id field's bytes,
-        decode each *unique* byte pattern once (the scalar oracle), then
-        broadcast — the columnar analogue of getSegmentId per record."""
+                                seg_field: Primitive) -> SegmentIds:
+        """Per-record segment ids (dictionary-coded): gather just the id
+        field's bytes, decode each *unique* byte pattern once (the scalar
+        oracle) — the columnar analogue of getSegmentId per record."""
         from .. import native
 
         start = self.params.start_offset
@@ -764,7 +774,7 @@ class VarLenReader:
         for i in np.nonzero(short)[0]:
             chunk = bytes(packed[i, start + seg_off: int(lengths[i])])
             value = options.decode(seg_field.dtype, chunk)
-            out[i] = "" if value is None else str(value).strip()
+            out.replace_at(int(i), "" if value is None else str(value).strip())
         return out
 
     def _read_result_fast(self, result: "FileResult", data, base: int,
@@ -788,30 +798,63 @@ class VarLenReader:
                 start_record_id)
             keep[no_root] = False  # before the first matched segment
         if segment_filter is not None and segment_ids is not None:
-            keep &= np.asarray(
-                [sid in segment_filter for sid in segment_ids], dtype=bool)
+            keep &= segment_ids.mask_of(segment_filter)
 
-        # map segment ids -> active redefines per UNIQUE id (a per-record
-        # dict lookup costs more than the whole numeric decode on narrow
-        # profiles); same-active ids merge into one sorted position set
+        start = params.start_offset
+        kept = np.nonzero(keep)[0]
+        result.n_rows = len(kept)
+
+        # Decode ONCE over every kept record with the full (all-redefines)
+        # plan: redefines share byte offsets, so inactive rows decode
+        # garbage that a per-redefine struct-validity mask hides — and the
+        # per-segment split + interleave gather disappears entirely. The
+        # split path remains for size-skewed profiles (e.g. exp3's 16KB 'C'
+        # vs 64B 'P' records), where running the wide plan's column checks
+        # over every narrow record would dominate.
+        if segment_ids is not None and self.segment_redefine_map:
+            full = self._decoder_for_segment("", backend)
+            extent = full.plan.max_extent
+            size_skewed = (extent > 512
+                           and float((lengths < extent // 4).mean()) > 0.5)
+            if not size_skewed:
+                decoded = full.decode_raw(
+                    data, offsets[kept], lengths[kept], start_offset=start)
+                active_of_uniq = segment_ids.map_uniq(
+                    self.segment_redefine_map)
+                distinct = sorted(set(active_of_uniq))
+                a_idx = {a: j for j, a in enumerate(distinct)}
+                per_uniq = np.asarray([a_idx[a] for a in active_of_uniq],
+                                      dtype=np.int32)
+                row_act = per_uniq[segment_ids.codes[kept]]
+                masks = {a.upper(): row_act == j
+                         for a, j in a_idx.items() if a}
+                kept64 = kept.astype(np.int64)
+                result.segments.append(SegmentBatch(
+                    decoded, None, kept64, start_record_id + kept64,
+                    seg_level_ids=(
+                        level_ids_per_record
+                        if level_ids_per_record is not None
+                        and len(kept) == n
+                        else level_ids_per_record.take(kept)
+                        if level_ids_per_record is not None else None),
+                    redefine_masks=masks,
+                    row_actives=SegmentIds(row_act, distinct)))
+                return
+
+        # per-active-segment split: map segment ids -> active redefines per
+        # UNIQUE id; same-active ids merge into one integer-code mask
         by_segment: Dict[str, np.ndarray] = {}
         if segment_ids is None:
-            by_segment[""] = np.nonzero(keep)[0]
+            by_segment[""] = kept
         else:
-            sid_arr = np.asarray(segment_ids, dtype=object)
-            by_active_mask: Dict[str, np.ndarray] = {}
-            for sid in set(segment_ids):
-                active = self.segment_redefine_map.get(sid, "")
-                mask = sid_arr == sid
-                prev = by_active_mask.get(active)
-                by_active_mask[active] = mask if prev is None else prev | mask
-            for active, mask in by_active_mask.items():
+            active_of_uniq = segment_ids.map_uniq(self.segment_redefine_map)
+            for active in set(active_of_uniq):
+                ks = [k for k, a in enumerate(active_of_uniq) if a == active]
+                mask = np.isin(segment_ids.codes, ks)
                 positions = np.nonzero(keep & mask)[0]
                 if positions.size:
                     by_segment[active] = positions
 
-        start = params.start_offset
-        result.n_rows = int(keep.sum())
         for active, positions in by_segment.items():
             decoder = self._decoder_for_segment(active, backend)
             decoded = decoder.decode_raw(

@@ -281,3 +281,44 @@ def test_generate_record_id_drops_trailing_partial_record():
         res = read_cobol(path, copybook_contents=copybook,
                          generate_record_id="true")
         assert [r[2:] for r in res.to_rows()] == [[("ABCD",)], [("EFGH",)]]
+
+
+def _rdw_rec(payload: bytes) -> bytes:
+    """Little-endian RDW header + payload (is_rdw_big_endian default
+    false). Distinct from `_rdw(n)` above, which builds the header only."""
+    n = len(payload)
+    return bytes([0, 0, n & 0xFF, n >> 8]) + payload
+
+
+def test_decode_once_wide_decimal_garbage_rows_stay_null():
+    """Decode-once multisegment batches decode every record through the
+    full (all-redefines) plan; rows of OTHER segments produce garbage at a
+    redefine's offsets. A wide (precision>18) decimal column must keep
+    those hidden rows as None in the Arrow fallback — review finding: the
+    values_hi fallback dropped the relevance mask and pa.array raised
+    ArrowInvalid when a garbage magnitude outran decimal128(38)."""
+    copybook = """
+       01 R.
+          05 SEG-ID      PIC X(1).
+          05 A-SEG.
+             10 WIDE     PIC S9(38) COMP.
+          05 B-SEG REDEFINES A-SEG.
+             10 TXT      PIC X(16).
+    """
+    a_payload = ebcdic_encode("A") + (10**37).to_bytes(16, "big", signed=True)
+    # 0xFF bytes form a negative/huge 128-bit pattern beyond 38 digits
+    b_payload = ebcdic_encode("B") + b"\x7f" + b"\xff" * 15
+    raw = _rdw_rec(a_payload) + _rdw_rec(b_payload)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "wide.bin", raw)
+        res = read_cobol(path, copybook_contents=copybook,
+                         is_record_sequence="true",
+                         segment_field="SEG-ID",
+                         redefine_segment_id_map="A-SEG => A",
+                         **{"redefine_segment_id_map:1": "B-SEG => B"})
+        tbl = res.to_arrow()
+        col = tbl.column("R").to_pylist()
+        assert col[0]["A_SEG"]["WIDE"] == 10**37
+        assert col[0]["B_SEG"] is None
+        assert col[1]["A_SEG"] is None
+        assert col[1]["B_SEG"]["TXT"] is not None
