@@ -132,6 +132,11 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.transcode_string_cols_raw.argtypes = [
             _U8P, _I64P, _I64P, ctypes.c_int64, _I64P, ctypes.c_int64,
             ctypes.c_int64, _U16P, _U16P]
+        lib.format_seg_id_level.restype = None
+        lib.format_seg_id_level.argtypes = [
+            _I64P, ctypes.c_void_p, ctypes.c_int64, _U8P, ctypes.c_int64,
+            ctypes.c_int32, _U8P, _I32P, _U8P, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
         lib.transcode_string_cols_arrow.restype = None
         lib.transcode_string_cols_arrow.argtypes = [
             _U8P, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
@@ -464,6 +469,36 @@ def transcode_string_cols_raw(data, rec_offsets, rec_lengths, col_offsets,
     lib.transcode_string_cols_raw(buf, offs, lens, n, cols, ncols, width,
                                   lut, out)
     return out
+
+
+def format_seg_id_level(root_rid, counter, prefix: str, level: int, valid):
+    """One Seg_Id level column as Arrow string buffers: (int32 offsets
+    [n+1], UTF-8 data). `root_rid`: current root's record index per row;
+    `counter`: child counter per row (None for level 0); `valid`: rows
+    shown (others emit empty — the caller nulls them via the validity
+    bitmap). None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    rid = np.ascontiguousarray(root_rid, dtype=np.int64)
+    n = rid.shape[0]
+    cnt = (None if counter is None
+           else np.ascontiguousarray(counter, dtype=np.int64))
+    pref = np.frombuffer(prefix.encode("utf-8"), dtype=np.uint8)
+    pref = np.ascontiguousarray(pref)
+    ok = np.ascontiguousarray(valid, dtype=np.uint8)
+    per_row = len(pref) + 21 + (0 if cnt is None else 25)
+    data_cap = n * per_row + 16
+    if n + 1 > 2**31 - 16 or data_cap > 2**31 - 16:
+        return None
+    out_offsets = np.empty(n + 1, dtype=np.int32)
+    out_data = np.empty(data_cap, dtype=np.uint8)
+    out_len = ctypes.c_int64(0)
+    lib.format_seg_id_level(
+        rid, None if cnt is None else cnt.ctypes.data, n, pref, len(pref),
+        int(level), ok, out_offsets, out_data, data_cap,
+        ctypes.byref(out_len))
+    return out_offsets, out_data[:out_len.value].copy()
 
 
 TRIM_NONE = 0

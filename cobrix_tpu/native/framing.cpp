@@ -13,6 +13,7 @@
 // Exposed via a plain C ABI for ctypes binding (no pybind11 in the image).
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 
 #ifdef _OPENMP
@@ -419,8 +420,18 @@ void transcode_string_cols_arrow(
         while (e > s && (cp(e - 1) == 0x20 || cp(e - 1) == 0x09)) --e;
       }
       if (pos + (e - s) * 3 > data_cap) {
-        overflow = true;
-        break;
+        // the 3x bound is conservative; count the exact UTF-8 size
+        // before declaring overflow (all-ASCII full-width values fit
+        // the caller's n*width cap exactly)
+        int64_t need = 0;
+        for (int64_t k = s; k < e; ++k) {
+          uint16_t u = cp(k);
+          need += u < 0x80 ? 1 : (u < 0x800 ? 2 : 3);
+        }
+        if (pos + need > data_cap) {
+          overflow = true;
+          break;
+        }
       }
       for (int64_t k = s; k < e; ++k) {
         uint16_t u = cp(k);
@@ -439,6 +450,88 @@ void transcode_string_cols_arrow(
     }
     data_lens[c] = overflow ? -1 : pos;
   }
+}
+
+// Format one Seg_Id level column straight into Arrow string buffers
+// (reference SegmentIdAccumulator.scala:19-86 value shapes: root rows
+// "prefix_fileId_rootRecordIndex", child level k rows "<root>_Lk_<count>").
+//   root_rid: per-row record index of the current root (-1 = none yet)
+//   counter:  per-row child counter (nullptr for level 0)
+//   valid:    per-row visibility (0 -> empty string; the Python side turns
+//             these into nulls via the validity bitmap)
+//   prefix:   preformatted "prefix_fileId_" bytes
+//   level:    0 for the root column, k >= 1 for "_Lk_" child columns
+// Rows repeat the previous value unless their root/counter changed, so the
+// formatter memoizes the last formatted tail.
+static inline int64_t fmt_i64(char* dst, int64_t v) {
+  if (v < 0) {
+    dst[0] = '-';
+    return 1 + fmt_i64(dst + 1, -v);
+  }
+  char buf[20];
+  int k = 0;
+  do {
+    buf[k++] = (char)('0' + (v % 10));
+    v /= 10;
+  } while (v);
+  for (int i = 0; i < k; ++i) dst[i] = buf[k - 1 - i];
+  return k;
+}
+
+void format_seg_id_level(const int64_t* root_rid, const int64_t* counter,
+                         int64_t n, const uint8_t* prefix,
+                         int64_t prefix_len, int32_t level,
+                         const uint8_t* valid, int32_t* out_offsets,
+                         uint8_t* out_data, int64_t data_cap,
+                         int64_t* out_len) {
+  char infix[26];
+  int64_t infix_len = 0;
+  if (counter) {
+    infix[infix_len++] = '_';
+    infix[infix_len++] = 'L';
+    infix_len += fmt_i64(infix + infix_len, level);
+    infix[infix_len++] = '_';
+  }
+  char tail[96];
+  int64_t tail_len = 0;
+  int64_t last_rid = -2, last_cnt = -2;
+  int64_t pos = 0;
+  out_offsets[0] = 0;
+  for (int64_t r = 0; r < n; ++r) {
+    if (!valid[r]) {
+      out_offsets[r + 1] = (int32_t)pos;
+      continue;
+    }
+    const int64_t rid = root_rid[r];
+    if (rid != last_rid || (counter && counter[r] != last_cnt)) {
+      last_rid = rid;
+      tail_len = 0;
+      if (rid >= 0) {
+        tail_len += fmt_i64(tail, rid);
+      }
+      // rid < 0: a child id arrived before any root — the accumulator's
+      // root prefix is the empty string (SegmentIdAccumulator semantics)
+      if (counter) {
+        last_cnt = counter[r];
+        std::memcpy(tail + tail_len, infix, infix_len);
+        tail_len += infix_len;
+        tail_len += fmt_i64(tail + tail_len, last_cnt);
+      }
+    }
+    const int64_t pre = rid >= 0 ? prefix_len : 0;
+    if (pos + pre + tail_len > data_cap) {  // cannot happen with
+      out_offsets[r + 1] = (int32_t)pos;    // caller-sized caps, but
+      continue;                             // never overrun
+    }
+    if (pre) {
+      std::memcpy(out_data + pos, prefix, pre);
+      pos += pre;
+    }
+    std::memcpy(out_data + pos, tail, tail_len);
+    pos += tail_len;
+    out_offsets[r + 1] = (int32_t)pos;
+  }
+  *out_len = pos;
 }
 
 // out_i32: write int32 values (halves the output traffic; callers pass 1

@@ -44,10 +44,16 @@ class DeviceAggregator:
     def __init__(self, copybook: Copybook,
                  columns: Optional[Sequence[str]] = None,
                  active_segment: Optional[str] = None,
-                 mesh=None):
+                 mesh=None, pack_bytes: bool = True):
         self.decoder = ShardedColumnarDecoder(
             copybook, mesh=mesh, active_segment=active_segment,
             select=columns)
+        # byte width a [n, extent] record matrix must have BEFORE byte
+        # projection (plan.max_extent shrinks when projection remaps)
+        self.record_extent = self.decoder.plan.max_extent
+        self.gather_index: Optional[np.ndarray] = None
+        if pack_bytes:
+            self._build_byte_projection()
         self._agg_fn = None
         # field name -> [(group index, positions within the group)]; one
         # entry PER GROUP, not per column — the traced program reduces a
@@ -61,6 +67,44 @@ class DeviceAggregator:
                 per_field.setdefault(c.name, {}).setdefault(gi, []).append(pos)
         self.fields = {name: [(gi, tuple(ps)) for gi, ps in by_group.items()]
                        for name, by_group in per_field.items()}
+
+    def _build_byte_projection(self):
+        """Host-side byte projection: rewrite the plan's column offsets
+        into a compacted layout covering only the byte ranges the query
+        reads, so `put` transfers just those bytes. On a link-bound remote
+        device the H2D rate scales directly with the projection ratio —
+        the physical payoff of `select` (plan/compiler.py) that the
+        reference's prune-free scan cannot express
+        (CobolScanners.scala:38-55)."""
+        import bisect
+
+        cols = self.decoder.plan.columns
+        if not cols:
+            return
+        full_extent = self.record_extent
+        ranges = sorted({(c.offset, c.width) for c in cols})
+        merged: List[List[int]] = []
+        for o, w in ranges:
+            if merged and o <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], o + w)
+            else:
+                merged.append([o, o + w])
+        total = sum(e - s for s, e in merged)
+        if total >= full_extent * 0.9:
+            return  # dense plan: the gather would cost more than it saves
+        starts = [s for s, _ in merged]
+        packed_start = {}
+        pos = 0
+        for s, e in merged:
+            packed_start[s] = pos
+            pos += e - s
+        for c in cols:
+            j = bisect.bisect_right(starts, c.offset) - 1
+            s, _e = merged[j]
+            c.offset = packed_start[s] + (c.offset - s)
+        self.decoder.rebuild_groups()
+        self.gather_index = np.concatenate(
+            [np.arange(s, e, dtype=np.int64) for s, e in merged])
 
     @property
     def mesh(self):
@@ -172,13 +216,18 @@ class DeviceAggregator:
         return jax.jit(agg, in_shardings=(sharding, None))
 
     def put(self, arr: np.ndarray, block: Optional[int] = None):
-        """Pad `arr` ([n, extent] uint8) and transfer it H2D with the mesh
-        sharding (explicit device_put: the implicit transfer inside jit
-        dispatch is far slower on remote-attached devices). Returns
-        (device_array, n). `block`: pad to this fixed batch so a streaming
-        loop reuses one compiled program."""
+        """Pad `arr` ([n, record_extent] uint8), byte-project it to the
+        query's packed layout, and transfer it H2D with the mesh sharding
+        (explicit device_put: the implicit transfer inside jit dispatch is
+        far slower on remote-attached devices). Returns (device_array, n).
+        `block`: pad to this fixed batch so a streaming loop reuses one
+        compiled program."""
         import jax
 
+        if (self.gather_index is not None
+                and arr.shape[1] > len(self.gather_index)):
+            # ship only the bytes the projected plan reads
+            arr = np.ascontiguousarray(arr[:, self.gather_index])
         n = arr.shape[0]
         nd = self.decoder.n_devices
         if block is not None:
@@ -285,7 +334,7 @@ def aggregate_file(copybook: Copybook, data, columns=None, mesh=None
                    ) -> Dict[str, dict]:
     """One-shot helper over a fixed-length byte image."""
     agg = DeviceAggregator(copybook, columns=columns, mesh=mesh)
-    rs = agg.decoder.plan.max_extent
+    rs = agg.record_extent
     arr = np.frombuffer(data, dtype=np.uint8)
     n = arr.size // copybook.record_size
     arr = arr[:n * copybook.record_size].reshape(n, copybook.record_size)

@@ -250,10 +250,14 @@ class ArrowBatchBuilder:
         mat = out["bytes"]
         if mat.ndim != 2 or mat.shape[1] == 0:
             return pa.array([""] * self.n, type=pa_type)
-        non_ascii = mat > 0x7F
-        if relevant is not None:
-            non_ascii = non_ascii & relevant[:, None]
-        if mat.dtype == np.uint16 and bool(non_ascii.any()):
+        if relevant is not None and not relevant.all():
+            # hidden rows' garbage code points must not poison the
+            # column (their >0x7F values would truncate to invalid
+            # UTF-8 below) — blank them; the null parent struct hides
+            # whatever value they produce
+            mat = mat.copy()
+            mat[~relevant] = 0x20
+        if mat.dtype == np.uint16 and bool((mat > 0x7F).any()):
             # non-ASCII code points need real UTF-8 encoding
             return self._python_fallback(spec.index, pa_type, relevant)
         return _string_from_codepoints(mat, self.decoder.plan.trimming)
@@ -406,6 +410,18 @@ def segment_table(batch: DecodedBatch,
         out = []
         for lvl in range(output_schema.generate_seg_id_field_count):
             if isinstance(seg_level_ids, SegLevelColumns):
+                ab = seg_level_ids.arrow_level(lvl)
+                if ab is not None:
+                    # native int-formatted Seg_Id buffers — no Python
+                    # strings at all
+                    offsets, data, valid = ab
+                    vbuf = (None if valid.all()
+                            else _validity_buffer(valid))
+                    out.append(pa.Array.from_buffers(
+                        pa.string(), n,
+                        [vbuf, pa.py_buffer(offsets),
+                         pa.py_buffer(data)]))
+                    continue
                 # per-level object column straight into Arrow (no
                 # per-row list materialization)
                 vals = (seg_level_ids.levels[lvl]

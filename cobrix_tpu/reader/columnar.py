@@ -766,7 +766,13 @@ class ColumnarDecoder:
             copybook.ascii_charset.lower().replace("_", "-")
             not in ("us-ascii", "ascii"))
         self.lut = code_page_lut_u16(copybook.ebcdic_code_page)
-        # kernel groups
+        self._jax_fn = None
+        self.rebuild_groups()
+
+    def rebuild_groups(self) -> None:
+        """(Re)build kernel groups and lookup maps from the plan columns —
+        called at construction and after an offset remap (device byte
+        projection rewrites column offsets into a packed layout)."""
         groups: Dict[tuple, List[ColumnSpec]] = {}
         for c in self.plan.columns:
             key = (c.codec, c.width) + _variant_key(c)

@@ -194,14 +194,12 @@ class FixedLenReader:
         self.check_binary_data_validity(len(data), ignore_file_size)
         matrix = self.to_record_matrix(data, ignore_file_size)
         segment_ids = self._segment_values(matrix)
-        active_of_uniq = segment_ids.map_uniq(self.segment_redefine_map)
 
         trimmed, width = self._trimmed_matrix(matrix)
         result.n_rows = matrix.shape[0]
-        for active in set(active_of_uniq):
-            ks = [k for k, a in enumerate(active_of_uniq) if a == active]
-            positions = np.nonzero(
-                np.isin(segment_ids.codes, ks))[0].astype(np.int64)
+        for active in set(segment_ids.map_uniq(self.segment_redefine_map)):
+            positions = np.nonzero(segment_ids.mask_of_mapped(
+                self.segment_redefine_map, active))[0].astype(np.int64)
             decoder = self._decoder_for_segment(active, backend)
             lengths = (np.full(len(positions), width, dtype=np.int64)
                        if width < self.copybook.record_size else None)

@@ -23,15 +23,66 @@ from .columnar import DecodedBatch
 
 
 class SegLevelColumns:
-    """Seg_Id0..N as per-LEVEL object arrays (None = level not shown)
-    with a lazy per-row view: the Arrow path reads whole level columns,
-    the row path indexes rows — no 600k-element list-of-lists build."""
+    """Seg_Id0..N level columns (None = level not shown for that row).
 
-    def __init__(self, levels: List[np.ndarray]):
-        self.levels = levels
+    Two representations: materialized per-level object arrays (`levels`),
+    or a coded form — per-row root record ids, child counters and
+    visibility masks — that the native formatter turns straight into Arrow
+    string buffers (`arrow_level`). The object arrays materialize lazily,
+    so Arrow-only reads never build 600k Python strings."""
+
+    def __init__(self, levels: Optional[List[np.ndarray]] = None,
+                 coded: Optional[dict] = None):
+        self._levels = levels
+        self.coded = coded
+
+    @property
+    def levels(self) -> List[np.ndarray]:
+        if self._levels is None:
+            self._levels = self._materialize()
+        return self._levels
+
+    def _materialize(self) -> List[np.ndarray]:
+        c = self.coded
+        root_rid = c["root_rid"]
+        prefix = c["prefix"]
+        rid_str = root_rid.astype("U20")
+        root_u = np.where(root_rid >= 0,
+                          np.char.add(np.asarray(prefix, dtype="U"),
+                                      rid_str), "")
+        levels: List[np.ndarray] = []
+        for k in range(c["level_count"]):
+            valid = c["valids"][k]
+            if k == 0:
+                col = root_u.astype(object)
+            else:
+                cnt_str = c["counters"][k].astype("U20")
+                col = np.char.add(np.char.add(root_u, f"_L{k}_"),
+                                  cnt_str).astype(object)
+            col[~valid] = None
+            levels.append(col)
+        return levels
+
+    def arrow_level(self, k: int):
+        """(int32 offsets, utf8 data, valid bool array) Arrow buffers for
+        level k via the native formatter; None when unavailable."""
+        from .. import native
+
+        c = self.coded
+        if c is None or k >= c["level_count"]:
+            return None
+        valid = c["valids"][k]
+        res = native.format_seg_id_level(
+            c["root_rid"], c["counters"][k], c["prefix"], k, valid)
+        if res is None:
+            return None
+        offsets, data = res
+        return offsets, data, valid
 
     def __len__(self) -> int:
-        return len(self.levels[0]) if self.levels else 0
+        if self.coded is not None:
+            return len(self.coded["root_rid"])
+        return len(self._levels[0]) if self._levels else 0
 
     def __bool__(self) -> bool:
         return len(self) > 0
@@ -45,6 +96,14 @@ class SegLevelColumns:
         return [self[i] for i in range(len(self))] == other
 
     def take(self, positions: np.ndarray) -> "SegLevelColumns":
+        if self.coded is not None:
+            c = self.coded
+            return SegLevelColumns(coded=dict(
+                c,
+                root_rid=c["root_rid"][positions],
+                counters=[None if cnt is None else cnt[positions]
+                          for cnt in c["counters"]],
+                valids=[v[positions] for v in c["valids"]]))
         return SegLevelColumns([lvl[positions] for lvl in self.levels])
 
 

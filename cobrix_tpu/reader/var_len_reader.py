@@ -135,32 +135,23 @@ def _segment_level_ids_vectorized(segment_ids: Sequence[str],
     np.maximum.accumulate(last_match, out=last_match)
     cur_level = np.where(last_match >= 0, lvl[np.maximum(last_match, 0)], -1)
     no_match_yet = last_match < 0
-    # forward-filled root position (-1 before the first root)
+    # forward-filled root position (-1 before the first root: the
+    # accumulator's empty pre-root prefix)
     root_pos = np.where(lvl == 0, idx, -1)
     np.maximum.accumulate(root_pos, out=root_pos)
-    # root id strings, one per ROOT record, broadcast by rank (the [-1]
-    # rank before the first root wraps to the "" tail — the accumulator's
-    # empty pre-root prefix)
-    roots = np.nonzero(lvl == 0)[0]
-    per_root = np.array(
-        [f"{prefix}_{file_id}_{start_record_id + int(p)}" for p in roots]
-        + [""], dtype="U")
-    root_rank = np.cumsum(lvl == 0) - 1
-    root_u = per_root[root_rank]
+    root_rid = np.where(root_pos >= 0, start_record_id + root_pos,
+                        np.int64(-1))
 
-    levels: List[np.ndarray] = []
-    level0 = root_u.astype(object)
-    level0[no_match_yet] = None
-    levels.append(level0)
+    # per-level child counters (cumulative count since the current root)
+    counters: List[Optional[np.ndarray]] = [None]
     for k in range(1, level_count):
         c = np.cumsum(lvl == k)
         at_root = np.where(root_pos >= 0, c[np.maximum(root_pos, 0)], 0)
-        cnt_str = (c - at_root).astype("U20")
-        col = np.char.add(np.char.add(root_u, f"_L{k}_"),
-                          cnt_str).astype(object)
-        col[cur_level < k] = None
-        levels.append(col)
-    return SegLevelColumns(levels), no_match_yet
+        counters.append(c - at_root)
+    valids = [cur_level >= k for k in range(level_count)]
+    coded = dict(root_rid=root_rid, counters=counters, valids=valids,
+                 prefix=f"{prefix}_{file_id}_", level_count=level_count)
+    return SegLevelColumns(coded=coded), no_match_yet
 
 
 def _has_dynamic_occurs_layout(root: Group) -> bool:
@@ -814,8 +805,10 @@ class VarLenReader:
         if segment_ids is not None and self.segment_redefine_map:
             full = self._decoder_for_segment("", backend)
             extent = full.plan.max_extent
-            size_skewed = (extent > 512
-                           and float((lengths < extent // 4).mean()) > 0.5)
+            kept_lengths = lengths[kept]
+            size_skewed = (extent > 512 and len(kept_lengths) > 0
+                           and float((kept_lengths
+                                      < extent // 4).mean()) > 0.5)
             if not size_skewed:
                 decoded = full.decode_raw(
                     data, offsets[kept], lengths[kept], start_offset=start)
@@ -847,10 +840,10 @@ class VarLenReader:
         if segment_ids is None:
             by_segment[""] = kept
         else:
-            active_of_uniq = segment_ids.map_uniq(self.segment_redefine_map)
-            for active in set(active_of_uniq):
-                ks = [k for k, a in enumerate(active_of_uniq) if a == active]
-                mask = np.isin(segment_ids.codes, ks)
+            for active in set(segment_ids.map_uniq(
+                    self.segment_redefine_map)):
+                mask = segment_ids.mask_of_mapped(
+                    self.segment_redefine_map, active)
                 positions = np.nonzero(keep & mask)[0]
                 if positions.size:
                     by_segment[active] = positions

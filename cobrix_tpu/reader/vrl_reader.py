@@ -85,6 +85,18 @@ class SegmentIds:
             return np.zeros(len(self.codes), dtype=bool)
         return np.isin(self.codes, hits)
 
+    def mask_of_mapped(self, mapping: dict, value: str,
+                       default: str = "") -> "np.ndarray":
+        """Boolean per-record mask of ids whose `mapping` image equals
+        `value` (segment id -> active redefine routing)."""
+        import numpy as np
+
+        hits = [k for k, u in enumerate(self.uniq)
+                if mapping.get(u, default) == value]
+        if not hits:
+            return np.zeros(len(self.codes), dtype=bool)
+        return np.isin(self.codes, hits)
+
     def replace_at(self, i: int, value: str) -> None:
         """Point fixup (truncated trailing records decode individually)."""
         try:

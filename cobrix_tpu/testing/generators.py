@@ -555,3 +555,395 @@ def generate_exp1(num_records: int, seed: int = 100) -> np.ndarray:
     out = np.concatenate(parts, axis=1)
     assert out.shape[1] == EXP1_RECORD_SIZE
     return out
+
+
+# ---------------------------------------------------------------------------
+# Remaining reference generator ports (examples-collection
+# TestDataGen1/7/8/9/11/13a/13b/16/17; TestDataGen3CompaniesBigEndian is
+# generate_exp2(big_endian_rdw=True)). Each reproduces the reference
+# record layout byte for byte; the value pools come from CommonLists.
+# ---------------------------------------------------------------------------
+
+_CURRENCIES = ["ZAR", "USD", "EUR", "GBP", "CAD", "CHF", "CZK", "ZWL"]
+_DEPARTMENTS = ["Executive", "Finance", "Operations", "Development",
+                "Sales", "Marketing", "Research", "Risk Management",
+                "Production", "Logistics", "Transportation", "Planning",
+                "Engineering", "Accounting", "Legal", "Compliance",
+                "Creative"]
+_ROLES = ["CEO", "CFO", "CTO", "COO", "VP of Sales", "VP of Operations",
+          "VP of Marketing", "VP of Development", "VP of Legal",
+          "VP of Accounting", "director", "managing director",
+          "software developer", "software engineer", "big data engineer",
+          "devops", "support", "project manager", "scrum master", "sales",
+          "copyrightor", "accountant", "analytic", "legal", "assistant",
+          "researcher", "specialist"]
+_CONTRACT_STATES = ["Unsigned", "Signed", "Progress", "Rejected", "Done",
+                    "Archived"]
+# CommonLists.companiesWithNonPrintableCharacters: control-byte names
+_NP_NAMES = [bytes(range(0x01, 0x09)), bytes(range(0x09, 0x11)),
+             bytes(range(0x09, 0x11)), bytes(range(0x11, 0x19)),
+             bytes(range(0x19, 0x21)), b"\x21\x22\x23\x24\x25\x26\x27\x28",
+             bytes(range(0x29, 0x31)), bytes(range(0x31, 0x39)),
+             bytes(range(0x39, 0x41))]
+
+TRANSDATA_COPYBOOK = """
+        01  TRANSDATA.
+            05  CURRENCY          PIC X(3).
+            05  SIGNATURE         PIC X(8).
+            05  COMPANY-NAME      PIC X(15).
+            05  COMPANY-ID        PIC X(10).
+            05  WEALTH-QFY        PIC 9(1).
+            05  AMOUNT            PIC S9(09)V99  BINARY.
+"""
+
+
+def _trans_amount(rng) -> int:
+    """The skewed AMOUNT distribution shared by the TRANSDATA generators
+    (TestDataGen1Transactions.scala:68-79)."""
+    tp = int(rng.integers(0, 100))
+    if tp < 80:
+        int_part = int(rng.integers(0, 1000))
+    elif tp < 95:
+        int_part = int(rng.integers(0, 100000))
+    else:
+        int_part = int(rng.integers(0, 10000000))
+    frac = int(rng.integers(0, 100)) if int_part < 10000 else 0
+    return int_part * 100 + frac
+
+
+def generate_transactions(num_records: int, seed: int = 100,
+                          name_pool: str = "companies",
+                          file_header: int = 0,
+                          file_footer: int = 0) -> bytes:
+    """TRANSDATA fixed-length records (45 bytes). `name_pool`:
+    "companies" (TestDataGen1Transactions), "non_printable" control-byte
+    names (TestDataGen8NonPrintableNames), or "random_bytes"
+    (TestDataGen9CodePages). `file_header`/`file_footer` wrap the records
+    in 0x01/0x02 filler regions (TestDataGen13aFileHeaderAndFooter:
+    10-byte header, 12-byte footer)."""
+    rng = np.random.default_rng(seed)
+    chunks = [b"\x01" * file_header] if file_header else []
+    for _ in range(num_records):
+        rec = bytearray(45)
+        rec[0:3] = ebcdic_encode(
+            _CURRENCIES[rng.integers(0, len(_CURRENCIES))], 3)
+        rec[3:11] = ebcdic_encode("S9276511", 8)
+        if name_pool == "non_printable":
+            rec[11:26] = (_NP_NAMES[rng.integers(0, len(_NP_NAMES))]
+                          + b"\x00" * 7)[:15]
+        elif name_pool == "random_bytes":
+            rec[11:26] = rng.integers(0, 256, size=14,
+                                      dtype=np.uint8).tobytes() + b"\x00"
+            rec[26:36] = ebcdic_encode("00000000", 10)
+        else:
+            rec[11:26] = ebcdic_encode(
+                _COMPANIES[rng.integers(0, len(_COMPANIES))], 15)
+        if name_pool != "random_bytes":
+            rec[26:36] = ebcdic_encode(
+                f"{rng.integers(0, 10 ** 9):010d}"[:10], 10)
+        amount = _trans_amount(rng)
+        rec[37:45] = amount.to_bytes(8, "big")
+        rec[36:37] = ebcdic_encode(
+            "1" if rng.integers(0, 100) < 37 else "0", 1)
+        chunks.append(bytes(rec))
+    if file_footer:
+        chunks.append(b"\x02" * file_footer)
+    return b"".join(chunks)
+
+
+FILLERS_COPYBOOK = """
+      01  RECORD.
+          05  COMPANY_NAME     PIC X(15).
+          05  FILLER REDEFINES COMPANY_NAME.
+             10   STR1         PIC X(5).
+             10   STR2         PIC X(2).
+             10   FILLER       PIC X(1).
+          05  ADDRESS          PIC X(25).
+          05  FILLER REDEFINES ADDRESS.
+             10   STR4         PIC X(10).
+             10   FILLER       PIC X(20).
+          05  FILL_FIELD.
+             10   FILLER       PIC X(5).
+             10   FILLER       PIC X(2).
+          05  CONTACT_PERSON REDEFINES FILL_FIELD.
+             10  FIRST_NAME    PIC X(6).
+          05  AMOUNT            PIC S9(09)V99  BINARY.
+"""
+
+
+def generate_fillers(num_records: int, seed: int = 100) -> bytes:
+    """FILLER/REDEFINES exercise records (TestDataGen7Fillers, 60 bytes:
+    name 15 + address 30 + contact 7 + binary amount 8)."""
+    rng = np.random.default_rng(seed)
+    chunks = []
+    for _ in range(num_records):
+        rec = bytearray(60)
+        rec[0:15] = ebcdic_encode(
+            _COMPANIES[rng.integers(0, len(_COMPANIES))], 15)
+        rec[15:45] = ebcdic_encode(
+            f"{rng.integers(1, 500)} Main Street", 30)
+        rec[45:52] = ebcdic_encode(
+            _EXP1_NAMES[rng.integers(0, len(_EXP1_NAMES))], 7)
+        rec[52:60] = _trans_amount(rng).to_bytes(8, "big")
+        chunks.append(bytes(rec))
+    return b"".join(chunks)
+
+
+CUSTOM_RDW_COPYBOOK = EXP2_COPYBOOK
+
+
+def generate_custom_rdw(num_records: int, seed: int = 100) -> bytes:
+    """COMPANY-DETAILS records behind a CUSTOM 5-byte record header
+    (TestDataGen11CustomRDW): byte 0 = validity flag, bytes 3-4 =
+    little-endian payload length. Invalid records (flag 0, length 15)
+    are interleaved and must be skipped by the custom header parser."""
+    rng = np.random.default_rng(seed)
+    chunks = []
+    i = 0
+
+    def header(valid: bool, length: int) -> bytes:
+        return bytes([1 if valid else 0, 0, 0,
+                      length & 0xFF, length >> 8])
+
+    while i < num_records:
+        company = _COMPANIES[rng.integers(0, len(_COMPANIES))]
+        company_id = (f"{rng.integers(10000, 99999)}"
+                      f"{rng.integers(10000, 99999)}")
+        if rng.integers(0, 2) == 1:
+            payload = bytearray()
+            payload += ebcdic_encode("C", 5)
+            payload += ebcdic_encode(company_id, 10)
+            payload += ebcdic_encode(company, 15)
+            payload += ebcdic_encode(f"{rng.integers(1, 500)} Main St", 25)
+            taxpayer = int(rng.integers(10000000, 99999999))
+            if rng.integers(0, 2) == 1:
+                payload += ebcdic_encode("A", 1)
+                payload += ebcdic_encode(str(taxpayer), 8)
+            else:
+                payload += ebcdic_encode("N", 1)
+                payload += taxpayer.to_bytes(4, "big") + b"\x00" * 4
+            chunks.append(header(True, 64) + bytes(payload))
+            i += 1
+            for _ in range(int(rng.integers(0, 5))):
+                if i >= num_records:
+                    break
+                contact = bytearray()
+                contact += ebcdic_encode("P", 5)
+                contact += ebcdic_encode(company_id, 10)
+                phone = (f"+({rng.integers(1, 921)}) "
+                         f"{rng.integers(100, 999)} "
+                         f"{rng.integers(10, 99)} {rng.integers(10, 99)}")
+                contact += ebcdic_encode(phone, 17)
+                person = (_FIRST[rng.integers(0, len(_FIRST))] + " "
+                          + _LAST[rng.integers(0, len(_LAST))])
+                contact += ebcdic_encode(person, 28)
+                chunks.append(header(True, 60) + bytes(contact))
+                i += 1
+        else:
+            chunks.append(header(False, 15) + b"\x00" * 15)
+    return b"".join(chunks)
+
+
+def generate_companies_with_headers(num_records: int, seed: int = 100
+                                    ) -> bytes:
+    """Big-endian RDW COMPANY-DETAILS stream wrapped in a 100-byte file
+    header and 120-byte footer (TestDataGen13bCompaniesFileHeaders)."""
+    body = generate_exp2(num_records, seed=seed, big_endian_rdw=True)
+    return b"\x01" * 100 + body + b"\x02" * 120
+
+
+ENTITY_FIXED_COPYBOOK = """
+        01  ENTITY.
+            05  SEGMENT-ID        PIC X(1).
+            05  COMPANY.
+               10  COMPANY-NAME      PIC X(20).
+               10  ADDRESS           PIC X(30).
+               10  TAXPAYER          PIC X(8).
+            05  PERSON REDEFINES COMPANY.
+               10  FIRST-NAME        PIC X(16).
+               10  LAST-NAME         PIC X(16).
+               10  ADDRESS           PIC X(20).
+               10  PHONE-NUM         PIC X(11).
+            05  PO-BOX REDEFINES COMPANY.
+               10  PO-NUMBER         PIC X(12).
+               10  BRANCH-ADDRESS    PIC X(20).
+"""
+
+
+def generate_multiseg_fixed(num_records: int, seed: int = 100) -> bytes:
+    """Fixed-length (64-byte, space-filled) multisegment C/P/B records
+    (TestDataGen16MultisegFixedLen)."""
+    rng = np.random.default_rng(seed)
+    chunks = []
+    for _ in range(num_records):
+        rec = bytearray(b"\x40" * 64)  # util.Arrays.fill(..., 64) = space
+        seg = int(rng.integers(0, 3))
+        company = _COMPANIES[rng.integers(0, len(_COMPANIES))]
+        address = f"{rng.integers(1, 500)} Main Street"
+        if seg == 0:
+            rec[0:1] = ebcdic_encode("C", 1)
+            rec[1:21] = ebcdic_encode(company, 20, pad=0x40)
+            rec[21:51] = ebcdic_encode(address, 30, pad=0x40)
+            rec[51:59] = ebcdic_encode(
+                str(rng.integers(10000000, 99999999)), 8, pad=0x40)
+        elif seg == 1:
+            rec[0:1] = ebcdic_encode("P", 1)
+            rec[1:17] = ebcdic_encode(
+                _EXP1_NAMES[rng.integers(0, len(_EXP1_NAMES))], 16,
+                pad=0x40)
+            rec[17:33] = ebcdic_encode(
+                _LAST[rng.integers(0, len(_LAST))], 16, pad=0x40)
+            rec[33:53] = ebcdic_encode(address, 20, pad=0x40)
+            phone = (f"+({rng.integers(1, 921)}) {rng.integers(100, 999)}"
+                     f" {rng.integers(10, 99)}")
+            rec[53:64] = ebcdic_encode(phone, 11, pad=0x40)
+        else:
+            rec[0:1] = ebcdic_encode("B", 1)
+            rec[1:13] = ebcdic_encode(
+                str(rng.integers(0, 10 ** 11)), 12, pad=0x40)
+            rec[13:33] = ebcdic_encode(address, 20, pad=0x40)
+        chunks.append(bytes(rec))
+    return b"".join(chunks)
+
+
+HIERARCHICAL_COPYBOOK = """
+     01  ENTITY.
+         05  SEGMENT-ID           PIC 9(1).
+         05  COMPANY.
+            10  COMPANY-NAME      PIC X(20).
+            10  ADDRESS           PIC X(30).
+            10  TAXPAYER          PIC 9(9) BINARY.
+         05  DEPT REDEFINES COMPANY.
+            10  DEPT-NAME         PIC X(22).
+            10  EXTENSION         PIC 9(6).
+         05  EMPLOYEE REDEFINES COMPANY.
+            10  FIRST-NAME        PIC X(16).
+            10  LAST-NAME         PIC X(16).
+            10  ROLE              PIC X(18).
+            10  HOME-ADDRESS      PIC X(40).
+            10  PHONE-NUM         PIC X(17).
+         05  OFFICE REDEFINES COMPANY.
+            10  ADDRESS           PIC X(30).
+            10  FLOOR             PIC 9(3).
+            10  ROOM-NUMBER       PIC 9(4).
+         05  CUSTOMER REDEFINES COMPANY.
+            10  CUSTOMER-NAME     PIC X(20).
+            10  POSTAL-ADDRESS    PIC X(30).
+            10  ZIP               PIC X(10).
+         05  CONTACT REDEFINES COMPANY.
+            10  FIRST-NAME        PIC X(16).
+            10  LAST-NAME         PIC X(16).
+            10  PHONE-NUM         PIC X(17).
+         05  CONTRACT REDEFINES COMPANY.
+            10  CONTRACT-NUMBER   PIC X(15).
+            10  STATE             PIC X(8).
+            10  DUE-DATE          PIC X(10).
+            10  AMOUNT            PIC 9(10)V9(2) COMP-3.
+"""
+
+HIERARCHICAL_SEGMENT_MAP = {
+    "1": "COMPANY", "2": "DEPT", "3": "EMPLOYEE", "4": "OFFICE",
+    "5": "CUSTOMER", "6": "CONTACT", "7": "CONTRACT"}
+HIERARCHICAL_PARENT_MAP = {
+    "DEPT": "COMPANY", "EMPLOYEE": "DEPT", "OFFICE": "DEPT",
+    "CUSTOMER": "COMPANY", "CONTACT": "CUSTOMER", "CONTRACT": "CUSTOMER"}
+
+
+def generate_hierarchical(num_companies: int, seed: int = 100) -> bytes:
+    """Little-endian-RDW hierarchical stream (TestDataGen17Hierarchical):
+    company -> departments (employees, offices) + customers (contacts,
+    contracts), segment ids 1-7."""
+    rng = np.random.default_rng(seed)
+    chunks = []
+
+    def phone() -> str:
+        return (f"+({rng.integers(1, 921)}) {rng.integers(100, 999)} "
+                f"{rng.integers(10, 99)} {rng.integers(10, 99)}")
+
+    def emit(seg: str, body: bytes) -> None:
+        payload = ebcdic_encode(seg, 1) + body
+        chunks.append(_rdw(len(payload)) + payload)
+
+    def put_contract() -> None:
+        amount_type = int(rng.integers(0, 4))
+        if amount_type == 0:
+            amount = int(rng.integers(0, 89999999)) + 10000
+        elif amount_type == 1:
+            amount = int(rng.integers(0, 99)) * 100 + 10000
+        elif amount_type == 2:
+            amount = int(rng.integers(0, 89999)) + 100000
+        else:
+            amount = int(rng.integers(0, 89999999)) + 10000000
+        due = (f"{rng.integers(1990, 2020):04d}-"
+               f"{rng.integers(1, 13):02d}-{rng.integers(1, 29):02d}")
+        body = (ebcdic_encode(str(rng.integers(0, 1000000)), 15)
+                + ebcdic_encode(
+                    _CONTRACT_STATES[rng.integers(
+                        0, len(_CONTRACT_STATES))], 8)
+                + ebcdic_encode(due, 10)
+                + encode_comp3_unsigned(
+                    np.asarray([amount]), 12).tobytes())
+        emit("7", body)
+
+    def put_customer() -> None:
+        body = (ebcdic_encode(
+                    _COMPANIES[rng.integers(0, len(_COMPANIES))], 20)
+                + ebcdic_encode(f"{rng.integers(1, 500)} Main Street", 30)
+                + ebcdic_encode(
+                    str(rng.integers(100000000, 999999999)), 10))
+        emit("5", body)
+        n_contacts, n_contracts = (int(rng.integers(0, 3)),
+                                   int(rng.integers(0, 5)))
+        for _ in range(n_contacts):
+            body = (ebcdic_encode(
+                        _EXP1_NAMES[rng.integers(0, len(_EXP1_NAMES))], 16)
+                    + ebcdic_encode(
+                        _LAST[rng.integers(0, len(_LAST))], 16)
+                    + ebcdic_encode(phone(), 17))
+            emit("6", body)
+        for _ in range(n_contracts):
+            put_contract()
+
+    def put_department() -> None:
+        body = (ebcdic_encode(
+                    _DEPARTMENTS[rng.integers(0, len(_DEPARTMENTS))], 22)
+                + encode_display_unsigned(
+                    np.asarray([rng.integers(100000, 999999)]),
+                    6).tobytes())
+        emit("2", body)
+        n_employees, n_offices = (int(rng.integers(0, 7)),
+                                  int(rng.integers(0, 4)))
+        for _ in range(n_employees):
+            body = (ebcdic_encode(
+                        _EXP1_NAMES[rng.integers(0, len(_EXP1_NAMES))], 16)
+                    + ebcdic_encode(
+                        _LAST[rng.integers(0, len(_LAST))], 16)
+                    + ebcdic_encode(
+                        _ROLES[rng.integers(0, len(_ROLES))], 18)
+                    + ebcdic_encode(
+                        f"{rng.integers(1, 500)} Main Street", 40)
+                    + ebcdic_encode(phone(), 17))
+            emit("3", body)
+        for _ in range(n_offices):
+            body = (ebcdic_encode(
+                        f"{rng.integers(1, 500)} Main Street", 30)
+                    + encode_display_unsigned(
+                        np.asarray([rng.integers(0, 120)]), 3).tobytes()
+                    + encode_display_unsigned(
+                        np.asarray([rng.integers(0, 3000)]), 4).tobytes())
+            emit("4", body)
+
+    for _ in range(num_companies):
+        body = (ebcdic_encode(
+                    _COMPANIES[rng.integers(0, len(_COMPANIES))], 20)
+                + ebcdic_encode(f"{rng.integers(1, 500)} Main Street", 30)
+                + int(rng.integers(100000000, 999999999)).to_bytes(
+                    4, "big"))
+        emit("1", body)
+        n_departments, n_customers = (int(rng.integers(0, 5)),
+                                      int(rng.integers(0, 5)))
+        for _ in range(n_departments):
+            put_department()
+        for _ in range(n_customers):
+            put_customer()
+    return b"".join(chunks)

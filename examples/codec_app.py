@@ -1,0 +1,56 @@
+"""Custom record-header-parser read (reference SparkCodecApp +
+CustomRecordHeadersParser: a 5-byte header with a validity flag; invalid
+records are skipped by the parser, TestDataGen11CustomRDW data)."""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.reader.header_parsers import (RecordHeaderParser,
+                                              RecordMetadata)
+from cobrix_tpu.testing.generators import (CUSTOM_RDW_COPYBOOK,
+                                           generate_custom_rdw)
+
+
+class CustomFlagHeaderParser(RecordHeaderParser):
+    """Byte 0 = validity flag; bytes 3-4 = little-endian payload length."""
+
+    @property
+    def header_length(self):
+        return 5
+
+    @property
+    def is_header_defined_in_copybook(self):
+        return False
+
+    def get_record_metadata(self, header, file_offset, file_size,
+                            record_num):
+        if len(header) < 5:
+            return RecordMetadata(-1, False)
+        return RecordMetadata(header[3] | (header[4] << 8), header[0] == 1)
+
+
+def main():
+    raw = generate_custom_rdw(500, seed=100)
+    with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+        f.write(raw)
+        path = f.name
+    try:
+        result = read_cobol(
+            path, copybook_contents=CUSTOM_RDW_COPYBOOK,
+            is_record_sequence="true",
+            record_header_parser=f"{__name__}.CustomFlagHeaderParser",
+            segment_field="SEGMENT-ID",
+            redefine_segment_id_map="STATIC-DETAILS => C",
+            **{"redefine_segment_id_map:1": "CONTACTS => P"})
+        table = result.to_arrow()
+    finally:
+        os.unlink(path)
+    print(f"{table.num_rows} valid records (invalid ones skipped "
+          "by the custom header parser)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Device-resident query (no reference analogue — the TPU-native
+capability): decode + aggregate the exp3 numeric plane ON the device;
+only scalar aggregates cross the host link (parallel/query.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from cobrix_tpu import native, parse_copybook
+from cobrix_tpu.parallel import DeviceAggregator, merge_aggregates
+from cobrix_tpu.testing.generators import EXP3_COPYBOOK, generate_exp3
+
+
+def main():
+    copybook = parse_copybook(
+        EXP3_COPYBOOK, segment_redefines=["STATIC_DETAILS", "CONTACTS"])
+    agg = DeviceAggregator(copybook, columns=["NUM1", "NUM2"],
+                           active_segment="STATIC_DETAILS")
+    raw = generate_exp3(512, seed=100)
+    offsets, lengths = native.rdw_scan(raw, big_endian=False)
+    pos = np.nonzero(lengths >= 1000)[0]  # wide 'C' records
+    rs = agg.record_extent
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    mat = buf[offsets[pos][:, None] + np.arange(rs)[None, :]]
+    parts = [agg.aggregate(mat)]
+    merged = merge_aggregates(parts)
+    for name, stats in merged.items():
+        print(name, stats)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""RDW multisegment read with Seg_Id generation (reference
+SparkCobolApp.scala:69-120): the exp2 COMPANY-DETAILS profile with 'C'
+root and 'P' contact segments."""
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.testing.generators import EXP2_COPYBOOK, generate_exp2
+
+
+def main():
+    raw = generate_exp2(2000, seed=100)
+    with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+        f.write(raw)
+        path = f.name
+    try:
+        result = read_cobol(
+            path, copybook_contents=EXP2_COPYBOOK,
+            is_record_sequence="true",
+            segment_field="SEGMENT-ID",
+            redefine_segment_id_map="STATIC-DETAILS => C",
+            **{"redefine_segment_id_map:1": "CONTACTS => P"},
+            segment_id_level0="C", segment_id_level1="P",
+            generate_record_id="true",
+            segment_id_prefix="ID")
+        table = result.to_arrow()
+    finally:
+        os.unlink(path)
+    print(f"{table.num_rows} rows; columns: {table.column_names}")
+    print(table.slice(0, 5).to_pandas()[["Seg_Id0", "Seg_Id1"]])
+
+
+if __name__ == "__main__":
+    main()

@@ -159,3 +159,40 @@ def test_aggregate_file_helper(copybook, dataset):
     res = aggregate_file(copybook, data.tobytes())
     assert res["A"]["sum"] == v["a"].sum()
     assert res["X"]["count"] == 3 * N
+
+
+def test_byte_projection_cuts_transfer_and_keeps_parity(copybook, dataset):
+    """A narrow `columns` selection must byte-project the H2D payload
+    (DeviceAggregator._build_byte_projection rewrites the plan offsets into
+    a packed layout) and still aggregate identically to the unprojected
+    query. The middle COMP-2/BAD/OCCURS bytes are not shipped at all."""
+    data, v = dataset
+    # A sits at the record start, X at the tail: the bytes between (B, C,
+    # CV, D, BAD — ~29 of 43) are never shipped. A prefix selection would
+    # be handled by max_extent alone; the gather covers the scattered case.
+    agg = DeviceAggregator(copybook, columns=["A", "X"])
+    assert agg.gather_index is not None
+    assert len(agg.gather_index) < agg.record_extent
+    res = agg.aggregate(data)
+    assert set(res) == {"A", "X"}
+    assert res["A"]["sum"] == v["a"].sum()
+    assert res["A"]["count"] == N
+    assert res["X"]["sum"] == v["x"].sum()
+    assert res["X"]["min"] == v["x"].min()
+
+    # dense selections skip the gather entirely
+    dense = DeviceAggregator(copybook)
+    assert dense.gather_index is None
+
+
+def test_byte_projection_streamed_blocks(copybook, dataset):
+    """Projection composes with the streaming put/submit/fetch loop."""
+    data, v = dataset
+    agg = DeviceAggregator(copybook, columns=["X"])
+    parts = []
+    for i in range(0, N, 16):
+        x, n = agg.put(data[i:i + 16], block=16)
+        parts.append(agg.aggregate_device(x, n))
+    merged = merge_aggregates(parts)
+    assert merged["X"]["count"] == 3 * N
+    assert merged["X"]["sum"] == v["x"].sum()

@@ -322,3 +322,54 @@ def test_decode_once_wide_decimal_garbage_rows_stay_null():
         assert col[0]["B_SEG"] is None
         assert col[1]["A_SEG"] is None
         assert col[1]["B_SEG"]["TXT"] is not None
+
+
+def test_full_width_string_column_uses_native_arrow_kernel():
+    """Review finding: the native string kernel's 3x-UTF-8 overflow guard
+    fired on any width>8 column whose final rows had no trailing spaces,
+    silently dropping the one-pass path for exactly the fully-populated
+    columns it was built for. All-ASCII full-width output must fit."""
+    from cobrix_tpu import native
+
+    n, w = 100, 10
+    batch = np.full((n, w), 0xC1, dtype=np.uint8)  # EBCDIC 'A', full width
+    from cobrix_tpu.encoding.codepages import code_page_lut_u16
+    lut = code_page_lut_u16("common")
+    res = native.string_cols_arrow_packed(
+        batch, np.asarray([0]), np.asarray([w]), lut, native.TRIM_BOTH)
+    if res is None:
+        pytest.skip("native library unavailable")
+    assert res[0] is not None, "full-width ASCII output must not overflow"
+    offsets, data = res[0]
+    assert offsets[-1] == n * w
+    assert data[:w].tobytes() == b"A" * w
+
+
+def test_decode_once_hidden_rows_with_non_ascii_garbage():
+    """Review finding: garbage >0x7F code points in rows hidden by a null
+    parent struct crashed to_arrow with ArrowInvalid when the column fell
+    back to the code-point-matrix path. Hidden rows must be blanked."""
+    copybook = """
+       01 R.
+          05 SEG-ID      PIC X(1).
+          05 A-SEG.
+             10 TXT      PIC X(20).
+          05 B-SEG REDEFINES A-SEG.
+             10 NUM      PIC S9(4) COMP.
+    """
+    a_payload = ebcdic_encode("A") + ebcdic_encode("HELLO", 20)
+    # B record: bytes at TXT's offsets map to non-ASCII cp037 characters
+    b_payload = ebcdic_encode("B") + b"\x42" * 20  # 0x42 -> a-circumflex
+    raw = _rdw_rec(a_payload) + _rdw_rec(b_payload)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "na.bin", raw)
+        res = read_cobol(path, copybook_contents=copybook,
+                         is_record_sequence="true",
+                         ebcdic_code_page="cp037",
+                         segment_field="SEG-ID",
+                         redefine_segment_id_map="A-SEG => A",
+                         **{"redefine_segment_id_map:1": "B-SEG => B"})
+        tbl = res.to_arrow()
+        col = tbl.column("R").to_pylist()
+        assert col[0]["A_SEG"]["TXT"] == "HELLO"
+        assert col[1]["A_SEG"] is None
