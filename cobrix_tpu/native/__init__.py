@@ -132,6 +132,10 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.transcode_string_cols_raw.argtypes = [
             _U8P, _I64P, _I64P, ctypes.c_int64, _I64P, ctypes.c_int64,
             ctypes.c_int64, _U16P, _U16P]
+        lib.decimal128_from_limbs.restype = None
+        lib.decimal128_from_limbs.argtypes = [
+            _U64P, _U64P, _U8P, _U8P, _I64P, ctypes.c_int64,
+            ctypes.c_int32, _U8P, _U8P]
         lib.format_seg_id_level.restype = None
         lib.format_seg_id_level.argtypes = [
             _I64P, ctypes.c_void_p, ctypes.c_int64, _U8P, ctypes.c_int64,
@@ -469,6 +473,28 @@ def transcode_string_cols_raw(data, rec_offsets, rec_lengths, col_offsets,
     lib.transcode_string_cols_raw(buf, offs, lens, n, cols, ncols, width,
                                   lut, out)
     return out
+
+
+def decimal128_from_limbs(hi, lo, neg, valid, shifts, max_digits: int = 38):
+    """[n] uint128 magnitude limbs (+sign/valid planes, per-value decimal
+    shift) -> ([n, 16] little-endian decimal128 bytes, ok mask). None when
+    the native library is unavailable; ok[r]=0 marks values needing the
+    exact-Decimal fallback (negative shift, magnitude past `max_digits`)."""
+    lib = _load()
+    if lib is None:
+        return None
+    hi = np.ascontiguousarray(hi, dtype=np.uint64)
+    lo = np.ascontiguousarray(lo, dtype=np.uint64)
+    neg = np.ascontiguousarray(neg, dtype=np.uint8)
+    ok_in = np.ascontiguousarray(valid, dtype=np.uint8)
+    n = hi.shape[0]
+    shifts = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(shifts, dtype=np.int64), (n,)))
+    out = np.empty((n, 16), dtype=np.uint8)
+    ok = np.empty(n, dtype=np.uint8)
+    lib.decimal128_from_limbs(hi, lo, neg, ok_in, shifts, n,
+                              int(max_digits), out, ok)
+    return out, ok.view(bool)
 
 
 def format_seg_id_level(root_rid, counter, prefix: str, level: int, valid):

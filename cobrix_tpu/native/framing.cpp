@@ -651,6 +651,70 @@ void decode_bcd_cols_raw(const uint8_t* data,
   }
 }
 
+// Arrow decimal128 buffers straight from uint128 magnitude limbs:
+// out[r] = (-1)^neg[r] * ((hi<<64)|lo) * 10^shifts[r] as a 16-byte
+// little-endian two's-complement value. ok[r]=0 when the value cannot be
+// represented exactly (negative shift would need rounding division;
+// overflow past 128 bits) — the caller falls back per column.
+typedef unsigned __int128 u128p;
+// load-time init (like kBcdPair): the ThreadPoolExecutor decode path can
+// enter concurrently with the GIL released — no lazy statics here
+static u128p kPow10[39];
+static bool InitPow10() {
+  kPow10[0] = 1;
+  for (int i = 1; i < 39; ++i) kPow10[i] = kPow10[i - 1] * 10;
+  return true;
+}
+static const bool kPow10Init = InitPow10();
+
+void decimal128_from_limbs(const uint64_t* hi, const uint64_t* lo,
+                           const uint8_t* neg, const uint8_t* valid,
+                           const int64_t* shifts, int64_t n,
+                           int32_t max_digits, uint8_t* out, uint8_t* ok) {
+  typedef u128p u128x;
+  const u128x* p10 = kPow10;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+  for (int64_t r = 0; r < n; ++r) {
+    uint8_t* o = out + r * 16;
+    if (!valid[r]) {
+      std::memset(o, 0, 16);
+      ok[r] = 1;  // nulled by the validity bitmap
+      continue;
+    }
+    const int64_t s = shifts[r];
+    if (s < 0 || s > 38) {
+      ok[r] = 0;
+      std::memset(o, 0, 16);
+      continue;
+    }
+    u128x m = (((u128x)hi[r]) << 64) | lo[r];
+    const u128x p = p10[s];
+    if (p != 1 && m > (~(u128x)0) / p) {
+      ok[r] = 0;
+      std::memset(o, 0, 16);
+      continue;
+    }
+    m *= p;
+    // the declared Arrow precision bounds the unscaled value — larger
+    // magnitudes take the exact-Decimal fallback (which raises, matching
+    // the unprojected path's strictness)
+    if ((m >> 127) ||
+        (max_digits >= 1 && max_digits <= 38 && m >= p10[max_digits])) {
+      ok[r] = 0;
+      std::memset(o, 0, 16);
+      continue;
+    }
+    u128x v = neg[r] ? (u128x)(0 - m) : m;
+    for (int i = 0; i < 16; ++i) {
+      o[i] = (uint8_t)(v & 0xFF);
+      v >>= 8;
+    }
+    ok[r] = 1;
+  }
+}
+
 }  // extern "C" (reopened below; the display helper is a C++ template)
 
 // One zoned-decimal field: the shared DISPLAY byte-classification state

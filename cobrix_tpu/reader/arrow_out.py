@@ -81,7 +81,8 @@ def arrow_schema(struct: StructType):
 
 def _validity_buffer(valid: np.ndarray):
     pa = _pa()
-    return pa.py_buffer(np.packbits(valid, bitorder="little").tobytes())
+    # py_buffer holds a reference to the packed array: zero-copy
+    return pa.py_buffer(np.packbits(valid, bitorder="little"))
 
 
 def _decimal128_from_mantissa(mantissa: np.ndarray, valid: np.ndarray,
@@ -93,8 +94,7 @@ def _decimal128_from_mantissa(mantissa: np.ndarray, valid: np.ndarray,
     le[:, 0] = mantissa
     le[:, 1] = mantissa >> 63  # sign extension of the high limb
     vbuf = None if valid.all() else _validity_buffer(valid)
-    return pa.Array.from_buffers(pa_type, n,
-                                 [vbuf, pa.py_buffer(le.tobytes())])
+    return pa.Array.from_buffers(pa_type, n, [vbuf, pa.py_buffer(le)])
 
 
 # Java String.trim strips everything <= ' ' on both sides; left/right trim
@@ -118,8 +118,7 @@ def _string_from_codepoints(mat: np.ndarray, trimming: TrimPolicy):
     off_t, s_t = ("<i8", pa.large_string()) if big else ("<i4", pa.string())
     offsets = np.arange(n + 1, dtype=off_t) * w
     arr = pa.Array.from_buffers(
-        s_t, n, [None, pa.py_buffer(offsets.tobytes()),
-                 pa.py_buffer(data.tobytes())])
+        s_t, n, [None, pa.py_buffer(offsets), pa.py_buffer(data)])
     if trimming is TrimPolicy.BOTH:
         arr = pc.utf8_trim(arr, characters=_JAVA_TRIM)
     elif trimming is TrimPolicy.LEFT:
@@ -202,9 +201,13 @@ class ArrowBatchBuilder:
         if "host" in out:
             return self._python_fallback(col, pa_type, relevant)
         if "values_hi" in out:
-            # wide uint128-limb columns: Decimal materialization owns the
-            # 128-bit sign/scale rules; hidden rows must stay None — their
-            # garbage magnitudes can exceed the declared decimal precision
+            # wide uint128-limb columns: native decimal128 build from the
+            # limbs; exact-Decimal fallback when any value needs rounding
+            # or outruns the declared precision
+            arr = self._decimal128_native(spec, out, pa_type, relevant,
+                                          wide=True)
+            if arr is not None:
+                return arr
             return self._python_fallback(col, pa_type, relevant)
         if spec.codec in _STRING_CODECS:
             return self._string_array(spec, out, pa_type, relevant)
@@ -223,7 +226,11 @@ class ArrowBatchBuilder:
             return pa.array(values.astype(np_t, copy=False), mask=mask)
         if pa.types.is_decimal(pa_type):
             if pa_type.precision > 18:
-                # int64 mantissa can't be widened safely past 18 digits
+                # int64 mantissa widened into 128-bit limbs natively
+                arr = self._decimal128_native(spec, out, pa_type, relevant,
+                                              wide=False)
+                if arr is not None:
+                    return arr
                 return self._python_fallback(col, pa_type, relevant)
             mantissa = values.astype(np.int64, copy=False)
             if spec.params.explicit_decimal or _dyn_scale(spec):
@@ -241,6 +248,45 @@ class ArrowBatchBuilder:
             mantissa = mantissa * 10 ** shift
             return _decimal128_from_mantissa(mantissa, valid, pa_type)
         return self._python_fallback(col, pa_type, relevant)
+
+    def _decimal128_native(self, spec, out, pa_type, relevant, wide: bool):
+        """decimal128 buffers straight from the kernel outputs via the
+        native 128-bit shift-and-pack; None -> caller falls back to exact
+        Decimal materialization."""
+        from .. import native
+
+        pa = _pa()
+        if not native.available() or not pa.types.is_decimal(pa_type):
+            return None
+        valid = np.asarray(out["valid"])
+        if relevant is not None:
+            valid = valid & relevant
+        if wide:
+            hi = np.asarray(out["values_hi"])
+            lo = np.asarray(out["values"])
+            neg = np.asarray(out["negative"])
+        else:
+            v = np.asarray(out["values"]).astype(np.int64, copy=False)
+            neg = v < 0
+            # |INT64_MIN| wraps under int64 abs; the uint64 view of the
+            # wrapped value is the correct 2^63 magnitude
+            lo = np.abs(v).view(np.uint64)
+            hi = np.zeros_like(lo)
+        if spec.params.explicit_decimal or _dyn_scale(spec):
+            shifts = pa_type.scale - np.asarray(out["dot_scale"],
+                                                dtype=np.int64)
+        else:
+            shifts = np.int64(pa_type.scale + fixed_point_exponent(spec))
+        res = native.decimal128_from_limbs(hi, lo, neg, valid, shifts,
+                                           max_digits=pa_type.precision)
+        if res is None:
+            return None
+        data, ok = res
+        if not bool(ok.all()):
+            return None
+        vbuf = None if valid.all() else _validity_buffer(valid)
+        return pa.Array.from_buffers(pa_type, len(valid),
+                                     [vbuf, pa.py_buffer(data)])
 
     def _string_array(self, spec, out, pa_type, relevant=None):
         pa = _pa()
