@@ -97,6 +97,22 @@ def _decimal128_from_mantissa(mantissa: np.ndarray, valid: np.ndarray,
     return pa.Array.from_buffers(pa_type, n, [vbuf, pa.py_buffer(le)])
 
 
+def _static_decimal_shift(spec, pa_type) -> Optional[int]:
+    """Mantissa power-of-ten shift for a fixed-exponent decimal column
+    (None when out of the exact-int64 0..18 window). The single source of
+    the rule for the per-column, flat-OCCURS, and native-limb paths."""
+    shift = pa_type.scale + fixed_point_exponent(spec)
+    return shift if 0 <= shift <= 18 else None
+
+
+def _numpy_dtype_for(pa_type):
+    """pa numeric type -> the numpy dtype the kernels' outputs cast to."""
+    pa = _pa()
+    if pa.types.is_floating(pa_type):
+        return np.float32 if pa.types.is_float32(pa_type) else np.float64
+    return np.int32 if pa.types.is_int32(pa_type) else np.int64
+
+
 # Java String.trim strips everything <= ' ' on both sides; left/right trim
 # strip " \t" (scalar_decoders._trim parity)
 _JAVA_TRIM = "".join(map(chr, range(0x21)))
@@ -214,16 +230,17 @@ class ArrowBatchBuilder:
         if spec.codec in _FLOAT_CODECS:
             values = np.asarray(out["values"])
             valid = np.asarray(out["valid"])
-            np_t = np.float32 if pa.types.is_float32(pa_type) else np.float64
-            return pa.array(values.astype(np_t, copy=False),
-                            mask=~valid if not valid.all() else None)
+            return pa.array(
+                values.astype(_numpy_dtype_for(pa_type), copy=False),
+                mask=~valid if not valid.all() else None)
         # fixed-point
         values = np.asarray(out["values"])
         valid = np.asarray(out["valid"])
         mask = None if valid.all() else ~valid
         if pa.types.is_integer(pa_type):
-            np_t = np.int32 if pa.types.is_int32(pa_type) else np.int64
-            return pa.array(values.astype(np_t, copy=False), mask=mask)
+            return pa.array(
+                values.astype(_numpy_dtype_for(pa_type), copy=False),
+                mask=mask)
         if pa.types.is_decimal(pa_type):
             if pa_type.precision > 18:
                 # int64 mantissa widened into 128-bit limbs natively
@@ -236,15 +253,17 @@ class ArrowBatchBuilder:
             if spec.params.explicit_decimal or _dyn_scale(spec):
                 shift = pa_type.scale - np.asarray(out["dot_scale"],
                                                    dtype=np.int64)
+                shift = np.broadcast_to(shift, mantissa.shape)
+                if relevant is not None:
+                    # garbage dot-scale planes in hidden rows must neither
+                    # force the fallback nor feed negative powers below
+                    shift = np.where(relevant, shift, 0)
+                if np.any((shift < 0) | (shift > 18)):
+                    return self._python_fallback(col, pa_type, relevant)
             else:
-                shift = pa_type.scale + fixed_point_exponent(spec)
-            shift = np.broadcast_to(np.asarray(shift), mantissa.shape)
-            if relevant is not None:
-                # garbage dot-scale planes in hidden rows must neither
-                # force the fallback nor feed negative powers below
-                shift = np.where(relevant, shift, 0)
-            if np.any((shift < 0) | (shift > 18)):
-                return self._python_fallback(col, pa_type, relevant)
+                shift = _static_decimal_shift(spec, pa_type)
+                if shift is None:
+                    return self._python_fallback(col, pa_type, relevant)
             mantissa = mantissa * 10 ** shift
             return _decimal128_from_mantissa(mantissa, valid, pa_type)
         return self._python_fallback(col, pa_type, relevant)
@@ -326,13 +345,89 @@ class ArrowBatchBuilder:
         return np.where((v >= st.array_min_size) & (v <= st.array_max_size),
                         v, st.array_max_size)
 
+    def _flat_slot_values(self, st: Primitive, slot_path, max_size: int):
+        """One record-major flat array covering every OCCURS slot of a
+        numeric leaf (the slots live in one kernel group; per-slot
+        pa.array calls would dominate wide-OCCURS materialization —
+        exp3's 2000-element plane is 4000 such calls otherwise). None ->
+        caller uses the per-slot path."""
+        pa = _pa()
+        pa_type = to_arrow_type(primitive_data_type(st))
+        is_decimal = pa.types.is_decimal(pa_type)
+        if not (pa.types.is_integer(pa_type) or pa.types.is_floating(pa_type)
+                or (is_decimal and pa_type.precision <= 18)):
+            return None
+        cols = [self.decoder.slot_map.get((id(st), slot_path + (k,)))
+                for k in range(max_size)]
+        if any(c is None for c in cols):
+            return None
+        spec0 = self.decoder.plan.columns[cols[0]]
+        if self.redefine_masks is not None and spec0.segment:
+            return None  # decode-once hidden rows: keep the masked path
+        if is_decimal and (spec0.params.explicit_decimal
+                           or _dyn_scale(spec0)):
+            return None  # per-value exponent planes stay per slot
+        lengths = self.batch.lengths
+        if lengths is not None:
+            last = self.decoder.plan.columns[cols[-1]]
+            if bool((lengths < last.offset + last.width).any()):
+                return None  # truncated tails own the partial-field rules
+        outs = [self.batch.column_arrays(c) for c in cols]
+        if any("values" not in o or "values_hi" in o for o in outs):
+            return None
+        vals = np.stack([o["values"] for o in outs], axis=1)
+        valid = np.stack([o["valid"] for o in outs], axis=1)
+        flat = vals.reshape(-1)
+        fvalid = valid.reshape(-1)
+        mask = None if fvalid.all() else ~fvalid
+        if is_decimal:
+            shift = _static_decimal_shift(spec0, pa_type)
+            if shift is None:
+                return None
+            mantissa = flat.astype(np.int64, copy=False) * 10 ** shift
+            return _decimal128_from_mantissa(
+                mantissa, fvalid, pa_type)
+        return pa.array(
+            flat.astype(_numpy_dtype_for(pa_type), copy=False), mask=mask)
+
+    def _flat_struct_values(self, group: Group, slot_path, max_size: int):
+        """Record-major flat StructArray over all OCCURS slots of a group
+        element whose fields are all numeric leaves (exp3's
+        STRATEGY-DETAIL). None -> per-slot path."""
+        pa = _pa()
+        names, children = [], []
+        for child in group.children:
+            if child.is_filler:
+                continue
+            if isinstance(child, Group) or child.is_array:
+                return None
+            flat = self._flat_slot_values(child, slot_path, max_size)
+            if flat is None:
+                return None
+            names.append(child.name)
+            children.append(flat)
+        if not children:
+            return None
+        return pa.StructArray.from_arrays(children, names=names)
+
     def _list_array(self, st: Statement, slot_path):
         """OCCURS -> ListArray: element slots interleaved via one take."""
         pa = _pa()
         n, max_size = self.n, st.array_max_size
+        counts_probe = self._occurs_counts(st)
+        if (counts_probe is None and n and max_size
+                and n * max_size < 2**31 - 1):
+            # constant-size OCCURS: one flat record-major values array,
+            # uniform offsets — no per-slot arrays, no interleave take
+            flat = (self._flat_struct_values(st, slot_path, max_size)
+                    if isinstance(st, Group)
+                    else self._flat_slot_values(st, slot_path, max_size))
+            if flat is not None:
+                offsets = np.arange(n + 1, dtype=np.int32) * max_size
+                return pa.ListArray.from_arrays(pa.array(offsets), flat)
         elems = [self._statement_array(st, slot_path + (k,), as_element=True)
                  for k in range(max_size)]
-        counts = self._occurs_counts(st)
+        counts = counts_probe
         if n == 0 or max_size == 0:
             value_type = (elems[0].type if elems
                           else to_arrow_type(self._element_schema_type(st)))
