@@ -717,6 +717,34 @@ void decimal128_from_limbs(const uint64_t* hi, const uint64_t* lo,
 
 }  // extern "C" (reopened below; the display helper is a C++ template)
 
+// Byte-class LUTs for the DISPLAY state machine: low nibble = digit value
+// (0xF = none); flag bits: 0x10 plus-sign, 0x20 minus-sign, 0x40 decimal
+// point, 0x80 space. A byte classifying to exactly 0x0F is unknown.
+static uint8_t kDisplayClass[2][256];
+static bool InitDisplayClass() {
+  for (int b = 0; b < 256; ++b) {
+    uint8_t e = 0x0F, a = 0x0F;
+    // EBCDIC (StringDecoders.decodeEbcdicNumber :154)
+    if (b >= 0xF0 && b <= 0xF9) e = (uint8_t)(b - 0xF0);
+    else if (b >= 0xC0 && b <= 0xC9) e = (uint8_t)(0x10 | (b - 0xC0));
+    else if (b >= 0xD0 && b <= 0xD9) e = (uint8_t)(0x20 | (b - 0xD0));
+    else if (b == 0x60) e = 0x2F;
+    else if (b == 0x4E) e = 0x1F;
+    else if (b == 0x4B || b == 0x6B) e = 0x4F;
+    else if (b == 0x40 || b == 0x00) e = 0x8F;
+    // ASCII (StringDecoders.decodeAsciiNumber)
+    if (b >= 0x30 && b <= 0x39) a = (uint8_t)(b - 0x30);
+    else if (b == 0x2D) a = 0x2F;
+    else if (b == 0x2B) a = 0x1F;
+    else if (b == 0x2E || b == 0x2C) a = 0x4F;
+    else if (b <= 0x20) a = 0x8F;
+    kDisplayClass[0][b] = e;
+    kDisplayClass[1][b] = a;
+  }
+  return true;
+}
+static const bool kDisplayClassInit = InitDisplayClass();
+
 // One zoned-decimal field: the shared DISPLAY byte-classification state
 // machine (StringDecoders.decodeEbcdicNumber :154 / decodeAsciiNumber),
 // templated over the accumulator so the narrow (uint64) and wide
@@ -727,39 +755,36 @@ static inline void decode_display_field(
     int32_t allow_dot, int32_t require_digits, int32_t dyn_sf,
     AccT* acc_out, uint8_t* ok_out, bool* negative_out,
     int64_t* dots_out) {
+  const uint8_t* cls = kDisplayClass[kind];
   AccT acc = 0;
   int32_t n_signs = 0, n_dots = 0, n_digits = 0, digits_after_dot = 0;
   bool negative = false, unknown = false, interior_space = false;
   bool seen_meaningful = false, space_after_meaningful = false;
   for (int32_t i = 0; i < width; ++i) {
-    uint8_t b = p[i];
-    int32_t d = -1;
+    const uint8_t cl = cls[p[i]];
+    const uint8_t d = cl & 0x0F;
     bool dot = false, space = false;
-    if (kind == 0) {  // EBCDIC
-      if (b >= 0xF0 && b <= 0xF9) d = b - 0xF0;
-      else if (b >= 0xC0 && b <= 0xC9) { d = b - 0xC0; ++n_signs; }
-      else if (b >= 0xD0 && b <= 0xD9) { d = b - 0xD0; ++n_signs; negative = true; }
-      else if (b == 0x60) { ++n_signs; negative = true; }
-      else if (b == 0x4E) { ++n_signs; }
-      else if (b == 0x4B || b == 0x6B) dot = true;
-      else if (b == 0x40 || b == 0x00) space = true;
-      else unknown = true;
-    } else {  // ASCII
-      if (b >= 0x30 && b <= 0x39) d = b - 0x30;
-      else if (b == 0x2D) { ++n_signs; negative = true; }
-      else if (b == 0x2B) { ++n_signs; }
-      else if (b == 0x2E || b == 0x2C) dot = true;
-      else if (b <= 0x20) space = true;
-      else unknown = true;
-    }
-    if (d >= 0) {
-      acc = acc * 10 + (uint32_t)d;
+    if (d < 10) {
+      acc = acc * 10 + d;
       ++n_digits;
       if (n_dots > 0) ++digits_after_dot;
+      if (cl & 0x30) {
+        ++n_signs;
+        if (cl & 0x20) negative = true;
+      }
+    } else if (cl & 0x30) {  // bare sign
+      ++n_signs;
+      if (cl & 0x20) negative = true;
+    } else if (cl & 0x40) {
+      dot = true;
+      ++n_dots;
+    } else if (cl & 0x80) {
+      space = true;
+    } else {
+      unknown = true;
     }
-    if (dot) ++n_dots;
     if (kind == 1) {  // ASCII edge-space rule
-      bool meaningful = (d >= 0) || dot;
+      bool meaningful = (d < 10) || dot;
       if (meaningful) {
         if (space_after_meaningful) interior_space = true;
         seen_meaningful = true;
