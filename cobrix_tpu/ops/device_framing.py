@@ -1,0 +1,120 @@
+"""Device-side RDW record-boundary discovery.
+
+The reference frames variable-length records with a sequential per-record
+loop (VRLRecordReader.scala:151-186), and this framework's production path
+runs that chain natively on the host (native/framing.cpp rdw_scan). The
+chain LOOKS inherently sequential — each record's start depends on the
+previous record's decoded length — but it parallelizes as a reachability
+problem over per-byte links (SURVEY.md §2.5: "RDW boundary discovery
+becomes a device-side prefix-scan"):
+
+  1. For EVERY byte position p, decode the 4-byte header that WOULD start
+     there: next(p) = p + 4 + length(p). One vectorized gather, no chain.
+  2. Record starts are exactly the orbit of 0 under `next`. Pointer
+     doubling computes it in ceil(log2 n) steps: after step k, `visited`
+     holds every position reachable from 0 in < 2^k hops and `jump` is
+     next^(2^k); one scatter-max extends reachability through the jump.
+
+O(n log n) total work and log n sequential steps, all gathers/scatters —
+the shape XLA maps onto a TPU's HBM bandwidth, vs the host's O(records)
+strictly-sequential walk. On a single host CPU the native scan wins by a
+wide margin; the device scan exists so framing can stay ON device when
+the record bytes already live there (e.g. feeding DeviceAggregator
+without a host round trip) and as the demonstration that the sequential
+index pass (IndexGenerator.scala:33) has a collective-free device
+formulation.
+
+Scope: plain RDW files (both endiannesses, rdw_adjustment); the
+file-header/footer region rules and custom header parsers stay on the
+host path.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def rdw_scan_device(data, big_endian: bool = False,
+                    rdw_adjustment: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """All RDW record (payload offset, length) pairs of a file image,
+    discovered on device. Returns host numpy arrays matching
+    native.rdw_scan(data, big_endian, rdw_adjustment) for well-formed
+    files (malformed zero/oversized headers raise there; here the scan
+    simply stops at the first invalid link)."""
+    import jax
+    import jax.numpy as jnp
+
+    buf = (np.frombuffer(data, dtype=np.uint8)
+           if isinstance(data, (bytes, bytearray, memoryview))
+           else np.asarray(data, dtype=np.uint8))
+    n = buf.size
+    if n < 4:
+        return (np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64))
+
+    starts_mask, lengths_at = _scan_jit(jnp.asarray(buf), bool(big_endian),
+                                        int(rdw_adjustment))
+    starts = np.nonzero(np.asarray(starts_mask))[0]
+    lens = np.asarray(lengths_at)[starts]
+    offsets = starts.astype(np.int64) + 4
+    # clamp the trailing record to the data end (native scan semantics)
+    avail = n - offsets
+    return offsets, np.minimum(lens.astype(np.int64), avail)
+
+
+def _scan_steps(n: int) -> int:
+    return max(1, int(np.ceil(np.log2(max(n, 2)))))
+
+
+def _build_scan(big_endian: bool, adjustment: int):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def scan(buf):
+        n = buf.shape[0]
+        pos = jnp.arange(n, dtype=jnp.int32)
+        # header length that WOULD start at every byte position (padded
+        # reads past the end decode as 0 -> invalid link)
+        b = jnp.pad(buf, (0, 4)).astype(jnp.int32)
+        if big_endian:
+            ln = (b[pos] << 8) | b[pos + 1]
+        else:
+            ln = (b[pos + 3] << 8) | b[pos + 2]
+        ln = ln + adjustment
+        valid = (ln > 0) & (pos + 4 <= n)
+        # next-record link; invalid headers link to the terminal n
+        nxt = jnp.where(valid, pos + 4 + ln, n).astype(jnp.int32)
+        nxt = jnp.minimum(nxt, n)
+        # terminal fixpoint at index n
+        jump = jnp.concatenate([nxt, jnp.asarray([n], dtype=jnp.int32)])
+
+        visited = jnp.zeros(n + 1, dtype=jnp.bool_).at[0].set(True)
+
+        def step(state, _):
+            visited, jump = state
+            # extend reachability through one 2^k jump: scatter-max the
+            # visited flags to their jump targets
+            reached = jnp.zeros_like(visited).at[jump].max(visited)
+            visited = visited | reached
+            jump = jump[jump]
+            return (visited, jump), None
+
+        (visited, _), _ = lax.scan(step, (visited, jump), None,
+                                   length=_scan_steps(n))
+        starts = visited[:n] & valid
+        return starts, ln
+
+    return jax.jit(scan)
+
+
+_scan_cache = {}
+
+
+def _scan_jit(buf, big_endian: bool, adjustment: int):
+    key = (big_endian, adjustment)
+    fn = _scan_cache.get(key)
+    if fn is None:
+        fn = _build_scan(big_endian, adjustment)
+        _scan_cache[key] = fn
+    return fn(buf)

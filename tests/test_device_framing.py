@@ -1,0 +1,53 @@
+"""Device-side RDW framing (ops/device_framing.py): the pointer-doubling
+reachability scan must produce exactly the record boundaries of the
+sequential host scan — the parallel formulation of the per-record chain
+(reference VRLRecordReader.scala:151-186, IndexGenerator.scala:33)."""
+import numpy as np
+import pytest
+
+from cobrix_tpu import native
+from cobrix_tpu.ops.device_framing import rdw_scan_device
+from cobrix_tpu.testing.generators import generate_exp2, generate_exp3
+
+pytestmark = pytest.mark.jax
+
+
+@pytest.mark.parametrize("big_endian", [False, True])
+def test_device_scan_matches_host_scan_exp2(big_endian):
+    raw = generate_exp2(400, seed=11, big_endian_rdw=big_endian)
+    off_h, len_h = native.rdw_scan(raw, big_endian=big_endian)
+    off_d, len_d = rdw_scan_device(raw, big_endian=big_endian)
+    np.testing.assert_array_equal(off_d, off_h)
+    np.testing.assert_array_equal(len_d, len_h)
+
+
+def test_device_scan_matches_host_scan_wide_records():
+    raw = generate_exp3(40, seed=11)
+    off_h, len_h = native.rdw_scan(raw, big_endian=False)
+    off_d, len_d = rdw_scan_device(raw, big_endian=False)
+    np.testing.assert_array_equal(off_d, off_h)
+    np.testing.assert_array_equal(len_d, len_h)
+
+
+def test_device_scan_with_adjustment():
+    # payload length stored +4 (RDW counted in the length): adjustment -4
+    recs = [b"ABCD", b"EFGHIJ", b"XY"]
+    raw = b"".join(
+        (len(r) + 4).to_bytes(2, "big") + b"\x00\x00" + r for r in recs)
+    off_h, len_h = native.rdw_scan(raw, big_endian=True, rdw_adjustment=-4)
+    off_d, len_d = rdw_scan_device(raw, big_endian=True, rdw_adjustment=-4)
+    np.testing.assert_array_equal(off_d, off_h)
+    np.testing.assert_array_equal(len_d, len_h)
+
+
+def test_device_scan_truncated_tail_clamps():
+    raw = (b"\x00\x00\x04\x00" + b"ABCD"
+           + b"\x00\x00\x08\x00" + b"EF")  # tail record short of 8 bytes
+    off_d, len_d = rdw_scan_device(raw, big_endian=False)
+    np.testing.assert_array_equal(off_d, [4, 12])
+    np.testing.assert_array_equal(len_d, [4, 2])
+
+
+def test_device_scan_empty_and_tiny():
+    assert rdw_scan_device(b"")[0].size == 0
+    assert rdw_scan_device(b"\x00\x00")[0].size == 0
