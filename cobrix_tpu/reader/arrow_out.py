@@ -172,13 +172,10 @@ class ArrowBatchBuilder:
 
     def _python_fallback(self, col: int, pa_type, relevant=None):
         pa = _pa()
-        if relevant is not None:
-            # decode-once batch: per-value decode only where the value is
-            # visible (other rows sit under a null parent struct); the
-            # column-level cache would walk every truncated row instead
-            vals = self.batch.column_values_where(col, relevant)
-            return pa.array(vals, type=pa_type)
-        return pa.array(self.batch.column_values(col), type=pa_type)
+        # `relevant` (decode-once batches): rows hidden by a null parent
+        # struct materialize as None and skip the truncation fixups
+        return pa.array(self.batch.column_values(col, relevant=relevant),
+                        type=pa_type)
 
     def _leaf_array(self, st: Primitive, slot_path):
         pa = _pa()

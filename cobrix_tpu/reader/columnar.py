@@ -482,8 +482,8 @@ class DecodedBatch:
         from ..ops.scalar_decoders import _trim
         return _trim(s, trimming)
 
-    def _decode_string_column(self, spec: ColumnSpec,
-                              out: dict) -> List[str]:
+    def _decode_string_column(self, spec: ColumnSpec, out: dict,
+                              relevant=None) -> List[str]:
         from ..ops.scalar_decoders import _trim
 
         arr = out["bytes"]
@@ -497,37 +497,54 @@ class DecodedBatch:
             text = blob.decode("utf-16-le", errors="replace")
         else:  # masked ASCII bytes (always < 0x80)
             text = np.ascontiguousarray(arr).tobytes().decode("latin-1")
+        if relevant is not None:
+            # build only the rows the caller can see (hierarchical walks
+            # read a redefine's columns solely on its own segment's rows)
+            lst: List[Optional[str]] = [None] * n
+            for i in np.nonzero(relevant)[0]:
+                i = int(i)
+                lst[i] = _trim(text[i * w:(i + 1) * w], trimming)
+            return lst
         return [_trim(text[i * w:(i + 1) * w], trimming) for i in range(n)]
 
-    def column_values_where(self, col: int, mask) -> list:
-        """Values at rows where `mask`; None elsewhere. Used by decode-once
-        batches whose other rows are hidden by a null parent struct — the
-        cached whole-column path would pay truncation fixups for rows
-        nobody can see."""
-        out: list = [None] * self.n_records
-        for i in np.nonzero(mask)[0]:
-            out[int(i)] = self.value(col, int(i))
-        return out
-
-    def column_values(self, col: int) -> list:
+    def column_values(self, col: int, relevant=None) -> list:
         """Whole column as a Python value list (the vectorized form of
         `value` — same null/decimal semantics, one pass per column instead
-        of one dynamic dispatch per cell)."""
-        lst = self._col_cache.get(col)
-        if lst is not None:
-            return lst
+        of one dynamic dispatch per cell). `relevant`: optional row mask —
+        rows outside it skip the truncation fixups and may materialize as
+        None (sparse masks take a per-row path; dense ones keep the
+        vectorized conversion, so hidden rows may carry kernel values —
+        hierarchical/decode-once callers never read them either way);
+        masked results are not cached."""
+        if relevant is None:
+            lst = self._col_cache.get(col)
+            if lst is not None:
+                return lst
         spec = self.decoder.plan.columns[col]
         out = self.column_arrays(col)
         n = self.n_records
+        if relevant is not None and "host" not in out \
+                and not self._vectorizable_string(spec):
+            k = int(np.count_nonzero(relevant))
+            if k * 4 < n:
+                # sparse segment: per-row scalar decode of just its rows
+                # beats whole-column Python materialization
+                lst = [None] * n
+                for i in np.nonzero(relevant)[0]:
+                    lst[int(i)] = self.value(col, int(i))
+                return lst
         if "host" in out:
             lst = list(out["host"])
         elif self._vectorizable_string(spec):
-            cached = self._str_cache.get(spec.index)
-            if cached is None:
-                cached = self._decode_string_column(spec, out)
-                self._str_cache[spec.index] = cached
-            # copy only when the truncation fixup below may mutate it
-            lst = list(cached) if self.lengths is not None else cached
+            if relevant is not None:
+                lst = self._decode_string_column(spec, out, relevant)
+            else:
+                cached = self._str_cache.get(spec.index)
+                if cached is None:
+                    cached = self._decode_string_column(spec, out)
+                    self._str_cache[spec.index] = cached
+                # copy only when the truncation fixup below may mutate it
+                lst = list(cached) if self.lengths is not None else cached
         elif spec.codec in _STRING_CODECS:
             lst = [self._string_value(spec, out, i) for i in range(n)]
         elif spec.codec in _FLOAT_CODECS:
@@ -584,11 +601,16 @@ class DecodedBatch:
                            for v, ok in zip(mant, vb)]
         if self.lengths is not None:
             # columns (partly) past a record's end: re-derive through the
-            # scalar path, which owns the truncation rules
-            for i in np.nonzero(
-                    self.lengths < spec.offset + spec.width)[0]:
+            # scalar path, which owns the truncation rules (only for rows
+            # the caller can see — OTHER segments' shorter records would
+            # otherwise storm the per-value path)
+            trunc = self.lengths < spec.offset + spec.width
+            if relevant is not None:
+                trunc = trunc & relevant
+            for i in np.nonzero(trunc)[0]:
                 lst[int(i)] = self.value(col, int(i))
-        self._col_cache[col] = lst
+        if relevant is None:
+            self._col_cache[col] = lst
         return lst
 
     # -- row materialization ----------------------------------------------

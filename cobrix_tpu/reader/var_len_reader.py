@@ -567,14 +567,22 @@ class VarLenReader:
             return None
         data, _base, offsets, rec_lengths, segment_ids = fast
         assert segment_ids is not None  # guaranteed by the seg-field guard
-        # the nesting walk indexes ids per record; a plain list beats the
-        # coded sequence's __getitem__ there
-        segment_ids = segment_ids.tolist()
         n = len(offsets)
         if n == 0:
             return []
 
         sid_map, parent_child_map, root_names = self._hierarchy_maps()
+
+        # per-redefine row masks: a redefine's columns are read only on its
+        # own segment's records, so whole-column materialization (and the
+        # truncation fixups of OTHER segments' shorter records) is skipped
+        # outside the mask
+        name_of_sid = {sid: g.name for sid, g in sid_map.items()}
+        seg_masks = {name: segment_ids.mask_of_mapped(name_of_sid, name)
+                     for name in {g.name for g in sid_map.values()}}
+        # the nesting walk indexes ids per record; a plain list beats the
+        # coded sequence's __getitem__ there
+        segment_ids = segment_ids.tolist()
 
         decoder = self._decoder_for_segment("", backend)
         batch = decoder.decode_raw(data, offsets, rec_lengths)
@@ -584,7 +592,17 @@ class VarLenReader:
         def values_of(col):
             lst = col_values.get(col)
             if lst is None:
-                lst = batch.column_values(col)
+                spec = decoder.plan.columns[col]
+                # dependee columns are READ at every row — the walk runs
+                # non-emitted parts to register DEPENDING-ON counters from
+                # whatever bytes overlay them (oracle parity) — so they
+                # must never be masked
+                is_dependee = (spec.statement is not None
+                               and spec.statement.is_dependee)
+                mask = (seg_masks.get(spec.segment)
+                        if spec.segment is not None and not is_dependee
+                        else None)
+                lst = batch.column_values(col, relevant=mask)
                 col_values[col] = lst
             return lst
 
