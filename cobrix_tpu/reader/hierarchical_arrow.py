@@ -1,0 +1,229 @@
+"""Columnar Arrow assembly for hierarchical (IMS-style) reads.
+
+The reference assembles hierarchical rows one root at a time — buffer a
+root record plus its children, then walk the AST per record
+(VarLenHierarchicalIterator.scala:43-162, extractHierarchicalRecord,
+RecordExtractors.scala:211). The row path here mirrors that walk; THIS
+module is its vectorized twin for Arrow output: the parent/child nesting
+is a pure function of the per-record segment types, so child-to-parent
+assignment, list offsets, and every leaf column come from array ops over
+the one decode-once batch — no Python rows at any point.
+
+Child-attachment rule (matches extract_children's forward scan): a child
+record attaches to the nearest PRECEDING occurrence of any segment type
+in its ancestor chain, and is kept only when that occurrence is of its
+direct parent's type (the oracle's scan from the parent breaks when any
+ancestor id reappears). That type-level formulation equals the oracle's
+sid-level one except when a NON-ROOT parent type is reachable from
+multiple segment ids (the oracle then scans PAST sibling occurrences with
+a different id, double-attaching their children) — such shapes bail to
+the row path. Record_Id parity: each assembled root row is stamped with
+the id of the record that TRIGGERS its flush — the next root, or one past
+the last record at end of stream.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..copybook.ast import Group
+from ..copybook.datatypes import SchemaRetentionPolicy
+
+
+def _pa():
+    import pyarrow as pa
+    return pa
+
+
+def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
+                       copybook, output_schema,
+                       sid_map: Dict[str, Group],
+                       parent_child_map: Dict[str, list],
+                       root_names: set,
+                       file_id: int, start_record_id: int,
+                       input_file_name: str = ""):
+    """pyarrow Table for a hierarchical read, straight from a decode-once
+    `DecodedBatch` over all framed records. `segment_names`: per-record
+    redefine group name ("" / None for unmapped ids). Returns None when
+    the shape needs the row path."""
+    from .arrow_out import ArrowBatchBuilder, arrow_schema
+
+    pa = _pa()
+    n = batch.n_records
+
+    # non-root parent types fed by multiple segment ids diverge from the
+    # oracle's sid-level break rule (see module docstring)
+    sids_per_name: Dict[str, int] = {}
+    for _sid, g in sid_map.items():
+        sids_per_name[g.name] = sids_per_name.get(g.name, 0) + 1
+    for name, count in sids_per_name.items():
+        if count > 1 and name not in root_names and name in parent_child_map:
+            return None
+
+    names = np.asarray([s if s else "" for s in segment_names],
+                       dtype=object)
+
+    parent_of = {}
+    for parent, children in parent_child_map.items():
+        for ch in children:
+            parent_of[ch.name] = parent
+
+    def ancestors(name: str) -> List[str]:
+        out = []
+        cur = parent_of.get(name)
+        while cur is not None:
+            out.append(cur)
+            cur = parent_of.get(cur)
+        return out
+
+    positions_of = {name: np.nonzero(names == name)[0]
+                    for name in {g.name for g in sid_map.values()}}
+    root_pos_list = [positions_of.get(name, np.zeros(0, dtype=np.int64))
+                     for name in root_names]
+    roots = (np.sort(np.concatenate(root_pos_list)) if root_pos_list
+             else np.zeros(0, dtype=np.int64))
+    if roots.size == 0:
+        return arrow_schema(output_schema.schema).empty_table()
+
+    # per-redefine visibility masks: leaf columns of a segment build only
+    # their own rows (hidden rows skip truncation fixups and string work;
+    # their values are garbage by design and are never gathered)
+    seg_masks = {g.name.upper(): names == g.name
+                 for g in sid_map.values()}
+    builder = ArrowBatchBuilder(batch, active=None,
+                                redefine_masks=seg_masks)
+    full_cache: Dict[int, object] = {}
+
+    def full_array(st):
+        """Full-length array for a non-redefine statement, cached (a child
+        type under two parents shares one build)."""
+        arr = full_cache.get(id(st))
+        if arr is None:
+            arr = builder._statement_array(st, ())
+            full_cache[id(st)] = arr
+        return arr
+
+    # child segments in the SCHEMA's order: global segment-redefine
+    # declaration order filtered by parent (reader/schema.py _parse_group)
+    all_redefines = copybook.get_all_segment_redefines()
+
+    def child_segments_of(group: Group) -> List[Group]:
+        return [seg for seg in all_redefines
+                if seg.parent_segment is not None
+                and seg.parent_segment.name.upper() == group.name.upper()]
+
+    def assign_children(child: Group, parent_positions: np.ndarray):
+        """(kept child positions in order, int32 list offsets aligned to
+        parent_positions)."""
+        ch_pos = positions_of.get(child.name, np.zeros(0, dtype=np.int64))
+        anc_names = list(set(ancestors(child.name)))
+        anc_pos = np.nonzero(np.isin(names, anc_names))[0]
+        if ch_pos.size and anc_pos.size:
+            idx = np.searchsorted(anc_pos, ch_pos, side="left") - 1
+            has_anc = idx >= 0
+            owner = np.where(has_anc, anc_pos[np.maximum(idx, 0)], -1)
+            # keep only children whose nearest ancestor occurrence is an
+            # occurrence of the DIRECT parent
+            is_parent_row = np.zeros(len(names) + 1, dtype=bool)
+            is_parent_row[parent_positions] = True
+            keep = has_anc & is_parent_row[owner]
+            ch_kept = ch_pos[keep]
+            owner = owner[keep]
+        else:
+            ch_kept = np.zeros(0, dtype=np.int64)
+            owner = ch_kept
+        # children arrive in position order, owners non-decreasing
+        starts = np.searchsorted(owner, parent_positions, side="left")
+        offsets = np.empty(len(parent_positions) + 1, dtype=np.int32)
+        offsets[:-1] = starts
+        offsets[-1] = len(owner)
+        return ch_kept, offsets
+
+    def expand_offsets(offsets_own: np.ndarray, owned: np.ndarray
+                       ) -> np.ndarray:
+        """Re-align list offsets computed over the owned subset to the
+        full positions vector (non-owned rows become empty lists)."""
+        m = len(owned)
+        ranks = np.cumsum(owned) - 1  # index into owned rows
+        offsets = np.empty(m + 1, dtype=np.int32)
+        start_owned = offsets_own[np.clip(ranks, 0, None)]
+        end_owned = offsets_own[np.clip(ranks + 1, 0, len(offsets_own) - 1)]
+        offsets[:-1] = np.where(owned, start_owned,
+                                np.where(ranks >= 0, end_owned, 0))
+        offsets[-1] = offsets_own[-1]
+        return offsets
+
+    def segment_struct(group: Group, positions: np.ndarray,
+                       null_mask: Optional[np.ndarray] = None):
+        """StructArray of `group` at `positions` (child segments nested as
+        list<struct> fields, schema order). `null_mask`: True where the
+        struct itself is null (rows of positions owned by a sibling
+        redefine — their decoded bytes are garbage by design)."""
+        arrays, field_names = [], []
+        owned = None if null_mask is None else ~null_mask
+        idx = pa.array(positions.astype(np.int64))
+        for child in group.children:
+            if child.is_filler:
+                continue
+            if isinstance(child, Group) and child.parent_segment is not None:
+                continue  # nested below in schema order
+            if isinstance(child, Group) and child.is_segment_redefine:
+                # a segment redefine nested below this group (the root
+                # case: the AST root holds the root redefines)
+                child_owned = np.asarray(
+                    names[positions] == child.name, dtype=bool)
+                sub_mask = (None if bool(child_owned.all())
+                            else ~child_owned)
+                arrays.append(segment_struct(child, positions, sub_mask))
+                field_names.append(child.name)
+                continue
+            field_names.append(child.name)
+            arrays.append(full_array(child).take(idx))
+        for seg in child_segments_of(group):
+            par_pos = positions if owned is None else positions[owned]
+            ch_pos, offs_own = assign_children(seg, par_pos)
+            offsets = (offs_own if owned is None
+                       else expand_offsets(offs_own, owned))
+            field_names.append(seg.name)
+            arrays.append(pa.ListArray.from_arrays(
+                pa.array(offsets), segment_struct(seg, ch_pos)))
+        if not arrays:
+            return pa.nulls(len(positions), type=pa.struct([]))
+        return pa.StructArray.from_arrays(
+            arrays, names=field_names,
+            mask=None if null_mask is None else pa.array(null_mask))
+
+    cols: List[object] = []
+    n_roots = len(roots)
+    if output_schema.generate_record_id:
+        cols.append(pa.array(np.full(n_roots, file_id, dtype=np.int32)))
+        # flush-trigger ids: the next root's record index, or one past the
+        # last record at end of stream
+        triggers = np.empty(n_roots, dtype=np.int64)
+        triggers[:-1] = start_record_id + roots[1:]
+        triggers[-1] = start_record_id + n
+        cols.append(pa.array(triggers))
+        if output_schema.input_file_name_field:
+            cols.append(pa.array([input_file_name] * n_roots,
+                                 type=pa.string()))
+    elif output_schema.input_file_name_field:
+        cols.append(pa.array([input_file_name] * n_roots, type=pa.string()))
+
+    for root in copybook.ast.children:
+        if not isinstance(root, Group):
+            continue
+        struct = segment_struct(root, roots)
+        if output_schema.policy is SchemaRetentionPolicy.COLLAPSE_ROOT:
+            for f in struct.type:
+                cols.append(struct.field(f.name))
+        else:
+            cols.append(struct)
+
+    target = arrow_schema(output_schema.schema)
+    if len(cols) != len(target):
+        return None  # shape mismatch: the row path owns it
+    arrays = [c.cast(target.field(i).type)
+              if c.type != target.field(i).type else c
+              for i, c in enumerate(cols)]
+    return pa.Table.from_arrays(arrays, schema=target)

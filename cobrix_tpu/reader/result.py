@@ -138,13 +138,24 @@ class FileResult:
     generate_input_file_field: bool = False
     segments: List[SegmentBatch] = dc_field(default_factory=list)
     rows: Optional[List[List[object]]] = None   # row-backed fallback
+    # lazy producers (hierarchical decode-once reads): rows and Arrow are
+    # materialized only when actually asked for; each factory is dropped
+    # after first use so the captured decode batch can be released once
+    # both products (cached below) exist
+    rows_factory: Optional[object] = None
+    arrow_factory: Optional[object] = None
+    _arrow_cache: Optional[object] = None
 
     @property
     def is_columnar(self) -> bool:
         """Kernel outputs available (independent of row caching)."""
-        return bool(self.segments)
+        return bool(self.segments) or self.arrow_factory is not None \
+            or self._arrow_cache is not None
 
     def to_rows(self) -> List[List[object]]:
+        if self.rows is None and self.rows_factory is not None:
+            self.rows = self.rows_factory()
+            self.rows_factory = None
         if self.rows is not None:
             return self.rows
         keyed: List[tuple] = []
@@ -177,6 +188,17 @@ class FileResult:
         # prefer the kernel outputs even when rows were also materialized
         # (to_rows caching must not reroute to_arrow onto the row fallback)
         if not self.segments:
+            if self._arrow_cache is not None:
+                return self._arrow_cache
+            if self.arrow_factory is not None:
+                table = self.arrow_factory(output_schema)
+                if table is not None:
+                    self._arrow_cache = table
+                    self.arrow_factory = None
+                    return table
+            if self.rows is None and self.rows_factory is not None:
+                self.rows = self.rows_factory()
+                self.rows_factory = None
             if self.rows is not None:
                 return rows_to_table(self.rows, output_schema.schema)
             return arrow_schema(output_schema.schema).empty_table()

@@ -532,24 +532,13 @@ class VarLenReader:
             root_record_index = last_index + 1
             yield flush()
 
-    def _read_rows_hierarchical_columnar(self, stream: SimpleStream,
-                                         file_id: int, backend: str,
-                                         start_record_id: int,
-                                         starting_file_offset: int
-                                         ) -> Optional[List[List[object]]]:
-        """Hierarchical rows with batched value decode: every record's
-        fields come from ONE full-plan columnar batch (kernels, not the
-        per-field scalar walk); only the parent/child nesting assembly
-        runs per record, mirroring extract_hierarchical_record's scan
-        semantics exactly (forward scan per child segment, stop when a
-        parent id reappears, flush-trigger Record_Id). Returns None when
-        the configuration needs the generic scalar path."""
-        from .extractors import _apply_post_processing
-        from .columnar import _resolve_occurs
-
+    def _hierarchical_columnar_setup(self, stream: SimpleStream,
+                                     backend: str) -> Optional[dict]:
+        """Frame + decode-once setup shared by the hierarchical row and
+        Arrow paths. Returns None when the configuration needs the
+        generic scalar path — every bail happens BEFORE framing consumes
+        the stream, so the caller's fallback can still read it."""
         params = self.params
-        # every bail below happens BEFORE framing consumes the stream: the
-        # caller's scalar fallback must still be able to read it
         if resolve_segment_id_field(params, self.copybook) is None:
             return None
         if params.select:
@@ -568,24 +557,52 @@ class VarLenReader:
         data, _base, offsets, rec_lengths, segment_ids = fast
         assert segment_ids is not None  # guaranteed by the seg-field guard
         n = len(offsets)
-        if n == 0:
-            return []
 
         sid_map, parent_child_map, root_names = self._hierarchy_maps()
-
-        # per-redefine row masks: a redefine's columns are read only on its
-        # own segment's records, so whole-column materialization (and the
-        # truncation fixups of OTHER segments' shorter records) is skipped
-        # outside the mask
         name_of_sid = {sid: g.name for sid, g in sid_map.items()}
+        # per-redefine row masks: a redefine's columns are read only on
+        # its own segment's records, so whole-column materialization (and
+        # the truncation fixups of OTHER segments' shorter records) is
+        # skipped outside the mask
         seg_masks = {name: segment_ids.mask_of_mapped(name_of_sid, name)
                      for name in {g.name for g in sid_map.values()}}
-        # the nesting walk indexes ids per record; a plain list beats the
-        # coded sequence's __getitem__ there
-        segment_ids = segment_ids.tolist()
-
+        sid_list = segment_ids.tolist()
+        segment_names = [name_of_sid.get(s) for s in sid_list]
         decoder = self._decoder_for_segment("", backend)
-        batch = decoder.decode_raw(data, offsets, rec_lengths)
+        batch = (decoder.decode_raw(data, offsets, rec_lengths) if n
+                 else None)
+        n_roots = sum(1 for s in segment_names if s in root_names)
+        return dict(batch=batch, segment_names=segment_names,
+                    sid_list=sid_list, sid_map=sid_map,
+                    parent_child_map=parent_child_map,
+                    root_names=root_names, seg_masks=seg_masks,
+                    decoder=decoder, n=n, n_roots=n_roots,
+                    input_file_name=stream.input_file_name)
+
+    def _read_rows_hierarchical_columnar(self, ctx: dict, file_id: int,
+                                         start_record_id: int
+                                         ) -> List[List[object]]:
+        """Hierarchical rows with batched value decode: every record's
+        fields come from ONE full-plan columnar batch (kernels, not the
+        per-field scalar walk); only the parent/child nesting assembly
+        runs per record, mirroring extract_hierarchical_record's scan
+        semantics exactly (forward scan per child segment, stop when a
+        parent id reappears, flush-trigger Record_Id)."""
+        from .extractors import _apply_post_processing
+        from .columnar import _resolve_occurs
+
+        params = self.params
+        n = ctx["n"]
+        if n == 0:
+            return []
+        batch = ctx["batch"]
+        segment_ids = ctx["sid_list"]
+        sid_map = ctx["sid_map"]
+        parent_child_map = ctx["parent_child_map"]
+        root_names = ctx["root_names"]
+        seg_masks = ctx["seg_masks"]
+        decoder = ctx["decoder"]
+        stream_name = ctx["input_file_name"]
         slot_map = decoder.slot_map
         col_values: Dict[int, list] = {}
 
@@ -711,7 +728,7 @@ class VarLenReader:
             rows.append(_apply_post_processing(
                 records, params.schema_policy, params.generate_record_id,
                 [], file_id, trigger_id, generate_input_file,
-                stream.input_file_name))
+                stream_name))
         return rows
 
     # -- columnar batch path -------------------------------------------------
@@ -910,22 +927,37 @@ class VarLenReader:
             # hierarchical nesting / per-record offset shifts have no
             # static columnar plan (reference extractHierarchicalRecord,
             # RecordExtractors.scala:211; VarOccursRecordExtractor) — but
-            # hierarchical VALUES can still come from batched kernels: the
-            # per-segment batches decode natively and only the nesting
-            # assembly walks per record
-            rows = None
+            # hierarchical VALUES still come from batched kernels: the
+            # decode-once batch feeds a span-based Arrow assembly (no
+            # Python rows) and a lazy nesting walk for the row path
+            ctx = None
             if (self.copybook.is_hierarchical
                     and not self.dynamic_occurs_layout
                     and not params.variable_size_occurs):
-                rows = self._read_rows_hierarchical_columnar(
-                    stream, file_id, backend, start_record_id,
-                    starting_file_offset)
-            if rows is None:
-                rows = list(self.iter_rows(
-                    stream, file_id=file_id,
-                    start_record_id=start_record_id,
-                    starting_file_offset=starting_file_offset,
-                    segment_id_prefix=segment_id_prefix))
+                ctx = self._hierarchical_columnar_setup(stream, backend)
+            if ctx is not None:
+                from .hierarchical_arrow import hierarchical_table
+
+                result.n_rows = ctx["n_roots"]
+                result.rows_factory = (
+                    lambda: self._read_rows_hierarchical_columnar(
+                        ctx, file_id, start_record_id))
+                result.arrow_factory = (
+                    lambda output_schema: hierarchical_table(
+                        ctx["batch"], ctx["segment_names"],
+                        self.copybook, output_schema,
+                        ctx["sid_map"],
+                        ctx["parent_child_map"], ctx["root_names"],
+                        file_id=file_id,
+                        start_record_id=start_record_id,
+                        input_file_name=ctx["input_file_name"])
+                    if ctx["n"] else None)
+                return result
+            rows = list(self.iter_rows(
+                stream, file_id=file_id,
+                start_record_id=start_record_id,
+                starting_file_offset=starting_file_offset,
+                segment_id_prefix=segment_id_prefix))
             result.rows = rows
             result.n_rows = len(rows)
             return result

@@ -221,24 +221,26 @@ class TestHierarchicalColumnar:
                                           start_record_id=2 << 32)
         scal = list(reader.iter_rows(MemoryStream(data), file_id=2,
                                      start_record_id=2 << 32))
-        assert res.rows == scal
+        assert res.to_rows() == scal
         assert res.n_rows == len(scal) > 0
 
     def test_columnar_path_engages(self, monkeypatch):
         reader = self._reader()
         data = generate_exp3(40, seed=10)
         called = {}
-        orig = reader._read_rows_hierarchical_columnar
+        orig = reader._hierarchical_columnar_setup
 
         def spy(*a, **k):
             called["yes"] = True
-            rows = orig(*a, **k)
-            assert rows is not None  # no silent scalar fallback
-            return rows
+            ctx = orig(*a, **k)
+            assert ctx is not None  # no silent scalar fallback
+            return ctx
 
-        monkeypatch.setattr(reader, "_read_rows_hierarchical_columnar", spy)
-        reader.read_result_columnar(MemoryStream(data))
+        monkeypatch.setattr(reader, "_hierarchical_columnar_setup", spy)
+        res = reader.read_result_columnar(MemoryStream(data))
         assert called.get("yes")
+        assert res.rows_factory is not None  # rows stay lazy
+        assert res.arrow_factory is not None
 
     def test_scalar_fallback_variable_size_occurs(self):
         """variable_size_occurs shifts per-record offsets: the columnar
@@ -255,7 +257,7 @@ class TestHierarchicalColumnar:
         data = generate_exp3(30, seed=11)
         res = reader.read_result_columnar(MemoryStream(data))
         scal = list(reader.iter_rows(MemoryStream(data)))
-        assert res.rows == scal
+        assert res.to_rows() == scal
 
     @pytest.mark.parametrize("extra", [dict(select=("COMPANY-ID",)),
                                        dict(start_offset=2)])
@@ -288,3 +290,74 @@ class TestHierarchicalColumnar:
         scal = list(reader.iter_rows(MemoryStream(data)))
         assert res.rows == scal
         assert len(scal) > 0
+
+
+class TestHierarchicalArrow:
+    """The span-based columnar Arrow assembly (reader/hierarchical_arrow)
+    must produce exactly the table the row path produces."""
+
+    def _read(self, **extra):
+        import os
+        import tempfile
+
+        from cobrix_tpu import read_cobol
+        from cobrix_tpu.testing import generators as g
+
+        raw = g.generate_hierarchical(40, seed=13)
+        seg_opts = {f"redefine_segment_id_map:{i}": f"{name} => {sid}"
+                    for i, (sid, name) in enumerate(
+                        g.HIERARCHICAL_SEGMENT_MAP.items())}
+        child_opts = {f"segment-children:{i}": f"{parent} => {child}"
+                      for i, (child, parent) in enumerate(
+                          g.HIERARCHICAL_PARENT_MAP.items())}
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, "hier.dat")
+            with open(path, "wb") as f:
+                f.write(raw)
+            return read_cobol(
+                path, copybook_contents=g.HIERARCHICAL_COPYBOOK,
+                is_record_sequence="true", segment_field="SEGMENT-ID",
+                **seg_opts, **child_opts, **extra)
+
+    def test_arrow_factory_matches_row_built_table(self):
+        from cobrix_tpu.reader.arrow_out import rows_to_table
+
+        res = self._read(generate_record_id="true")
+        tbl = res.to_arrow()
+        rows_tbl = rows_to_table(res.to_rows(), res.schema)
+        assert tbl.schema == rows_tbl.schema
+        assert tbl.num_rows == rows_tbl.num_rows
+        assert tbl.to_pylist() == rows_tbl.to_pylist()
+
+    def test_arrow_factory_matches_rows_collapse_root(self):
+        from cobrix_tpu.reader.arrow_out import rows_to_table
+
+        res = self._read(schema_retention_policy="collapse_root")
+        tbl = res.to_arrow()
+        rows_tbl = rows_to_table(res.to_rows(), res.schema)
+        assert tbl.schema == rows_tbl.schema
+        assert tbl.to_pylist() == rows_tbl.to_pylist()
+
+    def test_exp3_hierarchical_arrow_matches_rows(self):
+        from cobrix_tpu.reader.arrow_out import rows_to_table
+        from cobrix_tpu.reader.schema import CobolOutputSchema
+
+        params = ReaderParameters(
+            is_record_sequence=True,
+            generate_record_id=True,
+            multisegment=MultisegmentParameters(
+                segment_id_field="SEGMENT-ID",
+                segment_id_redefine_map={"C": "STATIC_DETAILS",
+                                         "P": "CONTACTS"},
+                field_parent_map={"CONTACTS": "STATIC-DETAILS"}))
+        reader = VarLenReader(EXP3_COPYBOOK, params)
+        data = generate_exp3(60, seed=14)
+        res = reader.read_result_columnar(MemoryStream(data), file_id=1,
+                                          start_record_id=1 << 32)
+        schema = CobolOutputSchema(
+            reader.copybook, policy=params.schema_policy,
+            generate_record_id=True)
+        tbl = res.to_arrow(schema)
+        rows_tbl = rows_to_table(res.to_rows(), schema.schema)
+        assert tbl.schema == rows_tbl.schema
+        assert tbl.to_pylist() == rows_tbl.to_pylist()
