@@ -361,3 +361,27 @@ class TestHierarchicalArrow:
         rows_tbl = rows_to_table(res.to_rows(), schema.schema)
         assert tbl.schema == rows_tbl.schema
         assert tbl.to_pylist() == rows_tbl.to_pylist()
+
+
+@pytest.mark.jax
+def test_decode_once_multiseg_jax_backend_matches_numpy():
+    """The decode-once multisegment path must be backend-agnostic: the
+    jax (XLA) decode of the full all-redefines plan produces the same
+    Arrow table as the native/numpy kernels."""
+    from cobrix_tpu.reader.schema import CobolOutputSchema
+
+    data = generate_exp2(300, seed=21)
+    params = ReaderParameters(
+        is_record_sequence=True,
+        multisegment=MultisegmentParameters(
+            segment_id_field="SEGMENT-ID",
+            segment_id_redefine_map={"C": "STATIC_DETAILS",
+                                     "P": "CONTACTS"}))
+    reader = VarLenReader(EXP2_COPYBOOK, params)
+    schema = CobolOutputSchema(reader.copybook, policy=params.schema_policy)
+    tables = {}
+    for backend in ("numpy", "jax"):
+        res = reader.read_result_columnar(MemoryStream(data),
+                                          backend=backend)
+        tables[backend] = res.to_arrow(schema)
+    assert tables["numpy"].to_pylist() == tables["jax"].to_pylist()
