@@ -467,9 +467,54 @@ def _emit(result: dict, device_status: str, probe_error, device_query,
     print(json.dumps(result), flush=True)
 
 
+def run_hierarchical_side_metric(mb_target: float) -> dict:
+    """Hierarchical (IMS-style) 7-segment profile through the span-based
+    columnar Arrow assembly (TestDataGen17Hierarchical layout). No
+    reference CSV exists for this shape — reported informationally."""
+    import tempfile
+
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing import generators as g
+
+    n_companies = max(50, int(mb_target * 1024 * 1024 / 1350))
+    raw = g.generate_hierarchical(n_companies, seed=100)
+    mb = len(raw) / (1024 * 1024)
+    seg_opts = {f"redefine_segment_id_map:{i}": f"{name} => {sid}"
+                for i, (sid, name) in enumerate(
+                    g.HIERARCHICAL_SEGMENT_MAP.items())}
+    child_opts = {f"segment-children:{i}": f"{parent} => {child}"
+                  for i, (child, parent) in enumerate(
+                      g.HIERARCHICAL_PARENT_MAP.items())}
+    kw = dict(copybook_contents=g.HIERARCHICAL_COPYBOOK,
+              is_record_sequence="true", segment_field="SEGMENT-ID",
+              **seg_opts, **child_opts)
+    path = None
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+            f.write(raw)
+            path = f.name
+        table = read_cobol(path, **kw).to_arrow()  # warmup
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            table = read_cobol(path, **kw).to_arrow()
+            times.append(time.perf_counter() - t0)
+    finally:
+        if path:
+            os.unlink(path)
+    result = {
+        "metric": "hierarchical_7seg_to_arrow",
+        "value": round(mb / min(times), 1),
+        "unit": "MB/s",
+        "roots_per_s": int(table.num_rows / min(times)),
+    }
+    _log(f"side metric hierarchical: {result}")
+    return result
+
+
 def _side_metrics(mb_target: float) -> dict:
-    """exp1/exp2 profiles as named JSON fields; a side-metric failure must
-    never break the headline bench."""
+    """exp1/exp2/hierarchical profiles as named JSON fields; a side-metric
+    failure must never break the headline bench."""
     side = {}
     try:
         side["exp1"] = run_exp1_side_metric(min(mb_target, 40.0))
@@ -479,6 +524,11 @@ def _side_metrics(mb_target: float) -> dict:
         side["exp2"] = run_exp2_side_metric(min(mb_target, 40.0))
     except Exception as exc:
         _log(f"exp2 side metric failed: {exc}")
+    try:
+        side["hierarchical"] = run_hierarchical_side_metric(
+            min(mb_target, 16.0))
+    except Exception as exc:
+        _log(f"hierarchical side metric failed: {exc}")
     return side
 
 
