@@ -62,6 +62,24 @@ def rdw_scan_device(data, big_endian: bool = False,
     return offsets, np.minimum(lens.astype(np.int64), avail)
 
 
+def pack_records_device(data, offsets, lengths, extent: int):
+    """Zero-padded [n, extent] record matrix gathered ON device — the
+    device twin of native.pack_records, so bytes already resident in HBM
+    can flow framing -> pack -> decode/aggregate without a host round
+    trip. Returns a device array."""
+    import jax.numpy as jnp
+
+    buf = jnp.asarray(np.frombuffer(data, dtype=np.uint8)
+                      if isinstance(data, (bytes, bytearray, memoryview))
+                      else data)
+    offs = jnp.asarray(offsets, dtype=jnp.int32)
+    lens = jnp.asarray(lengths, dtype=jnp.int32)
+    cols = jnp.arange(extent, dtype=jnp.int32)
+    idx = jnp.minimum(offs[:, None] + cols[None, :], buf.shape[0] - 1)
+    gathered = buf[idx]
+    return jnp.where(cols[None, :] < lens[:, None], gathered, 0)
+
+
 def _scan_steps(n: int) -> int:
     return max(1, int(np.ceil(np.log2(max(n, 2)))))
 

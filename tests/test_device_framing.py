@@ -51,3 +51,44 @@ def test_device_scan_truncated_tail_clamps():
 def test_device_scan_empty_and_tiny():
     assert rdw_scan_device(b"")[0].size == 0
     assert rdw_scan_device(b"\x00\x00")[0].size == 0
+
+
+def test_device_pack_matches_native_pack():
+    from cobrix_tpu.ops.device_framing import pack_records_device
+
+    raw = generate_exp2(200, seed=3)
+    offsets, lengths = native.rdw_scan(raw, big_endian=False)
+    extent = 68
+    host = native.pack_records(raw, offsets, lengths, extent)
+    dev = np.asarray(pack_records_device(raw, offsets, lengths, extent))
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_device_frame_then_pack_then_aggregate():
+    """The full on-HBM pipeline: device framing -> device pack -> device
+    aggregate; only scalars return to the host."""
+    from cobrix_tpu import parse_copybook
+    from cobrix_tpu.ops.device_framing import (pack_records_device,
+                                               rdw_scan_device)
+    from cobrix_tpu.parallel import DeviceAggregator
+
+    copybook = parse_copybook("""
+       01 R.
+          05 K PIC 9(4) COMP.
+          05 V PIC S9(5) COMP-3.
+    """)
+    vals = np.arange(1, 41)
+    recs = []
+    for v in vals:
+        payload = (int(v).to_bytes(2, "big")
+                   + bytes.fromhex(f"{v:05d}c"))
+        recs.append(bytes([0, 0, len(payload), 0]) + payload)
+    raw = b"".join(recs)
+    offsets, lengths = rdw_scan_device(raw)
+    agg = DeviceAggregator(copybook)
+    packed = np.asarray(pack_records_device(
+        raw, offsets, lengths, agg.record_extent))
+    res = agg.aggregate(packed)
+    assert res["V"]["sum"] == vals.sum()
+    assert res["V"]["count"] == len(vals)
+    assert res["K"]["max"] == vals.max()
