@@ -378,6 +378,16 @@ void transcode_string_cols_arrow(
     const int64_t* data_starts, const int64_t* data_caps,
     int64_t* data_lens) {
   const uint16_t pad = lut[0];
+  // byte-level class tables: trim scans and the all-ASCII copy loop touch
+  // raw bytes once, skipping the uint16 code-point indirection
+  uint8_t lut8[256], trim_both[256], trim_lr[256], wide_cp[256];
+  for (int b = 0; b < 256; ++b) {
+    const uint16_t u = lut[b];
+    lut8[b] = (uint8_t)u;
+    trim_both[b] = u <= 0x20;
+    trim_lr[b] = (u == 0x20 || u == 0x09);
+    wide_cp[b] = u >= 0x80;
+  }
 #ifdef _OPENMP
 #pragma omp parallel for schedule(dynamic)
 #endif
@@ -411,39 +421,69 @@ void transcode_string_cols_arrow(
         return k < avail ? lut[p[k]] : pad;
       };
       int64_t s = 0, e = width;
-      if (trim_mode == 1) {
-        while (s < e && cp(s) <= 0x20) ++s;
-        while (e > s && cp(e - 1) <= 0x20) --e;
-      } else if (trim_mode == 2) {
-        while (s < e && (cp(s) == 0x20 || cp(s) == 0x09)) ++s;
-      } else if (trim_mode == 3) {
-        while (e > s && (cp(e - 1) == 0x20 || cp(e - 1) == 0x09)) --e;
+      bool fast_done = false;
+      if (avail == width) {
+        // full-coverage rows (the overwhelming majority): trim over raw
+        // bytes, then an all-ASCII byte-LUT copy; any wide code point
+        // falls through to the generic UTF-8 path below
+        if (trim_mode == 1) {
+          while (s < e && trim_both[p[s]]) ++s;
+          while (e > s && trim_both[p[e - 1]]) --e;
+        } else if (trim_mode == 2) {
+          while (s < e && trim_lr[p[s]]) ++s;
+        } else if (trim_mode == 3) {
+          while (e > s && trim_lr[p[e - 1]]) --e;
+        }
+        if (pos + (e - s) <= data_cap) {
+          int64_t q = pos;
+          int64_t k = s;
+          for (; k < e; ++k) {
+            const uint8_t b2 = p[k];
+            if (wide_cp[b2]) break;
+            dst[q++] = lut8[b2];
+          }
+          if (k == e) {
+            pos = q;
+            fast_done = true;
+          }
+        }
+      } else {
+        if (trim_mode == 1) {
+          while (s < e && cp(s) <= 0x20) ++s;
+          while (e > s && cp(e - 1) <= 0x20) --e;
+        } else if (trim_mode == 2) {
+          while (s < e && (cp(s) == 0x20 || cp(s) == 0x09)) ++s;
+        } else if (trim_mode == 3) {
+          while (e > s && (cp(e - 1) == 0x20 || cp(e - 1) == 0x09)) --e;
+        }
       }
-      if (pos + (e - s) * 3 > data_cap) {
-        // the 3x bound is conservative; count the exact UTF-8 size
-        // before declaring overflow (all-ASCII full-width values fit
-        // the caller's n*width cap exactly)
-        int64_t need = 0;
+      if (!fast_done) {
+        if (pos + (e - s) * 3 > data_cap) {
+          // the 3x bound is conservative; count the exact UTF-8 size
+          // before declaring overflow (all-ASCII full-width values fit
+          // the caller's n*width cap exactly)
+          int64_t need = 0;
+          for (int64_t k = s; k < e; ++k) {
+            uint16_t u = cp(k);
+            need += u < 0x80 ? 1 : (u < 0x800 ? 2 : 3);
+          }
+          if (pos + need > data_cap) {
+            overflow = true;
+            break;
+          }
+        }
         for (int64_t k = s; k < e; ++k) {
           uint16_t u = cp(k);
-          need += u < 0x80 ? 1 : (u < 0x800 ? 2 : 3);
-        }
-        if (pos + need > data_cap) {
-          overflow = true;
-          break;
-        }
-      }
-      for (int64_t k = s; k < e; ++k) {
-        uint16_t u = cp(k);
-        if (u < 0x80) {
-          dst[pos++] = (uint8_t)u;
-        } else if (u < 0x800) {
-          dst[pos++] = (uint8_t)(0xC0 | (u >> 6));
-          dst[pos++] = (uint8_t)(0x80 | (u & 0x3F));
-        } else {
-          dst[pos++] = (uint8_t)(0xE0 | (u >> 12));
-          dst[pos++] = (uint8_t)(0x80 | ((u >> 6) & 0x3F));
-          dst[pos++] = (uint8_t)(0x80 | (u & 0x3F));
+          if (u < 0x80) {
+            dst[pos++] = (uint8_t)u;
+          } else if (u < 0x800) {
+            dst[pos++] = (uint8_t)(0xC0 | (u >> 6));
+            dst[pos++] = (uint8_t)(0x80 | (u & 0x3F));
+          } else {
+            dst[pos++] = (uint8_t)(0xE0 | (u >> 12));
+            dst[pos++] = (uint8_t)(0x80 | ((u >> 6) & 0x3F));
+            dst[pos++] = (uint8_t)(0x80 | (u & 0x3F));
+          }
         }
       }
       offs[r + 1] = (int32_t)pos;
