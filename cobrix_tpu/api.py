@@ -43,7 +43,12 @@ class Options:
     (reference Parameters.scala:27-98)."""
 
     def __init__(self, options: Dict[str, object]):
-        self._map = {str(k): str(v) for k, v in options.items()}
+        # Python-native callers pass mappings/lists directly (e.g.
+        # occurs_mapping as a dict); the option layer is string-keyed like
+        # the reference's .option() map, so structured values carry as JSON
+        self._map = {str(k): (json.dumps(v) if isinstance(v, (dict, list))
+                              else str(v))
+                     for k, v in options.items()}
         self._used = set()
 
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
@@ -189,10 +194,18 @@ def parse_options(options: Dict[str, object],
                 opts, ("segment-children", "segment_children")))
 
     occurs_mappings = {}
-    if "occurs_mappings" in opts:
+    # the reference README documents the singular key (`occurs_mapping`,
+    # README.md:1101); both spellings are accepted, but not together
+    occurs_keys = [k for k in ("occurs_mappings", "occurs_mapping")
+                   if k in opts]
+    if len(occurs_keys) > 1:
+        raise ValueError(
+            "Options 'occurs_mappings' and 'occurs_mapping' cannot be "
+            "specified at the same time")
+    if occurs_keys:
         occurs_mappings = {
             k: {sk: int(sv) for sk, sv in v.items()}
-            for k, v in json.loads(opts.get("occurs_mappings")).items()}
+            for k, v in json.loads(opts.get(occurs_keys[0])).items()}
 
     non_terminals = tuple(
         s for s in (opts.get("non_terminals", "") or "").split(",") if s)
@@ -242,6 +255,11 @@ def parse_options(options: Dict[str, object],
     opts.get_bool("debug_ignore_file_size")
     opts.get_int("parallelism", 0)
     opts.get_int("hosts", 0)
+    # HDFS-locality knobs (LocalityParameters.scala:21-30): accepted for
+    # workload compatibility; shard placement here has no HDFS block
+    # topology to optimize (SURVEY.md §2.5 — locality consciously dropped)
+    opts.get_bool("improve_locality", True)
+    opts.get_bool("optimize_allocation")
     _validate_options(opts, params, streaming)
     return params, opts
 
@@ -554,10 +572,6 @@ def read_cobol(path=None,
                          "cannot be specified at the same time")
     if has_multi:
         copybook = options.pop("copybooks").split(",")
-    if isinstance(options.get("occurs_mappings"), (dict, list)):
-        # Python-native callers pass the mapping directly; the option layer
-        # is string-keyed like the reference's .option() map
-        options["occurs_mappings"] = json.dumps(options["occurs_mappings"])
 
     if copybook_contents is None:
         if copybook is None:

@@ -88,3 +88,54 @@ def test_list_input_files_skips_hidden():
     files = list_input_files(os.path.join(REFERENCE_DATA, "test1_data"))
     assert files and all(not os.path.basename(f).startswith((".", "_"))
                          for f in files)
+
+
+def test_occurs_mapping_singular_key_and_locality_options(tmp_path):
+    """The reference README documents `occurs_mapping` (singular,
+    README.md:1101) and the HDFS-locality knobs (improve_locality /
+    optimize_allocation, LocalityParameters.scala:21-30); all must be
+    accepted — locality is a no-op here but pedantic mode must not
+    reject reference workloads."""
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing.generators import ebcdic_encode
+
+    copybook = """
+       01 REC.
+          05 KIND  PIC X(1).
+          05 ITEMS OCCURS 0 TO 3 TIMES DEPENDING ON KIND.
+             10 V PIC X(1).
+"""
+    path = tmp_path / "o.bin"
+    path.write_bytes(ebcdic_encode("AX--") + ebcdic_encode("BXYZ"))
+    out = read_cobol(str(path), copybook_contents=copybook,
+                     occurs_mapping={"ITEMS": {"A": 1, "B": 3}},
+                     improve_locality="false",
+                     optimize_allocation="true",
+                     pedantic="true")
+    rows = out.to_rows()
+    assert len(rows[0][0][1]) == 1
+    assert len(rows[1][0][1]) == 3
+
+
+def test_occurs_mapping_both_keys_conflict(tmp_path):
+    from cobrix_tpu import read_cobol
+
+    copybook = "       01 REC.\n          05 A PIC X(4).\n"
+    path = tmp_path / "c.bin"
+    path.write_bytes(b"\x00" * 4)
+    with pytest.raises(ValueError, match="cannot be specified"):
+        read_cobol(str(path), copybook_contents=copybook,
+                   occurs_mapping='{"A": {"X": 1}}',
+                   occurs_mappings='{"A": {"X": 2}}')
+
+
+def test_streaming_accepts_python_dict_occurs_mapping():
+    """Dict-valued options must normalize at the Options layer so every
+    entry point (read_cobol AND the streaming reader) handles them
+    (review finding: normalization lived only in read_cobol)."""
+    from cobrix_tpu.api import parse_options
+
+    params, _ = parse_options({
+        "copybook_contents": "x",
+        "occurs_mapping": {"ITEMS": {"A": 1}}})
+    assert params.occurs_mappings == {"ITEMS": {"A": 1}}
