@@ -38,6 +38,7 @@ from ..copybook.datatypes import (
     Usage,
 )
 from ..encoding.codepages import code_page_lut_u16
+from .. import native
 from ..ops import batch_np
 from ..profiling import annotate
 from ..plan.compiler import Codec, ColumnSpec, FieldPlan, compile_plan
@@ -145,8 +146,10 @@ def _wide_dyn_dots(hi: np.ndarray, lo: np.ndarray, sf: int) -> np.ndarray:
 # TrimPolicy -> native transcode+trim kernel mode (framing.cpp
 # transcode_string_cols_arrow): BOTH is Java String.trim (cp <= 0x20),
 # LEFT/RIGHT strip " \t" (scalar_decoders._trim parity)
-_NATIVE_TRIM_MODES = {TrimPolicy.NONE: 0, TrimPolicy.BOTH: 1,
-                      TrimPolicy.LEFT: 2, TrimPolicy.RIGHT: 3}
+_NATIVE_TRIM_MODES = {TrimPolicy.NONE: native.TRIM_NONE,
+                      TrimPolicy.BOTH: native.TRIM_BOTH,
+                      TrimPolicy.LEFT: native.TRIM_LEFT,
+                      TrimPolicy.RIGHT: native.TRIM_RIGHT}
 
 
 @functools.lru_cache(maxsize=1)
@@ -296,8 +299,6 @@ class DecodedBatch:
         """Resolve a lazily-deferred string kernel group into the code-point
         ("bytes") matrices the row/value paths consume. Reads never pay this
         when the Arrow path already emitted the column natively."""
-        from .. import native
-
         dec = self.decoder
         if g.codec is Codec.EBCDIC_STRING:
             if self.raw_source is not None:
@@ -322,8 +323,6 @@ class DecodedBatch:
         """[n, ncols, width] byte slab for a group, from the packed batch or
         the raw file image."""
         if self.raw_source is not None:
-            from .. import native
-
             buf, offs, lens = self.raw_source
             extent = int(g.offsets.max()) + g.width
             if self.data.shape[1] >= extent:
@@ -341,8 +340,6 @@ class DecodedBatch:
         can't express it — callers fall back to the code-point path.
         `relevant_of(spec)`: optional per-column row-visibility masks
         (decode-once batches skip rows hidden by a null parent struct)."""
-        from .. import native
-
         out = self._out.get(spec.index)
         if out is None or "lazy_string" not in out or not native.available():
             return None
@@ -359,8 +356,6 @@ class DecodedBatch:
         """Every lazily-deferred group of one string codec through ONE
         native transcode+trim pass — mixed-width columns share the walk
         over the record bytes."""
-        from .. import native
-
         dec = self.decoder
         seen: Dict[int, "_KernelGroup"] = {}
         for col_out in self._out.values():
@@ -851,8 +846,6 @@ class ColumnarDecoder:
         much as the decode), and only the narrow prefix covering the
         remaining groups is packed. Falls back to pack + `decode` when the
         native library or numpy backend is unavailable."""
-        from .. import native
-
         rec_lengths = np.asarray(rec_lengths, dtype=np.int64)
         extent_full = self.plan.max_extent
         lengths = np.minimum(rec_lengths - start_offset, extent_full)
@@ -960,8 +953,6 @@ class ColumnarDecoder:
                           outputs: Dict[int, dict]) -> bool:
         """Single-pass C++ kernels reading straight from the packed batch
         (no intermediate slab). False -> caller uses the numpy path."""
-        from .. import native
-
         if g.codec is Codec.BINARY:
             signed, big_endian, _, wide = g.variant
             if wide:

@@ -29,11 +29,7 @@ import numpy as np
 
 from ..copybook.ast import Group
 from ..copybook.datatypes import SchemaRetentionPolicy
-
-
-def _pa():
-    import pyarrow as pa
-    return pa
+from .arrow_out import _pa
 
 
 def hierarchical_table(batch, segment_names: Sequence[Optional[str]],

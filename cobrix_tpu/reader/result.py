@@ -144,7 +144,7 @@ class FileResult:
     # both products (cached below) exist
     rows_factory: Optional[object] = None
     arrow_factory: Optional[object] = None
-    _arrow_cache: Optional[object] = None
+    _arrow_cache: Optional[object] = dc_field(default=None, repr=False)
 
     @property
     def is_columnar(self) -> bool:

@@ -13,6 +13,8 @@ from __future__ import annotations
 
 from typing import Iterator, Optional, Tuple
 
+import numpy as np
+
 from ..copybook.ast import Primitive
 from ..copybook.copybook import Copybook
 from .header_parsers import RecordHeaderParser
@@ -65,8 +67,6 @@ class SegmentIds:
         return list(self) == list(other)
 
     def tolist(self) -> list:
-        import numpy as np
-
         if not self.uniq:
             return []
         return list(np.asarray(self.uniq, dtype=object)[self.codes])
@@ -78,8 +78,6 @@ class SegmentIds:
 
     def mask_of(self, values) -> "np.ndarray":
         """Boolean per-record mask of ids contained in `values`."""
-        import numpy as np
-
         hits = [k for k, u in enumerate(self.uniq) if u in values]
         if not hits:
             return np.zeros(len(self.codes), dtype=bool)
@@ -89,8 +87,6 @@ class SegmentIds:
                        default: str = "") -> "np.ndarray":
         """Boolean per-record mask of ids whose `mapping` image equals
         `value` (segment id -> active redefine routing)."""
-        import numpy as np
-
         hits = [k for k, u in enumerate(self.uniq)
                 if mapping.get(u, default) == value]
         if not hits:
@@ -115,8 +111,6 @@ def decode_segment_id_bytes(field_bytes, seg_field: Primitive,
     2 bytes code via one O(n) bincount; up to 8 bytes via an integer-key
     sort — both far cheaper than a row-wise lexicographic unique at exp2's
     600k narrow records."""
-    import numpy as np
-
     fb = np.ascontiguousarray(field_bytes)
     n, w = fb.shape
     if n == 0:
