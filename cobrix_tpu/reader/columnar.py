@@ -161,6 +161,22 @@ def _ascii_mask_lut() -> np.ndarray:
     return lut
 
 
+def _masks_equal(a, b) -> bool:
+    """Compare two per-column mask lists (None or [bool array|None, ...])
+    by value — bool-array memcmp, cheap next to a string rebuild."""
+    if a is None or b is None:
+        return a is None and b is None
+    if len(a) != len(b):
+        return False
+    for ma, mb in zip(a, b):
+        if ma is None or mb is None:
+            if ma is not mb:
+                return False
+        elif ma is not mb and not np.array_equal(ma, mb):
+            return False
+    return True
+
+
 class _KernelGroup:
     def __init__(self, codec: Codec, width: int, variant: tuple,
                  columns: List[ColumnSpec]):
@@ -276,7 +292,7 @@ class DecodedBatch:
         self._str_cache: Dict[int, List[str]] = {}
         self._col_cache: Dict[int, list] = {}
         self._maker_cache: Dict[tuple, object] = {}
-        self._arrow_str_cache: Dict[int, list] = {}
+        self._arrow_str_cache: Dict[int, tuple] = {}  # id(group) -> (masks, buffers)
         # actual byte length of each record when shorter than the padded row
         # (variable-length files); columns past a record's end are null /
         # truncated like reference Primitive.decodeTypeValue (Primitive.scala:102)
@@ -345,12 +361,18 @@ class DecodedBatch:
             return None
         g, pos = out["lazy_string"]
         cached = self._arrow_str_cache.get(id(g))
+        if cached is not None and not _masks_equal(
+                cached[0], self._group_masks(g, relevant_of)):
+            # same batch rendered with a different mask set (e.g. two
+            # segment_table calls over different redefine masks): the
+            # cached buffers were trimmed for the other masks — rebuild
+            cached = None
         if cached is None:
             self._build_arrow_strings(g.codec, relevant_of)
             cached = self._arrow_str_cache.get(id(g))
             if cached is None:
                 return None
-        return cached[pos]
+        return cached[1][pos]
 
     def _build_arrow_strings(self, codec: Codec, relevant_of=None) -> None:
         """Every lazily-deferred group of one string codec through ONE
@@ -374,6 +396,8 @@ class DecodedBatch:
             masks = [relevant_of(c) for g in gs for c in g.columns]
             if all(m is None for m in masks):
                 masks = None
+        # cache keys derived through the same helper the lookup path uses
+        group_masks = {id(g): self._group_masks(g, relevant_of) for g in gs}
         trim_mode = _NATIVE_TRIM_MODES.get(dec.plan.trimming)
         res = None
         if trim_mode is not None:
@@ -392,8 +416,19 @@ class DecodedBatch:
             res = [None] * len(col_offs)
         i = 0
         for g in gs:
-            self._arrow_str_cache[id(g)] = res[i:i + len(g.offsets)]
+            self._arrow_str_cache[id(g)] = (group_masks[id(g)],
+                                            res[i:i + len(g.offsets)])
             i += len(g.offsets)
+
+    @staticmethod
+    def _group_masks(g: "_KernelGroup", relevant_of):
+        """Per-column row-visibility masks for one kernel group (the cache
+        key companion for `_arrow_str_cache` — buffers built under one
+        mask set must not serve a render with another)."""
+        if relevant_of is None:
+            return None
+        masks = [relevant_of(c) for c in g.columns]
+        return None if all(m is None for m in masks) else masks
 
     # -- scalar access (row materialization / parity) ----------------------
 

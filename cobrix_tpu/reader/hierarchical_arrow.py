@@ -32,6 +32,52 @@ from ..copybook.datatypes import SchemaRetentionPolicy
 from .arrow_out import _pa
 
 
+def _depending_crosses_segment(copybook) -> bool:
+    """True when an OCCURS DEPENDING ON array inside a segment redefine
+    names a dependee that is not declared inside that SAME redefine.
+
+    The row oracle (extract_hierarchical_record, mirroring reference
+    RecordExtractors.scala:211-385) registers dependees while walking the
+    ROOT record's full AST — prefix fields and every overlay are decoded
+    from the root's bytes — and a child record's subtree walk only
+    re-registers dependees declared inside the child's own group. So for
+    an in-redefine array, a dependee outside that redefine resolves to the
+    ROOT record's value, while the columnar build would re-read the
+    current record's own bytes at the dependee's offset — bail. Arrays in
+    the shared area only materialize at root positions, where both paths
+    read the root record's own bytes — safe for any dependee placement.
+    A dependee name declared in multiple regions is ambiguous — bail."""
+    # keys upper-cased: the oracle binds DEPENDING ON case-insensitively
+    # (mark_dependee_fields matches on .upper(), pipeline.py)
+    regions: Dict[str, set] = {}
+
+    def collect(g: Group, region: Optional[str]) -> None:
+        for st in g.children:
+            r = (st.name if isinstance(st, Group) and st.is_segment_redefine
+                 else region)
+            regions.setdefault(st.name.upper(), set()).add(r)
+            if isinstance(st, Group):
+                collect(st, r)
+
+    for root in copybook.ast.children:
+        if isinstance(root, Group):
+            collect(root, None)
+
+    def crosses(g: Group, region: Optional[str]) -> bool:
+        for st in g.children:
+            r = (st.name if isinstance(st, Group) and st.is_segment_redefine
+                 else region)
+            if st.is_array and st.depending_on is not None and r is not None:
+                if regions.get(st.depending_on.upper()) != {r}:
+                    return True
+            if isinstance(st, Group) and crosses(st, r):
+                return True
+        return False
+
+    return any(crosses(root, None) for root in copybook.ast.children
+               if isinstance(root, Group))
+
+
 def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
                        copybook, output_schema,
                        sid_map: Dict[str, Group],
@@ -56,6 +102,12 @@ def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
     for name, count in sids_per_name.items():
         if count > 1 and name not in root_names and name in parent_child_map:
             return None
+
+    # DEPENDING ON arrays whose dependee lives in a different visibility
+    # region (shared area vs a segment redefine overlay): bail to the row
+    # path, which owns the oracle's cross-record dependee semantics
+    if _depending_crosses_segment(copybook):
+        return None
 
     names = np.asarray([s if s else "" for s in segment_names],
                        dtype=object)

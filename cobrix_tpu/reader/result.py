@@ -141,10 +141,13 @@ class FileResult:
     # lazy producers (hierarchical decode-once reads): rows and Arrow are
     # materialized only when actually asked for; each factory is dropped
     # after first use so the captured decode batch can be released once
-    # both products (cached below) exist
+    # both products (cached below) exist. The Arrow cache remembers the
+    # output_schema it was built for — a later call with a DIFFERENT
+    # schema rebuilds from the row path instead of serving a stale table
     rows_factory: Optional[object] = None
     arrow_factory: Optional[object] = None
     _arrow_cache: Optional[object] = dc_field(default=None, repr=False)
+    _arrow_cache_schema: Optional[object] = dc_field(default=None, repr=False)
 
     @property
     def is_columnar(self) -> bool:
@@ -188,18 +191,22 @@ class FileResult:
         # prefer the kernel outputs even when rows were also materialized
         # (to_rows caching must not reroute to_arrow onto the row fallback)
         if not self.segments:
-            if self._arrow_cache is not None:
+            if self._arrow_cache is not None \
+                    and self._arrow_cache_schema is output_schema:
                 return self._arrow_cache
             if self.arrow_factory is not None:
                 table = self.arrow_factory(output_schema)
                 if table is not None:
                     self._arrow_cache = table
+                    self._arrow_cache_schema = output_schema
                     self.arrow_factory = None
                     return table
             if self.rows is None and self.rows_factory is not None:
                 self.rows = self.rows_factory()
                 self.rows_factory = None
             if self.rows is not None:
+                # not cached: _arrow_cache feeds is_columnar, which must
+                # keep reporting "kernel outputs available" truthfully
                 return rows_to_table(self.rows, output_schema.schema)
             return arrow_schema(output_schema.schema).empty_table()
         tables = []

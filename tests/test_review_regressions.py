@@ -373,3 +373,209 @@ def test_decode_once_hidden_rows_with_non_ascii_garbage():
         col = tbl.column("R").to_pylist()
         assert col[0]["A_SEG"]["TXT"] == "HELLO"
         assert col[1]["A_SEG"] is None
+
+
+def test_hierarchical_odo_dependee_outside_segment_uses_row_path():
+    """Round-4 advisor (high): the columnar hierarchical Arrow assembly
+    resolved DEPENDING ON counts from each record's OWN bytes, but the
+    oracle (reference RecordExtractors depend_fields) carries the dependee
+    value registered from the parent/root record across child records.
+    Shapes where a depending array under a segment redefine names a
+    dependee outside that redefine must bail to the row path."""
+    copybook = """
+       01 RECORD.
+          05 SEG-ID    PIC X(1).
+          05 COMPANY.
+             10 CNT    PIC 9(1).
+             10 NAME   PIC X(4).
+          05 CONTACT REDEFINES COMPANY.
+             10 ITEM   PIC X(1) OCCURS 4 DEPENDING ON CNT.
+"""
+    recs = [("C", "2ACME"), ("P", "WXYZ"), ("C", "1GLOB"), ("P", "QRST")]
+    payload = b"".join(
+        _rdw(1 + len(body)) + ebcdic_encode(sid + body)
+        for sid, body in recs)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "odo.bin", payload)
+        kwargs = dict(
+            copybook_contents=copybook,
+            is_record_sequence=True,
+            is_rdw_big_endian="true",
+            segment_field="SEG-ID",
+            **{"redefine-segment-id-map:0": "COMPANY => C",
+               "redefine-segment-id-map:1": "CONTACT => P",
+               "segment-children:0": "COMPANY => CONTACT"})
+        host = read_cobol(path, backend="host", **kwargs)
+        default = read_cobol(path, backend="numpy", **kwargs)
+        host_tbl = host.to_arrow().to_pylist()
+        num_tbl = default.to_arrow().to_pylist()
+        assert num_tbl == host_tbl
+        # the parent's CNT governs each child's element count (2, then 1)
+        items = [c["ITEM"] for row in num_tbl
+                 for c in row["RECORD"]["COMPANY"]["CONTACT"]]
+        assert [len(it) for it in items] == [2, 1]
+
+
+def test_file_result_arrow_cache_keyed_on_schema():
+    """Round-4 advisor (low): FileResult._arrow_cache ignored the
+    output_schema argument — a second to_arrow() with a different schema
+    silently returned the table built for the first. Now the cache
+    remembers its schema; a different schema rebuilds via the row path."""
+    import pyarrow as pa
+
+    from cobrix_tpu.reader.result import FileResult
+    from cobrix_tpu.reader.schema import Field, SimpleType, StructType
+
+    class FakeSchema:
+        def __init__(self, name):
+            self.schema = StructType(
+                [Field(name, SimpleType("integer"), nullable=True)])
+
+    calls = []
+
+    def factory(schema):
+        calls.append(schema)
+        return pa.table({"a": [7]})
+
+    fr = FileResult(n_rows=1, arrow_factory=factory, rows=[[7]])
+    s1, s2 = FakeSchema("a"), FakeSchema("b")
+    t1 = fr.to_arrow(s1)
+    assert fr.to_arrow(s1) is t1            # same schema object: cached
+    assert calls == [s1]
+    t2 = fr.to_arrow(s2)                    # different schema: NOT the
+    assert t2 is not t1                     # stale cached table
+    assert t2.column_names == ["b"]
+
+
+def test_arrow_string_cache_keyed_on_masks():
+    """Round-4 advisor (low): DecodedBatch._arrow_str_cache was keyed only
+    by kernel-group id — rendering one batch under two different
+    row-visibility mask sets served the first render's trimmed buffers to
+    the second."""
+    from cobrix_tpu import native
+
+    if not native.available():
+        pytest.skip("native string kernel unavailable")
+    copybook = parse_copybook("""
+       01 R.
+          05 TXT      PIC X(5).
+""")
+    data = ebcdic_encode("HELLO") + ebcdic_encode("WORLD")
+    dec = ColumnarDecoder(copybook)
+    spec = next(c for c in dec.plan.columns if c.name == "TXT")
+
+    def render(mask):
+        batch = dec.decode(data)
+        return batch, batch.string_arrow_buffers(
+            spec, relevant_of=lambda c: mask)
+
+    only_first = np.array([True, False])
+    batch, buf1 = render(only_first)
+    assert buf1 is not None
+    offsets, _ = buf1
+    assert offsets[2] == offsets[1]  # hidden row renders empty
+    # SAME batch, different mask: must rebuild, not serve stale buffers
+    buf2 = batch.string_arrow_buffers(spec, relevant_of=lambda c: None)
+    offsets2, data2 = buf2
+    assert bytes(data2[offsets2[1]:offsets2[2]]) == b"WORLD"
+
+
+def test_odo_shared_prefix_dependee_follows_root_record(monkeypatch):
+    """Counter in the shared record prefix, DEPENDING ON array inside a
+    segment redefine: the oracle registers the dependee while walking the
+    ROOT record (extract_hierarchical_record walks prefix fields only for
+    the root), so a child record's element count follows the ROOT's CNT —
+    not the child's own overlapping bytes. The shape must bail to the row
+    path (the columnar build would read each record's own bytes)."""
+    import cobrix_tpu.reader.hierarchical_arrow as ha
+
+    copybook = """
+       01 RECORD.
+          05 SEG-ID    PIC X(1).
+          05 CNT       PIC 9(1).
+          05 COMPANY.
+             10 NAME   PIC X(4).
+          05 CONTACT REDEFINES COMPANY.
+             10 ITEM   PIC X(1) OCCURS 4 DEPENDING ON CNT.
+"""
+    # root carries CNT=2; the child's own prefix byte says 4 — the oracle
+    # must produce 2 items (root's value), not 4
+    recs = [("C", "2ACME"), ("P", "4WXYZ"), ("C", "3GLOB"), ("P", "1QRST")]
+    payload = b"".join(
+        _rdw(1 + len(body)) + ebcdic_encode(sid + body)
+        for sid, body in recs)
+    results = []
+    orig = ha.hierarchical_table
+
+    def spy(*args, **kw):
+        out = orig(*args, **kw)
+        results.append(out)
+        return out
+
+    monkeypatch.setattr(ha, "hierarchical_table", spy)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "odo2.bin", payload)
+        kwargs = dict(
+            copybook_contents=copybook,
+            is_record_sequence=True,
+            is_rdw_big_endian="true",
+            segment_field="SEG-ID",
+            **{"redefine-segment-id-map:0": "COMPANY => C",
+               "redefine-segment-id-map:1": "CONTACT => P",
+               "segment-children:0": "COMPANY => CONTACT"})
+        host = read_cobol(path, backend="host", **kwargs)
+        default = read_cobol(path, backend="numpy", **kwargs)
+        num_tbl = default.to_arrow().to_pylist()
+        assert num_tbl == host.to_arrow().to_pylist()
+        assert results and results[-1] is None  # bailed to the row path
+        items = [c["ITEM"] for row in num_tbl
+                 for c in row["RECORD"]["COMPANY"]["CONTACT"]]
+        assert [len(it) for it in items] == [2, 3]
+
+
+def test_odo_same_segment_dependee_keeps_columnar_path(monkeypatch):
+    """Dependee declared INSIDE the same segment redefine as its array:
+    both paths read each record's own bytes — the columnar hierarchical
+    assembly must NOT bail."""
+    import cobrix_tpu.reader.hierarchical_arrow as ha
+
+    copybook = """
+       01 RECORD.
+          05 SEG-ID    PIC X(1).
+          05 COMPANY.
+             10 NAME   PIC X(5).
+          05 CONTACT REDEFINES COMPANY.
+             10 CNT    PIC 9(1).
+             10 ITEM   PIC X(1) OCCURS 4 DEPENDING ON CNT.
+"""
+    recs = [("C", "ACME "), ("P", "2WXYZ"), ("C", "GLOBX"), ("P", "3QRST")]
+    payload = b"".join(
+        _rdw(1 + len(body)) + ebcdic_encode(sid + body)
+        for sid, body in recs)
+    results = []
+    orig = ha.hierarchical_table
+
+    def spy(*args, **kw):
+        out = orig(*args, **kw)
+        results.append(out)
+        return out
+
+    monkeypatch.setattr(ha, "hierarchical_table", spy)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "odo3.bin", payload)
+        kwargs = dict(
+            copybook_contents=copybook,
+            is_record_sequence=True,
+            is_rdw_big_endian="true",
+            segment_field="SEG-ID",
+            **{"redefine-segment-id-map:0": "COMPANY => C",
+               "redefine-segment-id-map:1": "CONTACT => P",
+               "segment-children:0": "COMPANY => CONTACT"})
+        host = read_cobol(path, backend="host", **kwargs)
+        default = read_cobol(path, backend="numpy", **kwargs)
+        num_tbl = default.to_arrow().to_pylist()
+        assert num_tbl == host.to_arrow().to_pylist()
+        assert results and results[-1] is not None  # columnar path engaged
+        items = [c["ITEM"] for row in num_tbl
+                 for c in row["RECORD"]["COMPANY"]["CONTACT"]]
+        assert [len(it) for it in items] == [2, 3]
