@@ -44,10 +44,11 @@ class DeviceAggregator:
     def __init__(self, copybook: Copybook,
                  columns: Optional[Sequence[str]] = None,
                  active_segment: Optional[str] = None,
-                 mesh=None, pack_bytes: bool = True):
+                 mesh=None, pack_bytes: bool = True,
+                 backend: Optional[str] = None):
         self.decoder = ShardedColumnarDecoder(
             copybook, mesh=mesh, active_segment=active_segment,
-            select=columns)
+            select=columns, backend=backend)
         # byte width a [n, extent] record matrix must have BEFORE byte
         # projection (plan.max_extent shrinks when projection remaps)
         self.record_extent = self.decoder.plan.max_extent
@@ -115,7 +116,7 @@ class DeviceAggregator:
         import jax.numpy as jnp
         from jax import lax
 
-        decode_all = self.decoder.build_jax_decode_fn()
+        decode_all = self.decoder.build_jax_decode_fn(mesh=self.mesh)
         groups = self.decoder.kernel_groups
         fields = self.fields
 
@@ -234,7 +235,7 @@ class DeviceAggregator:
             # round up so the padded batch stays shardable over the mesh
             multiple = -(-block // nd) * nd
         else:
-            multiple = max(self.decoder._bucket_size(n), nd)
+            multiple = self.decoder._mesh_bucket(n)
         padded = pad_batch_to_multiple(arr, multiple)
         return jax.device_put(padded, batch_sharding(self.mesh)), n
 

@@ -22,27 +22,53 @@ from ..reader.columnar import (ColumnarDecoder, DecodedBatch,
 from .mesh import batch_sharding, data_mesh, pad_batch_to_multiple
 
 
+def resolve_device_backend(backend: Optional[str]) -> str:
+    """Map the default ("auto") device backend to the platform: the fused
+    Pallas kernel on real TPU (the production decode plane), the XLA
+    gather path elsewhere (interpret-mode pallas on CPU is a parity tool,
+    not a fast path). An explicit "jax"/"pallas" wins."""
+    if backend not in (None, "auto"):
+        return backend
+    import jax
+
+    try:
+        return "pallas" if jax.default_backend() == "tpu" else "jax"
+    except Exception:
+        return "jax"
+
+
 class ShardedColumnarDecoder(ColumnarDecoder):
     """ColumnarDecoder whose jax path shards the batch axis over a mesh.
 
     The decode program is identical to the single-chip one
     (`build_jax_decode_fn`); only the shardings differ — GSPMD partitions
     the computation, which is the point: no per-device code, no explicit
-    communication, the mesh layout is declarative.
+    communication, the mesh layout is declarative. With backend="pallas"
+    (the default on TPU) the numeric plane runs the fused Pallas kernel,
+    shard_map-ped over the mesh so each chip decodes its own batch shard.
     """
 
     def __init__(self, copybook: Copybook,
                  mesh=None,
                  active_segment: Optional[str] = None,
-                 select=None):
+                 select=None,
+                 backend: Optional[str] = None):
         super().__init__(copybook, active_segment=active_segment,
-                         backend="jax", select=select)
+                         backend=resolve_device_backend(backend),
+                         select=select)
         self.mesh = mesh if mesh is not None else data_mesh()
         self._stats_fn = None
 
     @property
     def n_devices(self) -> int:
         return self.mesh.devices.size
+
+    def _mesh_bucket(self, n: int) -> int:
+        """Batch padding target: the jit bucket, rounded so the global
+        batch divides evenly over the mesh (shard_map requires it)."""
+        nd = self.n_devices
+        bucket = max(self._bucket_size(n), nd)
+        return -(-bucket // nd) * nd
 
     def _decode_jax(self, arr: np.ndarray) -> Dict[int, dict]:
         import jax
@@ -52,7 +78,7 @@ class ShardedColumnarDecoder(ColumnarDecoder):
                 if self._jax_fn is None:
                     sharding = batch_sharding(self.mesh)
                     self._jax_fn = jax.jit(
-                        self.build_jax_decode_fn(),
+                        self.build_jax_decode_fn(mesh=self.mesh),
                         in_shardings=sharding,
                         # every output's leading axis is the record axis;
                         # keep the results distributed — transfers gather
@@ -60,8 +86,7 @@ class ShardedColumnarDecoder(ColumnarDecoder):
                         out_shardings=sharding)
 
         n = arr.shape[0]
-        bucket = max(self._bucket_size(n), self.n_devices)
-        padded = pad_batch_to_multiple(arr, bucket)
+        padded = pad_batch_to_multiple(arr, self._mesh_bucket(n))
         device_outs = self._jax_fn(padded)
         return self.collect_outputs(device_outs, n)
 
@@ -74,7 +99,7 @@ class ShardedColumnarDecoder(ColumnarDecoder):
         import jax.numpy as jnp
 
         if self._stats_fn is None:
-            decode_all = self.build_jax_decode_fn()
+            decode_all = self.build_jax_decode_fn(mesh=self.mesh)
             groups = self.kernel_groups
 
             def stats(data, n):
@@ -103,8 +128,7 @@ class ShardedColumnarDecoder(ColumnarDecoder):
             self._stats_fn = jax.jit(stats, in_shardings=(sharding, None))
 
         n = arr.shape[0]
-        padded = pad_batch_to_multiple(
-            arr, max(self._bucket_size(n), self.n_devices))
+        padded = pad_batch_to_multiple(arr, self._mesh_bucket(n))
         out = jax.device_get(self._stats_fn(padded, np.int32(n)))
         return {k: int(v) for k, v in out.items()}
 

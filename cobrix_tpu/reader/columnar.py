@@ -1141,7 +1141,7 @@ class ColumnarDecoder:
 
     # -- jax backend ------------------------------------------------------
 
-    def build_jax_decode_fn(self):
+    def build_jax_decode_fn(self, mesh=None):
         """The pure decode program: [batch, record_len] uint8 -> list of
         per-kernel-group output tuples. One XLA computation; suitable for
         `jax.jit` directly (single chip) or a sharded jit over a device mesh
@@ -1151,7 +1151,11 @@ class ColumnarDecoder:
         progression (OCCURS-array layouts) decode through the single fused
         Pallas kernel — one VMEM pass of each batch tile for the whole
         numeric plane (ops/pallas_tpu.py); remaining groups use the XLA
-        gather path below."""
+        gather path below. `mesh`: with a multi-device mesh the fused
+        pallas_call is wrapped in shard_map over the ``data`` axis (GSPMD
+        cannot partition a custom call — an unwrapped kernel would force
+        an all-gather of the whole batch onto every chip); the non-fused
+        XLA groups stay in the outer GSPMD context."""
         import jax.numpy as jnp
         from ..ops import batch_jax
 
@@ -1173,6 +1177,17 @@ class ColumnarDecoder:
             if strided:
                 fused = pallas_tpu.build_fused_decode(
                     strided, self.plan.max_extent)
+                if mesh is not None and mesh.devices.size > 1:
+                    import jax
+                    from jax.sharding import PartitionSpec
+
+                    # decode is embarrassingly parallel: each device runs
+                    # the fused kernel on its batch shard, no collectives
+                    fused = jax.shard_map(
+                        fused, mesh=mesh,
+                        in_specs=PartitionSpec("data"),
+                        out_specs=PartitionSpec("data"),
+                        check_vma=False)
 
         def decode_all(data):
             outs: List[tuple] = [None] * len(kernel_groups)

@@ -10,6 +10,17 @@ import sys
 # against the real TPU chip instead (subject to the tunnel-health probe).
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
+# persistent XLA compilation cache: the suite is dominated by jit compiles
+# of the pallas interpret-mode programs (1-core builder); warm runs load
+# AOT results instead (56s -> 20s on the heaviest parity test). The
+# cpu_aot_loader logs a spurious machine-feature-order mismatch error on
+# every load — suppress C++ logging in tests.
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 USE_REAL_TPU = os.environ.get("COBRIX_TPU_TESTS", "").lower() == "real"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -54,9 +65,13 @@ def pytest_collection_modifyitems(config, items):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "jax: test requires a usable jax backend")
-    if not USE_REAL_TPU:
-        try:
-            import jax
+    try:
+        import jax
+        if not USE_REAL_TPU:
             jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
+        # explicit config.update: the axon site hook imports jax before
+        # this conftest runs, so the env vars above can be too late
+        jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
