@@ -80,12 +80,54 @@ def pack_records_device(data, offsets, lengths, extent: int):
     return jnp.where(cols[None, :] < lens[:, None], gathered, 0)
 
 
+def build_wide_pipeline(extent: int, cap: int, min_len: int = 1000,
+                        big_endian: bool = False, adjustment: int = 0,
+                        columns=None):
+    """One jit-able device program: file image ([n] uint8, already in HBM)
+    -> (packed [cap, width] record matrix, live-record count scalar) for
+    the records of length >= `min_len` (exp3's wide 'C' segments). This is
+    the "stay on HBM end-to-end" pipeline — frame (pointer-doubling scan)
+    -> select -> pack/byte-project — with NO host round trip; feed the
+    result straight into DeviceAggregator.submit. `cap`: static row bound
+    (records found beyond it are dropped — size it from the file bytes /
+    min record size). `columns`: optional per-record byte indices to
+    gather (DeviceAggregator.gather_index byte projection); None packs
+    [0, extent)."""
+    import jax
+    import jax.numpy as jnp
+
+    scan_body = _scan_body(big_endian, adjustment)
+    cols = (np.arange(extent, dtype=np.int32) if columns is None
+            else np.asarray(columns, dtype=np.int32))
+
+    def fn(buf):
+        starts, ln = scan_body(buf)
+        n = buf.shape[0]
+        wide = starts & (ln >= min_len)
+        (pos,) = jnp.nonzero(wide, size=cap, fill_value=n)
+        live = pos < n
+        offsets = jnp.where(live, pos + 4, n).astype(jnp.int32)
+        lens = jnp.where(live, ln[jnp.minimum(pos, n - 1)], 0)
+        # truncated trailing record: clamp to the bytes actually present
+        # (native scan semantics — unclamped, the pack mask would smear
+        # the file's last byte across the row instead of zero padding)
+        lens = jnp.minimum(lens, n - offsets)
+        c = jnp.asarray(cols)
+        idx = jnp.minimum(offsets[:, None] + c[None, :], n - 1)
+        packed = jnp.where((c[None, :] < lens[:, None]) & live[:, None],
+                           buf[idx], 0)
+        return packed, live.sum(dtype=jnp.int32)
+
+    return jax.jit(fn)
+
+
 def _scan_steps(n: int) -> int:
     return max(1, int(np.ceil(np.log2(max(n, 2)))))
 
 
-def _build_scan(big_endian: bool, adjustment: int):
-    import jax
+def _scan_body(big_endian: bool, adjustment: int):
+    """The traced (unjitted) scan body, shared by the standalone jitted
+    scan and the composed on-HBM pipeline."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -123,7 +165,13 @@ def _build_scan(big_endian: bool, adjustment: int):
         starts = visited[:n] & valid
         return starts, ln
 
-    return jax.jit(scan)
+    return scan
+
+
+def _build_scan(big_endian: bool, adjustment: int):
+    import jax
+
+    return jax.jit(_scan_body(big_endian, adjustment))
 
 
 _scan_cache = {}

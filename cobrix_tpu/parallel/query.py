@@ -239,18 +239,21 @@ class DeviceAggregator:
         padded = pad_batch_to_multiple(arr, multiple)
         return jax.device_put(padded, batch_sharding(self.mesh)), n
 
-    def submit(self, x, n: int):
+    def submit(self, x, n):
         """Dispatch the aggregate program on a device-resident padded batch
         (from `put`) WITHOUT synchronizing — returns the device-side scalar
         tree. A streaming loop that submits every block before fetching
-        lets the runtime overlap H2D transfers with compute."""
+        lets the runtime overlap H2D transfers with compute. `n` may be a
+        host int or a device scalar — on-HBM pipelines pass the framing
+        program's live-record count without syncing it to the host."""
         from ..ops import batch_jax
 
         batch_jax.ensure_x64()
         if self._agg_fn is None:
             self._agg_fn = self._build()
+        count = np.int32(n) if isinstance(n, int) else n
         with annotate("cobrix_device_aggregate"):
-            return self._agg_fn(x, np.int32(n))
+            return self._agg_fn(x, count)
 
     def fetch(self, tree) -> Dict[str, dict]:
         """Transfer a submitted scalar tree to host and shape the result.

@@ -90,11 +90,24 @@ class ShardedColumnarDecoder(ColumnarDecoder):
         device_outs = self._jax_fn(padded)
         return self.collect_outputs(device_outs, n)
 
-    def decode_stats(self, arr: np.ndarray) -> Dict[str, int]:
+    def put(self, arr: np.ndarray):
+        """Pad `arr` to the mesh bucket and transfer it H2D with the batch
+        sharding. Returns (device_array, n) for the device-resident
+        `decode_stats` path — benchmarks and pipelines that must time the
+        chip's compute apart from the (possibly tunnel-bound) link."""
+        import jax
+
+        n = arr.shape[0]
+        padded = pad_batch_to_multiple(arr, self._mesh_bucket(n))
+        return jax.device_put(padded, batch_sharding(self.mesh)), n
+
+    def decode_stats(self, arr, n: Optional[int] = None) -> Dict[str, int]:
         """Mesh-reduced decode statistics (record count, per-codec valid
         counts). The reductions cross the shard boundary, so XLA lowers
         them to all-reduce collectives over ICI — the only cross-chip
-        traffic the decode plane needs (SURVEY.md §2.5)."""
+        traffic the decode plane needs (SURVEY.md §2.5). Pass a host
+        [n, extent] array, or a device-resident padded batch from `put`
+        together with its `n`."""
         import jax
         import jax.numpy as jnp
 
@@ -127,9 +140,10 @@ class ShardedColumnarDecoder(ColumnarDecoder):
             sharding = batch_sharding(self.mesh)
             self._stats_fn = jax.jit(stats, in_shardings=(sharding, None))
 
-        n = arr.shape[0]
-        padded = pad_batch_to_multiple(arr, self._mesh_bucket(n))
-        out = jax.device_get(self._stats_fn(padded, np.int32(n)))
+        if n is None:
+            arr, n = (pad_batch_to_multiple(arr, self._mesh_bucket(
+                arr.shape[0])), arr.shape[0])
+        out = jax.device_get(self._stats_fn(arr, np.int32(n)))
         return {k: int(v) for k, v in out.items()}
 
 

@@ -92,3 +92,88 @@ def test_device_frame_then_pack_then_aggregate():
     assert res["V"]["sum"] == vals.sum()
     assert res["V"]["count"] == len(vals)
     assert res["K"]["max"] == vals.max()
+
+
+def test_wide_pipeline_matches_host_frame_and_pack():
+    """build_wide_pipeline (one jitted program: scan -> select wide ->
+    pack/byte-project, zero host round trips) must match the host chain
+    native.rdw_scan -> filter -> gather."""
+    from cobrix_tpu.ops.device_framing import build_wide_pipeline
+
+    raw = generate_exp3(24, seed=5)
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    offsets, lengths = native.rdw_scan(raw, big_endian=False)
+    wide = np.nonzero(lengths >= 1000)[0]
+    extent = 256  # a prefix window is enough to check the gather
+    host = buf[offsets[wide][:, None] + np.arange(extent)[None, :]]
+
+    import jax.numpy as jnp
+    cap = len(wide) + 5
+    fn = build_wide_pipeline(extent, cap=cap)
+    packed, count = fn(jnp.asarray(buf))
+    assert int(count) == len(wide)
+    np.testing.assert_array_equal(np.asarray(packed)[:len(wide)], host)
+    assert not np.asarray(packed)[len(wide):].any()  # fill rows zeroed
+
+
+def test_wide_pipeline_byte_projection_feeds_aggregator():
+    """The projected pipeline output IS the DeviceAggregator's packed
+    layout: submit it directly and match the host-path aggregate."""
+    from cobrix_tpu.ops.device_framing import build_wide_pipeline
+    from cobrix_tpu.parallel import DeviceAggregator
+    from cobrix_tpu.reader.parameters import (MultisegmentParameters,
+                                              ReaderParameters)
+    from cobrix_tpu.reader.var_len_reader import VarLenReader
+
+    from cobrix_tpu.testing.generators import EXP3_COPYBOOK
+
+    import jax.numpy as jnp
+
+    reader = VarLenReader(EXP3_COPYBOOK, ReaderParameters(
+        is_record_sequence=True,
+        multisegment=MultisegmentParameters(
+            segment_id_field="SEGMENT-ID",
+            segment_id_redefine_map={"C": "STATIC_DETAILS",
+                                     "P": "CONTACTS"})))
+    raw = generate_exp3(24, seed=6)
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    agg = DeviceAggregator(reader.copybook, columns=["NUM1"],
+                           active_segment="STATIC_DETAILS")
+    assert agg.gather_index is not None
+
+    offsets, lengths = native.rdw_scan(raw, big_endian=False)
+    wide = np.nonzero(lengths >= 1000)[0]
+    cap = -(-(len(wide) + 3) // 8) * 8
+    fn = build_wide_pipeline(agg.record_extent, cap=cap,
+                             columns=agg.gather_index)
+    packed, count = fn(jnp.asarray(buf))
+    got = agg.fetch(agg.submit(packed, np.int32(count)))
+
+    host_mat = buf[offsets[wide][:, None]
+                   + np.arange(agg.record_extent)[None, :]]
+    expect = agg.aggregate(host_mat)
+    assert got["NUM1"]["count"] == expect["NUM1"]["count"]
+    assert got["NUM1"]["sum"] == pytest.approx(expect["NUM1"]["sum"])
+    assert got["NUM1"]["min"] == expect["NUM1"]["min"]
+    assert got["NUM1"]["max"] == expect["NUM1"]["max"]
+
+
+def test_wide_pipeline_clamps_truncated_trailing_record():
+    """A trailing record whose declared RDW length outruns the file must
+    pack zero-padded (native scan clamp semantics), not smear the file's
+    last byte across the row."""
+    from cobrix_tpu.ops.device_framing import build_wide_pipeline
+
+    import jax.numpy as jnp
+
+    payload = bytes(range(1, 101)) * 12
+    # LE RDW declaring 1200 bytes; only 900 are actually present
+    raw = bytes([0, 0, 1200 % 256, 1200 // 256]) + payload[:900]
+    buf = np.frombuffer(raw, dtype=np.uint8)
+    fn = build_wide_pipeline(extent=1200, cap=8, min_len=1000)
+    packed, count = fn(jnp.asarray(buf))
+    packed = np.asarray(packed)
+    assert int(count) == 1
+    np.testing.assert_array_equal(packed[0, :900],
+                                  np.frombuffer(payload[:900], np.uint8))
+    assert not packed[0, 900:].any()  # clamped, zero-padded

@@ -1,0 +1,77 @@
+"""TPU-evidence watcher: probe the device tunnel periodically and run the
+device benchmark the moment it responds.
+
+The tunneled single-chip setup this framework is benchmarked on can wedge
+for hours (a killed mid-flight transfer takes the relay down), and a
+one-shot probe at bench time then forfeits the round's only TPU numbers.
+This watcher closes that gap operationally: it loops a cheap subprocess
+probe (a wedged tunnel can only hang — never the watcher itself) and, on
+the first healthy response, runs `bench.py` and writes the JSON to
+--out, then exits.
+
+Usage:  python tools/tpu_watch.py [--interval 600] [--out TPU_EVIDENCE.json]
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _probe_jax  # noqa: E402  (shared dead-relay fast path)
+
+
+def probe():
+    platform, _err = _probe_jax(timeouts=(45,))
+    return platform
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=int, default=600)
+    ap.add_argument("--out", default=os.path.join(REPO, "TPU_EVIDENCE.json"))
+    ap.add_argument("--bench-mb", default="48")
+    args = ap.parse_args()
+
+    while True:
+        platform = probe()
+        stamp = time.strftime("%H:%M:%S")
+        if platform and platform != "cpu":
+            print(f"[{stamp}] tunnel up ({platform}); running bench",
+                  flush=True)
+            env = dict(os.environ, BENCH_MB=args.bench_mb)
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.join(REPO, "bench.py")],
+                    capture_output=True, text=True, env=env, timeout=3600)
+            except subprocess.TimeoutExpired:
+                # the tunnel wedged mid-bench — the exact scenario the
+                # watcher exists to survive; keep polling
+                print(f"[{stamp}] bench timed out (tunnel wedged?); "
+                      "continuing", flush=True)
+                time.sleep(args.interval)
+                continue
+            line = (proc.stdout.strip().splitlines() or [""])[-1]
+            try:
+                parsed = json.loads(line)
+            except ValueError:
+                parsed = None
+            with open(args.out, "w") as f:
+                json.dump({"captured_at": time.strftime("%F %T"),
+                           "platform": platform, "rc": proc.returncode,
+                           "bench": parsed,
+                           "stderr_tail": proc.stderr[-3000:]}, f, indent=1)
+            print(f"[{stamp}] wrote {args.out} (rc={proc.returncode})",
+                  flush=True)
+            if parsed is not None:
+                return
+        else:
+            print(f"[{stamp}] tunnel down", flush=True)
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    main()
