@@ -145,7 +145,8 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.transcode_string_cols_arrow.argtypes = [
             _U8P, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int64, _I64P, _I64P, ctypes.c_int64, ctypes.c_void_p,
-            _U16P, ctypes.c_int32, _I32P, _U8P, _I64P, _I64P, _I64P]
+            _U16P, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+            _I64P, _I64P]
         _lib = lib
         return _lib
 
@@ -524,7 +525,11 @@ def format_seg_id_level(root_rid, counter, prefix: str, level: int, valid):
         rid, None if cnt is None else cnt.ctypes.data, n, pref, len(pref),
         int(level), ok, out_offsets, out_data, data_cap,
         ctypes.byref(out_len))
-    return out_offsets, out_data[:out_len.value].copy()
+    ln = out_len.value
+    # view when the buffer is mostly full (the common dense case): the
+    # Arrow column pins the parent either way
+    return out_offsets, (out_data[:ln] if ln * 2 >= data_cap
+                         else out_data[:ln].copy())
 
 
 TRIM_NONE = 0
@@ -544,15 +549,18 @@ def _string_cols_arrow(buf, extent_or_size, rec_offsets, rec_lengths, n,
     ncols = cols.shape[0]
     lut = np.ascontiguousarray(lut_u16, dtype=np.uint16)
     # per-column capacity sized for all-ASCII output (the overwhelmingly
-    # common case); columns whose UTF-8 output outgrows it fall back
+    # common case); columns whose UTF-8 output outgrows it fall back.
+    # Each column owns its OWN buffers so retaining one column never pins
+    # the others' memory (zero-copy views below slice these per column)
     data_caps = n * widths + 16
-    data_starts = np.zeros(ncols, dtype=np.int64)
-    np.cumsum(data_caps[:-1], out=data_starts[1:])
-    total = int(data_caps.sum())
-    if ncols * (n + 1) > 2**31 - 16 or bool((data_caps > 2**31 - 16).any()):
+    if n + 1 > 2**31 - 16 or bool((data_caps > 2**31 - 16).any()):
         return None  # int32 offsets can't address this batch
-    out_offsets = np.empty((ncols, n + 1), dtype=np.int32)
-    out_data = np.empty(total, dtype=np.uint8)
+    out_offsets = [np.empty(n + 1, dtype=np.int32) for _ in range(ncols)]
+    out_datas = [np.empty(int(c), dtype=np.uint8) for c in data_caps]
+    offs_ptrs = np.asarray([a.ctypes.data for a in out_offsets],
+                           dtype=np.uintp)
+    data_ptrs = np.asarray([a.ctypes.data for a in out_datas],
+                           dtype=np.uintp)
     data_lens = np.empty(ncols, dtype=np.int64)
     mask_ptrs_arg = None
     if col_masks is not None and any(m is not None for m in col_masks):
@@ -568,15 +576,20 @@ def _string_cols_arrow(buf, extent_or_size, rec_offsets, rec_lengths, n,
         None if rec_offsets is None else rec_offsets.ctypes.data,
         None if rec_lengths is None else rec_lengths.ctypes.data,
         n, cols, widths, ncols, mask_ptrs_arg, lut, trim_mode,
-        out_offsets, out_data, data_starts, data_caps, data_lens)
+        offs_ptrs.ctypes.data, data_ptrs.ctypes.data, data_caps, data_lens)
     result = []
     for c in range(ncols):
         ln = int(data_lens[c])
         if ln < 0:
             result.append(None)  # non-ASCII expansion outgrew the buffer
             continue
-        start = int(data_starts[c])
-        result.append((out_offsets[c], out_data[start:start + ln].copy()))
+        # zero-copy view of this column's own buffer when reasonably
+        # full; copy only when most of it would be dead weight (heavy
+        # trimming / sparse masks)
+        chunk = out_datas[c][:ln]
+        if ln * 2 < out_datas[c].size:
+            chunk = chunk.copy()
+        result.append((out_offsets[c], chunk))
     return result
 
 

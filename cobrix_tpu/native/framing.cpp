@@ -15,6 +15,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <vector>
 
 #ifdef _OPENMP
 #include <omp.h>
@@ -369,127 +370,194 @@ void transcode_string_cols_raw(const uint8_t* data,
 //                out_data + data_starts[c], capacity data_caps[c]
 //   data_lens[c]: UTF-8 bytes written for column c, or -1 when the
 //                 capacity was too small (caller falls back per column)
+// Per-value transcode+trim: emit one field's UTF-8 into dst at cur.
+// Returns the new cursor, or -1 when the value would overflow data_cap
+// (the caller rebuilds that one column in Python).
+struct StrClassTables {
+  uint8_t lut8[256], trim_both[256], trim_lr[256], wide_cp[256];
+};
+
+static inline int64_t transcode_one_value(
+    const uint8_t* p, int64_t avail, int64_t width, const uint16_t* lut,
+    uint16_t pad, const StrClassTables& t, int32_t trim_mode, uint8_t* dst,
+    int64_t cur, int64_t data_cap) {
+  // code point k of this value (zero padding past the record's end)
+  auto cp = [&](int64_t k) -> uint16_t {
+    return k < avail ? lut[p[k]] : pad;
+  };
+  int64_t s = 0, e = width;
+  if (avail == width) {
+    // full-coverage rows (the overwhelming majority): trim over raw
+    // bytes, then an all-ASCII byte-LUT copy; any wide code point
+    // falls through to the generic UTF-8 path below
+    if (trim_mode == 1) {
+      while (s < e && t.trim_both[p[s]]) ++s;
+      while (e > s && t.trim_both[p[e - 1]]) --e;
+    } else if (trim_mode == 2) {
+      while (s < e && t.trim_lr[p[s]]) ++s;
+    } else if (trim_mode == 3) {
+      while (e > s && t.trim_lr[p[e - 1]]) --e;
+    }
+    if (cur + (e - s) <= data_cap) {
+      int64_t q = cur;
+      int64_t k = s;
+      for (; k < e; ++k) {
+        const uint8_t b2 = p[k];
+        if (t.wide_cp[b2]) break;
+        dst[q++] = t.lut8[b2];
+      }
+      if (k == e) return q;
+    }
+  } else {
+    if (trim_mode == 1) {
+      while (s < e && cp(s) <= 0x20) ++s;
+      while (e > s && cp(e - 1) <= 0x20) --e;
+    } else if (trim_mode == 2) {
+      while (s < e && (cp(s) == 0x20 || cp(s) == 0x09)) ++s;
+    } else if (trim_mode == 3) {
+      while (e > s && (cp(e - 1) == 0x20 || cp(e - 1) == 0x09)) --e;
+    }
+  }
+  bool fits = cur + (e - s) * 3 <= data_cap;
+  if (!fits) {
+    // the 3x bound is conservative; count the exact UTF-8 size before
+    // declaring overflow (all-ASCII full-width values fit the caller's
+    // n*width cap exactly)
+    int64_t need = 0;
+    for (int64_t k = s; k < e; ++k) {
+      uint16_t u = cp(k);
+      need += u < 0x80 ? 1 : (u < 0x800 ? 2 : 3);
+    }
+    fits = cur + need <= data_cap;
+  }
+  if (!fits) return -1;
+  for (int64_t k = s; k < e; ++k) {
+    uint16_t u = cp(k);
+    if (u < 0x80) {
+      dst[cur++] = (uint8_t)u;
+    } else if (u < 0x800) {
+      dst[cur++] = (uint8_t)(0xC0 | (u >> 6));
+      dst[cur++] = (uint8_t)(0x80 | (u & 0x3F));
+    } else {
+      dst[cur++] = (uint8_t)(0xE0 | (u >> 12));
+      dst[cur++] = (uint8_t)(0x80 | ((u >> 6) & 0x3F));
+      dst[cur++] = (uint8_t)(0x80 | (u & 0x3F));
+    }
+  }
+  return cur;
+}
+
 void transcode_string_cols_arrow(
     const uint8_t* data, int64_t extent_or_size, const int64_t* rec_offsets,
     const int64_t* rec_lengths, int64_t n, const int64_t* col_offsets,
     const int64_t* col_widths, int64_t ncols,
     const uint8_t* const* col_masks, const uint16_t* lut,
-    int32_t trim_mode, int32_t* out_offsets, uint8_t* out_data,
-    const int64_t* data_starts, const int64_t* data_caps,
+    int32_t trim_mode, int32_t* const* out_offsets_ptrs,
+    uint8_t* const* out_data_ptrs, const int64_t* data_caps,
     int64_t* data_lens) {
   const uint16_t pad = lut[0];
   // byte-level class tables: trim scans and the all-ASCII copy loop touch
   // raw bytes once, skipping the uint16 code-point indirection
-  uint8_t lut8[256], trim_both[256], trim_lr[256], wide_cp[256];
+  StrClassTables t;
   for (int b = 0; b < 256; ++b) {
     const uint16_t u = lut[b];
-    lut8[b] = (uint8_t)u;
-    trim_both[b] = u <= 0x20;
-    trim_lr[b] = (u == 0x20 || u == 0x09);
-    wide_cp[b] = u >= 0x80;
+    t.lut8[b] = (uint8_t)u;
+    t.trim_both[b] = u <= 0x20;
+    t.trim_lr[b] = (u == 0x20 || u == 0x09);
+    t.wide_cp[b] = u >= 0x80;
   }
+  int threads = 1;
+#ifdef _OPENMP
+  threads = omp_get_max_threads();
+#endif
+  if (threads > 1 && ncols > 1) {
+    // multi-core: one thread per column (the pre-row-major scheme —
+    // redundant memory sweeps, but each core owns an independent cursor)
 #ifdef _OPENMP
 #pragma omp parallel for schedule(dynamic)
 #endif
-  for (int64_t c = 0; c < ncols; ++c) {
-    const int64_t col = col_offsets[c];
-    const int64_t width = col_widths[c];
-    const int64_t data_cap = data_caps[c];
-    const uint8_t* mask = col_masks ? col_masks[c] : nullptr;
-    int32_t* offs = out_offsets + c * (n + 1);
-    uint8_t* dst = out_data + data_starts[c];
-    int64_t pos = 0;
-    offs[0] = 0;
-    bool overflow = false;
-    for (int64_t r = 0; r < n; ++r) {
-      if (mask && !mask[r]) {
+    for (int64_t c = 0; c < ncols; ++c) {
+      const int64_t col = col_offsets[c];
+      const int64_t width = col_widths[c];
+      const int64_t data_cap = data_caps[c];
+      const uint8_t* mask = col_masks ? col_masks[c] : nullptr;
+      int32_t* offs = out_offsets_ptrs[c];
+      uint8_t* dst = out_data_ptrs[c];
+      int64_t pos = 0;
+      offs[0] = 0;
+      bool overflow = false;
+      for (int64_t r = 0; r < n; ++r) {
+        if ((mask && !mask[r]) || overflow) {
+          offs[r + 1] = (int32_t)pos;
+          continue;
+        }
+        const uint8_t* p;
+        int64_t avail;
+        if (rec_offsets) {
+          const int64_t len = rec_lengths[r];
+          p = data + rec_offsets[r] + col;
+          avail = col >= len ? 0 : (col + width <= len ? width : len - col);
+        } else {
+          p = data + r * extent_or_size + col;
+          avail = width;
+        }
+        const int64_t cur = transcode_one_value(
+            p, avail, width, lut, pad, t, trim_mode, dst, pos, data_cap);
+        if (cur < 0) {
+          overflow = true;
+        } else {
+          pos = cur;
+        }
         offs[r + 1] = (int32_t)pos;
+      }
+      data_lens[c] = overflow ? -1 : pos;
+    }
+    return;
+  }
+  // single core ROW-major walk: each record's bytes are touched once for
+  // ALL columns (the column-major form swept the whole file image once
+  // per column — on wide batches the redundant memory traffic, not the
+  // per-cell math, was the cost). Per-column output cursors; a column
+  // that overflows keeps consuming rows with writes disabled.
+  std::vector<int64_t> pos(ncols, 0);
+  std::vector<uint8_t> overflow(ncols, 0);
+  for (int64_t c = 0; c < ncols; ++c) out_offsets_ptrs[c][0] = 0;
+  for (int64_t r = 0; r < n; ++r) {
+    const uint8_t* rec;
+    int64_t rec_len;
+    if (rec_offsets) {
+      rec = data + rec_offsets[r];
+      rec_len = rec_lengths[r];
+    } else {
+      rec = data + r * extent_or_size;
+      rec_len = extent_or_size;
+    }
+    for (int64_t c = 0; c < ncols; ++c) {
+      int32_t* offs = out_offsets_ptrs[c];
+      const uint8_t* mask = col_masks ? col_masks[c] : nullptr;
+      if ((mask && !mask[r]) || overflow[c]) {
+        offs[r + 1] = (int32_t)pos[c];
         continue;
       }
-      const uint8_t* p;
-      int64_t avail;
-      if (rec_offsets) {
-        const int64_t len = rec_lengths[r];
-        p = data + rec_offsets[r] + col;
-        avail = col >= len ? 0 : (col + width <= len ? width : len - col);
+      const int64_t col = col_offsets[c];
+      const int64_t width = col_widths[c];
+      const uint8_t* p = rec + col;
+      const int64_t avail =
+          col >= rec_len ? 0 : (col + width <= rec_len ? width
+                                                       : rec_len - col);
+      const int64_t cur = transcode_one_value(
+          p, avail, width, lut, pad, t, trim_mode,
+          out_data_ptrs[c], pos[c], data_caps[c]);
+      if (cur < 0) {
+        overflow[c] = 1;
       } else {
-        p = data + r * extent_or_size + col;
-        avail = width;
+        pos[c] = cur;
       }
-      // code point k of this value (zero padding past the record's end)
-      auto cp = [&](int64_t k) -> uint16_t {
-        return k < avail ? lut[p[k]] : pad;
-      };
-      int64_t s = 0, e = width;
-      bool fast_done = false;
-      if (avail == width) {
-        // full-coverage rows (the overwhelming majority): trim over raw
-        // bytes, then an all-ASCII byte-LUT copy; any wide code point
-        // falls through to the generic UTF-8 path below
-        if (trim_mode == 1) {
-          while (s < e && trim_both[p[s]]) ++s;
-          while (e > s && trim_both[p[e - 1]]) --e;
-        } else if (trim_mode == 2) {
-          while (s < e && trim_lr[p[s]]) ++s;
-        } else if (trim_mode == 3) {
-          while (e > s && trim_lr[p[e - 1]]) --e;
-        }
-        if (pos + (e - s) <= data_cap) {
-          int64_t q = pos;
-          int64_t k = s;
-          for (; k < e; ++k) {
-            const uint8_t b2 = p[k];
-            if (wide_cp[b2]) break;
-            dst[q++] = lut8[b2];
-          }
-          if (k == e) {
-            pos = q;
-            fast_done = true;
-          }
-        }
-      } else {
-        if (trim_mode == 1) {
-          while (s < e && cp(s) <= 0x20) ++s;
-          while (e > s && cp(e - 1) <= 0x20) --e;
-        } else if (trim_mode == 2) {
-          while (s < e && (cp(s) == 0x20 || cp(s) == 0x09)) ++s;
-        } else if (trim_mode == 3) {
-          while (e > s && (cp(e - 1) == 0x20 || cp(e - 1) == 0x09)) --e;
-        }
-      }
-      if (!fast_done) {
-        if (pos + (e - s) * 3 > data_cap) {
-          // the 3x bound is conservative; count the exact UTF-8 size
-          // before declaring overflow (all-ASCII full-width values fit
-          // the caller's n*width cap exactly)
-          int64_t need = 0;
-          for (int64_t k = s; k < e; ++k) {
-            uint16_t u = cp(k);
-            need += u < 0x80 ? 1 : (u < 0x800 ? 2 : 3);
-          }
-          if (pos + need > data_cap) {
-            overflow = true;
-            break;
-          }
-        }
-        for (int64_t k = s; k < e; ++k) {
-          uint16_t u = cp(k);
-          if (u < 0x80) {
-            dst[pos++] = (uint8_t)u;
-          } else if (u < 0x800) {
-            dst[pos++] = (uint8_t)(0xC0 | (u >> 6));
-            dst[pos++] = (uint8_t)(0x80 | (u & 0x3F));
-          } else {
-            dst[pos++] = (uint8_t)(0xE0 | (u >> 12));
-            dst[pos++] = (uint8_t)(0x80 | ((u >> 6) & 0x3F));
-            dst[pos++] = (uint8_t)(0x80 | (u & 0x3F));
-          }
-        }
-      }
-      offs[r + 1] = (int32_t)pos;
+      offs[r + 1] = (int32_t)pos[c];
     }
-    data_lens[c] = overflow ? -1 : pos;
   }
+  for (int64_t c = 0; c < ncols; ++c)
+    data_lens[c] = overflow[c] ? -1 : pos[c];
 }
 
 // Format one Seg_Id level column straight into Arrow string buffers

@@ -444,7 +444,15 @@ class DecodedBatch:
                     return None
                 if spec.offset + spec.width > length:
                     # truncated varchar tail: decode the available bytes
-                    chunk = self.data[i, spec.offset:length].tobytes()
+                    # (from the raw file image when the packed matrix does
+                    # not cover them — see decode_raw's lazy strings)
+                    if self.raw_source is not None \
+                            and self.data.shape[1] < length:
+                        buf, offs, _lens = self.raw_source
+                        o = int(offs[i])
+                        chunk = buf[o + spec.offset:o + length].tobytes()
+                    else:
+                        chunk = self.data[i, spec.offset:length].tobytes()
                     return self.decoder.options.decode(spec.dtype, chunk)
             elif spec.offset + spec.width > length:
                 return None
@@ -922,17 +930,14 @@ class ColumnarDecoder:
             elif g.codec is Codec.EBCDIC_STRING:
                 # deferred: the Arrow path emits these columns straight from
                 # the raw image through the native transcode+trim kernel;
-                # the row path materializes the code-point matrix on demand
+                # the row path materializes the code-point matrix on demand.
+                # Truncated varchar tails re-decode from the raw image too
+                # (DecodedBatch.value raw_source fallback), so records
+                # short of this group never force a wider pack — on a
+                # string-dominated profile the pack was the single largest
+                # decode cost and served only rows that masked reads skip
                 for pos, c in enumerate(g.columns):
                     outputs[c.index] = {"lazy_string": (g, pos)}
-                if len(g.columns):
-                    # truncated varchar tails re-decode through the
-                    # packed batch (DecodedBatch.value); keep the pack
-                    # covering this group's bytes when any record is
-                    # short of them
-                    g_end = int(g.offsets.max()) + g.width
-                    if bool((rec_lengths < g_end).any()):
-                        narrow_extent = max(narrow_extent, g_end)
                 continue
             if res is not None:
                 self._store_numeric(g, outputs, *res)

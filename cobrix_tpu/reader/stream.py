@@ -47,6 +47,13 @@ class SimpleStream:
     def next(self, n: int) -> bytes:
         raise NotImplementedError
 
+    def next_view(self, n: int):
+        """Like `next`, but may return a zero-copy bytes-like view of the
+        underlying storage (FSStream: an mmap window — whole-file framing
+        then reads straight from the page cache instead of paying a full
+        copy). Callers must treat the result as read-only."""
+        return self.next(n)
+
     def close(self) -> None:
         pass
 
@@ -127,6 +134,23 @@ class FSStream(SimpleStream):
         chunk = self._f.read(n)
         self._pos += len(chunk)
         return chunk
+
+    def next_view(self, n: int):
+        """Zero-copy mmap window for bulk reads (small reads keep the
+        buffered path). The memoryview pins the mapping; it is released
+        when the last decode result referencing it is dropped."""
+        n = min(n, self._limit - self._pos)
+        if n <= 0:
+            return b""
+        if n < 4 * 1024 * 1024:
+            return self.next(n)
+        import mmap
+
+        mm = mmap.mmap(self._f.fileno(), 0, access=mmap.ACCESS_READ)
+        view = memoryview(mm)[self._pos:self._pos + n]
+        self._pos += n
+        self._f.seek(self._pos)
+        return view
 
     def close(self) -> None:
         self._f.close()

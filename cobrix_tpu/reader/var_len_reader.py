@@ -121,32 +121,40 @@ def _segment_level_ids_vectorized(segment_ids: Sequence[str],
     if isinstance(segment_ids, SegmentIds):
         # one level lookup per DISTINCT id, broadcast by the codes
         lvl_uniq = np.asarray([sid_level.get(u, -1)
-                               for u in segment_ids.uniq], dtype=np.int64)
+                               for u in segment_ids.uniq], dtype=np.int32)
         lvl = (lvl_uniq[segment_ids.codes] if len(lvl_uniq)
-               else np.full(n, -1, dtype=np.int64))
+               else np.full(n, -1, dtype=np.int32))
     else:
         get_level = sid_level.get
         lvl = np.fromiter((get_level(s, -1) for s in segment_ids),
-                          dtype=np.int64, count=n)
+                          dtype=np.int32, count=n)
 
-    idx = np.arange(n, dtype=np.int64)
+    # int32 state: the plane is memory-bandwidth bound; only root_rid
+    # widens to int64 at the end. Explicit bound instead of silent wrap
+    if n >= 2 ** 31:
+        raise ValueError(
+            f"shard of {n} records exceeds the 2^31 seg-id plane bound; "
+            "split the input (hosts/input_split options)")
+    idx = np.arange(n, dtype=np.int32)
     # forward-filled current level (last matched record's level; -1 = none)
-    last_match = np.where(lvl >= 0, idx, -1)
+    last_match = np.where(lvl >= 0, idx, np.int32(-1))
     np.maximum.accumulate(last_match, out=last_match)
     cur_level = np.where(last_match >= 0, lvl[np.maximum(last_match, 0)], -1)
     no_match_yet = last_match < 0
     # forward-filled root position (-1 before the first root: the
     # accumulator's empty pre-root prefix)
-    root_pos = np.where(lvl == 0, idx, -1)
+    root_pos = np.where(lvl == 0, idx, np.int32(-1))
     np.maximum.accumulate(root_pos, out=root_pos)
-    root_rid = np.where(root_pos >= 0, start_record_id + root_pos,
+    root_rid = np.where(root_pos >= 0,
+                        start_record_id + root_pos.astype(np.int64),
                         np.int64(-1))
 
     # per-level child counters (cumulative count since the current root)
     counters: List[Optional[np.ndarray]] = [None]
     for k in range(1, level_count):
-        c = np.cumsum(lvl == k)
-        at_root = np.where(root_pos >= 0, c[np.maximum(root_pos, 0)], 0)
+        c = np.cumsum(lvl == k, dtype=np.int32)
+        at_root = np.where(root_pos >= 0, c[np.maximum(root_pos, 0)],
+                           np.int32(0))
         counters.append(c - at_root)
     valids = [cur_level >= k for k in range(level_count)]
     coded = dict(root_rid=root_rid, counters=counters, valids=valids,
@@ -762,7 +770,7 @@ class VarLenReader:
             return None
         p = self.params
         base = stream.offset
-        data = stream.next(stream.size() - base)
+        data = stream.next_view(stream.size() - base)
         adjustment = p.rdw_adjustment
         if p.is_rdw_part_of_record_length:
             adjustment -= 4

@@ -59,14 +59,22 @@ def main():
                 parsed = json.loads(line)
             except ValueError:
                 parsed = None
+            # only JSON with ACTUAL device evidence ends the watch — the
+            # tunnel may answer the 45s probe yet wedge before bench's own
+            # probe, yielding an honest but deviceless CPU-only line
+            has_device = (parsed is not None
+                          and parsed.get("device") not in (None,
+                                                           "unavailable")
+                          and isinstance(parsed.get("device_query"), dict)
+                          and "error" not in parsed["device_query"])
             with open(args.out, "w") as f:
                 json.dump({"captured_at": time.strftime("%F %T"),
                            "platform": platform, "rc": proc.returncode,
                            "bench": parsed,
                            "stderr_tail": proc.stderr[-3000:]}, f, indent=1)
-            print(f"[{stamp}] wrote {args.out} (rc={proc.returncode})",
-                  flush=True)
-            if parsed is not None:
+            print(f"[{stamp}] wrote {args.out} (rc={proc.returncode}, "
+                  f"device={has_device})", flush=True)
+            if has_device:
                 return
         else:
             print(f"[{stamp}] tunnel down", flush=True)
