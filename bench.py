@@ -666,8 +666,10 @@ def run_hierarchical_side_metric(mb_target: float) -> dict:
     import tempfile
 
     from cobrix_tpu import read_cobol
+    from cobrix_tpu.reader.hierarchical_arrow import assembly_stats
     from cobrix_tpu.testing import generators as g
 
+    assembly_stats(reset=True)
     n_companies = max(50, int(mb_target * 1024 * 1024 / 1350))
     raw = g.generate_hierarchical(n_companies, seed=100)
     mb = len(raw) / (1024 * 1024)
@@ -694,11 +696,14 @@ def run_hierarchical_side_metric(mb_target: float) -> dict:
     finally:
         if path:
             os.unlink(path)
+    stats = assembly_stats(reset=True)
     result = {
         "metric": "hierarchical_7seg_to_arrow",
         "value": round(mb / min(times), 1),
         "unit": "MB/s",
+        "vs_exp3_bar": round(mb / min(times) / 160.0, 2),  # 20x exp3 bar
         "roots_per_s": int(table.num_rows / min(times)),
+        "assembly": stats,  # columnar builds vs row-path bails
     }
     _log(f"side metric hierarchical: {result}")
     return result

@@ -366,17 +366,21 @@ void transcode_string_cols_raw(const uint8_t* data,
 //              uint8[n] pointers): rows with mask 0 emit an empty string
 //              without transcoding — decode-once batches skip the rows a
 //              null parent struct hides anyway
-//   out_offsets: [ncols, n+1] int32; out_data: column c writes at
-//                out_data + data_starts[c], capacity data_caps[c]
+//   out_offsets_ptrs/out_data_ptrs: per-column output pointers — column c
+//                writes offsets to out_offsets_ptrs[c] ([n+1] int32) and
+//                UTF-8 bytes to out_data_ptrs[c], capacity data_caps[c]
+//                (independent buffers: retaining one column must not pin
+//                the others)
 //   data_lens[c]: UTF-8 bytes written for column c, or -1 when the
 //                 capacity was too small (caller falls back per column)
-// Per-value transcode+trim: emit one field's UTF-8 into dst at cur.
-// Returns the new cursor, or -1 when the value would overflow data_cap
-// (the caller rebuilds that one column in Python).
+// Byte-class tables shared by the trim scans and the all-ASCII copy loop.
 struct StrClassTables {
   uint8_t lut8[256], trim_both[256], trim_lr[256], wide_cp[256];
 };
 
+// Per-value transcode+trim: emit one field's UTF-8 into dst at cur.
+// Returns the new cursor, or -1 when the value would overflow data_cap
+// (the caller rebuilds that one column in Python).
 static inline int64_t transcode_one_value(
     const uint8_t* p, int64_t avail, int64_t width, const uint16_t* lut,
     uint16_t pad, const StrClassTables& t, int32_t trim_mode, uint8_t* dst,

@@ -32,6 +32,22 @@ from ..copybook.datatypes import SchemaRetentionPolicy
 from .arrow_out import _pa
 
 
+# columnar-vs-row-path assembly counters (observability: the bail rate is
+# a BENCH metric — a silent fall-back to the Python row path would read
+# as "columnar" while costing 5-10x)
+ASSEMBLY_STATS = {"columnar": 0, "bail_multi_sid_parent": 0,
+                  "bail_odo_cross_segment": 0, "bail_schema_shape": 0}
+
+
+def assembly_stats(reset: bool = False) -> Dict[str, int]:
+    """Snapshot (optionally reset) the columnar/bail counters."""
+    out = dict(ASSEMBLY_STATS)
+    if reset:
+        for k in ASSEMBLY_STATS:
+            ASSEMBLY_STATS[k] = 0
+    return out
+
+
 def _depending_crosses_segment(copybook) -> bool:
     """True when an OCCURS DEPENDING ON array inside a segment redefine
     names a dependee that is not declared inside that SAME redefine.
@@ -78,7 +94,7 @@ def _depending_crosses_segment(copybook) -> bool:
                if isinstance(root, Group))
 
 
-def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
+def hierarchical_table(batch, segment_names,
                        copybook, output_schema,
                        sid_map: Dict[str, Group],
                        parent_child_map: Dict[str, list],
@@ -87,8 +103,10 @@ def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
                        input_file_name: str = ""):
     """pyarrow Table for a hierarchical read, straight from a decode-once
     `DecodedBatch` over all framed records. `segment_names`: per-record
-    redefine group name ("" / None for unmapped ids). Returns None when
-    the shape needs the row path."""
+    redefine group names — either a plain sequence ("" / None for
+    unmapped ids) or the dictionary-coded pair (uniq_names, codes
+    ndarray) straight from SegmentIds. Returns None when the shape needs
+    the row path."""
     from .arrow_out import ArrowBatchBuilder, arrow_schema
 
     pa = _pa()
@@ -101,16 +119,50 @@ def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
         sids_per_name[g.name] = sids_per_name.get(g.name, 0) + 1
     for name, count in sids_per_name.items():
         if count > 1 and name not in root_names and name in parent_child_map:
+            ASSEMBLY_STATS["bail_multi_sid_parent"] += 1
             return None
 
     # DEPENDING ON arrays whose dependee lives in a different visibility
     # region (shared area vs a segment redefine overlay): bail to the row
     # path, which owns the oracle's cross-record dependee semantics
     if _depending_crosses_segment(copybook):
+        ASSEMBLY_STATS["bail_odo_cross_segment"] += 1
         return None
 
-    names = np.asarray([s if s else "" for s in segment_names],
-                       dtype=object)
+    # integer-coded segment names: every membership test below runs on an
+    # int32 code vector (object-dtype string compares/np.isin dominated
+    # the assembly at scale). Callers pass the dictionary-coded form
+    # (uniq_names, codes) straight from SegmentIds; a plain sequence is
+    # coded here for direct/test use.
+    if (isinstance(segment_names, tuple) and len(segment_names) == 2
+            and isinstance(segment_names[1], np.ndarray)):
+        uniq_names, codes = segment_names
+        uniq_names = ["" if not s else s for s in uniq_names]
+    else:
+        uniq_names, seen = [], {}
+        codes = np.empty(len(segment_names), dtype=np.int32)
+        for i, s in enumerate(segment_names):
+            s = s or ""
+            j = seen.get(s)
+            if j is None:
+                j = seen[s] = len(uniq_names)
+                uniq_names.append(s)
+            codes[i] = j
+    codes = np.asarray(codes, dtype=np.int32)
+    name_codes: Dict[str, list] = {}
+    for j, nm in enumerate(uniq_names):
+        name_codes.setdefault(nm, []).append(j)
+
+    def mask_of(names_iter) -> np.ndarray:
+        ids = [j for nm in names_iter for j in name_codes.get(nm, ())]
+        if not ids:
+            return np.zeros(len(codes), dtype=bool)
+        # id lists are tiny (distinct sids per name): OR of equality
+        # compares beats np.isin's sort machinery
+        mask = codes == ids[0]
+        for j in ids[1:]:
+            mask |= codes == j
+        return mask
 
     parent_of = {}
     for parent, children in parent_child_map.items():
@@ -125,7 +177,7 @@ def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
             cur = parent_of.get(cur)
         return out
 
-    positions_of = {name: np.nonzero(names == name)[0]
+    positions_of = {name: np.nonzero(mask_of([name]))[0]
                     for name in {g.name for g in sid_map.values()}}
     root_pos_list = [positions_of.get(name, np.zeros(0, dtype=np.int64))
                      for name in root_names]
@@ -137,7 +189,7 @@ def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
     # per-redefine visibility masks: leaf columns of a segment build only
     # their own rows (hidden rows skip truncation fixups and string work;
     # their values are garbage by design and are never gathered)
-    seg_masks = {g.name.upper(): names == g.name
+    seg_masks = {g.name.upper(): mask_of([g.name])
                  for g in sid_map.values()}
     builder = ArrowBatchBuilder(batch, active=None,
                                 redefine_masks=seg_masks)
@@ -165,15 +217,15 @@ def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
         """(kept child positions in order, int32 list offsets aligned to
         parent_positions)."""
         ch_pos = positions_of.get(child.name, np.zeros(0, dtype=np.int64))
-        anc_names = list(set(ancestors(child.name)))
-        anc_pos = np.nonzero(np.isin(names, anc_names))[0]
+        anc_names = set(ancestors(child.name))
+        anc_pos = np.nonzero(mask_of(anc_names))[0]
         if ch_pos.size and anc_pos.size:
             idx = np.searchsorted(anc_pos, ch_pos, side="left") - 1
             has_anc = idx >= 0
             owner = np.where(has_anc, anc_pos[np.maximum(idx, 0)], -1)
             # keep only children whose nearest ancestor occurrence is an
             # occurrence of the DIRECT parent
-            is_parent_row = np.zeros(len(names) + 1, dtype=bool)
+            is_parent_row = np.zeros(len(codes) + 1, dtype=bool)
             is_parent_row[parent_positions] = True
             keep = has_anc & is_parent_row[owner]
             ch_kept = ch_pos[keep]
@@ -219,8 +271,7 @@ def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
             if isinstance(child, Group) and child.is_segment_redefine:
                 # a segment redefine nested below this group (the root
                 # case: the AST root holds the root redefines)
-                child_owned = np.asarray(
-                    names[positions] == child.name, dtype=bool)
+                child_owned = mask_of([child.name])[positions]
                 sub_mask = (None if bool(child_owned.all())
                             else ~child_owned)
                 arrays.append(segment_struct(child, positions, sub_mask))
@@ -270,8 +321,10 @@ def hierarchical_table(batch, segment_names: Sequence[Optional[str]],
 
     target = arrow_schema(output_schema.schema)
     if len(cols) != len(target):
+        ASSEMBLY_STATS["bail_schema_shape"] += 1
         return None  # shape mismatch: the row path owns it
     arrays = [c.cast(target.field(i).type)
               if c.type != target.field(i).type else c
               for i, c in enumerate(cols)]
+    ASSEMBLY_STATS["columnar"] += 1
     return pa.Table.from_arrays(arrays, schema=target)

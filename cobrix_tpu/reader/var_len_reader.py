@@ -574,14 +574,19 @@ class VarLenReader:
         # skipped outside the mask
         seg_masks = {name: segment_ids.mask_of_mapped(name_of_sid, name)
                      for name in {g.name for g in sid_map.values()}}
-        sid_list = segment_ids.tolist()
-        segment_names = [name_of_sid.get(s) for s in sid_list]
+        # dictionary-coded segment names: one name per DISTINCT sid plus
+        # the int32 code vector — the Arrow assembly's membership tests
+        # run on the codes, never on per-row Python strings
+        uniq_named = [name_of_sid.get(u) for u in segment_ids.uniq]
+        segment_names = (uniq_named, segment_ids.codes)
         decoder = self._decoder_for_segment("", backend)
         batch = (decoder.decode_raw(data, offsets, rec_lengths) if n
                  else None)
-        n_roots = sum(1 for s in segment_names if s in root_names)
+        root_uniq = np.asarray([nm in root_names for nm in uniq_named])
+        n_roots = (int(root_uniq[segment_ids.codes].sum())
+                   if len(uniq_named) else 0)
         return dict(batch=batch, segment_names=segment_names,
-                    sid_list=sid_list, sid_map=sid_map,
+                    segment_ids=segment_ids, sid_map=sid_map,
                     parent_child_map=parent_child_map,
                     root_names=root_names, seg_masks=seg_masks,
                     decoder=decoder, n=n, n_roots=n_roots,
@@ -604,7 +609,7 @@ class VarLenReader:
         if n == 0:
             return []
         batch = ctx["batch"]
-        segment_ids = ctx["sid_list"]
+        segment_ids = ctx["segment_ids"].tolist()
         sid_map = ctx["sid_map"]
         parent_child_map = ctx["parent_child_map"]
         root_names = ctx["root_names"]

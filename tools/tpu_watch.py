@@ -67,6 +67,12 @@ def main():
                                                            "unavailable")
                           and isinstance(parsed.get("device_query"), dict)
                           and "error" not in parsed["device_query"])
+            if parsed is None and os.path.exists(args.out):
+                # never clobber earlier honest evidence with a failed run
+                print(f"[{stamp}] bench produced no JSON; keeping "
+                      f"existing {args.out}", flush=True)
+                time.sleep(args.interval)
+                continue
             with open(args.out, "w") as f:
                 json.dump({"captured_at": time.strftime("%F %T"),
                            "platform": platform, "rc": proc.returncode,
