@@ -651,6 +651,41 @@ def generate_transactions(num_records: int, seed: int = 100,
     return b"".join(chunks)
 
 
+# -- 1:1 named ports of the remaining reference generators -----------------
+# (thin aliases over the parameterized builders above, so the component
+# inventory maps one reference TestDataGen* to one callable here)
+
+def generate_companies_big_endian(num_records: int, seed: int = 100
+                                  ) -> bytes:
+    """TestDataGen3CompaniesBigEndian.scala: the exp2 companies
+    multisegment file with BIG-endian RDW headers."""
+    return generate_exp2(num_records, seed=seed, big_endian_rdw=True)
+
+
+def generate_file_header_and_footer(num_records: int, seed: int = 100
+                                    ) -> bytes:
+    """TestDataGen13aFileHeaderAndFooter.scala: fixed 45-byte TRANSDATA
+    records wrapped in a 10-byte 0x01 header and 12-byte 0x02 footer."""
+    return generate_transactions(num_records, seed=seed,
+                                 file_header=10, file_footer=12)
+
+
+def generate_code_pages(num_records: int, seed: int = 100) -> bytes:
+    """TestDataGen9CodePages.scala: TRANSDATA records whose COMPANY-NAME
+    carries 14 random bytes (exercises every code-page mapping) and a
+    constant "00000000" COMPANY-ID."""
+    return generate_transactions(num_records, seed=seed,
+                                 name_pool="random_bytes")
+
+
+def generate_non_printable_names(num_records: int, seed: int = 100
+                                 ) -> bytes:
+    """TestDataGen8NonPrintableNames.scala: TRANSDATA records whose
+    COMPANY-NAME bytes are the CommonLists control-character name pool."""
+    return generate_transactions(num_records, seed=seed,
+                                 name_pool="non_printable")
+
+
 FILLERS_COPYBOOK = """
       01  RECORD.
           05  COMPANY_NAME     PIC X(15).

@@ -1,0 +1,75 @@
+"""PySpark consuming the cobrix_tpu Arrow-IPC bridge.
+
+The reference is used from Spark as
+``spark.read.format("cobol").option(...)`` (DefaultSource.scala:36), and
+BASELINE.json's north star frames the TPU integration as
+``.option("decoder_backend", "tpu")`` on that DataSource. This example
+is that shape for cobrix_tpu: each Spark partition asks the bridge
+service (cobrix_tpu/bridge.py — run ``python -m cobrix_tpu.bridge`` on
+the host with TPU access) for its file shard and receives decoded Arrow
+record batches; Spark never touches EBCDIC bytes.
+
+Run (pyspark must be installed on the Spark side; the bridge host needs
+only cobrix_tpu):
+
+    python -m cobrix_tpu.bridge --port 8815 &
+    spark-submit examples/pyspark_bridge.py \
+        --bridge 127.0.0.1:8815 --copybook /path/book.cob data/*.dat
+"""
+import argparse
+import glob
+import sys
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--bridge", default="127.0.0.1:8815")
+    ap.add_argument("--copybook", required=True)
+    ap.add_argument("files", nargs="+")
+    args = ap.parse_args()
+    host, port = args.bridge.rsplit(":", 1)
+    address = (host, int(port))
+    files = sorted(p for pat in args.files for p in glob.glob(pat))
+    copybook = open(args.copybook).read()
+
+    try:
+        from pyspark.sql import SparkSession
+    except ImportError:
+        sys.exit("pyspark is not installed; this example runs on a Spark "
+                 "driver — see tests/test_bridge.py for the pure-Python "
+                 "client exercised in CI")
+
+    spark = SparkSession.builder.appName("cobrix-tpu-bridge").getOrCreate()
+
+    # probe the schema with a row-capped request (the bridge still decodes
+    # the probe file on ITS host, but only one row crosses the wire and
+    # sits in driver memory), then fan the files out one per task; each
+    # task streams its decoded Arrow batches from the bridge (the
+    # decoder_backend=tpu shape: decode happens on the bridge host's
+    # accelerator, Spark receives columnar batches)
+    from pyspark.sql.pandas.types import from_arrow_schema
+
+    from cobrix_tpu.bridge import read_remote
+
+    probe = read_remote(address, files[0], max_records=1,
+                        copybook_contents=copybook)
+    spark_schema = from_arrow_schema(probe.schema)
+
+    def decode_partition(batches):
+        # mapInArrow yields pyarrow.RecordBatch objects of the input rows
+        for batch in batches:
+            for path in batch.column("path").to_pylist():
+                table = read_remote(address, path,
+                                    copybook_contents=copybook)
+                yield from table.to_batches()
+
+    paths_df = spark.createDataFrame([(f,) for f in files], ["path"]) \
+                    .repartition(len(files))
+    df = paths_df.mapInArrow(decode_partition, schema=spark_schema)
+    df.show(5, truncate=False)
+    print(f"rows: {df.count()} from {len(files)} files")
+    spark.stop()
+
+
+if __name__ == "__main__":
+    main()

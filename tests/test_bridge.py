@@ -1,0 +1,75 @@
+"""Arrow-IPC bridge tests: the JVM/Spark-facing decode service
+(cobrix_tpu/bridge.py) — request/response framing, table parity with the
+in-process read, multi-request reuse, and structured errors."""
+import os
+import tempfile
+
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.bridge import BridgeServer, read_remote
+from cobrix_tpu.testing.generators import (EXP2_COPYBOOK, TRANSDATA_COPYBOOK,
+                                           generate_exp2,
+                                           generate_transactions)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = BridgeServer().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def exp2_file():
+    raw = generate_exp2(500, seed=11)
+    path = tempfile.mktemp(suffix=".dat")
+    with open(path, "wb") as f:
+        f.write(raw)
+    yield path
+    os.unlink(path)
+
+
+EXP2_OPTS = dict(copybook_contents=EXP2_COPYBOOK, is_record_sequence="true",
+                 segment_field="SEGMENT-ID",
+                 redefine_segment_id_map="STATIC-DETAILS => C",
+                 **{"redefine_segment_id_map:1": "CONTACTS => P"})
+
+
+def test_bridge_matches_in_process_read(server, exp2_file):
+    remote = read_remote(server.address, exp2_file, **EXP2_OPTS)
+    local = read_cobol(exp2_file, **EXP2_OPTS).to_arrow()
+    assert remote.schema == local.schema
+    assert remote.to_pylist() == local.to_pylist()
+
+
+def test_bridge_serves_multiple_requests(server, exp2_file):
+    t1 = read_remote(server.address, exp2_file, **EXP2_OPTS)
+    raw = generate_transactions(40, seed=3)
+    path = tempfile.mktemp(suffix=".dat")
+    with open(path, "wb") as f:
+        f.write(raw)
+    try:
+        t2 = read_remote(server.address, path,
+                         copybook_contents=TRANSDATA_COPYBOOK)
+    finally:
+        os.unlink(path)
+    assert t1.num_rows == 500
+    assert t2.num_rows == 40
+    assert "AMOUNT" in t2.column_names or "TRANSDATA" in t2.column_names
+
+
+def test_bridge_reports_errors_structured(server, exp2_file):
+    with pytest.raises(RuntimeError, match="bridge error"):
+        read_remote(server.address, exp2_file,
+                    copybook_contents="       01 R.\n          05 F PIC Q.\n")
+    # the server thread survives a failed request
+    t = read_remote(server.address, exp2_file, **EXP2_OPTS)
+    assert t.num_rows == 500
+
+
+def test_bridge_max_records_caps_response(server, exp2_file):
+    t = read_remote(server.address, exp2_file, max_records=3, **EXP2_OPTS)
+    assert t.num_rows == 3
+    full = read_remote(server.address, exp2_file, **EXP2_OPTS)
+    assert t.schema == full.schema
