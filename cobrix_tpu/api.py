@@ -687,11 +687,14 @@ def read_cobol(path=None,
 FIXED_READ_CHUNK_BYTES = 64 * 1024 * 1024
 
 
-def _read_file_bytes(path: str) -> bytes:
+def _read_file_bytes(path: str):
+    """Whole-file bytes-like payload: a read-only mmap memoryview for
+    local files (FSStream.next_view), plain bytes otherwise — consumers
+    must stick to buffer-protocol operations (len/slice/np.frombuffer)."""
     from .reader.stream import open_stream
 
     with open_stream(path) as stream:
-        return stream.next(stream.size())
+        return stream.next_view(stream.size())
 
 
 def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
@@ -720,7 +723,7 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
     done = 0
     with open_stream(file_path) as stream:
         while done < size:
-            data = stream.next(min(chunk_bytes, size - done))
+            data = stream.next_view(min(chunk_bytes, size - done))
             if not data:
                 break
             if len(data) % rs and done + len(data) < size:

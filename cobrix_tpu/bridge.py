@@ -35,6 +35,9 @@ import threading
 from typing import Optional, Sequence
 
 
+MAX_REQUEST_BYTES = 16 * 1024 * 1024  # requests are small JSON; cap DoS
+
+
 def _recv_exact(sock_file, n: int) -> bytes:
     buf = sock_file.read(n)
     if buf is None or len(buf) != n:
@@ -50,6 +53,9 @@ class _Handler(socketserver.StreamRequestHandler):
             from .api import read_cobol
 
             (length,) = struct.unpack(">I", _recv_exact(self.rfile, 4))
+            if length > MAX_REQUEST_BYTES:
+                raise ValueError(f"request frame of {length} bytes exceeds "
+                                 f"the {MAX_REQUEST_BYTES} byte cap")
             req = json.loads(_recv_exact(self.rfile, length))
             files = req["files"]
             options = dict(req.get("options") or {})
@@ -69,14 +75,19 @@ class _Handler(socketserver.StreamRequestHandler):
             except OSError:
                 pass  # peer already gone
             return
-        self.wfile.write(b"A")
-        with pa.ipc.new_stream(self.wfile, table.schema) as writer:
-            writer.write_table(table)
+        try:
+            self.wfile.write(b"A")
+            with pa.ipc.new_stream(self.wfile, table.schema) as writer:
+                writer.write_table(table)
+        except OSError:
+            pass  # peer disconnected mid-stream — nothing left to tell it
 
 
 class BridgeServer(socketserver.ThreadingTCPServer):
-    """Threaded Arrow-IPC decode service. `with BridgeServer() as srv:`
-    serves until shutdown; `srv.address` is the bound (host, port)."""
+    """Threaded Arrow-IPC decode service. Usage:
+    `srv = BridgeServer().start()` ... `srv.stop()` — `start()` runs the
+    accept loop in a daemon thread (a bare constructor or `with` block
+    does NOT serve); `srv.address` is the bound (host, port)."""
 
     allow_reuse_address = True
     daemon_threads = True
@@ -96,8 +107,8 @@ class BridgeServer(socketserver.ThreadingTCPServer):
         return self
 
     def stop(self) -> None:
-        self.shutdown()
-        if self._thread is not None:
+        if self._thread is not None:  # shutdown() deadlocks when
+            self.shutdown()           # serve_forever never ran
             self._thread.join(timeout=5)
         self.server_close()
 

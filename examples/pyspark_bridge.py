@@ -65,7 +65,8 @@ def main() -> None:
 
     paths_df = spark.createDataFrame([(f,) for f in files], ["path"]) \
                     .repartition(len(files))
-    df = paths_df.mapInArrow(decode_partition, schema=spark_schema)
+    df = paths_df.mapInArrow(decode_partition, schema=spark_schema) \
+                 .persist()  # show + count must not decode every file twice
     df.show(5, truncate=False)
     print(f"rows: {df.count()} from {len(files)} files")
     spark.stop()
