@@ -161,6 +161,23 @@ def _ascii_mask_lut() -> np.ndarray:
     return lut
 
 
+def _scatter_rows(arr: np.ndarray, mask: np.ndarray, n: int) -> np.ndarray:
+    """Expand a subset-row kernel output back to full batch length: rows
+    outside `mask` are zeros (valid=False for bool planes)."""
+    out = np.zeros((n,) + arr.shape[1:], dtype=arr.dtype)
+    out[mask] = arr
+    return out
+
+
+def _scatter_output(out: dict, mask: np.ndarray, n: int) -> dict:
+    """Scatter one column's output dict from subset rows to full length
+    (host-fallback groups never take the masked path)."""
+    if "lazy_string" in out:
+        return out  # deferred groups materialize from the full raw image
+    return {k: _scatter_rows(np.asarray(v), mask, n)
+            for k, v in out.items()}
+
+
 def _masks_equal(a, b) -> bool:
     """Compare two per-column mask lists (None or [bool array|None, ...])
     by value — bool-array memcmp, cheap next to a string rebuild."""
@@ -882,13 +899,22 @@ class ColumnarDecoder:
         return DecodedBatch(self, arr, outputs, lengths=lengths)
 
     def decode_raw(self, data, rec_offsets, rec_lengths,
-                   start_offset: int = 0) -> DecodedBatch:
+                   start_offset: int = 0,
+                   segment_row_masks: Optional[Dict[str, np.ndarray]] = None
+                   ) -> DecodedBatch:
         """Decode framed records in place from the file image: numeric
         groups read straight through the native raw kernels (no
         [batch, extent] pack copy — for wide records the pack costs as
         much as the decode), and only the narrow prefix covering the
         remaining groups is packed. Falls back to pack + `decode` when the
-        native library or numpy backend is unavailable."""
+        native library or numpy backend is unavailable.
+
+        `segment_row_masks`: segment-redefine name -> row-visibility mask.
+        A kernel group whose columns all belong to one masked segment
+        decodes ONLY that segment's rows (subset kernel + scatter);
+        hidden rows come back invalid instead of as decoded garbage.
+        On interleaved multisegment profiles (hierarchical) this skips
+        the majority of the numeric decode work."""
         rec_lengths = np.asarray(rec_lengths, dtype=np.int64)
         extent_full = self.plan.max_extent
         lengths = np.minimum(rec_lengths - start_offset, extent_full)
@@ -911,23 +937,34 @@ class ColumnarDecoder:
         if start_offset:
             offs = offs + start_offset
             rec_lengths = rec_lengths - start_offset
+        if segment_row_masks:
+            segment_row_masks = {k.upper(): v
+                                 for k, v in segment_row_masks.items()}
 
+        n = len(offs)
         outputs: Dict[int, dict] = {}
         narrow_groups = []
         narrow_extent = 1
+        # masked narrow groups, batched per distinct row mask
+        masked_narrow: Dict[int, Tuple[np.ndarray, list]] = {}
         for g in self.kernel_groups:
             res = None
+            gmask = self._group_segment_mask(g, segment_row_masks)
+            goffs, glens = ((offs, rec_lengths) if gmask is None
+                            else (offs[gmask], rec_lengths[gmask]))
             if g.codec is Codec.BINARY and not g.wide:
                 signed, big_endian, fits32, _ = g.variant
                 res = native.decode_binary_cols_raw(
-                    buf, offs, rec_lengths, g.offsets, g.width,
+                    buf, goffs, glens, g.offsets, g.width,
                     signed, big_endian, fits32=fits32)
             elif g.codec is Codec.BCD and not g.wide:
                 fits32, _ = g.variant
                 res = native.decode_bcd_cols_raw(
-                    buf, offs, rec_lengths, g.offsets, g.width,
+                    buf, goffs, glens, g.offsets, g.width,
                     fits32=fits32)
-            elif g.codec is Codec.EBCDIC_STRING:
+            elif g.codec is Codec.EBCDIC_STRING or (
+                    g.codec is Codec.ASCII_STRING
+                    and not self.non_standard_ascii_charset):
                 # deferred: the Arrow path emits these columns straight from
                 # the raw image through the native transcode+trim kernel;
                 # the row path materializes the code-point matrix on demand.
@@ -940,18 +977,63 @@ class ColumnarDecoder:
                     outputs[c.index] = {"lazy_string": (g, pos)}
                 continue
             if res is not None:
+                if gmask is not None:
+                    res = tuple(_scatter_rows(a, gmask, n) for a in res)
                 self._store_numeric(g, outputs, *res)
+                continue
+            if gmask is not None and g.codec is not Codec.HOST_FALLBACK:
+                masked_narrow.setdefault(id(gmask), (gmask, []))[1].append(g)
                 continue
             narrow_groups.append(g)
             if len(g.columns):
                 narrow_extent = max(narrow_extent,
                                     int(g.offsets.max()) + g.width)
 
+        for mask, gs in masked_narrow.values():
+            ext = max(int(g.offsets.max()) + g.width
+                      for g in gs if len(g.columns))
+            sub = native.pack_records(buf, offs[mask], rec_lengths[mask],
+                                      ext)
+            sub_out: Dict[int, dict] = {}
+            self._run_groups(gs, sub, sub_out)
+            for col, out in sub_out.items():
+                outputs[col] = _scatter_output(out, mask, n)
+
         batch = native.pack_records(buf, offs, rec_lengths, narrow_extent)
         self._run_groups(narrow_groups, batch, outputs)
         self._decode_host_fallback(batch, outputs)
         return DecodedBatch(self, batch, outputs, lengths=lengths,
                             raw_source=(buf, offs, rec_lengths))
+
+    @staticmethod
+    def _group_segment_mask(g: "_KernelGroup", segment_row_masks):
+        """The shared row mask when EVERY column of `g` belongs to one
+        masked segment redefine; None keeps the full decode."""
+        if not segment_row_masks:
+            return None
+        # dependee (DEPENDING ON counter) columns are read on EVERY row
+        # by the oracle's walk (registered from whatever bytes are there,
+        # including other segments' overlays) — they must never be masked
+        if any(c.statement is not None and c.statement.is_dependee
+               for c in g.columns):
+            return None
+        segs = {c.segment.upper() if c.segment else None
+                for c in g.columns}
+        if len(segs) != 1:
+            return None
+        (seg,) = segs
+        if seg is None:
+            return None
+        m = segment_row_masks.get(seg)
+        if m is None:
+            return None
+        # engage only when the skipped decode outweighs the subset
+        # gather + full-length scatter (narrow planes are a wash: the
+        # zeros/fancy-index cost per row rivals the decode saved)
+        hidden = 1.0 - float(m.mean())
+        if hidden * g.width < 4.0:
+            return None
+        return m
 
     @staticmethod
     def _bucket_size(n: int) -> int:

@@ -580,7 +580,11 @@ class VarLenReader:
         uniq_named = [name_of_sid.get(u) for u in segment_ids.uniq]
         segment_names = (uniq_named, segment_ids.codes)
         decoder = self._decoder_for_segment("", backend)
-        batch = (decoder.decode_raw(data, offsets, rec_lengths) if n
+        # masked decode: each segment's numeric groups run only on its
+        # own rows (hidden rows come back invalid, which the assembly and
+        # the nesting walk treat exactly like the garbage they replace)
+        batch = (decoder.decode_raw(data, offsets, rec_lengths,
+                                    segment_row_masks=seg_masks) if n
                  else None)
         root_uniq = np.asarray([nm in root_names for nm in uniq_named])
         n_roots = (int(root_uniq[segment_ids.codes].sum())

@@ -116,3 +116,68 @@ def test_jit_bucket_padding():
     batch = dec.decode(data)
     assert batch.n_records == 3
     assert len(batch.to_rows()) == 3
+
+
+def test_decode_raw_segment_masks_match_full_decode():
+    """decode_raw(segment_row_masks=...) decodes each masked group only on
+    its own rows; visible rows must match the unmasked decode exactly and
+    hidden rows must come back invalid (None), not as decoded garbage."""
+    import numpy as np
+
+    from cobrix_tpu import parse_copybook
+    from cobrix_tpu.reader.columnar import ColumnarDecoder
+
+    cb = parse_copybook("""
+       01 R.
+          05 SEG-ID    PIC X(1).
+          05 A-SEG.
+             10 BIGN   PIC S9(12)V99 COMP-3.
+             10 WIDE   PIC 9(10).
+          05 B-SEG REDEFINES A-SEG.
+             10 NUM    PIC S9(8) COMP.
+             10 TXT    PIC X(14).
+    """, segment_redefines=["A-SEG", "B-SEG"])
+    from cobrix_tpu.testing.generators import ebcdic_encode
+
+    recs = []
+    for i in range(40):
+        if i % 3 == 0:
+            body = (bytes.fromhex(f"{i * 100:013d}c")
+                    + ebcdic_encode(f"{i:010d}"))
+            recs.append(ebcdic_encode("A") + body)
+        else:
+            recs.append(ebcdic_encode("B") + i.to_bytes(4, "big", signed=True)
+                        + ebcdic_encode(f"person-{i:05d}", 14))
+    data = b"".join(recs)
+    n = len(recs)
+    rs = cb.record_size
+    offsets = np.arange(n, dtype=np.int64) * rs
+    lengths = np.full(n, rs, dtype=np.int64)
+    a_mask = np.array([i % 3 == 0 for i in range(n)])
+    masks = {"A_SEG": a_mask, "B_SEG": ~a_mask}
+
+    dec = ColumnarDecoder(cb)
+    full = dec.decode_raw(data, offsets, lengths)
+    dec2 = ColumnarDecoder(cb)
+    masked = dec2.decode_raw(data, offsets, lengths,
+                             segment_row_masks=masks)
+    upper_masks = {k.upper(): v for k, v in masks.items()}
+    from cobrix_tpu.reader.columnar import _STRING_CODECS
+    engaged = {c.index
+               for g in dec2.kernel_groups
+               if g.codec not in _STRING_CODECS
+               and dec2._group_segment_mask(g, upper_masks) is not None
+               for c in g.columns}
+    assert engaged, "heuristic should engage at least one group"
+    for c in dec.plan.columns:
+        seg = (c.segment or "").upper()
+        vis = masks.get(seg)
+        fv = full.column_values(c.index)
+        mv = masked.column_values(c.index)
+        for i in range(n):
+            if vis is None or vis[i]:
+                assert mv[i] == fv[i], (c.name, i, mv[i], fv[i])
+            elif c.index in engaged:
+                # hidden rows of a masked group come back invalid,
+                # never as decoded garbage
+                assert mv[i] is None, (c.name, i, mv[i])

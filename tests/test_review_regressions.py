@@ -579,3 +579,38 @@ def test_odo_same_segment_dependee_keeps_columnar_path(monkeypatch):
         items = [c["ITEM"] for row in num_tbl
                  for c in row["RECORD"]["COMPANY"]["CONTACT"]]
         assert [len(it) for it in items] == [2, 3]
+
+
+def test_masked_decode_never_masks_dependee_columns():
+    """Review finding: a DEPENDING ON counter inside a segment redefine is
+    read by the oracle's walk on EVERY record (registered from whatever
+    overlay bytes are there) — segment-masked decode must leave dependee
+    columns unmasked or the numpy hierarchical paths diverge from host."""
+    copybook = """
+       01 RECORD.
+          05 SEG-ID    PIC X(1).
+          05 COMPANY.
+             10 NAME   PIC X(5).
+          05 CONTACT REDEFINES COMPANY.
+             10 CNT    PIC 9(5).
+          05 TAIL     PIC X(1) OCCURS 4 DEPENDING ON CNT.
+"""
+    recs = [("C", "ACME ", "AB"), ("P", "00002", "XY"),
+            ("C", "GLOBX", "CD"), ("P", "00001", "Z")]
+    payload = b"".join(
+        _rdw(1 + 5 + len(tail)) + ebcdic_encode(sid + body + tail)
+        for sid, body, tail in recs)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = _write(tmp, "dep.bin", payload)
+        kwargs = dict(
+            copybook_contents=copybook,
+            is_record_sequence=True,
+            is_rdw_big_endian="true",
+            segment_field="SEG-ID",
+            variable_size_occurs="true",
+            **{"redefine-segment-id-map:0": "COMPANY => C",
+               "redefine-segment-id-map:1": "CONTACT => P",
+               "segment-children:0": "COMPANY => CONTACT"})
+        host = read_cobol(path, backend="host", **kwargs)
+        default = read_cobol(path, backend="numpy", **kwargs)
+        assert default.to_json_lines() == host.to_json_lines()
