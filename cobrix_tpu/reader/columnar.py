@@ -170,10 +170,10 @@ def _scatter_rows(arr: np.ndarray, mask: np.ndarray, n: int) -> np.ndarray:
 
 
 def _scatter_output(out: dict, mask: np.ndarray, n: int) -> dict:
-    """Scatter one column's output dict from subset rows to full length
-    (host-fallback groups never take the masked path)."""
-    if "lazy_string" in out:
-        return out  # deferred groups materialize from the full raw image
+    """Scatter one column's output dict from subset rows to full length.
+    Only plain array planes reach here: string codecs defer before the
+    masked routing and HOST_FALLBACK groups are excluded from it
+    explicitly in decode_raw."""
     return {k: _scatter_rows(np.asarray(v), mask, n)
             for k, v in out.items()}
 
@@ -947,18 +947,23 @@ class ColumnarDecoder:
         narrow_extent = 1
         # masked narrow groups, batched per distinct row mask
         masked_narrow: Dict[int, Tuple[np.ndarray, list]] = {}
+        def subset(gmask):
+            return ((offs, rec_lengths) if gmask is None
+                    else (offs[gmask], rec_lengths[gmask]))
+
         for g in self.kernel_groups:
             res = None
-            gmask = self._group_segment_mask(g, segment_row_masks)
-            goffs, glens = ((offs, rec_lengths) if gmask is None
-                            else (offs[gmask], rec_lengths[gmask]))
+            gmask = (None if g.codec in _STRING_CODECS
+                     else self._group_segment_mask(g, segment_row_masks))
             if g.codec is Codec.BINARY and not g.wide:
                 signed, big_endian, fits32, _ = g.variant
+                goffs, glens = subset(gmask)
                 res = native.decode_binary_cols_raw(
                     buf, goffs, glens, g.offsets, g.width,
                     signed, big_endian, fits32=fits32)
             elif g.codec is Codec.BCD and not g.wide:
                 fits32, _ = g.variant
+                goffs, glens = subset(gmask)
                 res = native.decode_bcd_cols_raw(
                     buf, goffs, glens, g.offsets, g.width,
                     fits32=fits32)

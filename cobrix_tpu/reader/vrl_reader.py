@@ -76,22 +76,28 @@ class SegmentIds:
         per distinct id; broadcast over records via `codes`)."""
         return [mapping.get(u, default) for u in self.uniq]
 
-    def mask_of(self, values) -> "np.ndarray":
-        """Boolean per-record mask of ids contained in `values`."""
-        hits = [k for k, u in enumerate(self.uniq) if u in values]
+    def _hits_mask(self, hits) -> "np.ndarray":
+        """OR of code equalities — hit lists are tiny (distinct ids), so
+        this beats np.isin's sort machinery on the hot per-record axis."""
         if not hits:
             return np.zeros(len(self.codes), dtype=bool)
-        return np.isin(self.codes, hits)
+        mask = self.codes == hits[0]
+        for k in hits[1:]:
+            mask |= self.codes == k
+        return mask
+
+    def mask_of(self, values) -> "np.ndarray":
+        """Boolean per-record mask of ids contained in `values`."""
+        return self._hits_mask(
+            [k for k, u in enumerate(self.uniq) if u in values])
 
     def mask_of_mapped(self, mapping: dict, value: str,
                        default: str = "") -> "np.ndarray":
         """Boolean per-record mask of ids whose `mapping` image equals
         `value` (segment id -> active redefine routing)."""
-        hits = [k for k, u in enumerate(self.uniq)
-                if mapping.get(u, default) == value]
-        if not hits:
-            return np.zeros(len(self.codes), dtype=bool)
-        return np.isin(self.codes, hits)
+        return self._hits_mask(
+            [k for k, u in enumerate(self.uniq)
+             if mapping.get(u, default) == value])
 
     def replace_at(self, i: int, value: str) -> None:
         """Point fixup (truncated trailing records decode individually)."""
