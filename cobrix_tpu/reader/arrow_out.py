@@ -23,9 +23,11 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..copybook.ast import Group, Primitive, Statement
+from ..plan.compiler import Codec
 from ..copybook.datatypes import SchemaRetentionPolicy, TrimPolicy
 from .columnar import (
     _FLOAT_CODECS,
+    _NATIVE_TRIM_MODES,
     _STRING_CODECS,
     _dyn_scale,
     _resolve_occurs,
@@ -162,6 +164,85 @@ class ArrowBatchBuilder:
         self.n = batch.n_records
 
     # -- leaves ------------------------------------------------------------
+
+    def leaf_strings_at(self, sts, positions: np.ndarray) -> dict:
+        """String-leaf values built AT `positions` straight from the raw
+        file image, for EVERY eligible statement of one struct in ONE
+        subset kernel call (per-column calls paid the wrapper/gather
+        overhead once per leaf). Returns {id(st): pa.StringArray} for the
+        leaves it could build; callers fall back to the full-length
+        build + take for the rest (non-EBCDIC codecs, no raw image,
+        truncated rows, native library unavailable)."""
+        from .. import native
+
+        pa = _pa()
+        rs = self.batch.raw_source
+        trim = _NATIVE_TRIM_MODES.get(self.decoder.plan.trimming)
+        if rs is None or trim is None or not native.available():
+            return {}
+        buf, offs, lens = rs
+        sub_offs = sub_lens = None
+        chosen, specs = [], []
+        for st in sts:
+            col = self.decoder.slot_map.get((id(st), ()))
+            if col is None:
+                continue
+            spec = self.decoder.plan.columns[col]
+            if spec.codec is not Codec.EBCDIC_STRING:
+                continue
+            if sub_lens is None:
+                sub_offs = offs[positions]
+                sub_lens = lens[positions]
+            if bool((sub_lens < spec.offset + spec.width).any()):
+                continue  # truncated tails keep the scalar-owned path
+            chosen.append(st)
+            specs.append(spec)
+        if not specs:
+            return {}
+        res = native.string_cols_arrow_raw(
+            buf, sub_offs, sub_lens,
+            np.asarray([sp.offset for sp in specs], dtype=np.int64),
+            np.asarray([sp.width for sp in specs], dtype=np.int64),
+            self.decoder.lut, trim)
+        out = {}
+        if res:
+            for st, r in zip(chosen, res):
+                if r is None:
+                    continue
+                offsets, data = r
+                out[id(st)] = pa.Array.from_buffers(
+                    pa.string(), len(positions),
+                    [None, pa.py_buffer(offsets), pa.py_buffer(data)])
+        return out
+
+    def leaf_numeric_at(self, st: Primitive, positions: np.ndarray):
+        """Integer/float leaf values gathered AT `positions` — the numpy
+        gather happens before the Arrow build instead of a full-length
+        array + take. None -> caller uses the full path (decimals, wide
+        planes, truncation, host fallback)."""
+        pa = _pa()
+        col = self.decoder.slot_map.get((id(st), ()))
+        if col is None:
+            return None
+        spec = self.decoder.plan.columns[col]
+        pa_type = to_arrow_type(primitive_data_type(st))
+        if not (pa.types.is_integer(pa_type)
+                or pa.types.is_floating(pa_type)):
+            return None
+        if spec.codec in _STRING_CODECS:
+            return None
+        lengths = self.batch.lengths
+        if lengths is not None and bool(
+                (lengths[positions] < spec.offset + spec.width).any()):
+            return None  # truncated tails keep the scalar-owned path
+        out = self.batch.column_arrays(col)
+        if "values" not in out or "values_hi" in out:
+            return None
+        values = np.asarray(out["values"])[positions]
+        valid = np.asarray(out["valid"])[positions]
+        return pa.array(
+            values.astype(_numpy_dtype_for(pa_type), copy=False),
+            mask=None if valid.all() else ~valid)
 
     def _relevant_of(self, spec):
         """Row-visibility mask for a column of a decode-once batch (None =

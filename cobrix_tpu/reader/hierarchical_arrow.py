@@ -28,7 +28,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..copybook.ast import Group
+from ..copybook.ast import Group, Primitive
 from ..copybook.datatypes import SchemaRetentionPolicy
 from .arrow_out import _pa
 
@@ -271,6 +271,11 @@ def hierarchical_table(batch, segment_names,
         arrays, field_names = [], []
         owned = None if null_mask is None else ~null_mask
         idx = pa.array(positions.astype(np.int64))
+        # all of this struct's string leaves in ONE subset kernel call
+        built_at = builder.leaf_strings_at(
+            [c for c in group.children
+             if isinstance(c, Primitive) and not c.is_filler
+             and not c.is_array], positions)
         for child in group.children:
             if child.is_filler:
                 continue
@@ -286,7 +291,16 @@ def hierarchical_table(batch, segment_names,
                 field_names.append(child.name)
                 continue
             field_names.append(child.name)
-            arrays.append(full_array(child).take(idx))
+            arr = None
+            if isinstance(child, Primitive) and not child.is_array:
+                # string/numeric leaves build straight at `positions`
+                # (raw-image subset transcode / numpy gather) — no
+                # full-length build, no take
+                arr = built_at.get(id(child))
+                if arr is None:
+                    arr = builder.leaf_numeric_at(child, positions)
+            arrays.append(arr if arr is not None
+                          else full_array(child).take(idx))
         for seg in child_segments_of(group):
             par_pos = positions if owned is None else positions[owned]
             ch_pos, offs_own = assign_children(seg, par_pos)
