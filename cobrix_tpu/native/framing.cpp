@@ -615,9 +615,17 @@ void format_seg_id_level(const int64_t* root_rid, const int64_t* counter,
     infix_len += fmt_i64(infix + infix_len, level);
     infix[infix_len++] = '_';
   }
-  char tail[96];
-  int64_t tail_len = 0;
-  int64_t last_rid = -2, last_cnt = -2;
+  // memoized pieces: the root id digits change once per root; the child
+  // counter is usually last+1, so its decimal string increments in place
+  // (carry walk) instead of re-running the division itoa per row
+  char ridbuf[24];
+  int64_t rid_len = 0;
+  char cntbuf[24];
+  int64_t cnt_len = 0;
+  // INT64_MIN sentinels: a real counter/rid can never equal them, so the
+  // first valid row always formats (a -2 sentinel collided with a
+  // legitimate -2 counter value)
+  int64_t last_rid = INT64_MIN, last_cnt = INT64_MIN;
   int64_t pos = 0;
   out_offsets[0] = 0;
   for (int64_t r = 0; r < n; ++r) {
@@ -626,32 +634,51 @@ void format_seg_id_level(const int64_t* root_rid, const int64_t* counter,
       continue;
     }
     const int64_t rid = root_rid[r];
-    if (rid != last_rid || (counter && counter[r] != last_cnt)) {
+    if (rid != last_rid) {
       last_rid = rid;
-      tail_len = 0;
-      if (rid >= 0) {
-        tail_len += fmt_i64(tail, rid);
-      }
       // rid < 0: a child id arrived before any root — the accumulator's
       // root prefix is the empty string (SegmentIdAccumulator semantics)
-      if (counter) {
-        last_cnt = counter[r];
-        std::memcpy(tail + tail_len, infix, infix_len);
-        tail_len += infix_len;
-        tail_len += fmt_i64(tail + tail_len, last_cnt);
+      rid_len = rid >= 0 ? fmt_i64(ridbuf, rid) : 0;
+    }
+    if (counter) {
+      const int64_t cv = counter[r];
+      if (cv != last_cnt) {
+        if (cnt_len > 0 && cv > 0 && cv == last_cnt + 1
+            && cnt_len < 19) {
+          int i = (int)cnt_len - 1;
+          while (i >= 0 && cntbuf[i] == '9') cntbuf[i--] = '0';
+          if (i < 0) {
+            std::memmove(cntbuf + 1, cntbuf, cnt_len);
+            cntbuf[0] = '1';
+            ++cnt_len;
+          } else {
+            ++cntbuf[i];
+          }
+        } else {
+          cnt_len = fmt_i64(cntbuf, cv);
+        }
+        last_cnt = cv;
       }
     }
     const int64_t pre = rid >= 0 ? prefix_len : 0;
-    if (pos + pre + tail_len > data_cap) {  // cannot happen with
-      out_offsets[r + 1] = (int32_t)pos;    // caller-sized caps, but
-      continue;                             // never overrun
+    const int64_t mid = counter ? infix_len : 0;
+    const int64_t tail = counter ? cnt_len : 0;
+    if (pos + pre + rid_len + mid + tail > data_cap) {  // cannot happen
+      out_offsets[r + 1] = (int32_t)pos;                // with caller-
+      continue;                                         // sized caps
     }
     if (pre) {
       std::memcpy(out_data + pos, prefix, pre);
       pos += pre;
     }
-    std::memcpy(out_data + pos, tail, tail_len);
-    pos += tail_len;
+    std::memcpy(out_data + pos, ridbuf, rid_len);
+    pos += rid_len;
+    if (counter) {
+      std::memcpy(out_data + pos, infix, infix_len);
+      pos += infix_len;
+      std::memcpy(out_data + pos, cntbuf, cnt_len);
+      pos += cnt_len;
+    }
     out_offsets[r + 1] = (int32_t)pos;
   }
   *out_len = pos;
