@@ -24,6 +24,12 @@ from .copybook.datatypes import (
     SchemaRetentionPolicy,
     TrimPolicy,
 )
+from .reader.diagnostics import (
+    DEFAULT_LEDGER_CAP,
+    DEFAULT_RESYNC_WINDOW,
+    ReadDiagnostics,
+    RecordErrorPolicy,
+)
 from .reader.fixed_len_reader import FixedLenReader
 from .reader.json_out import rows_to_json
 from .reader.parameters import (
@@ -34,7 +40,7 @@ from .reader.parameters import (
 from .profiling import ReadMetrics, stage
 from .reader.result import FileResult, rows_file_result
 from .reader.schema import CobolOutputSchema, StructType
-from .reader.stream import open_stream, path_scheme
+from .reader.stream import RetryPolicy, open_stream, path_scheme
 from .reader.var_len_reader import VarLenReader, default_segment_id_prefix
 
 
@@ -249,6 +255,20 @@ def parse_options(options: Dict[str, object],
         input_file_name_column=opts.get("with_input_file_name_col", ""),
         select=tuple(s.strip() for s in opts.get("select", "").split(",")
                      if s.strip()) or None,
+        record_error_policy=RecordErrorPolicy.parse(
+            opts.get("record_error_policy", "fail_fast")),
+        resync_window_bytes=opts.get_int("resync_window",
+                                         DEFAULT_RESYNC_WINDOW),
+        max_corrupt_ledger_entries=opts.get_int(
+            "max_corrupt_ledger_entries", DEFAULT_LEDGER_CAP),
+        corrupt_record_column=opts.get("corrupt_record_column", ""),
+        io_retry_attempts=opts.get_int("io_retry_attempts", 3),
+        io_retry_base_delay=float(
+            opts.get_int("io_retry_base_delay_ms", 50)) / 1000.0,
+        io_retry_max_delay=float(
+            opts.get_int("io_retry_max_delay_ms", 2000)) / 1000.0,
+        io_retry_deadline=float(
+            opts.get_int("io_retry_deadline_ms", 30000)) / 1000.0,
     )
     # recognized keys consumed later by read_cobol — mark used before the
     # pedantic unused-key audit runs
@@ -293,6 +313,20 @@ def _validate_options(opts: Options, params: ReaderParameters,
                 "'variable_size_occurs' = true or one of these options is "
                 "set: 'record_length_field', 'file_start_offset', "
                 "'file_end_offset' or a custom record extractor is specified")
+    if params.corrupt_record_column and not params.is_permissive:
+        raise ValueError(
+            "Option 'corrupt_record_column' requires "
+            "record_error_policy='permissive' or 'drop_malformed' "
+            "(under 'fail_fast' the first malformed record raises instead "
+            "of being recorded).")
+    if params.resync_window_bytes <= 0:
+        raise ValueError(
+            f"Invalid 'resync_window' of {params.resync_window_bytes} "
+            "bytes; it must be a positive byte count.")
+    if params.io_retry_attempts < 1:
+        raise ValueError(
+            f"Invalid 'io_retry_attempts' of {params.io_retry_attempts}; "
+            "at least one attempt is required.")
     seg = params.multisegment
     if seg and seg.field_parent_map and seg.segment_level_ids:
         raise ValueError(
@@ -359,6 +393,9 @@ class CobolData:
         # structured per-read metrics (profiling.ReadMetrics); populated by
         # read_cobol
         self.metrics: Optional[ReadMetrics] = None
+        # the read's error ledger (permissive policies; None under
+        # fail_fast) — aggregated over every file/shard by read_cobol
+        self.diagnostics: Optional[ReadDiagnostics] = None
 
     @classmethod
     def from_results(cls, results: List["FileResult"],
@@ -420,11 +457,12 @@ class CobolData:
 
         if self._arrow_tables is not None:
             if not self._arrow_tables:
-                return arrow_schema(self.schema).empty_table()
-            return (self._arrow_tables[0] if len(self._arrow_tables) == 1
-                    else pa.concat_tables(self._arrow_tables))
+                return self._stamp(arrow_schema(self.schema).empty_table())
+            return self._stamp(
+                self._arrow_tables[0] if len(self._arrow_tables) == 1
+                else pa.concat_tables(self._arrow_tables))
         if self._results is None:
-            return rows_to_table(self._rows, self.schema)
+            return self._stamp(rows_to_table(self._rows, self.schema))
         if self.parallelism > 1 and len(self._results) > 1:
             # per-shard table builds release the GIL inside Arrow; shard
             # order preserves record order, so concat needs no reordering
@@ -439,11 +477,32 @@ class CobolData:
         else:
             tables = [r.to_arrow(self.output_schema) for r in self._results]
         if not tables:
-            return arrow_schema(self.schema).empty_table()
-        return tables[0] if len(tables) == 1 else pa.concat_tables(tables)
+            return self._stamp(arrow_schema(self.schema).empty_table())
+        return self._stamp(tables[0] if len(tables) == 1
+                           else pa.concat_tables(tables))
+
+    def _stamp(self, table):
+        """Attach the read's error ledger to the Arrow schema metadata
+        (key 'cobrix_tpu.read_diagnostics', JSON) so the fault record
+        travels with the data through downstream Arrow/Parquet sinks."""
+        if self.diagnostics is None:
+            return table
+        metadata = dict(table.schema.metadata or {})
+        metadata[b"cobrix_tpu.read_diagnostics"] = \
+            self.diagnostics.to_json().encode()
+        return table.replace_schema_metadata(metadata)
 
 
-def _index_entries(reader, file_path: str, file_order: int, params):
+def _retry_policy(params: ReaderParameters) -> RetryPolicy:
+    """The read's IO retry policy for registry-backed storage."""
+    return RetryPolicy(max_attempts=params.io_retry_attempts,
+                       base_delay=params.io_retry_base_delay,
+                       max_delay=params.io_retry_max_delay,
+                       deadline=params.io_retry_deadline)
+
+
+def _index_entries(reader, file_path: str, file_order: int, params,
+                   retry: Optional[RetryPolicy] = None, on_retry=None):
     """Sparse index for one file, or None when a single shard suffices.
     The vectorized RDW index is used when the configuration allows it;
     otherwise the generic per-record generator (the reference's only mode,
@@ -480,13 +539,15 @@ def _index_entries(reader, file_path: str, file_order: int, params):
             return reader.generate_index(stream, file_order)
     # registry-backed storage: one stream serves both the size probe and
     # the index scan (a backend open is typically a network round trip)
-    with open_stream(file_path) as stream:
+    with open_stream(file_path, retry=retry, on_retry=on_retry) as stream:
         if too_small(stream.size()):
             return None
         return reader.generate_index(stream, file_order)
 
 
-def _plan_var_len_shards(reader, files, params) -> List["WorkShard"]:
+def _plan_var_len_shards(reader, files, params,
+                         retry: Optional[RetryPolicy] = None,
+                         on_retry=None) -> List["WorkShard"]:
     """Byte-range shard plan for a variable-length read: the sparse index
     per file turns the sequential record stream into shards; files without
     a useful index become one whole-file shard. Shared by the in-process
@@ -498,7 +559,8 @@ def _plan_var_len_shards(reader, files, params) -> List["WorkShard"]:
         base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
         entries = None
         if params.is_index_generation_needed:
-            entries = _index_entries(reader, file_path, file_order, params)
+            entries = _index_entries(reader, file_path, file_order, params,
+                                     retry, on_retry)
         if entries is not None and len(entries) > 1:
             # an open-ended last entry (-1) flows into the shard unchanged:
             # streams bound it to the file end themselves, so no extra
@@ -513,7 +575,9 @@ def _plan_var_len_shards(reader, files, params) -> List["WorkShard"]:
 
 
 def _scan_var_len(reader, files, params, backend: str, prefix: str,
-                  parallelism: int, metrics=None) -> List["FileResult"]:
+                  parallelism: int, metrics=None,
+                  retry: Optional[RetryPolicy] = None,
+                  on_retry=None) -> List["FileResult"]:
     """The indexed parallel scan — the reference's flagship execution
     strategy (CobolScanners.buildScanForVarLenIndex, CobolScanners.scala:
     38-55 + IndexBuilder.buildIndex, IndexBuilder.scala:49-66): a sparse
@@ -522,7 +586,7 @@ def _scan_var_len(reader, files, params, backend: str, prefix: str,
     Record_Id seeded from the index entry) and results reassemble in
     record order."""
     with stage(metrics, "plan_index"):
-        shards = _plan_var_len_shards(reader, files, params)
+        shards = _plan_var_len_shards(reader, files, params, retry, on_retry)
     if metrics is not None:
         metrics.shards = len(shards)
 
@@ -530,7 +594,8 @@ def _scan_var_len(reader, files, params, backend: str, prefix: str,
         max_bytes = (0 if shard.offset_to < 0
                      else shard.offset_to - shard.offset_from)
         with open_stream(shard.file_path, start_offset=shard.offset_from,
-                         maximum_bytes=max_bytes) as stream:
+                         maximum_bytes=max_bytes, retry=retry,
+                         on_retry=on_retry) as stream:
             return reader.read_result_columnar(
                 stream, file_id=shard.file_order, backend=backend,
                 segment_id_prefix=prefix,
@@ -634,6 +699,12 @@ def read_cobol(path=None,
             reader = FixedLenReader(copybook_contents, params)
         copybook_obj = reader.copybook
 
+    retry = _retry_policy(params)
+    retries_seen: List[int] = []  # list.append is GIL-atomic across shards
+
+    def on_retry():
+        retries_seen.append(1)
+
     with stage(metrics, "scan"):
         if is_var_len:
             prefix = (params.multisegment.segment_id_prefix
@@ -642,32 +713,54 @@ def read_cobol(path=None,
                       else default_segment_id_prefix())
             if backend == "host":
                 for file_order, file_path in enumerate(files):
-                    with open_stream(file_path) as stream:
-                        results.append(rows_file_result(list(
+                    ledger = (params.new_diagnostics()
+                              if params.is_permissive else None)
+                    reasons: dict = {}
+                    with open_stream(file_path, retry=retry,
+                                     on_retry=on_retry) as stream:
+                        result = rows_file_result(list(
                             reader.iter_rows(
                                 stream, file_id=file_order,
                                 segment_id_prefix=prefix,
                                 start_record_id=file_order
-                                * DEFAULT_FILE_RECORD_ID_INCREMENT))))
+                                * DEFAULT_FILE_RECORD_ID_INCREMENT,
+                                ledger=ledger,
+                                corrupt_reasons_out=reasons)))
+                    result.diagnostics = ledger
+                    result.corrupt_record_field = \
+                        params.corrupt_record_column
+                    result.corrupt_row_reasons = reasons or None
+                    results.append(result)
             else:
                 results = _scan_var_len(reader, files, params, backend,
                                         prefix, parallelism,
-                                        metrics=metrics)
+                                        metrics=metrics, retry=retry,
+                                        on_retry=on_retry)
         else:
             for file_order, file_path in enumerate(files):
                 base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
                 if backend == "host":
-                    data = _read_file_bytes(file_path)
-                    results.append(rows_file_result(list(
+                    ledger = (params.new_diagnostics()
+                              if params.is_permissive else None)
+                    reasons = {}
+                    data = _read_file_bytes(file_path, retry, on_retry)
+                    result = rows_file_result(list(
                         reader.iter_rows_host(
                             data, file_id=file_order,
                             first_record_id=base,
                             input_file_name=file_path,
-                            ignore_file_size=debug_ignore_file_size))))
+                            ignore_file_size=debug_ignore_file_size,
+                            ledger=ledger,
+                            corrupt_reasons_out=reasons)))
+                    result.diagnostics = ledger
+                    result.corrupt_record_field = \
+                        params.corrupt_record_column
+                    result.corrupt_row_reasons = reasons or None
+                    results.append(result)
                 else:
                     results.extend(_read_fixed_len_chunked(
                         reader, file_path, params, backend, file_order,
-                        base, debug_ignore_file_size))
+                        base, debug_ignore_file_size, retry, on_retry))
 
     schema = CobolOutputSchema(
         copybook_obj,
@@ -675,10 +768,29 @@ def read_cobol(path=None,
         input_file_name_field=params.input_file_name_column,
         generate_record_id=params.generate_record_id,
         generate_seg_id_field_count=seg_count,
-        segment_id_prefix="")
+        segment_id_prefix="",
+        corrupt_record_field=params.corrupt_record_column)
     data = CobolData.from_results(results, schema, parallelism=parallelism)
+    data.diagnostics = _aggregate_diagnostics(params, results,
+                                              len(retries_seen))
     metrics.finalize(data, len(results))
     return data
+
+
+def _aggregate_diagnostics(params: ReaderParameters,
+                           results: List["FileResult"],
+                           io_retries: int) -> Optional[ReadDiagnostics]:
+    """Merge per-file/shard ledgers into the read-level ledger. None under
+    fail_fast with no IO incidents (the read either succeeded cleanly or
+    raised)."""
+    if not params.is_permissive and io_retries == 0:
+        return None
+    merged = ReadDiagnostics(
+        max_entries=params.max_corrupt_ledger_entries)
+    for r in results:
+        merged.merge(getattr(r, "diagnostics", None))
+    merged.io_retries += io_retries
+    return merged
 
 
 # fixed-length files stream through bounded chunk reads instead of one
@@ -687,26 +799,29 @@ def read_cobol(path=None,
 FIXED_READ_CHUNK_BYTES = 64 * 1024 * 1024
 
 
-def _read_file_bytes(path: str):
+def _read_file_bytes(path: str, retry: Optional[RetryPolicy] = None,
+                     on_retry=None):
     """Whole-file bytes-like payload: a read-only mmap memoryview for
     local files (FSStream.next_view), plain bytes otherwise — consumers
     must stick to buffer-protocol operations (len/slice/np.frombuffer)."""
     from .reader.stream import open_stream
 
-    with open_stream(path) as stream:
+    with open_stream(path, retry=retry, on_retry=on_retry) as stream:
         return stream.next_view(stream.size())
 
 
 def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
                             file_order: int, base_record_id: int,
-                            ignore_file_size: bool) -> List["FileResult"]:
+                            ignore_file_size: bool,
+                            retry: Optional[RetryPolicy] = None,
+                            on_retry=None) -> List["FileResult"]:
     from .reader.stream import open_stream, path_scheme
 
     rs = reader.record_size
     if path_scheme(file_path) in (None, "file"):
         size = os.path.getsize(file_path)
     else:
-        with open_stream(file_path) as s:
+        with open_stream(file_path, retry=retry, on_retry=on_retry) as s:
             size = s.size()
     payload = size - params.file_start_offset - params.file_end_offset
     chunkable = (size > FIXED_READ_CHUNK_BYTES
@@ -715,13 +830,13 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
                  and (payload % rs == 0 or ignore_file_size))
     if not chunkable:
         return [reader.read_result(
-            _read_file_bytes(file_path), backend=backend,
+            _read_file_bytes(file_path, retry, on_retry), backend=backend,
             file_id=file_order, first_record_id=base_record_id,
             input_file_name=file_path, ignore_file_size=ignore_file_size)]
     chunk_bytes = max(rs, (FIXED_READ_CHUNK_BYTES // rs) * rs)
     results: List[FileResult] = []
     done = 0
-    with open_stream(file_path) as stream:
+    with open_stream(file_path, retry=retry, on_retry=on_retry) as stream:
         while done < size:
             data = stream.next_view(min(chunk_bytes, size - done))
             if not data:
@@ -768,12 +883,32 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
         input_file_name_field=params.input_file_name_column,
         generate_record_id=params.generate_record_id,
         generate_seg_id_field_count=seg_count,
-        segment_id_prefix="")
+        segment_id_prefix="",
+        corrupt_record_field=params.corrupt_record_column)
     with stage(metrics, "scan"):
         tables = multihost_scan(reader, shards, is_var_len, schema, hosts,
                                 prefix,
                                 ignore_file_size=debug_ignore_file_size)
-    data = CobolData.from_arrow_tables(tables, schema)
+    # merge the per-shard ledgers the workers shipped back as IPC schema
+    # metadata (stripped here so shard keys don't leak into — or break
+    # concatenation of — the unified table); shard order is canonical, so
+    # entry order matches a single-process read. Workers ship a ledger
+    # under fail_fast too when IO retries fired, matching
+    # _aggregate_diagnostics.
+    diagnostics = params.new_diagnostics()
+    found = False
+    cleaned = []
+    for table in tables:
+        metadata = dict(table.schema.metadata or {})
+        raw = metadata.pop(b"cobrix_tpu.shard_diagnostics", None)
+        if raw:
+            found = True
+            diagnostics.merge(ReadDiagnostics.from_json(raw))
+            table = table.replace_schema_metadata(metadata or None)
+        cleaned.append(table)
+    data = CobolData.from_arrow_tables(cleaned, schema)
+    data.diagnostics = (diagnostics if params.is_permissive or found
+                        else None)
     if metrics is not None:
         metrics.finalize(data, len(shards))
     return data

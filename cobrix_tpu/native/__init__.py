@@ -166,6 +166,27 @@ def _as_u8(data) -> np.ndarray:
     return np.frombuffer(data, dtype=np.uint8)
 
 
+def _framing_error(buf: np.ndarray, pos: int, kind: str):
+    """Structured framing error (reader.diagnostics.FramingError — imported
+    lazily to keep this module free of reader dependencies at load time).
+    Messages keep the reference wording plus a hex header snapshot."""
+    from ..reader.diagnostics import FramingError, hex_snapshot
+
+    header = bytes(buf[pos:pos + 4])
+    hdr = ",".join(str(b) for b in header)
+    if kind == "zero":
+        message = (f"RDW headers should never be zero ({hdr}). "
+                   f"Found zero size record at {pos} "
+                   f"(header bytes: {hex_snapshot(header)}).")
+        reason = "zero-length RDW header"
+    else:
+        message = (f"RDW headers too big at {pos} "
+                   f"(header bytes: {hex_snapshot(header)}).")
+        reason = "oversized RDW header"
+    return FramingError(message, offset=int(pos), reason=reason,
+                        header=header)
+
+
 def rdw_scan(data, big_endian: bool, rdw_adjustment: int = 0,
              file_header_bytes: int = 0, file_footer_bytes: int = 0
              ) -> Tuple[np.ndarray, np.ndarray]:
@@ -184,12 +205,9 @@ def rdw_scan(data, big_endian: bool, rdw_adjustment: int = 0,
                          file_header_bytes, file_footer_bytes, offsets,
                          lengths, cap, ctypes.byref(err))
         if n == -1:
-            hdr = ",".join(str(b) for b in buf[err.value:err.value + 4])
-            raise ValueError(
-                f"RDW headers should never be zero ({hdr}). "
-                f"Found zero size record at {err.value}.")
+            raise _framing_error(buf, err.value, "zero")
         if n == -2:
-            raise ValueError(f"RDW headers too big at {err.value}.")
+            raise _framing_error(buf, err.value, "big")
         return offsets[:n].copy(), lengths[:n].copy()
     # NumPy fallback (still sequential in Python — the chain is data-dependent)
     pos = 0
@@ -205,12 +223,9 @@ def rdw_scan(data, big_endian: bool, rdw_adjustment: int = 0,
             ln = int(buf[pos + 2]) + 256 * int(buf[pos + 3])
         ln += rdw_adjustment
         if ln <= 0:
-            hdr = ",".join(str(b) for b in buf[pos:pos + 4])
-            raise ValueError(
-                f"RDW headers should never be zero ({hdr}). "
-                f"Found zero size record at {pos}.")
+            raise _framing_error(buf, pos, "zero")
         if ln > MAX_RDW_RECORD_SIZE:
-            raise ValueError(f"RDW headers too big at {pos}.")
+            raise _framing_error(buf, pos, "big")
         out_o.append(pos + 4)
         out_l.append(min(ln, body_end - (pos + 4)))
         pos += 4 + ln

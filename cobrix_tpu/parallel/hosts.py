@@ -36,20 +36,29 @@ def _worker_scan(host_shards: List[WorkShard]) -> List[Tuple[tuple, bytes]]:
     [(shard_key, arrow_ipc_bytes), ...]."""
     import pyarrow as pa
 
-    from ..reader.stream import open_stream
+    from ..reader.diagnostics import ReadDiagnostics
+    from ..reader.stream import RetryPolicy, open_stream
 
     ctx = _CTX
     reader = ctx["reader"]
     schema = ctx["schema"]
+    params = reader.params
+    retry = RetryPolicy(max_attempts=params.io_retry_attempts,
+                        base_delay=params.io_retry_base_delay,
+                        max_delay=params.io_retry_max_delay,
+                        deadline=params.io_retry_deadline)
     out = []
     for shard in host_shards:
         key = (shard.file_order, shard.offset_from)
+        retries: List[int] = []
+        on_retry = lambda: retries.append(1)  # noqa: E731
         if ctx["is_var_len"]:
             max_bytes = (0 if shard.offset_to < 0
                          else shard.offset_to - shard.offset_from)
             with open_stream(shard.file_path,
                              start_offset=shard.offset_from,
-                             maximum_bytes=max_bytes) as stream:
+                             maximum_bytes=max_bytes, retry=retry,
+                             on_retry=on_retry) as stream:
                 result = reader.read_result_columnar(
                     stream, file_id=shard.file_order, backend="numpy",
                     segment_id_prefix=ctx["prefix"],
@@ -60,7 +69,8 @@ def _worker_scan(host_shards: List[WorkShard]) -> List[Tuple[tuple, bytes]]:
                          else shard.offset_to - shard.offset_from)
             with open_stream(shard.file_path,
                              start_offset=shard.offset_from,
-                             maximum_bytes=max_bytes) as stream:
+                             maximum_bytes=max_bytes, retry=retry,
+                             on_retry=on_retry) as stream:
                 data = stream.next(stream.size() - shard.offset_from)
             result = reader.read_result(
                 data, backend="numpy", file_id=shard.file_order,
@@ -68,6 +78,21 @@ def _worker_scan(host_shards: List[WorkShard]) -> List[Tuple[tuple, bytes]]:
                 input_file_name=shard.file_path,
                 ignore_file_size=ctx["ignore_file_size"])
         table = result.to_arrow(schema)
+        diag = getattr(result, "diagnostics", None)
+        if retries:
+            # retried-but-recovered IO is an incident too (matching the
+            # single-process read, which ledgers io_retries even under
+            # fail_fast)
+            if diag is None:
+                diag = ReadDiagnostics()
+            diag.io_retries += len(retries)
+        if diag is not None and not diag.is_clean:
+            # ship the shard's error ledger to the parent on the IPC
+            # stream; the parent merges the shards into the read's ledger
+            metadata = dict(table.schema.metadata or {})
+            metadata[b"cobrix_tpu.shard_diagnostics"] = \
+                diag.to_json().encode()
+            table = table.replace_schema_metadata(metadata)
         sink = pa.BufferOutputStream()
         with pa.ipc.new_stream(sink, table.schema) as writer:
             writer.write_table(table)

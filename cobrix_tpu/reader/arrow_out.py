@@ -614,10 +614,12 @@ def segment_table(batch: DecodedBatch,
                   record_ids: Optional[np.ndarray],
                   seg_level_ids: Optional[Sequence[Sequence[object]]],
                   input_file_name: str = "",
-                  redefine_masks: Optional[dict] = None):
+                  redefine_masks: Optional[dict] = None,
+                  corrupt_reasons: Optional[Sequence] = None):
     """One Arrow table for one decoded batch (single active segment, or a
     decode-once batch with per-row redefine masks), with generated columns
-    prepended per the output schema."""
+    prepended per the output schema. `corrupt_reasons`: per-row values of
+    the trailing corrupt-record debug column (None entries = clean)."""
     pa = _pa()
     builder = ArrowBatchBuilder(batch, active, redefine_masks)
     n = batch.n_records
@@ -675,6 +677,9 @@ def segment_table(batch: DecodedBatch,
         if output_schema.input_file_name_field:
             cols.append(pa.array([input_file_name] * n, type=pa.string()))
     cols.extend(arr for _, arr in builder.body_columns(output_schema.policy))
+    if getattr(output_schema, "corrupt_record_field", ""):
+        cols.append(pa.nulls(n, pa.string()) if corrupt_reasons is None
+                    else pa.array(list(corrupt_reasons), type=pa.string()))
     target = arrow_schema(schema)
     if len(cols) != len(target):
         raise ValueError(

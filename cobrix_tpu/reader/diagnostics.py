@@ -1,0 +1,189 @@
+"""Fault-tolerant read diagnostics: corrupt-record policies + error ledger.
+
+The reference readers are fail-fast only (RecordHeaderParserRDW hard
+errors); production scans over real mainframe dumps need the Spark parse-
+mode triple instead:
+
+  * ``fail_fast``      — first malformed record aborts the read (default,
+                         reference behavior) with an actionable error
+                         (file, offset, hex header snapshot).
+  * ``permissive``     — malformed records are kept where decodable
+                         (fields past a truncated tail come back null),
+                         corrupt byte ranges are skipped via bounded
+                         header resynchronization, and every incident is
+                         recorded in the read's :class:`ReadDiagnostics`.
+  * ``drop_malformed`` — like permissive, but malformed records are
+                         dropped from the output entirely.
+
+``ReadDiagnostics`` is the per-read error ledger: counters plus a capped
+list of :class:`CorruptRecordInfo` entries, surfaced on ``CobolData``,
+attached to Arrow schema metadata, and optionally materialized as a
+``_corrupt_record``-style debug column.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field as dc_field
+from enum import Enum
+from typing import List, Optional
+
+
+class RecordErrorPolicy(Enum):
+    FAIL_FAST = "fail_fast"
+    PERMISSIVE = "permissive"
+    DROP_MALFORMED = "drop_malformed"
+
+    @classmethod
+    def parse(cls, value: "str | RecordErrorPolicy") -> "RecordErrorPolicy":
+        if isinstance(value, cls):
+            return value
+        try:
+            return cls(str(value).strip().lower())
+        except ValueError:
+            valid = ", ".join(repr(p.value) for p in cls)
+            raise ValueError(
+                f"Invalid value '{value}' for 'record_error_policy' option. "
+                f"Valid policies: {valid}.") from None
+
+    @property
+    def is_fail_fast(self) -> bool:
+        return self is RecordErrorPolicy.FAIL_FAST
+
+    @property
+    def keeps_malformed(self) -> bool:
+        return self is RecordErrorPolicy.PERMISSIVE
+
+
+DEFAULT_RESYNC_WINDOW = 64 * 1024
+DEFAULT_LEDGER_CAP = 100
+
+
+def hex_snapshot(header, limit: int = 16) -> str:
+    """Hex dump of a header/byte prefix for error messages and ledger
+    entries ('00 00 0a 00'); empty input renders as '<empty>'."""
+    data = bytes(header[:limit])
+    if not data:
+        return "<empty>"
+    out = " ".join(f"{b:02x}" for b in data)
+    return out + (" .." if len(header) > limit else "")
+
+
+class FramingError(ValueError):
+    """A malformed record header/length with structured location info.
+
+    Subclasses ValueError so existing fail-fast callers (and their tests)
+    keep working; permissive framers catch it to drive resynchronization.
+    """
+
+    def __init__(self, message: str, offset: int = -1, reason: str = "",
+                 header: bytes = b"", file_name: str = ""):
+        super().__init__(message)
+        self.offset = offset
+        self.reason = reason or message
+        self.header = bytes(header)
+        self.file_name = file_name
+
+
+@dataclass(frozen=True)
+class CorruptRecordInfo:
+    """One ledger entry: where the corruption was and what was done."""
+
+    file: str
+    offset: int            # byte offset of the corrupt region in the file
+    length: int            # bytes skipped (0 for kept-but-truncated records)
+    reason: str
+    header_snapshot: str   # hex dump of the bytes at `offset`
+    record_index: Optional[int] = None  # in-shard record position when kept
+
+    def as_dict(self) -> dict:
+        return {
+            "file": self.file,
+            "offset": self.offset,
+            "length": self.length,
+            "reason": self.reason,
+            "header_snapshot": self.header_snapshot,
+            "record_index": self.record_index,
+        }
+
+
+@dataclass
+class ReadDiagnostics:
+    """Per-read error ledger: counts always, entries up to `max_entries`."""
+
+    corrupt_records: int = 0    # malformed records kept or dropped
+    records_dropped: int = 0    # records excluded by drop_malformed
+    bytes_skipped: int = 0      # bytes discarded by resynchronization
+    resyncs: int = 0            # successful header resynchronizations
+    io_retries: int = 0         # storage reads retried by the IO layer
+    max_entries: int = DEFAULT_LEDGER_CAP
+    entries: List[CorruptRecordInfo] = dc_field(default_factory=list)
+
+    @property
+    def entries_truncated(self) -> bool:
+        return self.corrupt_records > len(self.entries)
+
+    def record(self, info: CorruptRecordInfo, dropped: bool = False) -> None:
+        self.corrupt_records += 1
+        if dropped:
+            self.records_dropped += 1
+        if len(self.entries) < self.max_entries:
+            self.entries.append(info)
+
+    def record_skip(self, file: str, offset: int, length: int, reason: str,
+                    header: bytes = b"") -> None:
+        """A corrupt byte range skipped by resynchronization."""
+        self.resyncs += 1
+        self.bytes_skipped += length
+        self.record(CorruptRecordInfo(file, offset, length, reason,
+                                      hex_snapshot(header)))
+
+    def merge(self, other: Optional["ReadDiagnostics"]) -> "ReadDiagnostics":
+        if other is None:
+            return self
+        self.corrupt_records += other.corrupt_records
+        self.records_dropped += other.records_dropped
+        self.bytes_skipped += other.bytes_skipped
+        self.resyncs += other.resyncs
+        self.io_retries += other.io_retries
+        room = self.max_entries - len(self.entries)
+        if room > 0:
+            self.entries.extend(other.entries[:room])
+        return self
+
+    @property
+    def is_clean(self) -> bool:
+        return (self.corrupt_records == 0 and self.bytes_skipped == 0
+                and self.io_retries == 0)
+
+    def as_dict(self) -> dict:
+        return {
+            "corrupt_records": self.corrupt_records,
+            "records_dropped": self.records_dropped,
+            "bytes_skipped": self.bytes_skipped,
+            "resyncs": self.resyncs,
+            "io_retries": self.io_retries,
+            "entries_truncated": self.entries_truncated,
+            "entries": [e.as_dict() for e in self.entries],
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.as_dict(), separators=(",", ":"))
+
+    @classmethod
+    def from_json(cls, raw: "str | bytes") -> "ReadDiagnostics":
+        """Inverse of to_json (worker shards ship their ledgers to the
+        parent as schema metadata on the Arrow IPC stream)."""
+        d = json.loads(raw)
+        diag = cls(corrupt_records=d.get("corrupt_records", 0),
+                   records_dropped=d.get("records_dropped", 0),
+                   bytes_skipped=d.get("bytes_skipped", 0),
+                   resyncs=d.get("resyncs", 0),
+                   io_retries=d.get("io_retries", 0))
+        diag.entries = [
+            CorruptRecordInfo(
+                file=e.get("file", ""), offset=e.get("offset", -1),
+                length=e.get("length", 0), reason=e.get("reason", ""),
+                header_snapshot=e.get("header_snapshot", ""),
+                record_index=e.get("record_index"))
+            for e in d.get("entries", [])]
+        return diag

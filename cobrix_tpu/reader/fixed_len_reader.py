@@ -14,6 +14,12 @@ import numpy as np
 from ..copybook.copybook import Copybook, merge_copybooks, parse_copybook
 from ..encoding.codepages import resolve_code_page
 from .columnar import ColumnarDecoder, DecodedBatch, decoder_for_segment
+from .diagnostics import (
+    CorruptRecordInfo,
+    ReadDiagnostics,
+    RecordErrorPolicy,
+    hex_snapshot,
+)
 from .extractors import DecodeOptions, extract_record
 from .parameters import ReaderParameters
 from .result import FileResult, SegmentBatch
@@ -63,7 +69,8 @@ class FixedLenReader:
                 + self.params.end_offset)
 
     def check_binary_data_validity(self, data_size: int,
-                                   ignore_file_size: bool = False) -> None:
+                                   ignore_file_size: bool = False,
+                                   file_name: str = "") -> None:
         """reference FixedLenNestedReader.checkBinaryDataValidity."""
         rs = self.record_size
         if self.params.start_offset < 0:
@@ -79,8 +86,38 @@ class FixedLenReader:
         payload = (data_size - self.params.file_start_offset
                    - self.params.file_end_offset)
         if payload % rs != 0:
+            where = f" of '{file_name}'" if file_name else ""
             raise ValueError(
-                f"Binary record size {rs} does not divide data size {payload}.")
+                f"Binary record size {rs} does not divide data size "
+                f"{payload}{where}: the last {payload % rs} byte(s) "
+                f"(at file offset {data_size - self.params.file_end_offset - payload % rs}) "
+                "do not form a whole record. Set "
+                "record_error_policy='permissive' (or 'drop_malformed') to "
+                "tolerate a truncated tail, or 'debug_ignore_file_size' to "
+                "ignore it.")
+
+    def _tail_remainder(self, data_size: int) -> int:
+        """Bytes of a trailing partial record (0 when the size divides)."""
+        payload = (data_size - self.params.file_start_offset
+                   - self.params.file_end_offset)
+        return payload % self.record_size if payload > 0 else 0
+
+    def _ledger_tail(self, ledger: Optional[ReadDiagnostics], data,
+                     file_name: str, kept_index: Optional[int]) -> str:
+        """Record a truncated trailing record in the ledger; returns the
+        reason string (for the corrupt-record debug column)."""
+        rem = self._tail_remainder(len(data))
+        reason = (f"fixed-length record truncated at end of data: "
+                  f"{self.record_size} bytes declared, {rem} available")
+        if ledger is None:
+            return reason
+        offset = len(data) - self.params.file_end_offset - rem
+        tail = bytes(data[offset:offset + 16])
+        ledger.record(
+            CorruptRecordInfo(file_name, offset, 0, reason,
+                              hex_snapshot(tail), record_index=kept_index),
+            dropped=kept_index is None)
+        return reason
 
     def to_record_matrix(self, data: bytes,
                          ignore_file_size: bool = False) -> np.ndarray:
@@ -139,24 +176,77 @@ class FixedLenReader:
         """Decode to a columnar FileResult (kernel outputs kept; rows and
         Arrow tables are materialized lazily at the API boundary)."""
         params = self.params
+        ledger = params.new_diagnostics() if params.is_permissive else None
         result = FileResult(
             n_rows=0,
             file_id=file_id,
             input_file_name=input_file_name,
             policy=params.schema_policy,
             generate_record_id=params.generate_record_id,
-            generate_input_file_field=bool(params.input_file_name_column))
+            generate_input_file_field=bool(params.input_file_name_column),
+            corrupt_record_field=params.corrupt_record_column,
+            diagnostics=ledger)
         if self._is_multisegment:
             self._read_multiseg_result(result, data, backend,
-                                       first_record_id, ignore_file_size)
+                                       first_record_id, ignore_file_size,
+                                       ledger, input_file_name)
             return result
-        batch = self.decode_batch(data, backend, ignore_file_size)
+        rem = self._policy_tail(data, ignore_file_size, input_file_name)
+        if rem == 0:
+            batch = self.decode_batch(data, backend, ignore_file_size)
+        else:
+            matrix, rec_lengths, reasons = self._matrix_with_tail(
+                data, rem, ledger, input_file_name)
+            trimmed, width = self._trimmed_matrix(matrix)
+            lengths = np.minimum(
+                np.maximum(rec_lengths - self.params.start_offset, 0), width)
+            batch = self.decoder(backend).decode(trimmed, lengths=lengths)
+            result.corrupt_row_reasons = reasons or None
         n = batch.n_records
         positions = np.arange(n, dtype=np.int64)
         result.n_rows = n
         result.segments.append(SegmentBatch(
             batch, None, positions, first_record_id + positions))
         return result
+
+    def _policy_tail(self, data, ignore_file_size: bool,
+                     file_name: str) -> int:
+        """Trailing partial-record bytes to handle under a permissive
+        policy. 0 = clean (or fail-fast: the validity check raises)."""
+        if self.params.is_permissive and not ignore_file_size:
+            rem = self._tail_remainder(len(data))
+            if rem:
+                # offset sanity still applies; size check is policy-handled
+                self.check_binary_data_validity(len(data), True, file_name)
+                return rem
+        self.check_binary_data_validity(len(data), ignore_file_size,
+                                        file_name)
+        return 0
+
+    def _matrix_with_tail(self, data, rem: int, ledger, file_name: str):
+        """[n(+1), rs] record matrix where a truncated trailing record is
+        kept as a zero-padded row (permissive) or dropped (drop_malformed),
+        plus per-row available byte counts and the kept-row reason map."""
+        rs = self.record_size
+        matrix = self.to_record_matrix(data, ignore_file_size=True)
+        n = matrix.shape[0]
+        keep = (self.params.record_error_policy
+                is RecordErrorPolicy.PERMISSIVE)
+        reason = self._ledger_tail(ledger, data, file_name,
+                                   n if keep else None)
+        rec_lengths = np.full(n + (1 if keep else 0), rs, dtype=np.int64)
+        reasons: dict = {}
+        if keep:
+            tail_start = self.params.file_start_offset + n * rs
+            tail = np.frombuffer(data[tail_start:tail_start + rem],
+                                 dtype=np.uint8)
+            padded = np.zeros((n + 1, rs), dtype=np.uint8)
+            padded[:n] = matrix
+            padded[n, :len(tail)] = tail
+            matrix = padded
+            rec_lengths[n] = rem
+            reasons[n] = reason
+        return matrix, rec_lengths, reasons
 
     # -- multisegment fixed-length records ---------------------------------
     # (reference FixedLenNestedRowIterator.scala:63-71: per-record segment
@@ -190,9 +280,17 @@ class FixedLenReader:
 
     def _read_multiseg_result(self, result: FileResult, data: bytes,
                               backend: str, first_record_id: int,
-                              ignore_file_size: bool) -> None:
-        self.check_binary_data_validity(len(data), ignore_file_size)
-        matrix = self.to_record_matrix(data, ignore_file_size)
+                              ignore_file_size: bool,
+                              ledger: Optional[ReadDiagnostics] = None,
+                              file_name: str = "") -> None:
+        rem = self._policy_tail(data, ignore_file_size, file_name)
+        if rem == 0:
+            matrix = self.to_record_matrix(data, ignore_file_size)
+            rec_lengths = None
+        else:
+            matrix, rec_lengths, reasons = self._matrix_with_tail(
+                data, rem, ledger, file_name)
+            result.corrupt_row_reasons = reasons or None
         segment_ids = self._segment_values(matrix)
 
         trimmed, width = self._trimmed_matrix(matrix)
@@ -201,8 +299,13 @@ class FixedLenReader:
             positions = np.nonzero(segment_ids.mask_of_mapped(
                 self.segment_redefine_map, active))[0].astype(np.int64)
             decoder = self._decoder_for_segment(active, backend)
-            lengths = (np.full(len(positions), width, dtype=np.int64)
-                       if width < self.copybook.record_size else None)
+            if rec_lengths is not None:
+                lengths = np.minimum(np.maximum(
+                    rec_lengths[positions] - self.params.start_offset, 0),
+                    width)
+            else:
+                lengths = (np.full(len(positions), width, dtype=np.int64)
+                           if width < self.copybook.record_size else None)
             decoded = decoder.decode(trimmed[positions], lengths=lengths)
             result.segments.append(SegmentBatch(
                 decoded, active or None, positions,
@@ -211,21 +314,40 @@ class FixedLenReader:
     def iter_rows_host(self, data: bytes, file_id: int = 0,
                        first_record_id: int = 0,
                        input_file_name: str = "",
-                       ignore_file_size: bool = False
+                       ignore_file_size: bool = False,
+                       ledger: Optional[ReadDiagnostics] = None,
+                       corrupt_reasons_out: Optional[dict] = None
                        ) -> Iterator[List[object]]:
         """Per-record host walk (oracle path)."""
-        self.check_binary_data_validity(len(data), ignore_file_size)
-        matrix = self.to_record_matrix(data, ignore_file_size)
+        rem = self._policy_tail(data, ignore_file_size, input_file_name)
+        tail_bytes = b""
+        if rem:
+            if ledger is None:
+                ledger = self.params.new_diagnostics()
+            keep = (self.params.record_error_policy
+                    is RecordErrorPolicy.PERMISSIVE)
+            matrix = self.to_record_matrix(data, ignore_file_size=True)
+            reason = self._ledger_tail(ledger, data, input_file_name,
+                                       matrix.shape[0] if keep else None)
+            if keep:
+                tail_start = (self.params.file_start_offset
+                              + matrix.shape[0] * self.record_size)
+                tail_bytes = bytes(data[tail_start:tail_start + rem])
+                if corrupt_reasons_out is not None:
+                    corrupt_reasons_out[matrix.shape[0]] = reason
+        else:
+            matrix = self.to_record_matrix(data, ignore_file_size)
         options = DecodeOptions.from_copybook(self.copybook)
         segment_ids = (self._segment_values(matrix)
                        if self._is_multisegment else None)
-        for i in range(matrix.shape[0]):
+
+        def extract(i: int, record: bytes):
             active = ""
-            if segment_ids is not None:
+            if segment_ids is not None and i < len(segment_ids):
                 active = self.segment_redefine_map.get(segment_ids[i], "")
-            yield extract_record(
+            return extract_record(
                 self.copybook.ast,
-                matrix[i].tobytes(),
+                record,
                 offset_bytes=self.params.start_offset,
                 policy=self.params.schema_policy,
                 variable_length_occurs=self.params.variable_size_occurs,
@@ -236,3 +358,8 @@ class FixedLenReader:
                 generate_input_file_field=bool(self.params.input_file_name_column),
                 input_file_name=input_file_name,
                 options=options)
+
+        for i in range(matrix.shape[0]):
+            yield extract(i, matrix[i].tobytes())
+        if tail_bytes:
+            yield extract(matrix.shape[0], tail_bytes)

@@ -11,6 +11,7 @@ import importlib
 from dataclasses import dataclass
 
 from ..copybook.datatypes import MAX_RDW_RECORD_SIZE
+from .diagnostics import FramingError, hex_snapshot
 
 
 @dataclass(frozen=True)
@@ -71,14 +72,20 @@ class RdwHeaderParser(RecordHeaderParser):
         if length > 0:
             if length > MAX_RDW_RECORD_SIZE:
                 hdr = ",".join(str(b) for b in header)
-                raise ValueError(
+                raise FramingError(
                     f"RDW headers too big (length = {length} > "
-                    f"{MAX_RDW_RECORD_SIZE}). Headers = {hdr} at {file_offset}.")
+                    f"{MAX_RDW_RECORD_SIZE}). Headers = {hdr} at file offset "
+                    f"{file_offset} (header bytes: {hex_snapshot(header)}).",
+                    offset=file_offset, reason="oversized RDW header",
+                    header=header)
             return RecordMetadata(length, True)
         hdr = ",".join(str(b) for b in header)
-        raise ValueError(
+        raise FramingError(
             f"RDW headers should never be zero ({hdr}). "
-            f"Found zero size record at {file_offset}.")
+            f"Found zero size record at file offset {file_offset} "
+            f"(header bytes: {hex_snapshot(header)}).",
+            offset=file_offset, reason="zero-length RDW header",
+            header=header)
 
 
 class FixedLengthHeaderParser(RecordHeaderParser):
@@ -127,8 +134,23 @@ def create_record_header_parser(name: str,
                                        file_footer_bytes)
     module_name, _, class_name = name.rpartition(".")
     if not module_name:
-        raise ValueError(f"Unknown record header parser '{name}'")
-    cls = getattr(importlib.import_module(module_name), class_name)
+        raise ValueError(
+            f"Unknown record header parser '{name}'. Use one of 'rdw', "
+            "'rdw_big_endian', 'rdw_little_endian', 'fixed_length', or a "
+            "dotted path to a RecordHeaderParser subclass "
+            "(e.g. 'my_pkg.my_module.MyParser').")
+    try:
+        module = importlib.import_module(module_name)
+    except ImportError as exc:
+        raise ValueError(
+            f"Custom record header parser '{name}': module "
+            f"'{module_name}' could not be imported ({exc}).") from exc
+    try:
+        cls = getattr(module, class_name)
+    except AttributeError:
+        raise ValueError(
+            f"Custom record header parser '{name}': module "
+            f"'{module_name}' has no attribute '{class_name}'.") from None
     instance = cls()
     if not isinstance(instance, RecordHeaderParser):
         raise TypeError(

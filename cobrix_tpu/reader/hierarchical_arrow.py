@@ -341,6 +341,12 @@ def hierarchical_table(batch, segment_names,
         else:
             cols.append(struct)
 
+    if getattr(output_schema, "corrupt_record_field", ""):
+        # hierarchical assemblies carry no per-row corruption attribution;
+        # the debug column is declared but all-null here (the ledger on
+        # CobolData.diagnostics still records every incident)
+        cols.append(pa.nulls(n_roots, pa.string()))
+
     target = arrow_schema(output_schema.schema)
     if len(cols) != len(target):
         _count("bail_schema_shape")

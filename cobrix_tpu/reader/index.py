@@ -19,9 +19,20 @@ from typing import List, Optional
 
 from ..copybook.ast import Primitive
 from ..copybook.copybook import Copybook
-from .header_parsers import RecordHeaderParser
+from .diagnostics import (
+    DEFAULT_RESYNC_WINDOW,
+    ReadDiagnostics,
+    RecordErrorPolicy,
+)
+from .header_parsers import RdwHeaderParser, RecordHeaderParser
 from .parameters import DEFAULT_INDEX_ENTRY_SIZE_MB, MEGABYTE
 from .raw_extractors import RawRecordExtractor
+from .recovery import (
+    PendingReader,
+    generic_blob_validator,
+    rdw_blob_validator,
+    resync_stream,
+)
 from .stream import SimpleStream
 
 
@@ -42,7 +53,12 @@ def sparse_index_generator(file_id: int,
                            copybook: Optional[Copybook] = None,
                            segment_field: Optional[Primitive] = None,
                            is_hierarchical: bool = False,
-                           root_segment_id: str = "") -> List[SparseIndexEntry]:
+                           root_segment_id: str = "",
+                           record_error_policy: RecordErrorPolicy =
+                           RecordErrorPolicy.FAIL_FAST,
+                           resync_window_bytes: int = DEFAULT_RESYNC_WINDOW,
+                           ledger: Optional[ReadDiagnostics] = None
+                           ) -> List[SparseIndexEntry]:
     root_segment_ids = root_segment_id.split(",")
     byte_index = 0
     index: List[SparseIndexEntry] = [SparseIndexEntry(0, -1, file_id, 0)]
@@ -67,6 +83,17 @@ def sparse_index_generator(file_id: int,
         value = copybook.extract_primitive_field(segment_field, record)
         return "" if value is None else str(value).strip()
 
+    permissive = record_error_policy is not RecordErrorPolicy.FAIL_FAST
+    if permissive and ledger is None:
+        ledger = ReadDiagnostics()
+    reader = PendingReader(data_stream)
+
+    def header_validator():
+        if type(record_header_parser) is RdwHeaderParser:
+            return rdw_blob_validator(record_header_parser)
+        return generic_blob_validator(record_header_parser,
+                                      data_stream.size(), reader.offset)
+
     end_of_file = False
     while not end_of_file:
         record = None
@@ -80,16 +107,41 @@ def sparse_index_generator(file_id: int,
             record_size = record_extractor.offset - offset0
             has_more = record_extractor.has_next()
         else:
-            header = data_stream.next(record_header_parser.header_length)
-            meta = record_header_parser.get_record_metadata(
-                header, data_stream.offset, data_stream.size(), record_index)
-            if meta.record_length > 0:
-                record = data_stream.next(meta.record_length)
-            record_size = data_stream.offset - byte_index
-            has_more = record_size > 0
-            is_valid = meta.is_valid
+            header = reader.read(record_header_parser.header_length)
+            while True:
+                try:
+                    meta = record_header_parser.get_record_metadata(
+                        header, reader.offset, data_stream.size(),
+                        record_index)
+                    break
+                except ValueError as exc:
+                    # corruption tolerance mirrors VRLRecordReader so the
+                    # index pass and the shard framers skip identically
+                    if not permissive:
+                        raise
+                    header = resync_stream(
+                        reader, header, header_validator(),
+                        record_header_parser.header_length,
+                        resync_window_bytes, ledger,
+                        data_stream.input_file_name,
+                        getattr(exc, "reason", str(exc)))
+                    if header is None:
+                        meta = None
+                        break
+            if meta is None:
+                record_size = reader.offset - byte_index
+                has_more = False
+                is_valid = False
+            else:
+                if meta.record_length > 0:
+                    record = reader.read(meta.record_length)
+                record_size = reader.offset - byte_index
+                has_more = record_size > 0
+                is_valid = meta.is_valid
 
-        if data_stream.is_end_of_stream or not has_more:
+        if (record_extractor is None and reader.at_end) \
+                or (record_extractor is not None
+                    and data_stream.is_end_of_stream) or not has_more:
             end_of_file = True
         elif is_valid:
             if is_really_hierarchical and not root_record_id:

@@ -18,6 +18,11 @@ from ..copybook.datatypes import (
     SchemaRetentionPolicy,
     TrimPolicy,
 )
+from .diagnostics import (
+    DEFAULT_LEDGER_CAP,
+    DEFAULT_RESYNC_WINDOW,
+    RecordErrorPolicy,
+)
 
 DEFAULT_FILE_RECORD_ID_INCREMENT = 2 ** 32      # reference reader Constants.scala:28
 DEFAULT_INDEX_ENTRY_SIZE_MB = 100
@@ -83,6 +88,34 @@ class ReaderParameters:
     # column projection: decode only these fields (others emit null).
     # A TPU-native extension — the reference decodes every field per record
     select: Optional[Sequence[str]] = None
+    # -- fault tolerance (Spark parse-mode analogue; not a reference
+    # option — the reference is fail-fast only) --------------------------
+    record_error_policy: RecordErrorPolicy = RecordErrorPolicy.FAIL_FAST
+    # bounded forward search for the next plausible header after a corrupt
+    # run (permissive policies only)
+    resync_window_bytes: int = DEFAULT_RESYNC_WINDOW
+    # cap on detailed ledger entries (counts are always exact)
+    max_corrupt_ledger_entries: int = DEFAULT_LEDGER_CAP
+    # name of the optional per-row debug column holding the corruption
+    # reason for malformed-but-kept rows ('' = no column)
+    corrupt_record_column: str = ""
+    # -- IO retry (stream.RetryPolicy inputs) ----------------------------
+    io_retry_attempts: int = 3          # total attempts per storage read
+    io_retry_base_delay: float = 0.05   # seconds; doubles per attempt
+    io_retry_max_delay: float = 2.0     # per-sleep cap, seconds
+    io_retry_deadline: float = 30.0     # overall budget per read, seconds
+
+    @property
+    def is_permissive(self) -> bool:
+        """True when malformed records are tolerated (resync + ledger
+        instead of a raised error)."""
+        return self.record_error_policy is not RecordErrorPolicy.FAIL_FAST
+
+    def new_diagnostics(self):
+        """A fresh per-read/shard error ledger sized by this config."""
+        from .diagnostics import ReadDiagnostics
+
+        return ReadDiagnostics(max_entries=self.max_corrupt_ledger_entries)
 
     @property
     def data_encoding(self) -> Encoding:

@@ -138,6 +138,12 @@ class FileResult:
     generate_input_file_field: bool = False
     segments: List[SegmentBatch] = dc_field(default_factory=list)
     rows: Optional[List[List[object]]] = None   # row-backed fallback
+    # fault-tolerance surface: the shard's error ledger, the name of the
+    # optional per-row debug column ('' = none), and the reason per kept
+    # malformed row keyed by record POSITION within this shard
+    diagnostics: Optional[object] = None
+    corrupt_record_field: str = ""
+    corrupt_row_reasons: Optional[dict] = None
     # lazy producers (hierarchical decode-once reads): rows and Arrow are
     # materialized only when actually asked for; each factory is dropped
     # after first use so the captured decode batch can be released once
@@ -148,6 +154,7 @@ class FileResult:
     arrow_factory: Optional[object] = None
     _arrow_cache: Optional[object] = dc_field(default=None, repr=False)
     _arrow_cache_schema: Optional[object] = dc_field(default=None, repr=False)
+    _corrupt_col_added: bool = dc_field(default=False, repr=False)
 
     @property
     def is_columnar(self) -> bool:
@@ -155,11 +162,23 @@ class FileResult:
         return bool(self.segments) or self.arrow_factory is not None \
             or self._arrow_cache is not None
 
+    def _append_corrupt_column(self, rows: List[List[object]],
+                               positions) -> None:
+        """Trailing debug-column values (reason for malformed rows, None
+        otherwise), appended once per materialization."""
+        if not self.corrupt_record_field or self._corrupt_col_added:
+            return
+        reasons = self.corrupt_row_reasons or {}
+        for p, row in zip(positions, rows):
+            row.append(reasons.get(p))
+        self._corrupt_col_added = True
+
     def to_rows(self) -> List[List[object]]:
         if self.rows is None and self.rows_factory is not None:
             self.rows = self.rows_factory()
             self.rows_factory = None
         if self.rows is not None:
+            self._append_corrupt_column(self.rows, range(len(self.rows)))
             return self.rows
         keyed: List[tuple] = []
         for seg in self.segments:
@@ -180,6 +199,7 @@ class FileResult:
             keyed.extend(zip((int(p) for p in seg.positions), seg_rows))
         keyed.sort(key=lambda t: t[0])  # positions are sparse order keys
         self.rows = [r for _, r in keyed]
+        self._append_corrupt_column(self.rows, (p for p, _ in keyed))
         return self.rows
 
     def to_arrow(self, output_schema):
@@ -207,20 +227,26 @@ class FileResult:
             if self.rows is not None:
                 # not cached: _arrow_cache feeds is_columnar, which must
                 # keep reporting "kernel outputs available" truthfully
-                return rows_to_table(self.rows, output_schema.schema)
+                return rows_to_table(self.to_rows(), output_schema.schema)
             return arrow_schema(output_schema.schema).empty_table()
+        reasons = (self.corrupt_row_reasons or {}) \
+            if self.corrupt_record_field else None
         tables = []
         order = []
         for seg in self.segments:
             record_ids = (seg.record_ids if seg.record_ids is not None
                           else seg.positions)
+            seg_reasons = None
+            if reasons:
+                seg_reasons = [reasons.get(int(p)) for p in seg.positions]
             tables.append(segment_table(
                 seg.batch, seg.active, output_schema,
                 file_id=self.file_id,
                 record_ids=np.asarray(record_ids, dtype=np.int64),
                 seg_level_ids=seg.seg_level_ids,
                 input_file_name=self.input_file_name,
-                redefine_masks=seg.redefine_masks))
+                redefine_masks=seg.redefine_masks,
+                corrupt_reasons=seg_reasons))
             order.append(np.asarray(seg.positions, dtype=np.int64))
         if len(tables) == 1:
             table = tables[0]

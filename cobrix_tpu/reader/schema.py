@@ -121,13 +121,18 @@ class CobolOutputSchema:
                  input_file_name_field: str = "",
                  generate_record_id: bool = False,
                  generate_seg_id_field_count: int = 0,
-                 segment_id_prefix: str = ""):
+                 segment_id_prefix: str = "",
+                 corrupt_record_field: str = ""):
         self.copybook = copybook
         self.policy = policy
         self.input_file_name_field = input_file_name_field
         self.generate_record_id = generate_record_id
         self.generate_seg_id_field_count = generate_seg_id_field_count
         self.segment_id_prefix = segment_id_prefix
+        # optional trailing debug column: corruption reason per kept
+        # malformed row, null for clean rows (Spark's
+        # columnNameOfCorruptRecord analogue)
+        self.corrupt_record_field = corrupt_record_field
         self._schema: Optional[StructType] = None
 
     @property
@@ -155,6 +160,9 @@ class CobolOutputSchema:
         if self.generate_record_id:
             records = [Field(FILE_ID_FIELD, INTEGER, False),
                        Field(RECORD_ID_FIELD, LONG, False)] + records
+        if self.corrupt_record_field:
+            records = records + [Field(self.corrupt_record_field, STRING,
+                                       True)]
         return StructType(records)
 
     def _parse_group(self, group: Group, segment_redefines: List[Group]) -> Field:

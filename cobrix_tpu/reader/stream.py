@@ -16,8 +16,14 @@ never hit storage).
 from __future__ import annotations
 
 import io
+import logging
 import os
+import random
+import time
+from dataclasses import dataclass
 from typing import Callable, Dict, Optional
+
+_logger = logging.getLogger(__name__)
 
 
 class SimpleStream:
@@ -184,6 +190,62 @@ DEFAULT_CHUNK_SIZE = 30 * 1024 * 1024  # reference 30MB buffer
 # (reader/common/Constants.scala defaultStreamBufferInMB)
 
 
+@dataclass(frozen=True)
+class RetryPolicy:
+    """IO retry with exponential backoff + jitter for storage backends.
+
+    A transient backend failure (exception or an empty read short of the
+    logical limit) is retried up to `max_attempts` total attempts with
+    sleeps of `base_delay * 2**k` seconds (capped at `max_delay`, each
+    multiplied by a uniform [0.5, 1.0) jitter so a fleet of shard readers
+    doesn't hammer storage in lockstep). `deadline` bounds the whole
+    retry sequence per read so a dead backend still fails promptly.
+    """
+
+    max_attempts: int = 3
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    deadline: float = 30.0
+
+    def delay(self, attempt: int) -> float:
+        """Sleep before retry number `attempt` (1-based), jittered."""
+        backoff = min(self.base_delay * (2 ** (attempt - 1)), self.max_delay)
+        return backoff * (0.5 + 0.5 * random.random())
+
+
+NO_RETRY = RetryPolicy(max_attempts=1)
+
+
+def retrying_read(fn: Callable[[], bytes], policy: RetryPolicy,
+                  describe: str = "storage read",
+                  on_retry: Optional[Callable[[], None]] = None) -> bytes:
+    """Run `fn` under `policy`. Empty results are returned as-is (EOF is
+    the caller's concern); only exceptions are retried here."""
+    start = time.monotonic()
+    attempt = 1
+    while True:
+        try:
+            return fn()
+        except Exception as exc:  # backend exceptions are retryable
+            if policy.max_attempts <= 1:
+                raise  # no retry configured: keep the backend's own type
+            elapsed = time.monotonic() - start
+            if (attempt >= policy.max_attempts
+                    or elapsed >= policy.deadline):
+                raise IOError(
+                    f"{describe} failed after {attempt} attempt(s) over "
+                    f"{elapsed:.2f}s: {exc}") from exc
+            delay = min(policy.delay(attempt),
+                        max(policy.deadline - elapsed, 0.0))
+            _logger.warning("%s failed (attempt %d/%d): %s — retrying in "
+                            "%.3fs", describe, attempt, policy.max_attempts,
+                            exc, delay)
+            time.sleep(delay)
+            attempt += 1
+            if on_retry is not None:
+                on_retry()
+
+
 class BufferedSourceStream(SimpleStream):
     """SimpleStream over a ByteRangeSource with chunked buffering: storage
     is hit once per DEFAULT_CHUNK_SIZE, not once per record, and short
@@ -192,9 +254,15 @@ class BufferedSourceStream(SimpleStream):
 
     def __init__(self, source: ByteRangeSource, start_offset: int = 0,
                  maximum_bytes: int = 0,
-                 chunk_size: int = DEFAULT_CHUNK_SIZE):
+                 chunk_size: int = DEFAULT_CHUNK_SIZE,
+                 retry: Optional[RetryPolicy] = None,
+                 on_retry: Optional[Callable[[], None]] = None):
         self._source = source
-        self._file_size = source.size()
+        self._retry = retry or NO_RETRY
+        self._on_retry = on_retry
+        self._file_size = retrying_read(
+            lambda: source.size(), self._retry,
+            describe=f"size probe of '{source.name}'", on_retry=on_retry)
         self._pos = start_offset
         if maximum_bytes > 0:
             self._limit = min(self._file_size, start_offset + maximum_bytes)
@@ -223,10 +291,27 @@ class BufferedSourceStream(SimpleStream):
         want = min(self._chunk_size, self._limit - offset)
         parts = []
         got = 0
+        empty_reads = 0
         while got < want:
-            chunk = self._source.read(offset + got, want - got)
+            chunk = retrying_read(
+                lambda: self._source.read(offset + got, want - got),
+                self._retry,
+                describe=(f"read of {want - got} bytes at {offset + got} "
+                          f"from '{self._source.name}'"),
+                on_retry=self._on_retry)
             if not chunk:
-                break  # storage EOF short of the logical limit
+                # storage EOF short of the logical limit: anomalous (the
+                # size probe said these bytes exist) — re-issue a bounded
+                # number of times, then surface the short data and let the
+                # framing layer handle the truncation
+                empty_reads += 1
+                if empty_reads >= self._retry.max_attempts:
+                    break
+                if self._on_retry is not None:
+                    self._on_retry()
+                time.sleep(self._retry.delay(empty_reads))
+                continue
+            empty_reads = 0
             parts.append(chunk)
             got += len(chunk)
         self._buf = b"".join(parts)
@@ -308,10 +393,15 @@ def normalize_local(path: str) -> str:
 
 
 def open_stream(path: str, start_offset: int = 0, maximum_bytes: int = 0,
-                chunk_size: int = DEFAULT_CHUNK_SIZE) -> SimpleStream:
+                chunk_size: int = DEFAULT_CHUNK_SIZE,
+                retry: Optional[RetryPolicy] = None,
+                on_retry: Optional[Callable[[], None]] = None
+                ) -> SimpleStream:
     """Open `path` as a SimpleStream: local files use the OS-buffered
     FSStream; `scheme://` paths resolve through the backend registry and
-    read through the 30MB chunked buffer. `file://` is local."""
+    read through the 30MB chunked buffer. `file://` is local. `retry`
+    applies to registry-backed storage only (local file IO is left to the
+    OS); `on_retry` is called once per retried read (diagnostics hook)."""
     scheme = path_scheme(path)
     if scheme in (None, "file"):
         local = path[len("file://"):] if scheme == "file" else path
@@ -322,6 +412,10 @@ def open_stream(path: str, start_offset: int = 0, maximum_bytes: int = 0,
         raise ValueError(
             f"No stream backend registered for scheme {scheme!r} "
             f"(register one with cobrix_tpu.register_stream_backend)")
-    return BufferedSourceStream(factory(path), start_offset=start_offset,
+    source = (retrying_read(lambda: factory(path), retry,
+                            describe=f"open of '{path}'", on_retry=on_retry)
+              if retry is not None else factory(path))
+    return BufferedSourceStream(source, start_offset=start_offset,
                                 maximum_bytes=maximum_bytes,
-                                chunk_size=chunk_size)
+                                chunk_size=chunk_size,
+                                retry=retry, on_retry=on_retry)

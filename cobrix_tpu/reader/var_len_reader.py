@@ -331,7 +331,9 @@ class VarLenReader:
             copybook=self.copybook,
             segment_field=seg_field,
             is_hierarchical=is_hierarchical,
-            root_segment_id=root_segment_id)
+            root_segment_id=root_segment_id,
+            record_error_policy=params.record_error_policy,
+            resync_window_bytes=params.resync_window_bytes)
 
     def generate_index_fast(self, data, file_id: int
                             ) -> Optional[List[SparseIndexEntry]]:
@@ -350,9 +352,21 @@ class VarLenReader:
         adjustment = p.rdw_adjustment
         if p.is_rdw_part_of_record_length:
             adjustment -= 4
-        offsets, lengths = native.rdw_scan(
-            data, p.is_rdw_big_endian, adjustment,
-            p.file_start_offset, p.file_end_offset)
+        if p.is_permissive:
+            # same skip decisions as the shard scan so split offsets land
+            # on records the shard framers will actually find; the ledger
+            # here is a throwaway (the decode pass records the incidents)
+            from .recovery import rdw_scan_permissive
+
+            offsets, lengths, _ = rdw_scan_permissive(
+                data, p.is_rdw_big_endian, adjustment,
+                p.file_start_offset, p.file_end_offset,
+                p.record_error_policy, p.resync_window_bytes,
+                p.new_diagnostics())
+        else:
+            offsets, lengths = native.rdw_scan(
+                data, p.is_rdw_big_endian, adjustment,
+                p.file_start_offset, p.file_end_offset)
         n = len(offsets)
         starts = offsets - 4  # RDW header precedes the payload
         # the file-header region is consumed as one counted invalid record
@@ -414,14 +428,23 @@ class VarLenReader:
 
     # -- framing -----------------------------------------------------------
 
-    def frame_records(self, stream: SimpleStream, start_record_id: int = 0,
-                      starting_file_offset: int = 0
-                      ) -> Iterator[Tuple[int, str, bytes]]:
-        """Yield (record_index, segment_id, record_bytes)."""
-        reader = VRLRecordReader(
+    def make_record_reader(self, stream: SimpleStream,
+                           start_record_id: int = 0,
+                           starting_file_offset: int = 0,
+                           ledger=None) -> VRLRecordReader:
+        """The per-record framing iterator (policy-aware; `ledger` carries
+        the error ledger across shards of one read)."""
+        return VRLRecordReader(
             self.copybook, stream, self.params, self.record_header_parser(),
             self.record_extractor(start_record_id, stream),
-            start_record_id, starting_file_offset)
+            start_record_id, starting_file_offset, ledger=ledger)
+
+    def frame_records(self, stream: SimpleStream, start_record_id: int = 0,
+                      starting_file_offset: int = 0, ledger=None
+                      ) -> Iterator[Tuple[int, str, bytes]]:
+        """Yield (record_index, segment_id, record_bytes)."""
+        reader = self.make_record_reader(stream, start_record_id,
+                                         starting_file_offset, ledger)
         while reader.has_next():
             index = reader.record_index + 1
             segment_id, data = next(reader)
@@ -431,11 +454,16 @@ class VarLenReader:
 
     def iter_rows(self, stream: SimpleStream, file_id: int = 0,
                   start_record_id: int = 0, starting_file_offset: int = 0,
-                  segment_id_prefix: Optional[str] = None
+                  segment_id_prefix: Optional[str] = None,
+                  ledger=None,
+                  corrupt_reasons_out: Optional[dict] = None
                   ) -> Iterator[List[object]]:
         if self.copybook.is_hierarchical:
+            # hierarchical assemblies carry no per-row corruption
+            # attribution (the ledger still records every incident)
             yield from self._iter_rows_hierarchical(
-                stream, file_id, start_record_id, starting_file_offset)
+                stream, file_id, start_record_id, starting_file_offset,
+                ledger=ledger)
             return
         params = self.params
         seg = params.multisegment
@@ -447,8 +475,12 @@ class VarLenReader:
         options = DecodeOptions.from_copybook(self.copybook)
         generate_input_file = bool(params.input_file_name_column)
 
-        for record_index, segment_id, data in self.frame_records(
-                stream, start_record_id, starting_file_offset):
+        record_reader = self.make_record_reader(
+            stream, start_record_id, starting_file_offset, ledger)
+        row_position = 0
+        while record_reader.has_next():
+            record_index = record_reader.record_index + 1
+            segment_id, data = next(record_reader)
             level_ids: List[Optional[str]] = []
             if level_count and accumulator is not None:
                 accumulator.acquired_segment_id(segment_id, record_index)
@@ -458,6 +490,13 @@ class VarLenReader:
                 continue  # before the first root segment
             if segment_filter is not None and segment_id not in segment_filter:
                 continue
+            if corrupt_reasons_out is not None:
+                # the reader ledgers a kept-malformed record during its
+                # prefetch, so the entry exists by the time it is emitted
+                reason = record_reader.corrupt_reasons.get(record_index)
+                if reason is not None:
+                    corrupt_reasons_out[row_position] = reason
+            row_position += 1
             active_redefine = self.segment_redefine_map.get(segment_id, "")
             yield extract_record(
                 self.copybook.ast,
@@ -490,8 +529,8 @@ class VarLenReader:
 
     def _iter_rows_hierarchical(self, stream: SimpleStream, file_id: int,
                                 start_record_id: int,
-                                starting_file_offset: int
-                                ) -> Iterator[List[object]]:
+                                starting_file_offset: int,
+                                ledger=None) -> Iterator[List[object]]:
         """Buffer one root record plus its children, then assemble
         (reference VarLenHierarchicalIterator.fetchNext :99)."""
         params = self.params
@@ -525,7 +564,8 @@ class VarLenReader:
         # count at end of stream), VarLenHierarchicalIterator.scala:99-135
         last_index = start_record_id - 1
         for record_index, segment_id, data in self.frame_records(
-                stream, start_record_id, starting_file_offset):
+                stream, start_record_id, starting_file_offset,
+                ledger=ledger):
             redefine = segment_id_redefine_map.get(segment_id)
             is_root = redefine is not None and redefine.name in root_names
             if is_root:
@@ -541,7 +581,8 @@ class VarLenReader:
             yield flush()
 
     def _hierarchical_columnar_setup(self, stream: SimpleStream,
-                                     backend: str) -> Optional[dict]:
+                                     backend: str,
+                                     ledger=None) -> Optional[dict]:
         """Frame + decode-once setup shared by the hierarchical row and
         Arrow paths. Returns None when the configuration needs the
         generic scalar path — every bail happens BEFORE framing consumes
@@ -559,10 +600,10 @@ class VarLenReader:
             # reference extractChildren) — the uniform decode_raw shift
             # cannot reproduce that
             return None
-        fast = self._frame_fast(stream)
+        fast = self._frame_fast(stream, ledger=ledger)
         if fast is None:
             return None
-        data, _base, offsets, rec_lengths, segment_ids = fast
+        data, _base, offsets, rec_lengths, segment_ids, _reasons = fast
         assert segment_ids is not None  # guaranteed by the seg-field guard
         n = len(offsets)
 
@@ -769,10 +810,12 @@ class VarLenReader:
                              or p.is_text or p.length_field_name
                              or p.variable_size_occurs))
 
-    def _frame_fast(self, stream: SimpleStream):
+    def _frame_fast(self, stream: SimpleStream, ledger=None):
         """Whole-shard RDW framing via the native scanner. Returns
-        (data, base_offset, offsets, lengths, segment_ids) or None when the
-        configuration needs the generic per-record reader."""
+        (data, base_offset, offsets, lengths, segment_ids, corrupt_reasons)
+        or None when the configuration needs the generic per-record
+        reader. `corrupt_reasons` maps kept malformed record positions to
+        reasons (permissive policy only; empty otherwise)."""
         from .. import native
 
         if not self.supports_fast_framing:
@@ -783,20 +826,31 @@ class VarLenReader:
         adjustment = p.rdw_adjustment
         if p.is_rdw_part_of_record_length:
             adjustment -= 4
-        offsets, lengths = native.rdw_scan(
-            data, p.is_rdw_big_endian, adjustment,
-            # the file-header region rule only applies at the file start,
-            # the footer rule only when this shard reaches the file's true
-            # end (an indexed shard ending mid-file has a data tail, not a
-            # footer)
-            p.file_start_offset if base == 0 else 0,
-            p.file_end_offset if stream.size() >= stream.true_size else 0)
+        # the file-header region rule only applies at the file start, the
+        # footer rule only when this shard reaches the file's true end (an
+        # indexed shard ending mid-file has a data tail, not a footer)
+        file_header = p.file_start_offset if base == 0 else 0
+        file_footer = (p.file_end_offset
+                       if stream.size() >= stream.true_size else 0)
+        corrupt_reasons: dict = {}
+        if p.is_permissive:
+            from .recovery import rdw_scan_permissive
+
+            offsets, lengths, corrupt_reasons = rdw_scan_permissive(
+                data, p.is_rdw_big_endian, adjustment, file_header,
+                file_footer, p.record_error_policy, p.resync_window_bytes,
+                ledger if ledger is not None else p.new_diagnostics(),
+                file_name=stream.input_file_name, base_offset=base)
+        else:
+            offsets, lengths = native.rdw_scan(
+                data, p.is_rdw_big_endian, adjustment, file_header,
+                file_footer)
         seg_field = resolve_segment_id_field(p, self.copybook)
         segment_ids: Optional[List[str]] = None
         if seg_field is not None:
             segment_ids = self._segment_ids_vectorized(
                 data, offsets, lengths, seg_field)
-        return data, base, offsets, lengths, segment_ids
+        return data, base, offsets, lengths, segment_ids, corrupt_reasons
 
     def _segment_ids_vectorized(self, data, offsets, lengths,
                                 seg_field: Primitive) -> SegmentIds:
@@ -825,7 +879,10 @@ class VarLenReader:
                           segment_ids: Optional[List[str]],
                           file_id: int, backend: str,
                           prefix: str,
-                          start_record_id: int) -> None:
+                          start_record_id: int,
+                          corrupt_reasons: Optional[dict] = None) -> None:
+        if corrupt_reasons:
+            result.corrupt_row_reasons = dict(corrupt_reasons)
         params = self.params
         seg = params.multisegment
         n = len(offsets)
@@ -933,13 +990,16 @@ class VarLenReader:
         with the batched kernels; rows/Arrow are materialized lazily from
         the FileResult."""
         params = self.params
+        ledger = params.new_diagnostics() if params.is_permissive else None
         result = FileResult(
             n_rows=0,
             file_id=file_id,
             input_file_name=stream.input_file_name,
             policy=params.schema_policy,
             generate_record_id=params.generate_record_id,
-            generate_input_file_field=bool(params.input_file_name_column))
+            generate_input_file_field=bool(params.input_file_name_column),
+            corrupt_record_field=params.corrupt_record_column,
+            diagnostics=ledger)
         if self.copybook.is_hierarchical or self.dynamic_occurs_layout:
             # hierarchical nesting / per-record offset shifts have no
             # static columnar plan (reference extractHierarchicalRecord,
@@ -951,7 +1011,8 @@ class VarLenReader:
             if (self.copybook.is_hierarchical
                     and not self.dynamic_occurs_layout
                     and not params.variable_size_occurs):
-                ctx = self._hierarchical_columnar_setup(stream, backend)
+                ctx = self._hierarchical_columnar_setup(stream, backend,
+                                                        ledger=ledger)
             if ctx is not None:
                 from .hierarchical_arrow import hierarchical_table
 
@@ -974,17 +1035,18 @@ class VarLenReader:
                 stream, file_id=file_id,
                 start_record_id=start_record_id,
                 starting_file_offset=starting_file_offset,
-                segment_id_prefix=segment_id_prefix))
+                segment_id_prefix=segment_id_prefix,
+                ledger=ledger))
             result.rows = rows
             result.n_rows = len(rows)
             return result
-        fast = self._frame_fast(stream)
+        fast = self._frame_fast(stream, ledger=ledger)
         if fast is not None:
-            data, base, offsets, lengths, segment_ids = fast
+            data, base, offsets, lengths, segment_ids, reasons = fast
             self._read_result_fast(
                 result, data, base, offsets, lengths, segment_ids, file_id,
                 backend, segment_id_prefix or default_segment_id_prefix(),
-                start_record_id)
+                start_record_id, corrupt_reasons=reasons)
             return result
         seg = params.multisegment
         prefix = segment_id_prefix or default_segment_id_prefix()
@@ -994,8 +1056,11 @@ class VarLenReader:
         segment_filter = set(seg.segment_id_filter) if seg and seg.segment_id_filter else None
 
         framed = []   # (record_index, active_redefine, data, level_ids)
-        for record_index, segment_id, data in self.frame_records(
-                stream, start_record_id, starting_file_offset):
+        record_reader = self.make_record_reader(
+            stream, start_record_id, starting_file_offset, ledger)
+        while record_reader.has_next():
+            record_index = record_reader.record_index + 1
+            segment_id, data = next(record_reader)
             level_ids: List[Optional[str]] = []
             if level_count and accumulator is not None:
                 accumulator.acquired_segment_id(segment_id, record_index)
@@ -1007,6 +1072,13 @@ class VarLenReader:
                 continue
             active = self.segment_redefine_map.get(segment_id, "")
             framed.append((record_index, active, data, level_ids))
+        if record_reader.corrupt_reasons:
+            # absolute record indices -> output positions of kept rows
+            pos_of = {idx: pos for pos, (idx, _, _, _) in enumerate(framed)}
+            result.corrupt_row_reasons = {
+                pos_of[idx]: reason
+                for idx, reason in record_reader.corrupt_reasons.items()
+                if idx in pos_of}
 
         start = params.start_offset
         by_segment: Dict[str, List[int]] = {}

@@ -1,0 +1,142 @@
+"""Fault injection — the corruption side of the test-data generators.
+
+Where `generators.py` builds clean EBCDIC fixtures, this module breaks
+them in the ways real mainframe dumps break: flipped bits, torn tails,
+garbage splices, zeroed/oversized RDW headers, and storage that fails a
+few reads before recovering. The fault-tolerance test matrix
+(tests/test_fault_tolerance.py) and `tools/corruptcheck.py` drive every
+`record_error_policy` through these injectors; they are permanent test
+infrastructure, not throwaway helpers.
+
+All injectors are pure: they take `bytes` and return corrupted `bytes`
+plus (where useful) the corruption site, so assertions can check the
+ledger points at the right offset.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..reader.stream import ByteRangeSource
+
+
+def rdw_record_starts(data: bytes, big_endian: bool = False,
+                      rdw_adjustment: int = 0) -> List[int]:
+    """Byte offset of every RDW header in a clean file — the structural
+    boundaries truncation/corruption fixtures enumerate."""
+    from .. import native
+
+    offsets, _ = native.rdw_scan(data, big_endian, rdw_adjustment, 0, 0)
+    return [int(o) - 4 for o in offsets]
+
+
+def flip_bit(data: bytes, offset: int, bit: int = 0) -> bytes:
+    """Flip one bit at `offset` (bit 0 = LSB)."""
+    out = bytearray(data)
+    out[offset] ^= (1 << bit)
+    return bytes(out)
+
+
+def truncate(data: bytes, keep: int) -> bytes:
+    """Torn tail: keep only the first `keep` bytes."""
+    return data[:keep]
+
+
+def splice_garbage(data: bytes, offset: int, garbage: bytes) -> bytes:
+    """Insert foreign bytes at `offset` (a torn-and-respliced dump)."""
+    return data[:offset] + garbage + data[offset:]
+
+
+def overwrite(data: bytes, offset: int, patch: bytes) -> bytes:
+    """Overwrite bytes in place at `offset`."""
+    return data[:offset] + patch + data[offset + len(patch):]
+
+
+def zero_rdw(data: bytes, record_start: int) -> bytes:
+    """Zero out the 4-byte RDW header at a record start — the classic
+    'RDW headers should never be zero' failure."""
+    return overwrite(data, record_start, b"\x00\x00\x00\x00")
+
+
+def oversize_rdw(data: bytes, record_start: int,
+                 big_endian: bool = False) -> bytes:
+    """Make the RDW at a record start declare an absurd length (driven
+    through the 100 MB cap by the rdw_adjustment=0 default decoders the
+    suite uses via huge 16-bit lengths only when adjusted; here it simply
+    declares far more bytes than the file holds)."""
+    header = b"\xff\xff\x00\x00" if big_endian else b"\x00\x00\xff\xff"
+    return overwrite(data, record_start, header)
+
+
+def garbage_run(length: int, seed: int = 0) -> bytes:
+    """Deterministic non-header-looking garbage: 0x00/0x40 heavy like a
+    real torn EBCDIC region (zero RDWs, so framing must resync)."""
+    rng = np.random.default_rng(seed)
+    pool = np.asarray([0x00, 0x40, 0x00, 0xFF], dtype=np.uint8)
+    return bytes(pool[rng.integers(0, len(pool), size=length)])
+
+
+def every_structural_truncation(data: bytes, big_endian: bool = False
+                                ) -> List[Tuple[int, bytes]]:
+    """(cut_position, truncated_file) for a cut at every structural
+    boundary class: mid-header, right after a header, and mid-payload of
+    each record (bounded to the first few records plus the last one to
+    keep fuzz loops fast by default; the full sweep is the slow tier)."""
+    starts = rdw_record_starts(data, big_endian)
+    cuts = []
+    for s in starts:
+        cuts.extend([s + 1, s + 4, s + 5])
+    cuts.append(len(data) - 1)
+    out = []
+    for cut in sorted({c for c in cuts if 0 < c < len(data)}):
+        out.append((cut, data[:cut]))
+    return out
+
+
+class FlakySource(ByteRangeSource):
+    """A ByteRangeSource that fails its first `fail_reads` read() calls
+    (raising IOError), then recovers — the transient-storage profile the
+    IO retry layer must absorb. `fail_forever=True` models a dead backend
+    (every read raises) for deadline tests."""
+
+    def __init__(self, data: bytes, fail_reads: int = 2,
+                 name: str = "flaky://test",
+                 fail_forever: bool = False,
+                 short_read: Optional[int] = None):
+        self._data = data
+        self._name = name
+        self.fail_reads = fail_reads
+        self.fail_forever = fail_forever
+        self.short_read = short_read
+        self.read_calls = 0
+        self.failures_served = 0
+
+    def size(self) -> int:
+        return len(self._data)
+
+    def read(self, offset: int, n: int) -> bytes:
+        self.read_calls += 1
+        if self.fail_forever or self.failures_served < self.fail_reads:
+            self.failures_served += 1
+            raise IOError(
+                f"injected transient failure #{self.failures_served} "
+                f"(offset={offset}, n={n})")
+        if self.short_read:
+            n = min(n, self.short_read)
+        return self._data[offset:offset + n]
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+
+def register_flaky_backend(scheme: str, data: bytes,
+                           **kwargs) -> "FlakySource":
+    """Register a `scheme://` backend serving `data` through a single
+    FlakySource instance (returned for assertions on its counters)."""
+    from ..reader.stream import register_stream_backend
+
+    source = FlakySource(data, **kwargs)
+    register_stream_backend(scheme, lambda path: source)
+    return source

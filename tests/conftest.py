@@ -65,6 +65,9 @@ def pytest_collection_modifyitems(config, items):
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "jax: test requires a usable jax backend")
+    config.addinivalue_line(
+        "markers",
+        "slow: large fuzz/sweep loops excluded from tier-1 (-m 'not slow')")
     try:
         import jax
         if not USE_REAL_TPU:

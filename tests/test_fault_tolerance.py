@@ -1,0 +1,462 @@
+"""Fault-tolerant ingestion: the corruption x policy matrix.
+
+For every corruption class (bit flip, truncated tail, garbage splice,
+zero/oversized RDW, flaky storage): `permissive` returns every decodable
+record with matching ledger entries and never raises; `drop_malformed`
+returns only clean rows; `fail_fast` raises with the file offset and a
+hex header snapshot. Indexed scans, the host oracle backend, and the
+fixed-length path are held to the same contract.
+"""
+import json
+import os
+
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.reader.diagnostics import (
+    FramingError,
+    ReadDiagnostics,
+    RecordErrorPolicy,
+)
+from cobrix_tpu.reader.recovery import find_next_rdw, rdw_scan_permissive
+from cobrix_tpu.reader.stream import RetryPolicy, open_stream
+from cobrix_tpu.testing.faults import (
+    FlakySource,
+    every_structural_truncation,
+    flip_bit,
+    garbage_run,
+    oversize_rdw,
+    rdw_record_starts,
+    register_flaky_backend,
+    splice_garbage,
+    truncate,
+    zero_rdw,
+)
+from cobrix_tpu.testing.generators import (
+    EXP1_COPYBOOK,
+    EXP2_COPYBOOK,
+    generate_exp1,
+    generate_exp2,
+)
+
+import numpy as np
+
+
+def _write(tmp_path, name, data: bytes) -> str:
+    p = tmp_path / name
+    p.write_bytes(data)
+    return str(p)
+
+
+def _read(path, policy=None, **extra):
+    kw = dict(copybook_contents=EXP2_COPYBOOK, is_record_sequence=True)
+    if policy:
+        kw["record_error_policy"] = policy
+    kw.update(extra)
+    return read_cobol(path, **kw)
+
+
+@pytest.fixture(scope="module")
+def clean():
+    return generate_exp2(60, seed=11)
+
+
+class TestZeroRdw:
+    def test_fail_fast_raises_with_offset_and_hex(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        bad = zero_rdw(clean, starts[7])
+        path = _write(tmp_path, "zero.dat", bad)
+        with pytest.raises(ValueError) as exc:
+            _read(path).to_rows()
+        assert str(starts[7]) in str(exc.value)
+        assert "00 00 00 00" in str(exc.value)
+
+    def test_permissive_skips_and_ledgers(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        bad = zero_rdw(clean, starts[7])
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        data = _read(_write(tmp_path, "zero.dat", bad), "permissive")
+        rows = data.to_rows()
+        # the zeroed record is skipped by resync; every other record decodes
+        assert rows == good_rows[:7] + good_rows[8:]
+        diag = data.diagnostics
+        assert diag.resyncs == 1
+        assert diag.entries[0].offset == starts[7]
+        assert diag.entries[0].reason == "zero-length RDW header"
+
+    def test_drop_malformed_equals_permissive_for_skips(self, tmp_path,
+                                                        clean):
+        starts = rdw_record_starts(clean)
+        bad = zero_rdw(clean, starts[3])
+        p = _write(tmp_path, "zero.dat", bad)
+        assert _read(p, "drop_malformed").to_rows() \
+            == _read(p, "permissive").to_rows()
+
+    def test_resync_rejects_payload_parsed_headers(self, tmp_path):
+        """Regression: a resync candidate inside the zeroed header region
+        'parses' (leading zeros act as the reserved pair, EBCDIC payload
+        bytes as a ~60 KB length) and once hijacked the scan mid-file —
+        framing drifted into payloads and a later garbage header clamped
+        thousands of records away as a bogus tail. The reserved-pair
+        check on successor headers must kill that chain so the resync
+        lands on the true next record."""
+        big = bytes(generate_exp2(4000, seed=9))
+        starts = rdw_record_starts(big)
+        bad = zero_rdw(big, starts[1000])
+        path = _write(tmp_path, "big_zero.dat", bad)
+        data = _read(path, "permissive")
+        assert len(data.to_rows()) == 3999
+        diag = data.diagnostics
+        assert diag.resyncs == 1
+        # exactly the corrupt record is skipped: header + payload
+        assert diag.bytes_skipped == starts[1001] - starts[1000]
+        assert diag.entries[0].offset == starts[1000]
+
+
+class TestOversizedRdw:
+    """A 16-bit RDW can only exceed the 100 MB cap through rdw_adjustment
+    (unit-tested on the parser); at the file level 'oversized' means the
+    header declares more bytes than the file holds — the reference clamps
+    that silently, permissive additionally ledgers the truncation."""
+
+    def test_parser_cap_raises_with_offset_and_hex(self):
+        from cobrix_tpu.reader.header_parsers import RdwHeaderParser
+
+        parser = RdwHeaderParser(rdw_adjustment=101 * 1024 * 1024)
+        with pytest.raises(ValueError) as exc:
+            parser.get_record_metadata(b"\x00\x00\x10\x00", 1234, 0, 0)
+        assert "1234" in str(exc.value)
+        assert "00 00 10 00" in str(exc.value)
+
+    def test_permissive_ledgers_overrun_header(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        bad = oversize_rdw(clean, starts[5])
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        data = _read(_write(tmp_path, "big.dat", bad), "permissive")
+        rows = data.to_rows()
+        # the overrun record swallows the rest of the file as a clamped
+        # tail; everything before it is untouched and the ledger says so
+        assert rows[:5] == good_rows[:5]
+        assert len(rows) == 6
+        diag = data.diagnostics
+        assert diag.corrupt_records == 1
+        assert "truncated" in diag.entries[0].reason
+
+    def test_drop_malformed_drops_overrun_record(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        bad = oversize_rdw(clean, starts[5])
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        data = _read(_write(tmp_path, "big.dat", bad), "drop_malformed")
+        assert data.to_rows() == good_rows[:5]
+        assert data.diagnostics.records_dropped == 1
+
+
+class TestGarbageSplice:
+    def test_permissive_skips_the_splice(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        bad = splice_garbage(clean, starts[10], garbage_run(120, seed=3))
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        data = _read(_write(tmp_path, "spliced.dat", bad), "permissive")
+        assert data.to_rows() == good_rows
+        diag = data.diagnostics
+        assert diag.bytes_skipped == 120
+        assert diag.entries[0].offset == starts[10]
+
+    def test_corrupt_run_beyond_window_still_fails(self, tmp_path, clean):
+        # all-zero garbage can never look like a header, so a run longer
+        # than the window must abort even in permissive mode
+        starts = rdw_record_starts(clean)
+        bad = splice_garbage(clean, starts[10], b"\x00" * 8192)
+        path = _write(tmp_path, "run.dat", bad)
+        with pytest.raises(ValueError) as exc:
+            _read(path, "permissive", resync_window="1024").to_rows()
+        assert "resync window" in str(exc.value)
+
+    def test_unheaderlike_garbage_tail_is_skipped(self, tmp_path, clean):
+        # zero bytes can never parse as a header: the whole tail is
+        # skipped and the clean rows are untouched
+        bad = clean + b"\x00" * 300
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        data = _read(_write(tmp_path, "tailjunk.dat", bad), "permissive")
+        assert data.to_rows() == good_rows
+        assert data.diagnostics.bytes_skipped == 300
+
+    def test_headerlike_garbage_tail_is_kept_but_ledgered(self, tmp_path,
+                                                          clean):
+        # garbage whose first bytes parse as a valid RDW is
+        # indistinguishable from a legitimate truncated final record:
+        # permissive keeps the clamped record and ledgers the truncation,
+        # drop_malformed drops it
+        bad = clean + garbage_run(300, seed=5)
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        p1 = _write(tmp_path, "tailjunk.dat", bad)
+        data = _read(p1, "permissive")
+        rows = data.to_rows()
+        assert rows[:len(good_rows)] == good_rows
+        assert data.diagnostics.corrupt_records == 1
+        assert "truncated" in data.diagnostics.entries[0].reason
+        assert _read(p1, "drop_malformed").to_rows() == good_rows
+
+
+class TestTruncatedTail:
+    def test_permissive_keeps_partial_record_with_nulled_tail(
+            self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        # cut mid-payload of the last record
+        cut = starts[-1] + 4 + 10
+        path = _write(tmp_path, "torn.dat", truncate(clean, cut))
+        good = _read(_write(tmp_path, "good.dat", clean))
+        data = _read(path, "permissive")
+        rows = data.to_rows()
+        good_rows = good.to_rows()
+        assert len(rows) == len(good_rows)
+        assert rows[:-1] == good_rows[:-1]
+        diag = data.diagnostics
+        assert diag.corrupt_records == 1
+        assert "truncated" in diag.entries[0].reason
+        assert diag.entries[0].record_index == len(rows) - 1
+
+    def test_drop_malformed_drops_partial_record(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        cut = starts[-1] + 4 + 10
+        path = _write(tmp_path, "torn.dat", truncate(clean, cut))
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        data = _read(path, "drop_malformed")
+        assert data.to_rows() == good_rows[:-1]
+        assert data.diagnostics.records_dropped == 1
+
+    def test_cut_inside_header_skips_partial_header(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        cut = starts[-1] + 2  # only half an RDW remains
+        path = _write(tmp_path, "torn.dat", truncate(clean, cut))
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        assert _read(path, "permissive").to_rows() == good_rows[:-1]
+
+    def test_every_structural_boundary_never_raises(self, tmp_path):
+        data = generate_exp2(8, seed=23)
+        for cut, torn in every_structural_truncation(data):
+            path = _write(tmp_path, f"cut{cut}.dat", torn)
+            result = _read(path, "permissive")
+            result.to_rows()
+            result.to_arrow()
+
+
+class TestBitFlip:
+    def test_payload_bit_flip_never_raises(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        bad = flip_bit(clean, starts[4] + 4 + 8, bit=5)
+        data = _read(_write(tmp_path, "flip.dat", bad), "permissive")
+        assert len(data.to_rows()) == 60
+
+    def test_header_bit_flip_recovers_remaining_records(self, tmp_path,
+                                                        clean):
+        # flipping a high bit of the little-endian RDW length desyncs the
+        # chain mid-file; permissive must resync and keep reading
+        starts = rdw_record_starts(clean)
+        bad = flip_bit(clean, starts[6] + 3, bit=6)  # length += 16384
+        path = _write(tmp_path, "flip.dat", bad)
+        data = _read(path, "permissive")
+        rows = data.to_rows()
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        # everything before the flip decodes; the flipped record's declared
+        # extent swallows the rest of the file, which comes back truncated
+        assert rows[:6] == good_rows[:6]
+        assert data.diagnostics.corrupt_records >= 1
+
+    @pytest.mark.slow
+    def test_fuzz_header_bit_flips_never_raise(self, tmp_path):
+        data = generate_exp2(40, seed=31)
+        starts = rdw_record_starts(data)
+        k = 0
+        for s in starts:
+            for byte in range(4):
+                for bit in (0, 3, 7):
+                    bad = flip_bit(data, s + byte, bit=bit)
+                    path = _write(tmp_path, f"f{k}.dat", bad)
+                    k += 1
+                    result = _read(path, "permissive")
+                    result.to_rows()
+                    result.to_arrow()
+
+
+class TestHostOracleParity:
+    """The host (per-record oracle) backend applies the same policies."""
+
+    def test_permissive_rows_match_columnar(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        bad = splice_garbage(zero_rdw(clean, starts[3]), starts[12],
+                             garbage_run(64, seed=9))
+        path = _write(tmp_path, "multi.dat", bad)
+        columnar = _read(path, "permissive").to_rows()
+        host = _read(path, "permissive", backend="host").to_rows()
+        assert host == columnar
+
+    def test_fail_fast_host_raises(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        path = _write(tmp_path, "zero.dat", zero_rdw(clean, starts[3]))
+        with pytest.raises(ValueError):
+            read_cobol(path, copybook_contents=EXP2_COPYBOOK,
+                       is_record_sequence=True, backend="host").to_rows()
+
+
+class TestIndexedScanUnderCorruption:
+    def test_indexed_equals_sequential_under_corruption(self, tmp_path):
+        data = generate_exp2(400, seed=17)
+        starts = rdw_record_starts(data)
+        bad = splice_garbage(zero_rdw(data, starts[100]), starts[300],
+                             b"\x00" * 96)
+        path = _write(tmp_path, "big.dat", bad)
+        kw = dict(copybook_contents=EXP2_COPYBOOK, is_record_sequence=True,
+                  record_error_policy="permissive")
+        sequential = read_cobol(path, enable_indexes="false", **kw)
+        indexed = read_cobol(path, input_split_records=64, **kw)
+        assert indexed.to_rows() == sequential.to_rows()
+        assert indexed.diagnostics.resyncs >= 2
+
+
+class TestCorruptRecordColumn:
+    def test_debug_column_marks_truncated_row(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        cut = starts[-1] + 4 + 10
+        path = _write(tmp_path, "torn.dat", truncate(clean, cut))
+        data = _read(path, "permissive",
+                     corrupt_record_column="_corrupt_record")
+        assert data.schema.field_names()[-1] == "_corrupt_record"
+        rows = data.to_rows()
+        assert all(r[-1] is None for r in rows[:-1])
+        assert "truncated" in rows[-1][-1]
+        table = data.to_arrow()
+        col = table.column("_corrupt_record").to_pylist()
+        assert col[:-1] == [None] * (len(rows) - 1)
+        assert "truncated" in col[-1]
+
+    def test_debug_column_requires_permissive(self, tmp_path, clean):
+        path = _write(tmp_path, "good.dat", clean)
+        with pytest.raises(ValueError, match="corrupt_record_column"):
+            _read(path, corrupt_record_column="_corrupt_record")
+
+
+class TestFixedLengthTruncation:
+    def test_fail_fast_message_is_actionable(self, tmp_path):
+        data = generate_exp1(10, seed=3).tobytes()
+        path = _write(tmp_path, "f.dat", data[:-7])
+        with pytest.raises(ValueError, match="permissive"):
+            read_cobol(path, copybook_contents=EXP1_COPYBOOK)
+
+    def test_permissive_keeps_partial_tail_row(self, tmp_path):
+        data = generate_exp1(10, seed=3).tobytes()
+        path = _write(tmp_path, "f.dat", data[:-7])
+        good = read_cobol(_write(tmp_path, "g.dat", data),
+                          copybook_contents=EXP1_COPYBOOK).to_rows()
+        res = read_cobol(path, copybook_contents=EXP1_COPYBOOK,
+                         record_error_policy="permissive")
+        rows = res.to_rows()
+        assert len(rows) == 10
+        assert rows[:9] == good[:9]
+        assert res.diagnostics.corrupt_records == 1
+        # host oracle parity for the truncated tail row
+        host = read_cobol(path, copybook_contents=EXP1_COPYBOOK,
+                          record_error_policy="permissive",
+                          backend="host").to_rows()
+        assert host == rows
+
+    def test_drop_malformed_drops_tail(self, tmp_path):
+        data = generate_exp1(10, seed=3).tobytes()
+        path = _write(tmp_path, "f.dat", data[:-7])
+        res = read_cobol(path, copybook_contents=EXP1_COPYBOOK,
+                         record_error_policy="drop_malformed")
+        assert len(res.to_rows()) == 9
+        assert res.diagnostics.records_dropped == 1
+
+
+class TestFlakyStorage:
+    def test_retry_recovers_transient_failures(self, tmp_path, clean):
+        source = register_flaky_backend("flaky1", clean, fail_reads=2)
+        data = read_cobol("flaky1://f.dat",
+                          copybook_contents=EXP2_COPYBOOK,
+                          is_record_sequence=True,
+                          record_error_policy="permissive",
+                          io_retry_base_delay_ms=1)
+        assert len(data.to_rows()) == 60
+        assert source.failures_served == 2
+        assert data.diagnostics.io_retries == 2
+
+    def test_dead_backend_fails_promptly(self, tmp_path, clean):
+        register_flaky_backend("flaky2", clean, fail_forever=True)
+        with pytest.raises(IOError, match="attempt"):
+            read_cobol("flaky2://f.dat",
+                       copybook_contents=EXP2_COPYBOOK,
+                       is_record_sequence=True,
+                       io_retry_attempts=2, io_retry_base_delay_ms=1,
+                       io_retry_deadline_ms=200)
+
+    def test_retry_policy_backoff_is_bounded_and_jittered(self):
+        policy = RetryPolicy(max_attempts=5, base_delay=0.1, max_delay=0.3)
+        delays = [policy.delay(a) for a in range(1, 6)]
+        assert all(0.05 <= d <= 0.3 for d in delays)
+
+
+class TestLedger:
+    def test_ledger_caps_entries_but_counts_all(self, tmp_path):
+        data = generate_exp2(80, seed=13)
+        starts = rdw_record_starts(data)
+        bad = data
+        for s in reversed(starts[10:50:5]):  # 8 corrupt sites
+            bad = zero_rdw(bad, s)
+        path = _write(tmp_path, "many.dat", bad)
+        res = _read(path, "permissive", max_corrupt_ledger_entries="3")
+        res.to_rows()
+        diag = res.diagnostics
+        assert diag.corrupt_records == 8
+        assert len(diag.entries) == 3
+        assert diag.entries_truncated
+
+    def test_merge_accumulates(self):
+        a = ReadDiagnostics(max_entries=10)
+        b = ReadDiagnostics(max_entries=10)
+        a.record_skip("f", 0, 10, "zero-length RDW header", b"\0\0\0\0")
+        b.record_skip("f", 99, 5, "oversized RDW header", b"\xff\xff\0\0")
+        b.io_retries = 3
+        a.merge(b)
+        assert a.corrupt_records == 2
+        assert a.bytes_skipped == 15
+        assert a.io_retries == 3
+        assert len(a.entries) == 2
+
+    def test_json_round_trip(self):
+        d = ReadDiagnostics()
+        d.record_skip("f.dat", 42, 7, "zero-length RDW header", b"\0\0\0\0")
+        loaded = json.loads(d.to_json())
+        assert loaded["entries"][0]["offset"] == 42
+        assert loaded["entries"][0]["header_snapshot"] == "00 00 00 00"
+
+
+class TestRecoveryPrimitives:
+    def test_find_next_rdw_finds_clean_record(self):
+        clean = generate_exp2(10, seed=1)
+        starts = rdw_record_starts(clean)
+        buf = np.frombuffer(b"\x00" * 32 + clean, dtype=np.uint8)
+        found = find_next_rdw(buf, 1, 200, False, 0, body_end=len(buf))
+        assert found == 32
+
+    def test_scan_permissive_clean_file_matches_fail_fast(self):
+        from cobrix_tpu import native
+
+        clean = generate_exp2(25, seed=4)
+        o1, l1 = native.rdw_scan(clean, False, 0, 0, 0)
+        ledger = ReadDiagnostics()
+        o2, l2, reasons = rdw_scan_permissive(
+            clean, False, 0, 0, 0, RecordErrorPolicy.PERMISSIVE,
+            64 * 1024, ledger)
+        assert np.array_equal(o1, o2) and np.array_equal(l1, l2)
+        assert not reasons and ledger.is_clean
+
+    def test_policy_parse_rejects_unknown(self):
+        with pytest.raises(ValueError, match="record_error_policy"):
+            RecordErrorPolicy.parse("lenient")
+
+    def test_framing_error_carries_location(self):
+        err = FramingError("boom", offset=7, reason="zero-length RDW header",
+                           header=b"\0\0\0\0", file_name="x.dat")
+        assert isinstance(err, ValueError)
+        assert err.offset == 7 and err.file_name == "x.dat"
