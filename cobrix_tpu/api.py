@@ -269,6 +269,9 @@ def parse_options(options: Dict[str, object],
             opts.get_int("io_retry_max_delay_ms", 2000)) / 1000.0,
         io_retry_deadline=float(
             opts.get_int("io_retry_deadline_ms", 30000)) / 1000.0,
+        pipeline_workers=opts.get_int("pipeline_workers", 0),
+        pipeline_chunk_mb=float(opts.get("chunk_size_mb", "") or 16.0),
+        pipeline_max_inflight=opts.get_int("max_inflight_chunks", 0),
     )
     # recognized keys consumed later by read_cobol — mark used before the
     # pedantic unused-key audit runs
@@ -327,6 +330,15 @@ def _validate_options(opts: Options, params: ReaderParameters,
         raise ValueError(
             f"Invalid 'io_retry_attempts' of {params.io_retry_attempts}; "
             "at least one attempt is required.")
+    if params.pipeline_chunk_mb <= 0:
+        raise ValueError(
+            f"Invalid 'chunk_size_mb' of {params.pipeline_chunk_mb}; "
+            "it must be a positive size in megabytes.")
+    if params.pipeline_max_inflight < 0:
+        raise ValueError(
+            f"Invalid 'max_inflight_chunks' of "
+            f"{params.pipeline_max_inflight}; it must be >= 0 "
+            "(0 sizes it from the worker count).")
     seg = params.multisegment
     if seg and seg.field_parent_map and seg.segment_level_ids:
         raise ValueError(
@@ -501,77 +513,15 @@ def _retry_policy(params: ReaderParameters) -> RetryPolicy:
                        deadline=params.io_retry_deadline)
 
 
-def _index_entries(reader, file_path: str, file_order: int, params,
-                   retry: Optional[RetryPolicy] = None, on_retry=None):
-    """Sparse index for one file, or None when a single shard suffices.
-    The vectorized RDW index is used when the configuration allows it;
-    otherwise the generic per-record generator (the reference's only mode,
-    IndexGenerator.scala:33) runs."""
-    from .reader.parameters import DEFAULT_INDEX_ENTRY_SIZE_MB, MEGABYTE
-    from .reader.stream import path_scheme
-
-    explicit = (params.input_split_records is not None
-                or params.input_split_size_mb is not None)
-    split_mb = params.input_split_size_mb or DEFAULT_INDEX_ENTRY_SIZE_MB
-
-    def too_small(size: int) -> bool:
-        if size == 0:
-            return True  # nothing to index (and mmap rejects empty files)
-        # the whole file is one shard anyway
-        return not explicit and size <= split_mb * MEGABYTE
-
-    if path_scheme(file_path) in (None, "file"):
-        if too_small(os.path.getsize(file_path)):
-            return None
-        if reader.supports_fast_framing:
-            # mmap, not read(): the scan touches the whole file once to
-            # find split offsets; materializing it would spike RSS by the
-            # file size on exactly the large files indexing targets
-            import mmap
-
-            with open(file_path, "rb") as f:
-                with mmap.mmap(f.fileno(), 0,
-                               access=mmap.ACCESS_READ) as mm:
-                    entries = reader.generate_index_fast(mm, file_order)
-            if entries is not None:
-                return entries
-        with open_stream(file_path) as stream:
-            return reader.generate_index(stream, file_order)
-    # registry-backed storage: one stream serves both the size probe and
-    # the index scan (a backend open is typically a network round trip)
-    with open_stream(file_path, retry=retry, on_retry=on_retry) as stream:
-        if too_small(stream.size()):
-            return None
-        return reader.generate_index(stream, file_order)
-
-
 def _plan_var_len_shards(reader, files, params,
                          retry: Optional[RetryPolicy] = None,
                          on_retry=None) -> List["WorkShard"]:
-    """Byte-range shard plan for a variable-length read: the sparse index
-    per file turns the sequential record stream into shards; files without
-    a useful index become one whole-file shard. Shared by the in-process
-    threaded scan and the multi-host (process) executor."""
-    from .parallel.planner import WorkShard
+    """Byte-range shard plan for a variable-length read (the sparse-index
+    chunk planner, engine/chunks.py). Shared by the in-process threaded
+    scan, the pipelined executor, and the multi-host (process) executor."""
+    from .engine.chunks import plan_var_len_chunks
 
-    shards: List[WorkShard] = []
-    for file_order, file_path in enumerate(files):
-        base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
-        entries = None
-        if params.is_index_generation_needed:
-            entries = _index_entries(reader, file_path, file_order, params,
-                                     retry, on_retry)
-        if entries is not None and len(entries) > 1:
-            # an open-ended last entry (-1) flows into the shard unchanged:
-            # streams bound it to the file end themselves, so no extra
-            # size round trip is needed for registry-backed storage
-            for e in entries:
-                shards.append(WorkShard(file_path, file_order,
-                                        e.offset_from, e.offset_to,
-                                        base + e.record_index))
-        else:
-            shards.append(WorkShard(file_path, file_order, 0, -1, base))
-    return shards
+    return plan_var_len_chunks(reader, files, params, retry, on_retry)
 
 
 def _scan_var_len(reader, files, params, backend: str, prefix: str,
@@ -670,6 +620,26 @@ def read_cobol(path=None,
 
     is_var_len = params.needs_var_len_reader
 
+    # chunked pipeline executor (cobrix_tpu.engine): overlap storage read,
+    # framing, decode, and Arrow assembly across a bounded thread pool.
+    # Off by default (pipeline_workers=0 keeps the sequential path); the
+    # host (oracle) backend and the multi-host process executor have their
+    # own execution models
+    pipe_workers = params.resolved_pipeline_workers()
+    use_pipeline = pipe_workers > 0 and hosts <= 1 and backend != "host"
+    if use_pipeline and is_var_len:
+        from .engine.chunks import auto_split_mb
+
+        split_mb = auto_split_mb(params)
+        if split_mb is not None:
+            # default the sparse-index split to the pipeline chunk size so
+            # mid-size files actually produce multiple chunks (explicit
+            # input_split options always win; see auto_split_mb for the
+            # configurations where this is pinned row-identical)
+            from dataclasses import replace as _dc_replace
+
+            params = _dc_replace(params, input_split_size_mb=split_mb)
+
     # Seg_Id columns exist only on the variable-length path (the reference
     # fixed-length reader never generates them)
     seg_count = (len(params.multisegment.segment_level_ids)
@@ -698,6 +668,18 @@ def read_cobol(path=None,
         else:
             reader = FixedLenReader(copybook_contents, params)
         copybook_obj = reader.copybook
+
+    # the output schema is a pure function of copybook + options; built
+    # before the scan so the pipelined path can assemble per-chunk Arrow
+    # tables against it while later chunks are still decoding
+    schema = CobolOutputSchema(
+        copybook_obj,
+        policy=params.schema_policy,
+        input_file_name_field=params.input_file_name_column,
+        generate_record_id=params.generate_record_id,
+        generate_seg_id_field_count=seg_count,
+        segment_id_prefix="",
+        corrupt_record_field=params.corrupt_record_column)
 
     retry = _retry_policy(params)
     retries_seen: List[int] = []  # list.append is GIL-atomic across shards
@@ -731,11 +713,29 @@ def read_cobol(path=None,
                         params.corrupt_record_column
                     result.corrupt_row_reasons = reasons or None
                     results.append(result)
+            elif use_pipeline:
+                from .engine.pipeline import pipelined_var_len_scan
+
+                with stage(metrics, "plan_index"):
+                    shards = _plan_var_len_shards(reader, files, params,
+                                                  retry, on_retry)
+                metrics.shards = len(shards)
+                results = pipelined_var_len_scan(
+                    reader, shards, params, backend, prefix, schema,
+                    pipe_workers, metrics=metrics, retry=retry,
+                    on_retry=on_retry)
             else:
                 results = _scan_var_len(reader, files, params, backend,
                                         prefix, parallelism,
                                         metrics=metrics, retry=retry,
                                         on_retry=on_retry)
+        elif use_pipeline:
+            from .engine.pipeline import pipelined_fixed_scan
+
+            results = pipelined_fixed_scan(
+                reader, files, params, backend, schema, pipe_workers,
+                ignore_file_size=debug_ignore_file_size, metrics=metrics,
+                retry=retry, on_retry=on_retry)
         else:
             for file_order, file_path in enumerate(files):
                 base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
@@ -762,14 +762,6 @@ def read_cobol(path=None,
                         reader, file_path, params, backend, file_order,
                         base, debug_ignore_file_size, retry, on_retry))
 
-    schema = CobolOutputSchema(
-        copybook_obj,
-        policy=params.schema_policy,
-        input_file_name_field=params.input_file_name_column,
-        generate_record_id=params.generate_record_id,
-        generate_seg_id_field_count=seg_count,
-        segment_id_prefix="",
-        corrupt_record_field=params.corrupt_record_column)
     data = CobolData.from_results(results, schema, parallelism=parallelism)
     data.diagnostics = _aggregate_diagnostics(params, results,
                                               len(retries_seen))
@@ -782,13 +774,14 @@ def _aggregate_diagnostics(params: ReaderParameters,
                            io_retries: int) -> Optional[ReadDiagnostics]:
     """Merge per-file/shard ledgers into the read-level ledger. None under
     fail_fast with no IO incidents (the read either succeeded cleanly or
-    raised)."""
+    raised). Deterministic: entries sort by (file, offset) with stable
+    cap truncation (ReadDiagnostics.merged), so sequential, threaded, and
+    pipelined scans over the same bytes produce byte-identical ledgers."""
     if not params.is_permissive and io_retries == 0:
         return None
-    merged = ReadDiagnostics(
+    merged = ReadDiagnostics.merged(
+        (getattr(r, "diagnostics", None) for r in results),
         max_entries=params.max_corrupt_ledger_entries)
-    for r in results:
-        merged.merge(getattr(r, "diagnostics", None))
     merged.io_retries += io_retries
     return merged
 
@@ -817,18 +810,18 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
                             on_retry=None) -> List["FileResult"]:
     from .reader.stream import open_stream, path_scheme
 
+    from .engine.chunks import fixed_file_chunkable
+
     rs = reader.record_size
     if path_scheme(file_path) in (None, "file"):
         size = os.path.getsize(file_path)
     else:
         with open_stream(file_path, retry=retry, on_retry=on_retry) as s:
             size = s.size()
-    payload = size - params.file_start_offset - params.file_end_offset
-    chunkable = (size > FIXED_READ_CHUNK_BYTES
-                 and not params.file_start_offset
-                 and not params.file_end_offset
-                 and (payload % rs == 0 or ignore_file_size))
-    if not chunkable:
+    # the SAME predicate drives the pipelined chunk planner — the
+    # pipelined-vs-sequential parity guarantee needs one split rule
+    if not fixed_file_chunkable(size, rs, params, FIXED_READ_CHUNK_BYTES,
+                                ignore_file_size):
         return [reader.read_result(
             _read_file_bytes(file_path, retry, on_retry), backend=backend,
             file_id=file_order, first_record_id=base_record_id,
@@ -895,7 +888,7 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
     # entry order matches a single-process read. Workers ship a ledger
     # under fail_fast too when IO retries fired, matching
     # _aggregate_diagnostics.
-    diagnostics = params.new_diagnostics()
+    shard_ledgers: List[ReadDiagnostics] = []
     found = False
     cleaned = []
     for table in tables:
@@ -903,9 +896,11 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
         raw = metadata.pop(b"cobrix_tpu.shard_diagnostics", None)
         if raw:
             found = True
-            diagnostics.merge(ReadDiagnostics.from_json(raw))
+            shard_ledgers.append(ReadDiagnostics.from_json(raw))
             table = table.replace_schema_metadata(metadata or None)
         cleaned.append(table)
+    diagnostics = ReadDiagnostics.merged(
+        shard_ledgers, max_entries=params.max_corrupt_ledger_entries)
     data = CobolData.from_arrow_tables(cleaned, schema)
     data.diagnostics = (diagnostics if params.is_permissive or found
                         else None)

@@ -85,6 +85,10 @@ def register_code_page(name: str, table: str) -> None:
     if len(table) != 256:
         raise ValueError("A code page table must have exactly 256 entries")
     _CUSTOM[name] = table
+    # a re-registration under the same name must not serve a stale LUT
+    from ..plan.cache import invalidate_code_page
+
+    invalidate_code_page(name)
 
 
 def load_code_page_class(class_path: str) -> str:

@@ -1,0 +1,23 @@
+"""Chunked pipelined execution engine.
+
+Splits a scan into chunks (fixed byte strides for fixed-length records,
+sparse-index entries for variable-length streams) and overlaps the stages
+— storage read, framing, columnar decode, Arrow RecordBatch assembly —
+across a bounded thread pool with backpressure, while keeping the output
+row-identical to the sequential path. See `pipeline.PipelineExecutor`.
+"""
+from .chunks import FixedChunk, plan_fixed_chunks, plan_var_len_chunks
+from .pipeline import (
+    PipelineExecutor,
+    pipelined_fixed_scan,
+    pipelined_var_len_scan,
+)
+
+__all__ = [
+    "FixedChunk",
+    "PipelineExecutor",
+    "plan_fixed_chunks",
+    "plan_var_len_chunks",
+    "pipelined_fixed_scan",
+    "pipelined_var_len_scan",
+]

@@ -1,0 +1,152 @@
+"""Chunk planning: split a scan into independently-executable byte ranges.
+
+Two planners, one chunk contract:
+
+* fixed-length files split on record-size-aligned byte strides — chunk
+  boundaries are pure arithmetic, no scan needed;
+* variable-length streams split on sparse-index entries
+  (reader/index.py) — the index pass turns the inherently-sequential
+  record stream into restartable byte ranges, exactly the mechanism the
+  reference uses to parallelize VRL files across Spark partitions
+  (IndexBuilder.scala:49-66).
+
+Chunk plans are EXECUTION plans only: a pipelined read with the same
+split options decodes the same records with the same Record_Ids as the
+sequential path, so turning the pipeline on can never change results.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..reader.index import file_index_entries
+from ..reader.parameters import DEFAULT_FILE_RECORD_ID_INCREMENT
+from ..reader.stream import RetryPolicy, open_stream, path_scheme
+
+
+@dataclass(frozen=True)
+class FixedChunk:
+    """One fixed-length unit of pipelined work: a record-aligned byte
+    range of one input file (the whole file when the file is too small or
+    not cleanly divisible)."""
+
+    file_path: str
+    file_order: int
+    offset: int            # byte offset of the chunk in the file
+    nbytes: int            # bytes to read (0 = to end of file)
+    first_record_id: int   # Record_Id of the chunk's first record
+    whole_file: bool       # single-chunk file (offset trims / odd tails)
+
+
+def _file_size(file_path: str, retry: Optional[RetryPolicy] = None,
+               on_retry=None) -> int:
+    if path_scheme(file_path) in (None, "file"):
+        return os.path.getsize(file_path)
+    with open_stream(file_path, retry=retry, on_retry=on_retry) as s:
+        return s.size()
+
+
+def fixed_file_chunkable(size: int, record_size: int, params,
+                         chunk_bytes: int, ignore_file_size: bool) -> bool:
+    """THE fixed-length split predicate — shared by the sequential
+    chunked read (api._read_fixed_len_chunked) and the pipelined planner,
+    because the parity guarantee rests on both making the identical
+    split/whole-file decision: no file-level header/footer trims and a
+    record-divisible payload (or debug_ignore_file_size)."""
+    payload = size - params.file_start_offset - params.file_end_offset
+    return (size > chunk_bytes
+            and not params.file_start_offset
+            and not params.file_end_offset
+            and (payload % record_size == 0 or ignore_file_size))
+
+
+def plan_fixed_chunks(reader, files, params, chunk_bytes: int,
+                      ignore_file_size: bool,
+                      retry: Optional[RetryPolicy] = None,
+                      on_retry=None) -> List[FixedChunk]:
+    """Byte-stride chunk plan over fixed-length input files.
+
+    A file splits only when the same conditions hold that make the
+    sequential chunked read safe (api._read_fixed_len_chunked): no
+    file-level header/footer trims and a record-size-divisible payload
+    (or debug_ignore_file_size). Anything else — including a truncated
+    tail a permissive policy will ledger — stays a single whole-file
+    chunk, so tail handling and ledger offsets match the sequential read
+    byte for byte.
+    """
+    rs = reader.record_size
+    chunk_bytes = max(rs, (chunk_bytes // rs) * rs)  # record-aligned
+    chunks: List[FixedChunk] = []
+    for file_order, file_path in enumerate(files):
+        base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
+        size = _file_size(file_path, retry, on_retry)
+        if not fixed_file_chunkable(size, rs, params, chunk_bytes,
+                                    ignore_file_size):
+            chunks.append(FixedChunk(file_path, file_order, 0, 0, base,
+                                     whole_file=True))
+            continue
+        done = 0
+        while done < size:
+            n = min(chunk_bytes, size - done)
+            chunks.append(FixedChunk(file_path, file_order, done, n,
+                                     base + done // rs, whole_file=False))
+            done += n
+    return chunks
+
+
+def plan_var_len_chunks(reader, files, params,
+                        retry: Optional[RetryPolicy] = None,
+                        on_retry=None) -> List["WorkShard"]:
+    """Byte-range shard plan for a variable-length read: the sparse index
+    per file turns the sequential record stream into shards; files
+    without a useful index become one whole-file shard. Shared by the
+    in-process threaded scan, the pipelined executor, and the multi-host
+    (process) executor."""
+    from ..parallel.planner import WorkShard
+
+    shards: List[WorkShard] = []
+    for file_order, file_path in enumerate(files):
+        base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
+        entries = None
+        if params.is_index_generation_needed:
+            entries = file_index_entries(reader, file_path, file_order,
+                                         params, retry, on_retry)
+        if entries is not None and len(entries) > 1:
+            # an open-ended last entry (-1) flows into the shard unchanged:
+            # streams bound it to the file end themselves, so no extra
+            # size round trip is needed for registry-backed storage
+            for e in entries:
+                shards.append(WorkShard(file_path, file_order,
+                                        e.offset_from, e.offset_to,
+                                        base + e.record_index))
+        else:
+            shards.append(WorkShard(file_path, file_order, 0, -1, base))
+    return shards
+
+
+def auto_split_mb(params) -> Optional[int]:
+    """The sparse-index split size (MB) a pipelined variable-length read
+    should default to, or None to leave the plan untouched.
+
+    Only configurations where split granularity provably cannot change
+    results get the default: plain RDW streams (fast framing, which the
+    indexed-scan invariants pin row-identical) with no file header/footer
+    regions (whose counted-invalid-record quirk shifts Record_Ids between
+    indexed and unindexed reads — reference IndexGenerator.scala:117-120).
+    Explicit input_split options always win.
+    """
+    if (params.input_split_records is not None
+            or params.input_split_size_mb is not None):
+        return None
+    if not params.is_index_generation_needed:
+        return None
+    if params.file_start_offset or params.file_end_offset:
+        return None
+    if not params.supports_fast_framing:
+        return None
+    # fractional chunk sizes pass through (file_index_entries multiplies
+    # by MEGABYTE); below 1 MB the split-option validation floor applies,
+    # so tiny-chunk runs use explicit input_split options instead
+    mb = float(params.pipeline_chunk_mb)
+    return mb if mb >= 1 else None

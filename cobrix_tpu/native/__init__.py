@@ -141,6 +141,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.decimal128_from_limbs.argtypes = [
             _U64P, _U64P, _U8P, _U8P, _I64P, ctypes.c_int64,
             ctypes.c_int32, _U8P, _U8P]
+        lib.decimal128_batch.restype = None
+        lib.decimal128_batch.argtypes = [
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, _U8P,
+            ctypes.c_void_p, _U8P, _I64P, _I32P, _U8P, _U8P]
+        lib.set_omp_threads.restype = None
+        lib.set_omp_threads.argtypes = [ctypes.c_int32]
         lib.format_seg_id_level.restype = None
         lib.format_seg_id_level.argtypes = [
             _I64P, ctypes.c_void_p, ctypes.c_int64, _U8P, ctypes.c_int64,
@@ -443,52 +450,78 @@ NUMERIC_GROUP_DISPLAY_EBCDIC = 2
 NUMERIC_GROUP_DISPLAY_ASCII = 3
 
 
-def decode_numeric_groups(batch: np.ndarray, groups):
+class NumericGroupsPlan:
+    """Pre-marshaled static descriptor arrays for decode_numeric_groups.
+
+    Rebuilt per decode call these cost milliseconds of GIL-held numpy/
+    ctypes work on many-group profiles (exp1: 59 groups) — the chunked
+    pipeline pays that once per CHUNK, so decoders cache one plan per
+    group subset and only the per-call output buffers remain."""
+
+    __slots__ = ("ng", "kinds", "widths", "ncols", "flags", "dyn_sfs",
+                 "offs_list", "offs_ptrs", "has_dots")
+
+    def __init__(self, groups):
+        ng = len(groups)
+        self.ng = ng
+        self.kinds = np.empty(ng, dtype=np.int32)
+        self.widths = np.empty(ng, dtype=np.int32)
+        self.ncols = np.empty(ng, dtype=np.int64)
+        self.flags = np.zeros(ng, dtype=np.int32)
+        self.dyn_sfs = np.zeros(ng, dtype=np.int32)
+        self.offs_list = []
+        self.has_dots = []
+        for i, g in enumerate(groups):
+            offs = np.ascontiguousarray(g["offsets"], dtype=np.int64)
+            self.offs_list.append(offs)
+            self.kinds[i] = g["kind"]
+            self.widths[i] = g["width"]
+            self.ncols[i] = offs.shape[0]
+            self.flags[i] = (int(bool(g.get("signed")))
+                             | (int(bool(g.get("big_endian"))) << 1)
+                             | (int(bool(g.get("allow_dot"))) << 2)
+                             | (int(bool(g.get("require_digits"))) << 3))
+            self.dyn_sfs[i] = int(g.get("dyn_sf", 0))
+            self.has_dots.append(
+                g["kind"] >= NUMERIC_GROUP_DISPLAY_EBCDIC)
+        self.offs_ptrs = np.asarray([a.ctypes.data for a in self.offs_list],
+                                    dtype=np.uintp)
+
+
+def decode_numeric_groups(batch: np.ndarray, groups, plan=None):
     """Merged one-pass decode of MANY narrow numeric kernel groups from a
     packed [n, extent] batch — each record's bytes are touched once for
     the whole plane instead of once per group. `groups`: list of dicts
     with keys kind (NUMERIC_GROUP_*), offsets, width, and (per kind)
-    signed/big_endian/allow_dot/require_digits/dyn_sf. Returns a list
-    aligned to `groups`: (values, valid) or (values, valid, dot_scale)
+    signed/big_endian/allow_dot/require_digits/dyn_sf — or None when a
+    prebuilt `plan` (NumericGroupsPlan) is passed. Returns a list
+    aligned to the groups: (values, valid) or (values, valid, dot_scale)
     for display kinds. None when the native library is unavailable."""
     lib = _load()
-    if lib is None or not groups:
+    if lib is None or (not groups and plan is None):
         return None
     b = np.ascontiguousarray(batch, dtype=np.uint8)
     n, extent = b.shape
-    ng = len(groups)
-    kinds = np.empty(ng, dtype=np.int32)
-    widths = np.empty(ng, dtype=np.int32)
-    ncols_arr = np.empty(ng, dtype=np.int64)
-    flags = np.zeros(ng, dtype=np.int32)
-    dyn_sfs = np.zeros(ng, dtype=np.int32)
-    offs_list, values, valids, dots = [], [], [], []
-    for i, g in enumerate(groups):
-        offs = np.ascontiguousarray(g["offsets"], dtype=np.int64)
-        offs_list.append(offs)
-        nc = offs.shape[0]
-        kinds[i] = g["kind"]
-        widths[i] = g["width"]
-        ncols_arr[i] = nc
-        flags[i] = (int(bool(g.get("signed")))
-                    | (int(bool(g.get("big_endian"))) << 1)
-                    | (int(bool(g.get("allow_dot"))) << 2)
-                    | (int(bool(g.get("require_digits"))) << 3))
-        dyn_sfs[i] = int(g.get("dyn_sf", 0))
+    if plan is None:
+        plan = NumericGroupsPlan(groups)
+    ng = plan.ng
+    values, valids, dots = [], [], []
+    for i in range(ng):
+        nc = int(plan.ncols[i])
         values.append(np.empty((n, nc), dtype=np.int64))
         valids.append(np.empty((n, nc), dtype=np.uint8))
         dots.append(np.empty((n, nc), dtype=np.int64)
-                    if g["kind"] >= NUMERIC_GROUP_DISPLAY_EBCDIC else None)
+                    if plan.has_dots[i] else None)
+
     def ptrs(arrs):
         return np.asarray([0 if a is None else a.ctypes.data for a in arrs],
                           dtype=np.uintp)
-    offs_ptrs = ptrs(offs_list)
     v_ptrs = ptrs(values)
     ok_ptrs = ptrs(valids)
     dot_ptrs = ptrs(dots)
     lib.decode_numeric_groups(
-        b, n, extent, ng, kinds, widths, ncols_arr,
-        offs_ptrs.ctypes.data, flags, dyn_sfs,
+        b, n, extent, ng, plan.kinds, plan.widths, plan.ncols,
+        plan.offs_ptrs.ctypes.data, plan.flags, plan.dyn_sfs,
         v_ptrs.ctypes.data, ok_ptrs.ctypes.data, dot_ptrs.ctypes.data)
     out = []
     for i in range(ng):
@@ -556,6 +589,51 @@ def transcode_string_cols_raw(data, rec_offsets, rec_lengths, col_offsets,
     lib.transcode_string_cols_raw(buf, offs, lens, n, cols, ncols, width,
                                   lut, out)
     return out
+
+
+def set_thread_omp_width(n: int) -> None:
+    """Cap the CALLING thread's OpenMP team size for subsequent native
+    kernel calls (per-thread ICV). The pipeline executor calls this from
+    each worker/assembler thread so concurrent chunks split the cores
+    instead of oversubscribing them; sequential reads are unaffected."""
+    lib = _load()
+    if lib is not None:
+        lib.set_omp_threads(int(n))
+
+
+def decimal128_batch(hi, lo, values, neg, valid, dots, use_dots, shifts,
+                     maxd):
+    """Whole-kernel-group decimal128 build: [k, n] packed column planes ->
+    ([k, n, 16] little-endian decimal128 buffers, [k] per-column ok
+    flags) in ONE native call. Narrow mode passes `values` (int64
+    mantissas, hi/lo/neg None); wide mode passes the uint64 limb planes +
+    sign plane. `use_dots[c]`=1 derives the shift per value as
+    shifts[c] - dots[c, r]; otherwise shifts[c] is static. maxd[c] bounds
+    the unscaled magnitude (0 disables the bound). ok[c]=0 -> the caller
+    rebuilds column c via its exact fallback. None when the native
+    library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    valid = np.ascontiguousarray(valid, dtype=np.uint8)
+    k, n = valid.shape
+    out = np.empty((k, n, 16), dtype=np.uint8)
+    ok = np.empty(k, dtype=np.uint8)
+    # hold every converted array until the call returns — a bare
+    # `ascontiguousarray(a).ctypes.data` could free the temporary first
+    keep = [None if a is None else np.ascontiguousarray(a)
+            for a in (hi, lo, values, neg, dots)]
+
+    def ptr(a):
+        return None if a is None else a.ctypes.data
+
+    lib.decimal128_batch(
+        n, k, ptr(keep[0]), ptr(keep[1]), ptr(keep[2]), ptr(keep[3]),
+        valid, ptr(keep[4]),
+        np.ascontiguousarray(use_dots, dtype=np.uint8),
+        np.ascontiguousarray(shifts, dtype=np.int64),
+        np.ascontiguousarray(maxd, dtype=np.int32), out, ok)
+    return out, ok.view(bool)
 
 
 def decimal128_from_limbs(hi, lo, neg, valid, shifts, max_digits: int = 38):

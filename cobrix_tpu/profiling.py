@@ -12,10 +12,15 @@ and index counts). The TPU-native equivalents here:
   paths (visible on the profiler timeline; ~free when no trace is on).
 - `ReadMetrics`: per-read structured counters (files, shards, records,
   bytes, per-stage timings) attached to every CobolData as `.metrics`.
+- `StageTimes`: thread-safe per-stage BUSY time accumulation for the
+  pipelined execution engine (cobrix_tpu.engine) — wall time alone cannot
+  attribute a pipeline win, because overlapped stages each burn close to
+  the full wall on a busy pool; busy/wall is the overlap factor.
 """
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional
@@ -55,6 +60,46 @@ def annotate(name: str):
     return _TRACE_ANNOTATION(name)
 
 
+class StageTimes:
+    """Per-stage busy-time accumulator shared by pipeline worker threads.
+
+    `busy_s[stage]` is the SUM of time any thread spent inside that stage
+    (read / frame / decode / assemble), so with N-way overlap the busy
+    total exceeds the pipeline wall time — the ratio is the overlap
+    factor reported in ReadMetrics. A plain dict read-modify-write races
+    across threads; the lock makes each accumulation atomic."""
+
+    __slots__ = ("_lock", "busy_s")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.busy_s: Dict[str, float] = {}
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self.busy_s[name] = self.busy_s.get(name, 0.0) + seconds
+
+    @contextlib.contextmanager
+    def timed(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def as_dict(self) -> Dict[str, float]:
+        with self._lock:
+            return {k: round(v, 6) for k, v in self.busy_s.items()}
+
+
+def timed_stage(stage_times: Optional[StageTimes], name: str):
+    """`stage_times.timed(name)` or a no-op when no accumulator is wired
+    (sequential reads pass None through the reader hot paths)."""
+    if stage_times is None:
+        return contextlib.nullcontext()
+    return stage_times.timed(name)
+
+
 @dataclass
 class ReadMetrics:
     """Structured per-read metrics (the IndexBuilder/CobolScanners log
@@ -67,15 +112,36 @@ class ReadMetrics:
     backend: str = ""
     hosts: int = 1
     timings_s: Dict[str, float] = field(default_factory=dict)
+    # pipelined execution: per-stage busy times (thread-summed) and the
+    # executor's shape/overlap report ({workers, chunks, max_inflight,
+    # peak_inflight, wall_s, busy_s, overlap}); None on sequential reads
+    stage_busy: Optional[StageTimes] = None
+    pipeline: Optional[dict] = None
+    # compile-cache activity DURING this read (copybook parse / field-plan
+    # / code-page LUT hits and misses, delta from read start). The
+    # counters are process-global: with CONCURRENT read_cobol calls the
+    # delta includes the other reads' lookups in the window — exact for
+    # the common one-read-at-a-time case, an upper bound otherwise
+    plan_cache: Optional[dict] = None
+
+    def __post_init__(self):
+        from .plan.cache import cache_stats
+
+        self._cache_baseline = cache_stats()
 
     def finalize(self, data, shards: int) -> None:
         """Attach this metrics object to a finished CobolData."""
+        from .plan.cache import cache_stats
+
         self.shards = max(self.shards, shards)
         self.records = len(data)
+        now = cache_stats()
+        self.plan_cache = {k: now[k] - self._cache_baseline.get(k, 0)
+                           for k in now}
         data.metrics = self
 
     def as_dict(self) -> dict:
-        return {
+        out = {
             "files": self.files,
             "shards": self.shards,
             "records": self.records,
@@ -84,6 +150,13 @@ class ReadMetrics:
             "hosts": self.hosts,
             "timings_s": {k: round(v, 6) for k, v in self.timings_s.items()},
         }
+        if self.stage_busy is not None:
+            out["stage_busy_s"] = self.stage_busy.as_dict()
+        if self.pipeline is not None:
+            out["pipeline"] = self.pipeline
+        if self.plan_cache is not None:
+            out["plan_cache"] = self.plan_cache
+        return out
 
 
 class _Stage:

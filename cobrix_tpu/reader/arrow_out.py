@@ -77,8 +77,20 @@ def to_arrow_type(t):
 
 
 def arrow_schema(struct: StructType):
+    # memoized on the StructType instance: per-chunk pipeline assembly
+    # calls this once per chunk, and rebuilding a wide schema (exp1: 195
+    # typed fields) is pure GIL-held overhead
+    cached = getattr(struct, "_pa_schema", None)
+    if cached is not None:
+        return cached
     pa = _pa()
-    return pa.schema([(f.name, to_arrow_type(f.dtype)) for f in struct.fields])
+    schema = pa.schema([(f.name, to_arrow_type(f.dtype))
+                        for f in struct.fields])
+    try:
+        struct._pa_schema = schema
+    except AttributeError:  # slotted/frozen struct types stay uncached
+        pass
+    return schema
 
 
 def _validity_buffer(valid: np.ndarray):
@@ -296,10 +308,13 @@ class ArrowBatchBuilder:
             return self._python_fallback(col, pa_type, relevant)
         if "values_hi" in out:
             # wide uint128-limb columns: native decimal128 build from the
-            # limbs; exact-Decimal fallback when any value needs rounding
-            # or outruns the declared precision
-            arr = self._decimal128_native(spec, out, pa_type, relevant,
-                                          wide=True)
+            # limbs (one batched call per kernel group when possible);
+            # exact-Decimal fallback when any value needs rounding or
+            # outruns the declared precision
+            arr = self._decimal_group_array(spec, pa_type)
+            if arr is None:
+                arr = self._decimal128_native(spec, out, pa_type, relevant,
+                                              wide=True)
             if arr is not None:
                 return arr
             return self._python_fallback(col, pa_type, relevant)
@@ -320,6 +335,9 @@ class ArrowBatchBuilder:
                 values.astype(_numpy_dtype_for(pa_type), copy=False),
                 mask=mask)
         if pa.types.is_decimal(pa_type):
+            arr = self._decimal_group_array(spec, pa_type)
+            if arr is not None:
+                return arr
             if pa_type.precision > 18:
                 # int64 mantissa widened into 128-bit limbs natively
                 arr = self._decimal128_native(spec, out, pa_type, relevant,
@@ -345,6 +363,111 @@ class ArrowBatchBuilder:
             mantissa = mantissa * 10 ** shift
             return _decimal128_from_mantissa(mantissa, valid, pa_type)
         return self._python_fallback(col, pa_type, relevant)
+
+    def _decimal_group_array(self, spec, pa_type):
+        """Per-column decimal128 array served from ONE native build per
+        kernel group (native.decimal128_batch): the group's column planes
+        are stacked and shifted/packed in a single call, replacing
+        per-column wrapper calls + strided copies — the dominant GIL-held
+        assembly cost on decimal-heavy profiles, and what lets pipeline
+        workers overlap instead of serializing on the interpreter. None ->
+        caller's per-column paths (masked rows, host fallback, ok=0
+        exact-fallback columns, native library unavailable)."""
+        from .. import native
+
+        if not native.available():
+            return None
+        if self._relevant_of(spec) is not None:
+            return None
+        g = self.decoder.group_of_col.get(spec.index)
+        if g is None or len(g.columns) < 2:
+            return None  # single column: the per-column kernel is enough
+        cache = self.batch._arrow_dec_cache
+        entry = cache.get(id(g))
+        if entry is None:
+            entry = self._build_decimal_group(g)
+            cache[id(g)] = entry
+        return entry.get(spec.index)
+
+    def _build_decimal_group(self, g) -> dict:
+        """{col index -> pa.Array | None} for every decimal-typed column
+        of one kernel group, via one decimal128_batch call."""
+        from .. import native
+
+        pa = _pa()
+        entry: dict = {}
+        chosen = []
+        for c in g.columns:
+            if c.statement is None:
+                continue
+            if self.redefine_masks is not None and c.segment:
+                continue  # masked columns keep the per-column path
+            pa_t = to_arrow_type(primitive_data_type(c.statement))
+            if not pa.types.is_decimal(pa_t):
+                continue
+            out = self.batch._out.get(c.index)
+            if out is None or "values" not in out or "host" in out:
+                continue
+            use_dots = bool(c.params.explicit_decimal or _dyn_scale(c))
+            if use_dots and "dot_scale" not in out:
+                continue
+            chosen.append((c, pa_t, out, use_dots))
+        if not chosen:
+            return entry
+        n = self.n
+        k = len(chosen)
+        wide = "values_hi" in chosen[0][2]
+        valid = np.stack([np.asarray(o["valid"])
+                          for _, _, o, _ in chosen]).astype(np.uint8,
+                                                            copy=False)
+        if wide:
+            hi = np.stack([np.asarray(o["values_hi"], dtype=np.uint64)
+                           for _, _, o, _ in chosen])
+            lo = np.stack([np.asarray(o["values"], dtype=np.uint64)
+                           for _, _, o, _ in chosen])
+            neg = np.stack([np.asarray(o["negative"])
+                            for _, _, o, _ in chosen]).astype(np.uint8,
+                                                              copy=False)
+            values = None
+        else:
+            hi = lo = neg = None
+            values = np.stack([np.asarray(o["values"])
+                               for _, _, o, _ in chosen]).astype(
+                np.int64, copy=False)
+        use_dots_arr = np.asarray([ud for _, _, _, ud in chosen],
+                                  dtype=np.uint8)
+        dots = None
+        if use_dots_arr.any():
+            dots = np.zeros((k, n), dtype=np.int64)
+            for j, (_, _, o, ud) in enumerate(chosen):
+                if ud:
+                    dots[j] = np.asarray(o["dot_scale"], dtype=np.int64)
+        shifts = np.asarray(
+            [pa_t.scale if ud
+             else pa_t.scale + fixed_point_exponent(c)
+             for c, pa_t, _, ud in chosen], dtype=np.int64)
+        # precision bounds mirror the per-column paths exactly: wide limbs
+        # and >18-digit narrow columns went through the native kernel with
+        # max_digits=precision (overflow -> exact fallback, which
+        # surfaces it); only the <=18 narrow numpy-mantissa path never
+        # bounded, so maxd=0 keeps that behavior there
+        maxd = np.asarray(
+            [pa_t.precision if (wide or pa_t.precision > 18) else 0
+             for _, pa_t, _, _ in chosen], dtype=np.int32)
+        res = native.decimal128_batch(hi, lo, values, neg, valid, dots,
+                                      use_dots_arr, shifts, maxd)
+        if res is None:
+            return entry
+        data, ok = res
+        for j, (c, pa_t, _, _) in enumerate(chosen):
+            if not ok[j]:
+                entry[c.index] = None
+                continue
+            vcol = valid[j].view(bool)
+            vbuf = None if vcol.all() else _validity_buffer(vcol)
+            entry[c.index] = pa.Array.from_buffers(
+                pa_t, n, [vbuf, pa.py_buffer(data[j])])
+        return entry
 
     def _decimal128_native(self, spec, out, pa_type, relevant, wide: bool):
         """decimal128 buffers straight from the kernel outputs via the

@@ -37,11 +37,11 @@ from ..copybook.datatypes import (
     TrimPolicy,
     Usage,
 )
-from ..encoding.codepages import code_page_lut_u16
 from .. import native
 from ..ops import batch_np
 from ..profiling import annotate
-from ..plan.compiler import Codec, ColumnSpec, FieldPlan, compile_plan
+from ..plan.cache import cached_code_page_lut, cached_compile_plan
+from ..plan.compiler import Codec, ColumnSpec, FieldPlan
 from .extractors import DecodeOptions
 import decimal as _decimal
 
@@ -310,6 +310,7 @@ class DecodedBatch:
         self._col_cache: Dict[int, list] = {}
         self._maker_cache: Dict[tuple, object] = {}
         self._arrow_str_cache: Dict[int, tuple] = {}  # id(group) -> (masks, buffers)
+        self._arrow_dec_cache: Dict[int, dict] = {}   # id(group) -> {col: Array|None}
         # actual byte length of each record when shorter than the padded row
         # (variable-length files); columns past a record's end are null /
         # truncated like reference Primitive.decodeTypeValue (Primitive.scala:102)
@@ -815,16 +816,21 @@ def decoder_for_segment(cache: Dict[str, "ColumnarDecoder"],
     fixed-length and variable-length readers. Locked: the indexed parallel
     scan hits a shared reader's cache from worker threads, and plan
     compilation (or a jax jit) must not be duplicated per worker."""
+    from ..plan.cache import note_decoder
+
     key = f"{active}|{backend}|{','.join(select) if select else ''}"
     dec = cache.get(key)
     if dec is None:
         with _decoder_build_lock:
             dec = cache.get(key)
             if dec is None:
+                note_decoder(hit=False)
                 dec = ColumnarDecoder(
                     copybook, active_segment=active or None, backend=backend,
                     select=select)
                 cache[key] = dec
+                return dec
+    note_decoder(hit=True)
     return dec
 
 
@@ -835,14 +841,14 @@ class ColumnarDecoder:
                  select: Optional[Sequence[str]] = None):
         self.copybook = copybook
         self.select = tuple(select) if select else None
-        self.plan: FieldPlan = compile_plan(copybook, active_segment,
-                                            select=self.select)
+        self.plan: FieldPlan = cached_compile_plan(copybook, active_segment,
+                                                   select=self.select)
         self.backend = backend
         self.options = DecodeOptions.from_copybook(copybook)
         self.non_standard_ascii_charset = (
             copybook.ascii_charset.lower().replace("_", "-")
             not in ("us-ascii", "ascii"))
-        self.lut = code_page_lut_u16(copybook.ebcdic_code_page)
+        self.lut = cached_code_page_lut(copybook.ebcdic_code_page)
         self._jax_fn = None
         self.rebuild_groups()
 
@@ -857,6 +863,14 @@ class ColumnarDecoder:
         self.kernel_groups = [
             _KernelGroup(key[0], key[1], key[2:], cols)
             for key, cols in groups.items()]
+        # column index -> its kernel group (group-batched Arrow builds)
+        self.group_of_col: Dict[int, _KernelGroup] = {
+            c.index: g for g in self.kernel_groups for c in g.columns}
+        # marshaled merged-numeric descriptors, keyed by the group subset
+        # (decode() always passes the full list; decode_raw passes masked
+        # subsets) — rebuilt per decode call they cost ~5ms on a
+        # 59-group profile, pure GIL-held overhead per pipeline chunk
+        self._numeric_descs: Dict[tuple, tuple] = {}
         # lookup maps for row assembly
         self.slot_map: Dict[Tuple[int, Tuple[int, ...]], int] = {}
         for c in self.plan.columns:
@@ -1086,36 +1100,47 @@ class ColumnarDecoder:
         """Decode all narrow binary/BCD/DISPLAY groups in one native pass
         (native.decode_numeric_groups); returns the groups still needing
         the per-group path. A single eligible group keeps the per-group
-        kernel (same work, simpler call)."""
-        descs, eligible, rest = [], [], []
-        for g in groups:
-            desc = None
-            if g.codec is Codec.BINARY and not g.wide:
-                signed, big_endian, _, _ = g.variant
-                desc = dict(kind=native.NUMERIC_GROUP_BINARY,
-                            offsets=g.offsets, width=g.width,
-                            signed=signed, big_endian=big_endian)
-            elif g.codec is Codec.BCD and not g.wide:
-                desc = dict(kind=native.NUMERIC_GROUP_BCD,
-                            offsets=g.offsets, width=g.width)
-            elif g.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII) \
-                    and not g.wide:
-                signed, allow_dot, require_digits, _, sf, _ = g.variant
-                kind = (native.NUMERIC_GROUP_DISPLAY_EBCDIC
-                        if g.codec is Codec.DISPLAY_NUM
-                        else native.NUMERIC_GROUP_DISPLAY_ASCII)
-                desc = dict(kind=kind, offsets=g.offsets, width=g.width,
-                            signed=signed, allow_dot=allow_dot,
-                            require_digits=require_digits,
-                            dyn_sf=min(sf, 0))
-            if desc is None or not len(g.columns):
-                rest.append(g)
-            else:
-                descs.append(desc)
-                eligible.append(g)
-        if len(eligible) < 2:
+        kernel (same work, simpler call). The marshaled descriptor plan
+        is cached per group subset — per-chunk pipeline decodes reuse it
+        instead of re-marshaling ~ms of arrays every call."""
+        key = tuple(id(g) for g in groups)
+        cached = self._numeric_descs.get(key)
+        if cached is None:
+            descs, eligible, rest = [], [], []
+            for g in groups:
+                desc = None
+                if g.codec is Codec.BINARY and not g.wide:
+                    signed, big_endian, _, _ = g.variant
+                    desc = dict(kind=native.NUMERIC_GROUP_BINARY,
+                                offsets=g.offsets, width=g.width,
+                                signed=signed, big_endian=big_endian)
+                elif g.codec is Codec.BCD and not g.wide:
+                    desc = dict(kind=native.NUMERIC_GROUP_BCD,
+                                offsets=g.offsets, width=g.width)
+                elif g.codec in (Codec.DISPLAY_NUM,
+                                 Codec.DISPLAY_NUM_ASCII) and not g.wide:
+                    signed, allow_dot, require_digits, _, sf, _ = g.variant
+                    kind = (native.NUMERIC_GROUP_DISPLAY_EBCDIC
+                            if g.codec is Codec.DISPLAY_NUM
+                            else native.NUMERIC_GROUP_DISPLAY_ASCII)
+                    desc = dict(kind=kind, offsets=g.offsets,
+                                width=g.width, signed=signed,
+                                allow_dot=allow_dot,
+                                require_digits=require_digits,
+                                dyn_sf=min(sf, 0))
+                if desc is None or not len(g.columns):
+                    rest.append(g)
+                else:
+                    descs.append(desc)
+                    eligible.append(g)
+            plan = (native.NumericGroupsPlan(descs)
+                    if len(eligible) >= 2 else None)
+            cached = (eligible, rest, plan)
+            self._numeric_descs[key] = cached
+        eligible, rest, plan = cached
+        if plan is None:
             return groups
-        res = native.decode_numeric_groups(arr, descs)
+        res = native.decode_numeric_groups(arr, None, plan=plan)
         if res is None:  # no native library: per-group numpy path
             return groups
         for g, out in zip(eligible, res):

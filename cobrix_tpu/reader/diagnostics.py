@@ -150,6 +150,33 @@ class ReadDiagnostics:
             self.entries.extend(other.entries[:room])
         return self
 
+    @classmethod
+    def merged(cls, ledgers, max_entries: int = DEFAULT_LEDGER_CAP
+               ) -> "ReadDiagnostics":
+        """Deterministic multi-shard merge: counters sum in any order;
+        entries from EVERY shard are collected, sorted by
+        (file, offset, record_index), then cap-truncated — so the merged
+        ledger is identical whether shards were scanned sequentially or
+        raced through the pipeline executor, and the entries kept under
+        the cap are always the earliest incidents, not the first shards
+        to finish."""
+        out = cls(max_entries=max_entries)
+        entries: List[CorruptRecordInfo] = []
+        for ledger in ledgers:
+            if ledger is None:
+                continue
+            out.corrupt_records += ledger.corrupt_records
+            out.records_dropped += ledger.records_dropped
+            out.bytes_skipped += ledger.bytes_skipped
+            out.resyncs += ledger.resyncs
+            out.io_retries += ledger.io_retries
+            entries.extend(ledger.entries)
+        entries.sort(key=lambda e: (
+            e.file, e.offset,
+            -1 if e.record_index is None else e.record_index))
+        out.entries = entries[:max_entries]
+        return out
+
     @property
     def is_clean(self) -> bool:
         return (self.corrupt_records == 0 and self.bytes_skipped == 0

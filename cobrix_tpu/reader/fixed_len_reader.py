@@ -11,8 +11,8 @@ from typing import Iterator, List, Optional
 
 import numpy as np
 
-from ..copybook.copybook import Copybook, merge_copybooks, parse_copybook
-from ..encoding.codepages import resolve_code_page
+from ..copybook.copybook import Copybook
+from ..plan.cache import copybook_for_params, decoder_cache_for
 from .columnar import ColumnarDecoder, DecodedBatch, decoder_for_segment
 from .diagnostics import (
     CorruptRecordInfo,
@@ -20,6 +20,7 @@ from .diagnostics import (
     RecordErrorPolicy,
     hex_snapshot,
 )
+from ..profiling import timed_stage
 from .extractors import DecodeOptions, extract_record
 from .parameters import ReaderParameters
 from .result import FileResult, SegmentBatch
@@ -28,38 +29,15 @@ from .vrl_reader import decode_segment_id_bytes, resolve_segment_id_field
 
 class FixedLenReader:
     def __init__(self, copybook_contents, params: ReaderParameters):
-        if isinstance(copybook_contents, str):
-            contents_list = [copybook_contents]
-        else:
-            contents_list = list(copybook_contents)
         seg = params.multisegment
-        copybooks = [
-            parse_copybook(
-                c,
-                data_encoding=params.data_encoding,
-                drop_group_fillers=params.drop_group_fillers,
-                drop_value_fillers=params.drop_value_fillers,
-                segment_redefines=sorted(set(
-                    (seg.segment_id_redefine_map or {}).values())) if seg else (),
-                field_parent_map=dict(seg.field_parent_map) if seg else None,
-                string_trimming_policy=params.string_trimming_policy,
-                comment_policy=params.comment_policy,
-                ebcdic_code_page=resolve_code_page(
-                    params.ebcdic_code_page, params.ebcdic_code_page_class),
-                ascii_charset=params.ascii_charset,
-                is_utf16_big_endian=params.is_utf16_big_endian,
-                floating_point_format=params.floating_point_format,
-                non_terminals=params.non_terminals,
-                occurs_mappings=params.occurs_mappings,
-                debug_fields_policy=params.debug_fields_policy,
-            ) for c in contents_list]
-        self.copybook = (copybooks[0] if len(copybooks) == 1
-                         else merge_copybooks(copybooks))
+        # fingerprint-keyed parse cache: repeated scans of the same
+        # copybook/options share the Copybook object — and through it the
+        # compiled field plans and decoders (plan/cache.py)
+        self.copybook = copybook_for_params(copybook_contents, params)
         self.params = params
         self.segment_redefine_map = dict(
             seg.segment_id_redefine_map) if seg else {}
-        self._decoder: Optional[ColumnarDecoder] = None
-        self._seg_decoders: dict = {}
+        self._seg_decoders: dict = decoder_cache_for(self.copybook)
 
     @property
     def record_size(self) -> int:
@@ -133,10 +111,10 @@ class FixedLenReader:
         return arr.reshape(-1, rs)
 
     def decoder(self, backend: str = "numpy") -> ColumnarDecoder:
-        if self._decoder is None or self._decoder.backend != backend:
-            self._decoder = ColumnarDecoder(self.copybook, backend=backend,
-                                            select=self.params.select)
-        return self._decoder
+        # the whole-plan decoder shares the per-copybook cache with the
+        # segment decoders (key ""), so repeated/chunked reads reuse it
+        return decoder_for_segment(self._seg_decoders, self.copybook, "",
+                                   backend, select=self.params.select)
 
     def _trimmed_matrix(self, matrix: np.ndarray):
         """Strip record start/end offsets to the copybook layout width.
@@ -172,9 +150,12 @@ class FixedLenReader:
     def read_result(self, data: bytes, backend: str = "numpy",
                     file_id: int = 0, first_record_id: int = 0,
                     input_file_name: str = "",
-                    ignore_file_size: bool = False) -> FileResult:
+                    ignore_file_size: bool = False,
+                    stage_times=None) -> FileResult:
         """Decode to a columnar FileResult (kernel outputs kept; rows and
-        Arrow tables are materialized lazily at the API boundary)."""
+        Arrow tables are materialized lazily at the API boundary).
+        `stage_times`: optional profiling.StageTimes — the pipeline engine
+        passes it to attribute frame vs decode busy time."""
         params = self.params
         ledger = params.new_diagnostics() if params.is_permissive else None
         result = FileResult(
@@ -187,21 +168,30 @@ class FixedLenReader:
             corrupt_record_field=params.corrupt_record_column,
             diagnostics=ledger)
         if self._is_multisegment:
-            self._read_multiseg_result(result, data, backend,
-                                       first_record_id, ignore_file_size,
-                                       ledger, input_file_name)
+            with timed_stage(stage_times, "decode"):
+                self._read_multiseg_result(result, data, backend,
+                                           first_record_id,
+                                           ignore_file_size,
+                                           ledger, input_file_name)
             return result
         rem = self._policy_tail(data, ignore_file_size, input_file_name)
-        if rem == 0:
-            batch = self.decode_batch(data, backend, ignore_file_size)
-        else:
-            matrix, rec_lengths, reasons = self._matrix_with_tail(
-                data, rem, ledger, input_file_name)
+        with timed_stage(stage_times, "frame"):
+            if rem == 0:
+                matrix = self.to_record_matrix(data, ignore_file_size)
+                rec_lengths = None
+            else:
+                matrix, rec_lengths, reasons = self._matrix_with_tail(
+                    data, rem, ledger, input_file_name)
+                result.corrupt_row_reasons = reasons or None
             trimmed, width = self._trimmed_matrix(matrix)
-            lengths = np.minimum(
-                np.maximum(rec_lengths - self.params.start_offset, 0), width)
+            if rec_lengths is not None:
+                lengths = np.minimum(np.maximum(
+                    rec_lengths - self.params.start_offset, 0), width)
+            else:
+                lengths = (np.full(matrix.shape[0], width, dtype=np.int64)
+                           if width < self.copybook.record_size else None)
+        with timed_stage(stage_times, "decode"):
             batch = self.decoder(backend).decode(trimmed, lengths=lengths)
-            result.corrupt_row_reasons = reasons or None
         n = batch.n_records
         positions = np.arange(n, dtype=np.int64)
         result.n_rows = n

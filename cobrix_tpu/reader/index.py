@@ -14,6 +14,7 @@ and ships to the device as a `[batch, max_len]` block (SURVEY.md §2.5).
 from __future__ import annotations
 
 import logging
+import os
 from dataclasses import dataclass, replace
 from typing import List, Optional
 
@@ -42,6 +43,62 @@ class SparseIndexEntry:
     offset_to: int      # -1 = to end of file
     file_id: int
     record_index: int
+
+
+def file_index_entries(reader, file_path: str, file_order: int, params,
+                       retry=None, on_retry=None
+                       ) -> Optional[List[SparseIndexEntry]]:
+    """Sparse index for one file, or None when a single shard suffices —
+    the chunk-planning primitive shared by the threaded indexed scan, the
+    multi-host executor, and the chunked pipeline engine
+    (cobrix_tpu.engine.chunks). The vectorized RDW index is used when the
+    configuration allows it; otherwise the generic per-record generator
+    (the reference's only mode, IndexGenerator.scala:33) runs."""
+    from .parameters import DEFAULT_INDEX_ENTRY_SIZE_MB, MEGABYTE
+    from .stream import open_stream, path_scheme
+
+    explicit = (params.input_split_records is not None
+                or params.input_split_size_mb is not None)
+    split_mb = params.input_split_size_mb or DEFAULT_INDEX_ENTRY_SIZE_MB
+
+    def too_small(size: int) -> bool:
+        if size == 0:
+            return True  # nothing to index (and mmap rejects empty files)
+        # the whole file is one shard anyway
+        return not explicit and size <= split_mb * MEGABYTE
+
+    if path_scheme(file_path) in (None, "file"):
+        if too_small(os.path.getsize(file_path)):
+            return None
+        if reader.supports_fast_framing:
+            # mmap, not read(): the scan touches the whole file once to
+            # find split offsets; materializing it would spike RSS by the
+            # file size on exactly the large files indexing targets
+            import mmap
+
+            with open(file_path, "rb") as f:
+                mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+                try:
+                    entries = reader.generate_index_fast(mm, file_order)
+                finally:
+                    try:
+                        mm.close()
+                    except BufferError:
+                        # a FramingError in flight still references the
+                        # map through its traceback; closing here would
+                        # MASK that actionable error with a BufferError —
+                        # the map is released when the exception is
+                        pass
+            if entries is not None:
+                return entries
+        with open_stream(file_path) as stream:
+            return reader.generate_index(stream, file_order)
+    # registry-backed storage: one stream serves both the size probe and
+    # the index scan (a backend open is typically a network round trip)
+    with open_stream(file_path, retry=retry, on_retry=on_retry) as stream:
+        if too_small(stream.size()):
+            return None
+        return reader.generate_index(stream, file_order)
 
 
 def sparse_index_generator(file_id: int,

@@ -104,6 +104,27 @@ class ReaderParameters:
     io_retry_base_delay: float = 0.05   # seconds; doubles per attempt
     io_retry_max_delay: float = 2.0     # per-sleep cap, seconds
     io_retry_deadline: float = 30.0     # overall budget per read, seconds
+    # -- chunked pipeline executor (cobrix_tpu.engine) -------------------
+    # worker threads overlapping read -> frame -> decode -> Arrow assembly
+    # across chunks. 0 = today's sequential path (the safe fallback);
+    # < 0 = auto-size to the machine (min(8, cpu_count))
+    pipeline_workers: int = 0
+    # target chunk size for fixed-length byte strides AND the default
+    # sparse-index split size for variable-length reads when pipelining
+    # is on and no explicit input_split option is set (fractional MB
+    # accepted — tests force multi-chunk plans on tiny files)
+    pipeline_chunk_mb: float = 16.0
+    # backpressure bound: chunks concurrently held in flight (raw bytes +
+    # decoded columns). 0 = workers + 2
+    pipeline_max_inflight: int = 0
+
+    def resolved_pipeline_workers(self) -> int:
+        """Effective worker count: 0 = sequential, negative = auto."""
+        if self.pipeline_workers >= 0:
+            return self.pipeline_workers
+        import os
+
+        return min(8, os.cpu_count() or 1)
 
     @property
     def is_permissive(self) -> bool:
@@ -130,6 +151,18 @@ class ReaderParameters:
                     or self.variable_size_occurs or self.length_field_name
                     or self.record_extractor or self.file_start_offset > 0
                     or self.file_end_offset > 0)
+
+    @property
+    def supports_fast_framing(self) -> bool:
+        """True when whole-shard vectorized RDW framing applies (no custom
+        extractors/parsers, no text mode, no length fields, no variable
+        OCCURS) — also the gate for pipeline auto-splitting, where split
+        granularity is pinned row-identical by the indexed-scan tests."""
+        return bool(self.is_record_sequence
+                    and not (self.record_extractor
+                             or self.record_header_parser
+                             or self.is_text or self.length_field_name
+                             or self.variable_size_occurs))
 
     @property
     def needs_var_len_reader(self) -> bool:

@@ -208,12 +208,14 @@ class FileResult:
 
         from .arrow_out import arrow_schema, rows_to_table, segment_table
 
+        # a table assembled eagerly (pipeline engine's per-chunk assemble
+        # stage) serves any later call for the same schema directly
+        if self._arrow_cache is not None \
+                and self._arrow_cache_schema is output_schema:
+            return self._arrow_cache
         # prefer the kernel outputs even when rows were also materialized
         # (to_rows caching must not reroute to_arrow onto the row fallback)
         if not self.segments:
-            if self._arrow_cache is not None \
-                    and self._arrow_cache_schema is output_schema:
-                return self._arrow_cache
             if self.arrow_factory is not None:
                 table = self.arrow_factory(output_schema)
                 if table is not None:

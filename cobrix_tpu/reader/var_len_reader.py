@@ -21,8 +21,9 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..copybook.ast import Group, Primitive
-from ..copybook.copybook import Copybook, merge_copybooks, parse_copybook
-from ..encoding.codepages import resolve_code_page
+from ..copybook.copybook import Copybook
+from ..plan.cache import copybook_for_params, decoder_cache_for
+from ..profiling import timed_stage
 from .columnar import ColumnarDecoder, decoder_for_segment
 from .extractors import (
     DecodeOptions,
@@ -195,37 +196,17 @@ class VarLenReader:
     """Core variable-length reader bound to one copybook + parameters."""
 
     def __init__(self, copybook_contents, params: ReaderParameters):
-        if isinstance(copybook_contents, str):
-            contents_list = [copybook_contents]
-        else:
-            contents_list = list(copybook_contents)
         seg = params.multisegment
-        copybooks = [
-            parse_copybook(
-                c,
-                data_encoding=params.data_encoding,
-                drop_group_fillers=params.drop_group_fillers,
-                drop_value_fillers=params.drop_value_fillers,
-                segment_redefines=sorted(set(
-                    (seg.segment_id_redefine_map or {}).values())) if seg else (),
-                field_parent_map=dict(seg.field_parent_map) if seg else None,
-                string_trimming_policy=params.string_trimming_policy,
-                comment_policy=params.comment_policy,
-                ebcdic_code_page=resolve_code_page(
-                    params.ebcdic_code_page, params.ebcdic_code_page_class),
-                ascii_charset=params.ascii_charset,
-                is_utf16_big_endian=params.is_utf16_big_endian,
-                floating_point_format=params.floating_point_format,
-                non_terminals=params.non_terminals,
-                occurs_mappings=params.occurs_mappings,
-                debug_fields_policy=params.debug_fields_policy,
-            ) for c in contents_list]
-        self.copybook = (copybooks[0] if len(copybooks) == 1
-                         else merge_copybooks(copybooks))
+        # fingerprint-keyed parse cache (plan/cache.py): repeated scans of
+        # the same copybook/options share the Copybook object and its
+        # compiled plans/decoders — per-chunk pipeline decodes never
+        # re-derive them
+        self.copybook = copybook_for_params(copybook_contents, params)
         self.params = params
         self.segment_redefine_map = dict(
             seg.segment_id_redefine_map) if seg else {}
-        self._decoders: Dict[str, ColumnarDecoder] = {}
+        self._decoders: Dict[str, ColumnarDecoder] = \
+            decoder_cache_for(self.copybook)
         # variable-size OCCURS that shift later fields make the static
         # columnar plan inapplicable — those records decode on the host.
         # Walked over the whole record (all 01-level roots in one pass): a
@@ -582,7 +563,8 @@ class VarLenReader:
 
     def _hierarchical_columnar_setup(self, stream: SimpleStream,
                                      backend: str,
-                                     ledger=None) -> Optional[dict]:
+                                     ledger=None,
+                                     stage_times=None) -> Optional[dict]:
         """Frame + decode-once setup shared by the hierarchical row and
         Arrow paths. Returns None when the configuration needs the
         generic scalar path — every bail happens BEFORE framing consumes
@@ -600,7 +582,8 @@ class VarLenReader:
             # reference extractChildren) — the uniform decode_raw shift
             # cannot reproduce that
             return None
-        fast = self._frame_fast(stream, ledger=ledger)
+        fast = self._frame_fast(stream, ledger=ledger,
+                                stage_times=stage_times)
         if fast is None:
             return None
         data, _base, offsets, rec_lengths, segment_ids, _reasons = fast
@@ -624,9 +607,10 @@ class VarLenReader:
         # masked decode: each segment's numeric groups run only on its
         # own rows (hidden rows come back invalid, which the assembly and
         # the nesting walk treat exactly like the garbage they replace)
-        batch = (decoder.decode_raw(data, offsets, rec_lengths,
-                                    segment_row_masks=seg_masks) if n
-                 else None)
+        with timed_stage(stage_times, "decode"):
+            batch = (decoder.decode_raw(data, offsets, rec_lengths,
+                                        segment_row_masks=seg_masks) if n
+                     else None)
         root_uniq = np.asarray([nm in root_names for nm in uniq_named])
         n_roots = (int(root_uniq[segment_ids.codes].sum())
                    if len(uniq_named) else 0)
@@ -804,25 +788,25 @@ class VarLenReader:
         """True when whole-shard vectorized RDW framing applies (no custom
         extractors/parsers, no text mode, no length fields, no variable
         OCCURS)."""
-        p = self.params
-        return bool(p.is_record_sequence
-                    and not (p.record_extractor or p.record_header_parser
-                             or p.is_text or p.length_field_name
-                             or p.variable_size_occurs))
+        return self.params.supports_fast_framing
 
-    def _frame_fast(self, stream: SimpleStream, ledger=None):
+    def _frame_fast(self, stream: SimpleStream, ledger=None,
+                    stage_times=None):
         """Whole-shard RDW framing via the native scanner. Returns
         (data, base_offset, offsets, lengths, segment_ids, corrupt_reasons)
         or None when the configuration needs the generic per-record
         reader. `corrupt_reasons` maps kept malformed record positions to
-        reasons (permissive policy only; empty otherwise)."""
+        reasons (permissive policy only; empty otherwise). `stage_times`:
+        optional StageTimes — the bulk byte materialization is attributed
+        to "read", the header scan + segment-id decode to "frame"."""
         from .. import native
 
         if not self.supports_fast_framing:
             return None
         p = self.params
         base = stream.offset
-        data = stream.next_view(stream.size() - base)
+        with timed_stage(stage_times, "read"):
+            data = stream.next_view(stream.size() - base)
         adjustment = p.rdw_adjustment
         if p.is_rdw_part_of_record_length:
             adjustment -= 4
@@ -833,23 +817,25 @@ class VarLenReader:
         file_footer = (p.file_end_offset
                        if stream.size() >= stream.true_size else 0)
         corrupt_reasons: dict = {}
-        if p.is_permissive:
-            from .recovery import rdw_scan_permissive
+        with timed_stage(stage_times, "frame"):
+            if p.is_permissive:
+                from .recovery import rdw_scan_permissive
 
-            offsets, lengths, corrupt_reasons = rdw_scan_permissive(
-                data, p.is_rdw_big_endian, adjustment, file_header,
-                file_footer, p.record_error_policy, p.resync_window_bytes,
-                ledger if ledger is not None else p.new_diagnostics(),
-                file_name=stream.input_file_name, base_offset=base)
-        else:
-            offsets, lengths = native.rdw_scan(
-                data, p.is_rdw_big_endian, adjustment, file_header,
-                file_footer)
-        seg_field = resolve_segment_id_field(p, self.copybook)
-        segment_ids: Optional[List[str]] = None
-        if seg_field is not None:
-            segment_ids = self._segment_ids_vectorized(
-                data, offsets, lengths, seg_field)
+                offsets, lengths, corrupt_reasons = rdw_scan_permissive(
+                    data, p.is_rdw_big_endian, adjustment, file_header,
+                    file_footer, p.record_error_policy,
+                    p.resync_window_bytes,
+                    ledger if ledger is not None else p.new_diagnostics(),
+                    file_name=stream.input_file_name, base_offset=base)
+            else:
+                offsets, lengths = native.rdw_scan(
+                    data, p.is_rdw_big_endian, adjustment, file_header,
+                    file_footer)
+            seg_field = resolve_segment_id_field(p, self.copybook)
+            segment_ids: Optional[List[str]] = None
+            if seg_field is not None:
+                segment_ids = self._segment_ids_vectorized(
+                    data, offsets, lengths, seg_field)
         return data, base, offsets, lengths, segment_ids, corrupt_reasons
 
     def _segment_ids_vectorized(self, data, offsets, lengths,
@@ -985,10 +971,13 @@ class VarLenReader:
                              backend: str = "numpy",
                              segment_id_prefix: Optional[str] = None,
                              start_record_id: int = 0,
-                             starting_file_offset: int = 0) -> FileResult:
+                             starting_file_offset: int = 0,
+                             stage_times=None) -> FileResult:
         """Frame all records, pack per-active-segment padded batches, decode
         with the batched kernels; rows/Arrow are materialized lazily from
-        the FileResult."""
+        the FileResult. `stage_times`: optional profiling.StageTimes —
+        the pipeline engine passes it to attribute read/frame/decode busy
+        time."""
         params = self.params
         ledger = params.new_diagnostics() if params.is_permissive else None
         result = FileResult(
@@ -1011,8 +1000,9 @@ class VarLenReader:
             if (self.copybook.is_hierarchical
                     and not self.dynamic_occurs_layout
                     and not params.variable_size_occurs):
-                ctx = self._hierarchical_columnar_setup(stream, backend,
-                                                        ledger=ledger)
+                ctx = self._hierarchical_columnar_setup(
+                    stream, backend, ledger=ledger,
+                    stage_times=stage_times)
             if ctx is not None:
                 from .hierarchical_arrow import hierarchical_table
 
@@ -1040,13 +1030,16 @@ class VarLenReader:
             result.rows = rows
             result.n_rows = len(rows)
             return result
-        fast = self._frame_fast(stream, ledger=ledger)
+        fast = self._frame_fast(stream, ledger=ledger,
+                                stage_times=stage_times)
         if fast is not None:
             data, base, offsets, lengths, segment_ids, reasons = fast
-            self._read_result_fast(
-                result, data, base, offsets, lengths, segment_ids, file_id,
-                backend, segment_id_prefix or default_segment_id_prefix(),
-                start_record_id, corrupt_reasons=reasons)
+            with timed_stage(stage_times, "decode"):
+                self._read_result_fast(
+                    result, data, base, offsets, lengths, segment_ids,
+                    file_id, backend,
+                    segment_id_prefix or default_segment_id_prefix(),
+                    start_record_id, corrupt_reasons=reasons)
             return result
         seg = params.multisegment
         prefix = segment_id_prefix or default_segment_id_prefix()
@@ -1058,20 +1051,23 @@ class VarLenReader:
         framed = []   # (record_index, active_redefine, data, level_ids)
         record_reader = self.make_record_reader(
             stream, start_record_id, starting_file_offset, ledger)
-        while record_reader.has_next():
-            record_index = record_reader.record_index + 1
-            segment_id, data = next(record_reader)
-            level_ids: List[Optional[str]] = []
-            if level_count and accumulator is not None:
-                accumulator.acquired_segment_id(segment_id, record_index)
-                level_ids = [accumulator.get_segment_level_id(i)
-                             for i in range(level_count)]
-            if level_ids and level_ids[0] is None:
-                continue
-            if segment_filter is not None and segment_id not in segment_filter:
-                continue
-            active = self.segment_redefine_map.get(segment_id, "")
-            framed.append((record_index, active, data, level_ids))
+        with timed_stage(stage_times, "frame"):
+            while record_reader.has_next():
+                record_index = record_reader.record_index + 1
+                segment_id, data = next(record_reader)
+                level_ids: List[Optional[str]] = []
+                if level_count and accumulator is not None:
+                    accumulator.acquired_segment_id(segment_id,
+                                                    record_index)
+                    level_ids = [accumulator.get_segment_level_id(i)
+                                 for i in range(level_count)]
+                if level_ids and level_ids[0] is None:
+                    continue
+                if segment_filter is not None \
+                        and segment_id not in segment_filter:
+                    continue
+                active = self.segment_redefine_map.get(segment_id, "")
+                framed.append((record_index, active, data, level_ids))
         if record_reader.corrupt_reasons:
             # absolute record indices -> output positions of kept rows
             pos_of = {idx: pos for pos, (idx, _, _, _) in enumerate(framed)}
@@ -1086,25 +1082,29 @@ class VarLenReader:
             by_segment.setdefault(active, []).append(pos)
 
         result.n_rows = len(framed)
-        for active, positions in by_segment.items():
-            decoder = self._decoder_for_segment(active, backend)
-            # pack to the plan's byte extent, not the full record size —
-            # narrow segments of a wide copybook decode from narrow matrices
-            rs = decoder.plan.max_extent
-            batch = np.zeros((len(positions), rs), dtype=np.uint8)
-            lengths = np.zeros(len(positions), dtype=np.int64)
-            for row_i, pos in enumerate(positions):
-                payload = framed[pos][2][start: start + rs]
-                batch[row_i, :len(payload)] = np.frombuffer(payload, np.uint8)
-                lengths[row_i] = len(payload)
-            decoded = decoder.decode(batch, lengths=lengths)
-            has_levels = level_count > 0
-            result.segments.append(SegmentBatch(
-                decoded, active or None,
-                np.asarray(positions, dtype=np.int64),
-                np.asarray([framed[p][0] for p in positions], dtype=np.int64),
-                seg_level_ids=([framed[p][3] for p in positions]
-                               if has_levels else None)))
+        with timed_stage(stage_times, "decode"):
+            for active, positions in by_segment.items():
+                decoder = self._decoder_for_segment(active, backend)
+                # pack to the plan's byte extent, not the full record
+                # size — narrow segments of a wide copybook decode from
+                # narrow matrices
+                rs = decoder.plan.max_extent
+                batch = np.zeros((len(positions), rs), dtype=np.uint8)
+                lengths = np.zeros(len(positions), dtype=np.int64)
+                for row_i, pos in enumerate(positions):
+                    payload = framed[pos][2][start: start + rs]
+                    batch[row_i, :len(payload)] = np.frombuffer(payload,
+                                                                np.uint8)
+                    lengths[row_i] = len(payload)
+                decoded = decoder.decode(batch, lengths=lengths)
+                has_levels = level_count > 0
+                result.segments.append(SegmentBatch(
+                    decoded, active or None,
+                    np.asarray(positions, dtype=np.int64),
+                    np.asarray([framed[p][0] for p in positions],
+                               dtype=np.int64),
+                    seg_level_ids=([framed[p][3] for p in positions]
+                                   if has_levels else None)))
         return result
 
 

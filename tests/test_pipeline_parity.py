@@ -1,0 +1,230 @@
+"""Pipelined-vs-sequential parity: the chunked execution engine must be
+execution-only — rows, Arrow tables (schema metadata included), and error
+ledgers byte-identical to the sequential path across record formats ×
+record_error_policies, including corruption planted at chunk boundaries.
+"""
+import numpy as np
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.reader.diagnostics import CorruptRecordInfo, ReadDiagnostics
+from cobrix_tpu.testing import faults
+from cobrix_tpu.testing.generators import (
+    EXP1_COPYBOOK,
+    EXP2_COPYBOOK,
+    generate_exp1,
+    generate_exp2,
+)
+
+POLICIES = ["fail_fast", "permissive", "drop_malformed"]
+
+# variable-length via a record length FIELD (no RDW): 3 EBCDIC digit
+# bytes carry the total record length, then a fixed payload
+LENGTH_FIELD_COPYBOOK = """
+       01  REC.
+           05  REC-LEN     PIC 9(3).
+           05  PAYLOAD     PIC X(10).
+"""
+
+
+def _ebcdic_digits(value: int, width: int) -> bytes:
+    return bytes(0xF0 + int(d) for d in str(value).zfill(width))
+
+
+def generate_length_field(n: int, seed: int = 0) -> bytes:
+    rng = np.random.default_rng(seed)
+    out = bytearray()
+    for i in range(n):
+        payload = bytes(0xC1 + int(b) for b in rng.integers(0, 9, 10))
+        out += _ebcdic_digits(3 + len(payload), 3) + payload
+    return bytes(out)
+
+
+def _assert_same(tmp_path, data: bytes, base_kw: dict, pipe_kw: dict,
+                 corrupt: bool = False):
+    """Both modes agree on rows, Arrow bytes, metadata, and ledgers — or
+    both raise (fail_fast over corrupt input)."""
+    p = tmp_path / "data.dat"
+    p.write_bytes(data)
+    policy = base_kw.get("record_error_policy", "fail_fast")
+    if corrupt and policy == "fail_fast":
+        with pytest.raises(Exception):
+            read_cobol(str(p), **base_kw).to_arrow()
+        with pytest.raises(Exception):
+            read_cobol(str(p), **base_kw, **pipe_kw).to_arrow()
+        return
+    seq = read_cobol(str(p), **base_kw)
+    pipe = read_cobol(str(p), **base_kw, **pipe_kw)
+    assert pipe.metrics.pipeline is not None, \
+        "pipeline did not engage (check knobs/chunk plan)"
+    assert seq.to_rows() == pipe.to_rows()
+    ts, tp = seq.to_arrow(), pipe.to_arrow()
+    assert ts.equals(tp)
+    assert ts.schema.metadata == tp.schema.metadata  # ledger JSON included
+    if seq.diagnostics is not None or pipe.diagnostics is not None:
+        assert seq.diagnostics.as_dict() == pipe.diagnostics.as_dict()
+
+
+# -- fixed-length ----------------------------------------------------------
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_fixed_parity_clean(tmp_path, policy):
+    data = generate_exp1(400, seed=11).tobytes()
+    _assert_same(tmp_path, data,
+                 dict(copybook_contents=EXP1_COPYBOOK,
+                      record_error_policy=policy),
+                 dict(pipeline_workers="4", chunk_size_mb="0.05"))
+
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_fixed_parity_torn_tail(tmp_path, policy):
+    """A truncated trailing record (the classic torn fixed-length tail)."""
+    data = faults.truncate(generate_exp1(400, seed=12).tobytes(), 400 * 1493 - 700)
+    _assert_same(tmp_path, data,
+                 dict(copybook_contents=EXP1_COPYBOOK,
+                      record_error_policy=policy),
+                 dict(pipeline_workers="4", chunk_size_mb="0.05"),
+                 corrupt=True)
+
+
+def test_fixed_parity_multifile(tmp_path):
+    """Chunks spanning several files keep per-file Record_Id bases."""
+    d = tmp_path / "in"
+    d.mkdir()
+    for i in range(3):
+        (d / f"f{i}.dat").write_bytes(
+            generate_exp1(120 + i, seed=20 + i).tobytes())
+    kw = dict(copybook_contents=EXP1_COPYBOOK, generate_record_id="true")
+    seq = read_cobol(str(d), **kw)
+    pipe = read_cobol(str(d), pipeline_workers="3", chunk_size_mb="0.05",
+                      **kw)
+    assert seq.to_rows() == pipe.to_rows()
+    assert seq.to_arrow().equals(pipe.to_arrow())
+
+
+# -- VRL (RDW) multisegment ------------------------------------------------
+
+EXP2_KW = dict(copybook_contents=EXP2_COPYBOOK, is_record_sequence="true",
+               segment_field="SEGMENT-ID",
+               redefine_segment_id_map="STATIC-DETAILS => C",
+               redefine_segment_id_map_1="CONTACTS => P",
+               segment_id_prefix="PAR")
+
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_vrl_parity_clean(tmp_path, policy):
+    raw = generate_exp2(2500, seed=13)
+    _assert_same(tmp_path, raw,
+                 dict(EXP2_KW, record_error_policy=policy,
+                      input_split_records="600"),
+                 dict(pipeline_workers="3"))
+
+
+@pytest.mark.parametrize("policy", ["permissive", "drop_malformed"])
+def test_vrl_parity_chunk_boundary_corruption(tmp_path, policy):
+    """A zeroed RDW header planted exactly at a sparse-index split
+    boundary: the shard framer starting THERE must resynchronize exactly
+    like the sequential scan did."""
+    raw = generate_exp2(2500, seed=14)
+    starts = faults.rdw_record_starts(raw)
+    # splits land every 600 records -> corrupt the boundary record itself
+    bad = faults.zero_rdw(raw, starts[600])
+    _assert_same(tmp_path, bad,
+                 dict(EXP2_KW, record_error_policy=policy,
+                      input_split_records="600"),
+                 dict(pipeline_workers="3"),
+                 corrupt=True)
+
+
+def test_vrl_parity_with_seg_ids(tmp_path):
+    """Seg_Id0/1 generation across shard restarts (root-aligned splits)."""
+    raw = generate_exp2(2500, seed=15)
+    _assert_same(tmp_path, raw,
+                 dict(EXP2_KW, segment_id_level0="C", segment_id_level1="P",
+                      input_split_records="700"),
+                 dict(pipeline_workers="3"))
+
+
+def test_vrl_pipeline_auto_split_matches_unsplit(tmp_path):
+    """Pipelining on a plain RDW read auto-splits by chunk_size_mb; the
+    result must still match a sequential read with NO splits at all (the
+    indexed-scan row-identity invariant)."""
+    raw = generate_exp2(40000, seed=16)  # ~2.6 MB
+    p = tmp_path / "auto.dat"
+    p.write_bytes(raw)
+    seq = read_cobol(str(p), **EXP2_KW)
+    pipe = read_cobol(str(p), pipeline_workers="3", chunk_size_mb="1",
+                      **EXP2_KW)
+    assert pipe.metrics.shards > 1, "auto-split did not engage"
+    assert seq.to_rows() == pipe.to_rows()
+    assert seq.to_arrow().equals(pipe.to_arrow())
+
+
+# -- variable-length via record length field -------------------------------
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_length_field_parity(tmp_path, policy):
+    raw = generate_length_field(800, seed=17)
+    _assert_same(tmp_path, raw,
+                 dict(copybook_contents=LENGTH_FIELD_COPYBOOK,
+                      record_length_field="REC-LEN",
+                      record_error_policy=policy,
+                      input_split_records="200"),
+                 dict(pipeline_workers="3"))
+
+
+# -- determinism of the merged ledger --------------------------------------
+
+def test_merged_ledger_sorts_and_caps_deterministically():
+    def entry(f, off, idx=None):
+        return CorruptRecordInfo(f, off, 4, "r", "00", record_index=idx)
+
+    a = ReadDiagnostics(corrupt_records=2, bytes_skipped=8, resyncs=2,
+                        entries=[entry("b.dat", 30), entry("b.dat", 10)])
+    b = ReadDiagnostics(corrupt_records=2, records_dropped=1,
+                        entries=[entry("a.dat", 50, 2), entry("a.dat", 50)])
+    m1 = ReadDiagnostics.merged([a, b], max_entries=3)
+    m2 = ReadDiagnostics.merged([b, a], max_entries=3)  # shard order flip
+    assert m1.as_dict() == m2.as_dict()
+    assert [(e.file, e.offset, e.record_index) for e in m1.entries] == [
+        ("a.dat", 50, None), ("a.dat", 50, 2), ("b.dat", 10, None)]
+    assert m1.corrupt_records == 4 and m1.entries_truncated
+
+
+# -- compile caches --------------------------------------------------------
+
+def test_plan_cache_hits_across_reads(tmp_path):
+    p = tmp_path / "c.dat"
+    p.write_bytes(generate_exp1(16, seed=18).tobytes())
+    read_cobol(str(p), copybook_contents=EXP1_COPYBOOK).to_arrow()
+    again = read_cobol(str(p), copybook_contents=EXP1_COPYBOOK)
+    again.to_arrow()
+    stats = again.metrics.as_dict()["plan_cache"]
+    assert stats["parse_hits"] >= 1       # copybook reused, not reparsed
+    assert stats["decoder_hits"] >= 1     # compiled decoder (plan) reused
+    assert stats["parse_misses"] == 0
+
+
+# -- pipecheck smoke (the long sweep stays behind the slow marker) ---------
+
+def test_pipecheck_quick(tmp_path):
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/pipecheck.py", "--mb", "1", "--records",
+         "400"],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_pipecheck_sweep():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/pipecheck.py", "--mb", "8", "--sweep"],
+        capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,127 @@
+"""Pipeline smoke check: generate -> scan pipelined vs sequential -> diff.
+
+Verifies the chunked pipeline executor (cobrix_tpu.engine) end to end on
+the two bench profiles — exp1 fixed-length and exp2 RDW multisegment —
+asserting row- and Arrow-identical output, then prints a timing table
+with the per-stage busy breakdown so a pipeline win (or regression) is
+visible at a glance.
+
+    python tools/pipecheck.py                 # quick: ~8 MB per profile
+    python tools/pipecheck.py --mb 64         # bigger inputs
+    python tools/pipecheck.py --records 400   # tiny record-count mode
+    python tools/pipecheck.py --sweep         # worker x chunk-size grid
+                                              # (slow; tier-1 runs quick)
+
+Exit code 0 = outputs identical everywhere; 1 = any mismatch.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _profiles(mb: float, records: int | None):
+    from cobrix_tpu.testing.generators import (
+        EXP1_COPYBOOK,
+        EXP2_COPYBOOK,
+        generate_exp1,
+        generate_exp2,
+    )
+
+    n1 = records or max(64, int(mb * 1024 * 1024) // 1493)
+    n2 = records or max(1000, int(mb * 1024 * 1024 / 66))
+    return [
+        ("exp1_fixed", generate_exp1(n1, seed=7).tobytes(),
+         dict(copybook_contents=EXP1_COPYBOOK)),
+        ("exp2_rdw", generate_exp2(n2, seed=7),
+         dict(copybook_contents=EXP2_COPYBOOK, is_record_sequence="true",
+              segment_field="SEGMENT-ID",
+              redefine_segment_id_map="STATIC-DETAILS => C",
+              redefine_segment_id_map_1="CONTACTS => P",
+              segment_id_prefix="PIPE")),
+    ]
+
+
+def _timed_read(path: str, kw: dict, runs: int = 2):
+    from cobrix_tpu import read_cobol
+
+    read_cobol(path, **kw).to_arrow()  # warmup (compile caches, page cache)
+    best, out, table = None, None, None
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = read_cobol(path, **kw)
+        table = out.to_arrow()
+        dt = time.perf_counter() - t0
+        if best is None or dt < best:
+            best = dt
+    return best, out, table
+
+
+def check_profile(name: str, data: bytes, kw: dict, workers: str,
+                  chunk_mb: str, runs: int = 2) -> bool:
+    mb = len(data) / (1024 * 1024)
+    with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+        f.write(data)
+        path = f.name
+    try:
+        seq_s, seq, seq_t = _timed_read(path, kw, runs)
+        pipe_kw = dict(kw, pipeline_workers=workers, chunk_size_mb=chunk_mb)
+        pipe_s, pipe, pipe_t = _timed_read(path, pipe_kw, runs)
+        rows_ok = seq.to_rows() == pipe.to_rows()
+        arrow_ok = seq_t.equals(pipe_t)
+        meta_ok = seq_t.schema.metadata == pipe_t.schema.metadata
+        md = pipe.metrics.as_dict()
+        stages = md.get("stage_busy_s") or {}
+        rep = md.get("pipeline") or {}
+        print(f"{name:<12} {mb:7.1f} MB | seq {mb / seq_s:7.1f} MB/s | "
+              f"pipe {mb / pipe_s:7.1f} MB/s | on/off "
+              f"{seq_s / pipe_s:5.2f}x | chunks {rep.get('chunks', '-'):>3} "
+              f"overlap {rep.get('overlap', '-')}")
+        busy = " ".join(f"{k}={v:.3f}s" for k, v in stages.items())
+        print(f"{'':<12} stages: {busy}")
+        status = "identical" if (rows_ok and arrow_ok and meta_ok) else (
+            f"MISMATCH rows={rows_ok} arrow={arrow_ok} metadata={meta_ok}")
+        print(f"{'':<12} output: {status}")
+        return rows_ok and arrow_ok and meta_ok
+    finally:
+        os.unlink(path)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mb", type=float, default=8.0,
+                    help="approx input size per profile (MB)")
+    ap.add_argument("--records", type=int, default=None,
+                    help="exact record count (overrides --mb; tiny runs)")
+    ap.add_argument("--workers", default="-1",
+                    help="pipeline_workers for the pipelined read")
+    ap.add_argument("--chunk-mb", default=None,
+                    help="chunk_size_mb (default: sized to ~6 chunks)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run a worker x chunk-size grid (slow)")
+    args = ap.parse_args()
+
+    chunk_default = args.chunk_mb or str(max(0.01, round(args.mb / 6, 3)))
+    ok = True
+    for name, data, kw in _profiles(args.mb, args.records):
+        if args.sweep:
+            for w in ("1", "2", "4"):
+                for c in (str(max(0.01, round(args.mb / 12, 3))),
+                          chunk_default,
+                          str(max(0.02, round(args.mb / 3, 3)))):
+                    print(f"--- {name} workers={w} chunk_size_mb={c}")
+                    ok &= check_profile(name, data, kw, w, c, runs=1)
+        else:
+            ok &= check_profile(name, data, kw, args.workers, chunk_default)
+    print("OK: pipelined output identical to sequential" if ok
+          else "FAILED: pipelined output diverged")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
