@@ -8,6 +8,8 @@ byte-transcoding kernels over `[batch, record_len]` uint8 arrays.
 """
 from .api import CobolData, read_cobol
 from .copybook.copybook import Copybook, merge_copybooks, parse_copybook
+from .reader.diagnostics import (ReadDiagnostics, RecordErrorPolicy,
+                                 ShardErrorPolicy, ShardFailureInfo)
 from .reader.handlers import (DictHandler, JsonHandler, RecordHandler,
                               TupleHandler)
 from .profiling import ReadMetrics, profile_trace
@@ -47,4 +49,8 @@ __all__ = [
     "register_stream_backend",
     "ReadMetrics",
     "profile_trace",
+    "ReadDiagnostics",
+    "RecordErrorPolicy",
+    "ShardErrorPolicy",
+    "ShardFailureInfo",
 ]

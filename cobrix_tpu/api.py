@@ -29,6 +29,8 @@ from .reader.diagnostics import (
     DEFAULT_RESYNC_WINDOW,
     ReadDiagnostics,
     RecordErrorPolicy,
+    ShardErrorPolicy,
+    ShardFailureInfo,
 )
 from .reader.fixed_len_reader import FixedLenReader
 from .reader.json_out import rows_to_json
@@ -272,6 +274,15 @@ def parse_options(options: Dict[str, object],
         pipeline_workers=opts.get_int("pipeline_workers", 0),
         pipeline_chunk_mb=float(opts.get("chunk_size_mb", "") or 16.0),
         pipeline_max_inflight=opts.get_int("max_inflight_chunks", 0),
+        shard_error_policy=ShardErrorPolicy.parse(
+            opts.get("shard_error_policy", "fail_fast")),
+        shard_timeout_s=float(opts.get("shard_timeout_s", "") or 0.0),
+        shard_max_retries=opts.get_int("shard_max_retries", 2),
+        speculative_quantile=float(
+            opts.get("speculative_quantile", "") or 0.0),
+        scan_deadline_s=float(opts.get("scan_deadline_s", "") or 0.0),
+        heartbeat_interval_s=float(
+            opts.get("heartbeat_interval_s", "") or 0.5),
     )
     # recognized keys consumed later by read_cobol — mark used before the
     # pedantic unused-key audit runs
@@ -339,6 +350,28 @@ def _validate_options(opts: Options, params: ReaderParameters,
             f"Invalid 'max_inflight_chunks' of "
             f"{params.pipeline_max_inflight}; it must be >= 0 "
             "(0 sizes it from the worker count).")
+    if params.shard_timeout_s < 0:
+        raise ValueError(
+            f"Invalid 'shard_timeout_s' of {params.shard_timeout_s}; "
+            "it must be >= 0 (0 disables the per-shard deadline).")
+    if params.scan_deadline_s < 0:
+        raise ValueError(
+            f"Invalid 'scan_deadline_s' of {params.scan_deadline_s}; "
+            "it must be >= 0 (0 disables the whole-scan deadline).")
+    if params.shard_max_retries < 0:
+        raise ValueError(
+            f"Invalid 'shard_max_retries' of {params.shard_max_retries}; "
+            "it must be >= 0 (0 means a failed shard is never "
+            "re-dispatched).")
+    if not 0.0 <= params.speculative_quantile < 1.0:
+        raise ValueError(
+            f"Invalid 'speculative_quantile' of "
+            f"{params.speculative_quantile}; it must be in [0, 1) "
+            "(0 disables straggler speculation).")
+    if params.heartbeat_interval_s <= 0:
+        raise ValueError(
+            f"Invalid 'heartbeat_interval_s' of "
+            f"{params.heartbeat_interval_s}; it must be positive.")
     seg = params.multisegment
     if seg and seg.field_parent_map and seg.segment_level_ids:
         raise ValueError(
@@ -683,6 +716,9 @@ def read_cobol(path=None,
 
     retry = _retry_policy(params)
     retries_seen: List[int] = []  # list.append is GIL-atomic across shards
+    # chunks the supervised pipeline gave up on (partial policy only;
+    # fail_fast raises from inside the executor instead)
+    shard_failures: List[ShardFailureInfo] = []
 
     def on_retry():
         retries_seen.append(1)
@@ -720,10 +756,12 @@ def read_cobol(path=None,
                     shards = _plan_var_len_shards(reader, files, params,
                                                   retry, on_retry)
                 metrics.shards = len(shards)
-                results = pipelined_var_len_scan(
+                results, failed = pipelined_var_len_scan(
                     reader, shards, params, backend, prefix, schema,
                     pipe_workers, metrics=metrics, retry=retry,
                     on_retry=on_retry)
+                shard_failures.extend(failed)
+                results = [r for r in results if r is not None]
             else:
                 results = _scan_var_len(reader, files, params, backend,
                                         prefix, parallelism,
@@ -732,10 +770,12 @@ def read_cobol(path=None,
         elif use_pipeline:
             from .engine.pipeline import pipelined_fixed_scan
 
-            results = pipelined_fixed_scan(
+            results, failed = pipelined_fixed_scan(
                 reader, files, params, backend, schema, pipe_workers,
                 ignore_file_size=debug_ignore_file_size, metrics=metrics,
                 retry=retry, on_retry=on_retry)
+            shard_failures.extend(failed)
+            results = [r for r in results if r is not None]
         else:
             for file_order, file_path in enumerate(files):
                 base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
@@ -764,25 +804,32 @@ def read_cobol(path=None,
 
     data = CobolData.from_results(results, schema, parallelism=parallelism)
     data.diagnostics = _aggregate_diagnostics(params, results,
-                                              len(retries_seen))
+                                              len(retries_seen),
+                                              shard_failures)
     metrics.finalize(data, len(results))
     return data
 
 
 def _aggregate_diagnostics(params: ReaderParameters,
                            results: List["FileResult"],
-                           io_retries: int) -> Optional[ReadDiagnostics]:
+                           io_retries: int,
+                           shard_failures: Sequence[ShardFailureInfo] = (),
+                           ) -> Optional[ReadDiagnostics]:
     """Merge per-file/shard ledgers into the read-level ledger. None under
-    fail_fast with no IO incidents (the read either succeeded cleanly or
-    raised). Deterministic: entries sort by (file, offset) with stable
-    cap truncation (ReadDiagnostics.merged), so sequential, threaded, and
-    pipelined scans over the same bytes produce byte-identical ledgers."""
-    if not params.is_permissive and io_retries == 0:
+    fail_fast with no IO incidents and no lost shards (the read either
+    succeeded cleanly or raised). Deterministic: entries sort by
+    (file, offset) with stable cap truncation (ReadDiagnostics.merged),
+    so sequential, threaded, and pipelined scans over the same bytes
+    produce byte-identical ledgers."""
+    if (not params.is_permissive and io_retries == 0
+            and not shard_failures):
         return None
     merged = ReadDiagnostics.merged(
         (getattr(r, "diagnostics", None) for r in results),
         max_entries=params.max_corrupt_ledger_entries)
     merged.io_retries += io_retries
+    for failure in shard_failures:
+        merged.record_shard_failure(failure)
     return merged
 
 
@@ -879,9 +926,11 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
         segment_id_prefix="",
         corrupt_record_field=params.corrupt_record_column)
     with stage(metrics, "scan"):
-        tables = multihost_scan(reader, shards, is_var_len, schema, hosts,
-                                prefix,
-                                ignore_file_size=debug_ignore_file_size)
+        tables, shard_failures, supervision = multihost_scan(
+            reader, shards, is_var_len, schema, hosts, prefix,
+            ignore_file_size=debug_ignore_file_size)
+    if metrics is not None:
+        metrics.supervision = supervision
     # merge the per-shard ledgers the workers shipped back as IPC schema
     # metadata (stripped here so shard keys don't leak into — or break
     # concatenation of — the unified table); shard order is canonical, so
@@ -901,8 +950,13 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
         cleaned.append(table)
     diagnostics = ReadDiagnostics.merged(
         shard_ledgers, max_entries=params.max_corrupt_ledger_entries)
+    # shards the supervisor gave up on (partial policy): the rows are
+    # missing from the output — say so on the read's ledger
+    for failure in shard_failures:
+        diagnostics.record_shard_failure(failure)
     data = CobolData.from_arrow_tables(cleaned, schema)
-    data.diagnostics = (diagnostics if params.is_permissive or found
+    data.diagnostics = (diagnostics
+                        if params.is_permissive or found or shard_failures
                         else None)
     if metrics is not None:
         metrics.finalize(data, len(shards))

@@ -22,6 +22,17 @@ RecordBatches concatenate exactly like the sequential scan's, and
 per-chunk error ledgers merge in offset order downstream
 (ReadDiagnostics.merged).
 
+Supervision (the same discipline as the multi-host scheduler in
+parallel/supervisor.py): every queue wait and join is bounded; the run
+loop doubles as a watchdog enforcing the per-chunk deadline
+(`shard_timeout_s`), the whole-scan deadline (`scan_deadline_s`), and a
+no-progress stall limit; a chunk whose stage raises is re-queued once
+(`crash-of-one-worker -> re-queue-chunk-once`); a worker thread wedged
+past the chunk deadline is abandoned (its late result is discarded) and
+a replacement thread restores pool capacity. Under
+`shard_error_policy='partial'` an unrecoverable chunk becomes a
+ShardFailureInfo ledger entry instead of aborting the scan.
+
 Per-stage busy time (read/frame/decode/assemble) accumulates in a shared
 `profiling.StageTimes`; the executor reports wall time, busy total, their
 ratio (the overlap factor), and the peak queue depth so a pipeline win is
@@ -32,11 +43,29 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from typing import Callable, List, Optional, Sequence, Tuple
 
-from ..profiling import ReadMetrics, StageTimes, timed_stage
+from ..profiling import ReadMetrics, StageTimes
+from ..reader.diagnostics import ShardErrorPolicy, ShardFailureInfo
 from ..reader.stream import RetryPolicy, open_stream
 from .chunks import FixedChunk, plan_fixed_chunks
+
+# poll tick bounding every queue wait in the pipeline (so cancellation is
+# cooperative and no thread ever blocks indefinitely)
+_TICK_S = 0.1
+# grace given to stage threads to exit after a stop/abort before they are
+# declared stuck (they are daemons — a wedged stage cannot hang exit)
+_JOIN_GRACE_S = 2.0
+# catch-all stall limit when no explicit deadlines are configured: if NO
+# chunk makes progress for this long the run aborts naming the stuck
+# stage instead of hanging CI
+DEFAULT_STALL_TIMEOUT_S = 300.0
+
+
+class PipelineTimeoutError(RuntimeError):
+    """A chunk or the whole scan exceeded its deadline (or the pipeline
+    stalled with a stage stuck); the message names the stage."""
 
 
 def _cap_omp_width(workers: int) -> None:
@@ -54,7 +83,8 @@ def _cap_omp_width(workers: int) -> None:
 
 
 class PipelineExecutor:
-    """Bounded-thread chunk pipeline with backpressure and ordered output.
+    """Bounded-thread chunk pipeline with backpressure, ordered output,
+    and watchdog supervision.
 
     `run(tasks)` takes (read_fn, process_fn[, finalize_fn]) tuples:
 
@@ -69,124 +99,306 @@ class PipelineExecutor:
       released) scale — so the shape that wins is a decode pool overlapped
       with a single assembler, not symmetric workers doing everything.
 
-    Results return in task order regardless of completion order.
+    Results return in task order regardless of completion order. A chunk
+    whose read/process raises is re-queued once before counting as
+    failed; failure then aborts (fail_fast) or ledgers the chunk in
+    `shard_failures` and continues (partial).
     """
 
     def __init__(self, workers: int, max_inflight: int = 0,
-                 stage_times: Optional[StageTimes] = None):
+                 stage_times: Optional[StageTimes] = None,
+                 chunk_timeout_s: float = 0.0,
+                 scan_deadline_s: float = 0.0,
+                 error_policy: ShardErrorPolicy = ShardErrorPolicy.FAIL_FAST,
+                 chunk_retries: int = 1,
+                 stall_timeout_s: float = DEFAULT_STALL_TIMEOUT_S,
+                 failure_info: Optional[Callable] = None):
         self.workers = max(1, workers)
         self.max_inflight = max_inflight if max_inflight > 0 \
             else self.workers + 2
         self.stage_times = stage_times if stage_times is not None \
             else StageTimes()
+        self.chunk_timeout_s = chunk_timeout_s
+        self.scan_deadline_s = scan_deadline_s
+        self.error_policy = error_policy
+        self.chunk_retries = max(0, chunk_retries)
+        self.stall_timeout_s = stall_timeout_s
+        # failure_info(index, attempts, reason, error) -> ShardFailureInfo
+        self.failure_info = failure_info or _default_failure_info
+        self.shard_failures: List[ShardFailureInfo] = []
         self.report: dict = {}
 
     def run(self, tasks: Sequence[tuple]) -> List[object]:
         n = len(tasks)
         results: List[object] = [None] * n
         if n == 0:
+            self.report = {"workers": self.workers, "chunks": 0,
+                           "max_inflight": self.max_inflight,
+                           "peak_queue": 0, "wall_s": 0.0, "busy_s": 0.0,
+                           "overlap": 0.0}
             return results
         has_finalize = any(len(t) > 2 and t[2] is not None for t in tasks)
-        t_start = time.perf_counter()
+        t_start = time.monotonic()
+        scan_deadline = (t_start + self.scan_deadline_s
+                         if self.scan_deadline_s > 0 else None)
         q: "queue.Queue" = queue.Queue(maxsize=self.max_inflight)
         # decoded chunks waiting for the assembler; bounded so decode
         # cannot balloon RSS ahead of a slow assembly stage
         fq: "queue.Queue" = queue.Queue(maxsize=self.max_inflight)
-        stop = threading.Event()
+        retry_dq: "deque" = deque()   # failed-once chunks; workers re-read
+        stop = threading.Event()      # cooperative cancel: drain and exit
+        lock = threading.Lock()
+        # chunk states: 'pending' -> 'running' -> 'decoded' -> 'done'
+        #               (terminal: 'done' | 'failed')
+        state = ["pending"] * n
+        attempts = [0] * n
+        # in-flight stage per chunk: i -> (stage_name, start_monotonic)
+        inflight: dict = {}
         errors: List[Tuple[int, BaseException]] = []
-        err_lock = threading.Lock()
+        counters = {"chunk_retries": 0, "chunks_failed": 0,
+                    "chunk_timeouts": 0, "respawned_workers": 0}
+        progress_t = [time.monotonic()]
         peak_queue = [0]
 
-        def fail(index: int, exc: BaseException) -> None:
-            with err_lock:
-                errors.append((index, exc))
-            stop.set()
+        def touch() -> None:
+            progress_t[0] = time.monotonic()
+
+        def terminal(i: int) -> bool:
+            return state[i] in ("done", "failed")
+
+        def fail_chunk(i: int, reason: str, exc: BaseException) -> None:
+            """Retry budget exhausted (or hard abort) for chunk i."""
+            with lock:
+                if terminal(i):
+                    return
+                state[i] = "failed"
+                inflight.pop(i, None)
+                counters["chunks_failed"] += 1
+                if self.error_policy.is_partial:
+                    self.shard_failures.append(self.failure_info(
+                        i, attempts[i], reason,
+                        f"{type(exc).__name__}: {exc}"))
+                else:
+                    errors.append((i, exc))
+                    stop.set()
+            touch()
+
+        def attempt_failed(i: int, reason: str,
+                           exc: BaseException) -> None:
+            requeue = False
+            with lock:
+                if terminal(i):
+                    return
+                inflight.pop(i, None)
+                if (attempts[i] <= self.chunk_retries
+                        and not stop.is_set()):
+                    state[i] = "pending"
+                    counters["chunk_retries"] += 1
+                    requeue = True
+            if requeue:
+                retry_dq.append((i, tasks[i]))
+                touch()
+            else:
+                fail_chunk(i, reason, exc)
+
+        def chunk_decoded(i: int, result: object, finalize_fn) -> bool:
+            """Record a finished decode; False if the chunk was already
+            terminal (late result from an abandoned worker — discard)."""
+            with lock:
+                if terminal(i) or stop.is_set():
+                    return False
+                results[i] = result
+                if has_finalize and finalize_fn is not None:
+                    state[i] = "decoded"
+                else:
+                    state[i] = "done"
+                    inflight.pop(i, None)
+            touch()
+            return True
+
+        def bounded_put(dst: "queue.Queue", item) -> bool:
+            while not stop.is_set():
+                try:
+                    dst.put(item, timeout=_TICK_S)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def run_read(i: int, task) -> object:
+            with lock:
+                if terminal(i):
+                    return None
+                attempts[i] += 1
+                state[i] = "running"
+                inflight[i] = ("read", time.monotonic())
+            with self.stage_times.timed("read"):
+                return task[0]()
 
         def reader_loop() -> None:
+            for i, task in enumerate(tasks):
+                if stop.is_set():
+                    break
+                try:
+                    payload = run_read(i, task)
+                except BaseException as exc:
+                    attempt_failed(i, "error", exc)
+                    continue
+                with lock:
+                    if terminal(i):
+                        _close_payload(payload)
+                        continue
+                    inflight[i] = ("queued", time.monotonic())
+                # blocks (bounded) when max_inflight chunks are already
+                # queued or being processed — the backpressure valve
+                if not bounded_put(q, (i, task, payload)):
+                    _close_payload(payload)
+                    return
+                touch()
+                depth = q.qsize()
+                if depth > peak_queue[0]:
+                    peak_queue[0] = depth
+
+        workers_exit = threading.Event()
+
+        def next_item():
+            """A retry first (unbounded deque — a full queue must never
+            deadlock a re-dispatch), else a queued chunk, else None."""
             try:
-                for i, task in enumerate(tasks):
-                    if stop.is_set():
-                        break
-                    try:
-                        with self.stage_times.timed("read"):
-                            payload = task[0]()
-                    except BaseException as exc:
-                        fail(i, exc)
-                        break
-                    # blocks when max_inflight chunks are already queued
-                    # or being processed — the backpressure bound
-                    q.put((i, task, payload))
-                    depth = q.qsize()
-                    if depth > peak_queue[0]:
-                        peak_queue[0] = depth
-            finally:
-                for _ in range(self.workers):
-                    q.put(None)
+                i, task = retry_dq.popleft()
+                return ("retry", i, task, None)
+            except IndexError:
+                pass
+            try:
+                i, task, payload = q.get(timeout=_TICK_S)
+                return ("fresh", i, task, payload)
+            except queue.Empty:
+                return None
 
         def worker_loop() -> None:
             _cap_omp_width(self.workers)
-            while True:
-                item = q.get()
+            while not workers_exit.is_set():
+                item = next_item()
                 if item is None:
-                    return
-                i, task, payload = item
-                if stop.is_set():
+                    continue
+                kind, i, task, payload = item
+                if stop.is_set() or terminal(i):
                     # drain so the reader can unblock; payloads may be
                     # OPEN resources (var-len chunks carry streams whose
-                    # close normally happens in process_fn) — release
-                    # them or a failed read leaks one fd per chunk
-                    close = getattr(payload, "close", None)
-                    if close is not None:
-                        try:
-                            close()
-                        except Exception:
-                            pass
+                    # close normally happens in process_fn)
+                    _close_payload(payload)
                     continue
                 try:
+                    if kind == "retry":
+                        # the original payload is consumed/closed; the
+                        # re-dispatched attempt re-reads on this thread
+                        payload = run_read(i, task)
+                    with lock:
+                        if terminal(i):
+                            _close_payload(payload)
+                            continue
+                        inflight[i] = ("decode", time.monotonic())
                     result = task[1](payload)
-                    results[i] = result
-                    if has_finalize:
-                        finalize_fn = task[2] if len(task) > 2 else None
-                        fq.put((i, finalize_fn, result))
-                        depth = fq.qsize()
-                        if depth > peak_queue[0]:
-                            peak_queue[0] = depth
                 except BaseException as exc:
-                    fail(i, exc)
+                    attempt_failed(i, "error", exc)
+                    continue
+                finalize_fn = task[2] if len(task) > 2 else None
+                if not chunk_decoded(i, result, finalize_fn):
+                    continue
+                if has_finalize and finalize_fn is not None:
+                    with lock:
+                        inflight[i] = ("assemble_queued", time.monotonic())
+                    if not bounded_put(fq, (i, finalize_fn, result)):
+                        return
+                    depth = fq.qsize()
+                    if depth > peak_queue[0]:
+                        peak_queue[0] = depth
+
+        finalizer_exit = threading.Event()
 
         def finalizer_loop() -> None:
             _cap_omp_width(self.workers)
-            while True:
-                item = fq.get()
-                if item is None:
-                    return
-                i, finalize_fn, result = item
-                if stop.is_set() or finalize_fn is None:
+            while not finalizer_exit.is_set():
+                try:
+                    i, finalize_fn, result = fq.get(timeout=_TICK_S)
+                except queue.Empty:
                     continue
+                with lock:
+                    if terminal(i) or stop.is_set():
+                        continue
+                    inflight[i] = ("assemble", time.monotonic())
                 try:
                     finalize_fn(result)
                 except BaseException as exc:
-                    fail(i, exc)
+                    # assembly is deterministic — no retry
+                    attempts[i] = attempts[i] or 1
+                    fail_chunk(i, "error", exc)
+                    continue
+                with lock:
+                    if not terminal(i):
+                        state[i] = "done"
+                        inflight.pop(i, None)
+                touch()
 
-        threads = [threading.Thread(target=reader_loop,
-                                    name="cobrix-pipe-read", daemon=True)]
-        threads += [threading.Thread(target=worker_loop,
-                                     name=f"cobrix-pipe-{k}", daemon=True)
-                    for k in range(self.workers)]
+        reader = threading.Thread(target=reader_loop,
+                                  name="cobrix-pipe-read", daemon=True)
+        workers = [threading.Thread(target=worker_loop,
+                                    name=f"cobrix-pipe-{k}", daemon=True)
+                   for k in range(self.workers)]
         finalizer = None
         if has_finalize:
             finalizer = threading.Thread(target=finalizer_loop,
                                          name="cobrix-pipe-assemble",
                                          daemon=True)
             finalizer.start()
-        for t in threads:
+        reader.start()
+        for t in workers:
             t.start()
-        for t in threads:
-            t.join()
+
+        # -- the watchdog / supervision loop (runs on the caller's
+        # thread): every wait below is bounded by _TICK_S ---------------
+        deadline_exc: Optional[BaseException] = None
+        while True:
+            with lock:
+                all_terminal = all(terminal(i) for i in range(n))
+                if errors:
+                    break
+            if all_terminal:
+                break
+            now = time.monotonic()
+            if scan_deadline is not None and now > scan_deadline:
+                deadline_exc = PipelineTimeoutError(
+                    f"scan deadline of {self.scan_deadline_s}s expired "
+                    f"with {sum(1 for i in range(n) if not terminal(i))} "
+                    f"of {n} chunk(s) outstanding")
+                break
+            if self.chunk_timeout_s > 0:
+                self._enforce_chunk_deadline(
+                    now, lock, inflight, counters, fail_chunk, workers,
+                    worker_loop)
+                with lock:
+                    if errors:
+                        break
+            stall = self.stall_timeout_s
+            if stall > 0 and now - progress_t[0] > stall:
+                deadline_exc = PipelineTimeoutError(
+                    "pipeline stalled: no chunk progressed for "
+                    f"{stall:.0f}s; in-flight stages: "
+                    f"{_inflight_desc(lock, inflight, now)}")
+                break
+            time.sleep(_TICK_S / 2)
+
+        # -- cooperative shutdown: drain queues, join with deadlines ----
+        stop.set()
+        workers_exit.set()
+        finalizer_exit.set()
+        _drain(q)
+        stuck = _join_bounded([reader] + workers, _JOIN_GRACE_S)
         if finalizer is not None:
-            fq.put(None)
-            finalizer.join()
-        wall = time.perf_counter() - t_start
+            _drain_fq(fq)
+            stuck += _join_bounded([finalizer], _JOIN_GRACE_S)
+
+        wall = time.monotonic() - t_start
         busy = sum(self.stage_times.busy_s.values())
         self.report = {
             "workers": self.workers,
@@ -197,6 +409,11 @@ class PipelineExecutor:
             "busy_s": round(busy, 6),
             "overlap": round(busy / wall, 3) if wall > 0 else 0.0,
         }
+        if any(counters.values()):
+            self.report.update(counters)
+        if stuck:
+            self.report["stuck_stages"] = stuck
+
         if errors:
             # deterministic-ish error choice: the failing chunk with the
             # lowest index among those observed before the stop. (A later
@@ -205,13 +422,80 @@ class PipelineExecutor:
             # first; both surface A failure for the same corrupt input.)
             errors.sort(key=lambda e: e[0])
             raise errors[0][1]
+        if deadline_exc is not None:
+            if not self.error_policy.is_partial:
+                if stuck:
+                    deadline_exc = PipelineTimeoutError(
+                        f"{deadline_exc} (stuck stage thread(s): "
+                        f"{', '.join(stuck)})")
+                raise deadline_exc
+            # partial: every unfinished chunk becomes a ledger entry
+            for i in range(n):
+                if not terminal(i):
+                    state[i] = "failed"
+                    counters["chunks_failed"] += 1
+                    self.shard_failures.append(self.failure_info(
+                        i, attempts[i], "scan_deadline",
+                        str(deadline_exc)))
+                    results[i] = None
+            self.report.update(counters)
         return results
+
+    def _enforce_chunk_deadline(self, now, lock, inflight, counters,
+                                fail_chunk,
+                                workers: List[threading.Thread],
+                                worker_loop) -> None:
+        """Kill-and-replace semantics for threads: a chunk stuck in one
+        stage past the deadline is abandoned (late results discarded via
+        the terminal-state check) and a fresh worker thread restores pool
+        capacity; the chunk itself fails (no re-dispatch — a wedged chunk
+        would wedge its retry too)."""
+        expired = []
+        with lock:
+            for i, (stage_name, since) in list(inflight.items()):
+                if stage_name in ("queued", "assemble_queued"):
+                    continue  # waiting in a bounded queue, not wedged
+                if now - since > self.chunk_timeout_s:
+                    expired.append((i, stage_name, now - since))
+        for i, stage_name, elapsed in expired:
+            counters["chunk_timeouts"] += 1
+            fail_chunk(i, "timeout", PipelineTimeoutError(
+                f"chunk {i} exceeded shard_timeout_s="
+                f"{self.chunk_timeout_s} in stage '{stage_name}' "
+                f"({elapsed:.1f}s)"))
+            if self.error_policy.is_partial:
+                # the wedged thread still occupies a pool slot; top the
+                # pool back up so surviving chunks keep flowing
+                alive = sum(1 for t in workers if t.is_alive())
+                if alive >= self.workers:
+                    counters["respawned_workers"] += 1
+                    t = threading.Thread(
+                        target=worker_loop,
+                        name=f"cobrix-pipe-r{counters['respawned_workers']}",
+                        daemon=True)
+                    workers.append(t)
+                    t.start()
 
     def attach(self, metrics: Optional[ReadMetrics]) -> None:
         """Publish the run report + stage busy times on the read metrics."""
         if metrics is None:
             return
         metrics.stage_busy = self.stage_times
+        supervision = {k: self.report[k]
+                       for k in ("chunk_retries", "chunks_failed",
+                                 "chunk_timeouts", "respawned_workers",
+                                 "stuck_stages")
+                       if k in self.report}
+        if supervision:
+            if metrics.supervision is None:
+                metrics.supervision = supervision
+            else:
+                for k, v in supervision.items():
+                    if isinstance(v, int):
+                        metrics.supervision[k] = \
+                            metrics.supervision.get(k, 0) + v
+                    else:
+                        metrics.supervision[k] = v
         if metrics.pipeline is None:
             metrics.pipeline = self.report
         else:
@@ -231,6 +515,65 @@ class PipelineExecutor:
             metrics.pipeline = merged
 
 
+def _default_failure_info(index: int, attempts: int, reason: str,
+                          error: str) -> ShardFailureInfo:
+    return ShardFailureInfo(file="", offset_from=index, offset_to=index,
+                            record_index=index, attempts=attempts,
+                            reason=reason, error=error)
+
+
+def _close_payload(payload) -> None:
+    """Release a chunk payload that will never be processed (open var-len
+    streams leak an fd per chunk otherwise)."""
+    close = getattr(payload, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:
+            pass
+
+
+def _drain(q: "queue.Queue") -> None:
+    while True:
+        try:
+            item = q.get_nowait()
+        except queue.Empty:
+            return
+        if item is not None and len(item) > 2:
+            _close_payload(item[2])
+
+
+def _drain_fq(fq: "queue.Queue") -> None:
+    while True:
+        try:
+            fq.get_nowait()
+        except queue.Empty:
+            return
+
+
+def _join_bounded(threads: List[threading.Thread],
+                  grace_s: float) -> List[str]:
+    """Join each thread against one shared deadline; names of threads
+    still alive after it (wedged stages — daemons, so the interpreter
+    can still exit) are returned for the error/report."""
+    deadline = time.monotonic() + grace_s
+    stuck = []
+    for t in threads:
+        t.join(max(0.0, deadline - time.monotonic()))
+        if t.is_alive():
+            stuck.append(t.name)
+    return stuck
+
+
+def _inflight_desc(lock, inflight, now) -> str:
+    with lock:
+        items = sorted(inflight.items())
+    if not items:
+        return "<none>"
+    return ", ".join(f"chunk {i}: {stage_name} {now - since:.0f}s"
+                     for i, (stage_name, since) in items[:8])
+
+
 def _assemble(result, output_schema, stage_times: StageTimes):
     """Stage 4: per-chunk Arrow table, built on the worker and cached on
     the FileResult so CobolData.to_arrow concatenates without rebuilding."""
@@ -241,23 +584,47 @@ def _assemble(result, output_schema, stage_times: StageTimes):
     return result
 
 
+def _executor_for(params, workers: int,
+                  failure_info: Callable) -> PipelineExecutor:
+    """An executor wired with the read's supervision knobs."""
+    return PipelineExecutor(
+        workers, params.pipeline_max_inflight, stage_times=StageTimes(),
+        chunk_timeout_s=params.shard_timeout_s,
+        scan_deadline_s=params.scan_deadline_s,
+        error_policy=params.shard_error_policy,
+        chunk_retries=min(1, max(0, params.shard_max_retries)),
+        failure_info=failure_info)
+
+
 def pipelined_fixed_scan(reader, files, params, backend: str,
                          output_schema, workers: int,
                          ignore_file_size: bool = False,
                          metrics: Optional[ReadMetrics] = None,
                          retry: Optional[RetryPolicy] = None,
                          on_retry=None,
-                         assemble: bool = True) -> List["FileResult"]:
+                         assemble: bool = True
+                         ) -> Tuple[List["FileResult"],
+                                    List[ShardFailureInfo]]:
     """Fixed-length files through the chunk pipeline: record-aligned byte
     strides read concurrently, decoded by the batched kernels, and
     assembled into per-chunk Arrow tables — row-identical to the
     sequential `_read_fixed_len_chunked` path (same chunkability rules,
-    same per-chunk `read_result` decode)."""
+    same per-chunk `read_result` decode). Returns (results, failures);
+    a failed chunk under the partial policy leaves a None result slot
+    and a ledger entry."""
     chunk_bytes = max(1, int(params.pipeline_chunk_mb * 1024 * 1024))
     chunks = plan_fixed_chunks(reader, files, params, chunk_bytes,
                                ignore_file_size, retry, on_retry)
-    ex = PipelineExecutor(workers, params.pipeline_max_inflight,
-                          stage_times=StageTimes())
+
+    def failure_info(index, attempts, reason, error):
+        c = chunks[index]
+        return ShardFailureInfo(
+            file=c.file_path, offset_from=c.offset,
+            offset_to=c.offset + c.nbytes,
+            record_index=c.first_record_id, attempts=attempts,
+            reason=reason, error=error)
+
+    ex = _executor_for(params, workers, failure_info)
 
     def read_fn(c: FixedChunk):
         def read() -> object:
@@ -290,7 +657,7 @@ def pipelined_fixed_scan(reader, files, params, backend: str,
     ex.attach(metrics)
     if metrics is not None:
         metrics.shards = max(metrics.shards, len(chunks))
-    return results
+    return results, ex.shard_failures
 
 
 def pipelined_var_len_scan(reader, shards, params, backend: str,
@@ -298,14 +665,24 @@ def pipelined_var_len_scan(reader, shards, params, backend: str,
                            metrics: Optional[ReadMetrics] = None,
                            retry: Optional[RetryPolicy] = None,
                            on_retry=None,
-                           assemble: bool = True) -> List["FileResult"]:
+                           assemble: bool = True
+                           ) -> Tuple[List["FileResult"],
+                                      List[ShardFailureInfo]]:
     """Variable-length shards (sparse-index byte ranges) through the
     pipeline. The shard plan is EXACTLY the sequential indexed scan's
     (api._scan_var_len), so record framing, Record_Ids, and per-shard
     ledgers match; the pipeline only overlaps stage execution and adds
-    the per-shard Arrow assembly stage."""
-    ex = PipelineExecutor(workers, params.pipeline_max_inflight,
-                          stage_times=StageTimes())
+    the per-shard Arrow assembly stage. Returns (results, failures) like
+    pipelined_fixed_scan."""
+
+    def failure_info(index, attempts, reason, error):
+        s = shards[index]
+        return ShardFailureInfo(
+            file=s.file_path, offset_from=s.offset_from,
+            offset_to=s.offset_to, record_index=s.record_index,
+            attempts=attempts, reason=reason, error=error)
+
+    ex = _executor_for(params, workers, failure_info)
 
     def read_fn(shard):
         def read() -> object:
@@ -339,4 +716,4 @@ def pipelined_var_len_scan(reader, shards, params, backend: str,
     results = ex.run([(read_fn(s), process_fn(s), finalize)
                       for s in shards])
     ex.attach(metrics)
-    return results
+    return results, ex.shard_failures

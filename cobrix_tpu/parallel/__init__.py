@@ -7,10 +7,13 @@ from .mesh import batch_sharding, data_mesh, pad_batch_to_multiple
 from .planner import WorkShard, balance, plan_files, shards_from_index
 from .query import DeviceAggregator, aggregate_file, merge_aggregates
 from .sharded import ShardedColumnarDecoder, sharded_decode
+from .supervisor import (ScanDeadlineError, ShardSupervisionError,
+                         supervised_map)
 
 __all__ = [
     "batch_sharding", "data_mesh", "pad_batch_to_multiple",
     "WorkShard", "balance", "plan_files", "shards_from_index",
     "DeviceAggregator", "aggregate_file", "merge_aggregates",
     "ShardedColumnarDecoder", "sharded_decode",
+    "ScanDeadlineError", "ShardSupervisionError", "supervised_map",
 ]

@@ -3,101 +3,109 @@
 The reference executes its scan as Spark tasks in executor JVMs — one
 process per executor, each opening its assigned byte ranges
 (CobolScanners.buildScanForVarLenIndex, CobolScanners.scala:38-55). The
-equivalent here: the parent plans shards (sparse index + LPT balancing,
-parallel/planner.py) and forks one worker process per "host"; each worker
-scans its shard list with the native/numpy kernels and returns its decoded
-shards as Arrow IPC buffers (the DCN analogue: only columnar results
-cross process boundaries, never raw record bytes — workers read their own
-byte ranges from shared storage). The parent reassembles tables in
-canonical shard order, so Record_Ids and row order are byte-identical to
-a single-process read.
+equivalent here: the parent plans shards (sparse index + record-boundary
+splits) and forks worker processes; each worker scans dispatched shards
+with the native/numpy kernels and returns the decoded shard as an Arrow
+IPC buffer (the DCN analogue: only columnar results cross process
+boundaries, never raw record bytes — workers read their own byte ranges
+from shared storage). The parent reassembles tables in canonical shard
+order, so Record_Ids and row order are byte-identical to a
+single-process read.
+
+Unlike the original bare ``mp.Pool.map``, dispatch is *supervised*
+(parallel/supervisor.py): per-shard deadlines, heartbeats, bounded
+re-dispatch after worker crashes/timeouts, straggler speculation, and a
+``shard_error_policy`` that can return partial results plus a
+shard-failure ledger instead of aborting — the Spark task-retry /
+speculation semantics the reference inherits from its scheduler.
 
 Workers are plain OS processes, not threads: the decode plane's small-op
 Python/numpy glue holds the GIL, which caps thread scaling (the shard
 scan's native kernels release it, but framing glue and Arrow assembly do
 not). Fork semantics keep the parent's parsed copybook/options without
-re-importing; workers use only numpy/native/pyarrow (never jax — the
-device path belongs to the per-host process).
+re-importing; the worker context travels per-scan inside the dispatch
+closure (never a module global — concurrent multihost scans each own
+their workers), and workers use only numpy/native/pyarrow (never jax —
+the device path belongs to the per-host process).
 """
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
-from .planner import WorkShard, balance
+from ..reader.diagnostics import ShardFailureInfo
+from .planner import WorkShard
+from .supervisor import supervised_map
 
-# worker context, set in the parent immediately before forking; inherited
-# by fork (never pickled — the reader holds compiled plans)
-_CTX: Optional[dict] = None
+# test-only fault hook, called as hook(shard, seq) in the worker before
+# scanning (fork-inherited — see testing/faults.ShardFaultPlan). Read
+# once per dispatch; NOT part of the public API
+_SHARD_FAULT_HOOK: Optional[Callable] = None
 
 
-def _worker_scan(host_shards: List[WorkShard]) -> List[Tuple[tuple, bytes]]:
-    """Runs in a worker process: scan each shard, return
-    [(shard_key, arrow_ipc_bytes), ...]."""
+def set_shard_fault_hook(hook: Optional[Callable]) -> None:
+    global _SHARD_FAULT_HOOK
+    _SHARD_FAULT_HOOK = hook
+
+
+def _scan_shard(ctx: dict, shard: WorkShard) -> bytes:
+    """Scan ONE shard (in a worker process or inline) and return its
+    decoded table as Arrow IPC bytes, shard error ledger attached as
+    schema metadata."""
     import pyarrow as pa
 
     from ..reader.diagnostics import ReadDiagnostics
     from ..reader.stream import RetryPolicy, open_stream
 
-    ctx = _CTX
     reader = ctx["reader"]
-    schema = ctx["schema"]
     params = reader.params
     retry = RetryPolicy(max_attempts=params.io_retry_attempts,
                         base_delay=params.io_retry_base_delay,
                         max_delay=params.io_retry_max_delay,
                         deadline=params.io_retry_deadline)
-    out = []
-    for shard in host_shards:
-        key = (shard.file_order, shard.offset_from)
-        retries: List[int] = []
-        on_retry = lambda: retries.append(1)  # noqa: E731
-        if ctx["is_var_len"]:
-            max_bytes = (0 if shard.offset_to < 0
-                         else shard.offset_to - shard.offset_from)
-            with open_stream(shard.file_path,
-                             start_offset=shard.offset_from,
-                             maximum_bytes=max_bytes, retry=retry,
-                             on_retry=on_retry) as stream:
-                result = reader.read_result_columnar(
-                    stream, file_id=shard.file_order, backend="numpy",
-                    segment_id_prefix=ctx["prefix"],
-                    start_record_id=shard.record_index,
-                    starting_file_offset=shard.offset_from)
-        else:
-            max_bytes = (0 if shard.offset_to < 0
-                         else shard.offset_to - shard.offset_from)
-            with open_stream(shard.file_path,
-                             start_offset=shard.offset_from,
-                             maximum_bytes=max_bytes, retry=retry,
-                             on_retry=on_retry) as stream:
-                data = stream.next(stream.size() - shard.offset_from)
-            result = reader.read_result(
-                data, backend="numpy", file_id=shard.file_order,
-                first_record_id=shard.record_index,
-                input_file_name=shard.file_path,
-                ignore_file_size=ctx["ignore_file_size"])
-        table = result.to_arrow(schema)
-        diag = getattr(result, "diagnostics", None)
-        if retries:
-            # retried-but-recovered IO is an incident too (matching the
-            # single-process read, which ledgers io_retries even under
-            # fail_fast)
-            if diag is None:
-                diag = ReadDiagnostics()
-            diag.io_retries += len(retries)
-        if diag is not None and not diag.is_clean:
-            # ship the shard's error ledger to the parent on the IPC
-            # stream; the parent merges the shards into the read's ledger
-            metadata = dict(table.schema.metadata or {})
-            metadata[b"cobrix_tpu.shard_diagnostics"] = \
-                diag.to_json().encode()
-            table = table.replace_schema_metadata(metadata)
-        sink = pa.BufferOutputStream()
-        with pa.ipc.new_stream(sink, table.schema) as writer:
-            writer.write_table(table)
-        out.append((key, sink.getvalue().to_pybytes()))
-    return out
+    retries: List[int] = []
+    on_retry = lambda: retries.append(1)  # noqa: E731
+    max_bytes = (0 if shard.offset_to < 0
+                 else shard.offset_to - shard.offset_from)
+    if ctx["is_var_len"]:
+        with open_stream(shard.file_path, start_offset=shard.offset_from,
+                         maximum_bytes=max_bytes, retry=retry,
+                         on_retry=on_retry) as stream:
+            result = reader.read_result_columnar(
+                stream, file_id=shard.file_order, backend="numpy",
+                segment_id_prefix=ctx["prefix"],
+                start_record_id=shard.record_index,
+                starting_file_offset=shard.offset_from)
+    else:
+        with open_stream(shard.file_path, start_offset=shard.offset_from,
+                         maximum_bytes=max_bytes, retry=retry,
+                         on_retry=on_retry) as stream:
+            data = stream.next(stream.size() - shard.offset_from)
+        result = reader.read_result(
+            data, backend="numpy", file_id=shard.file_order,
+            first_record_id=shard.record_index,
+            input_file_name=shard.file_path,
+            ignore_file_size=ctx["ignore_file_size"])
+    table = result.to_arrow(ctx["schema"])
+    diag = getattr(result, "diagnostics", None)
+    if retries:
+        # retried-but-recovered IO is an incident too (matching the
+        # single-process read, which ledgers io_retries even under
+        # fail_fast)
+        if diag is None:
+            diag = ReadDiagnostics()
+        diag.io_retries += len(retries)
+    if diag is not None and not diag.is_clean:
+        # ship the shard's error ledger to the parent on the IPC
+        # stream; the parent merges the shards into the read's ledger
+        metadata = dict(table.schema.metadata or {})
+        metadata[b"cobrix_tpu.shard_diagnostics"] = \
+            diag.to_json().encode()
+        table = table.replace_schema_metadata(metadata)
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as writer:
+        writer.write_table(table)
+    return sink.getvalue().to_pybytes()
 
 
 def plan_fixed_len_shards(reader, files: Sequence[str], params,
@@ -135,37 +143,66 @@ def plan_fixed_len_shards(reader, files: Sequence[str], params,
     return shards
 
 
+def _shard_failure_info(shard: WorkShard, attempts: int, reason: str,
+                        error: str) -> ShardFailureInfo:
+    return ShardFailureInfo(
+        file=shard.file_path, offset_from=shard.offset_from,
+        offset_to=shard.offset_to, record_index=shard.record_index,
+        attempts=attempts, reason=reason, error=error)
+
+
 def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
                    schema, hosts: int, prefix: str,
-                   ignore_file_size: bool = False) -> List:
-    """Fork `hosts` workers over a shard plan and reassemble Arrow tables
-    in canonical (file_order, offset) order. Returns the ordered list."""
-    import multiprocessing as mp
+                   ignore_file_size: bool = False
+                   ) -> Tuple[List, List[ShardFailureInfo], dict]:
+    """Run a shard plan across `hosts` supervised worker processes and
+    reassemble Arrow tables in canonical (file_order, offset) order.
 
+    Returns ``(tables, shard_failures, supervision_report)``:
+    `shard_failures` is non-empty only under
+    ``shard_error_policy='partial'`` — under ``fail_fast`` an
+    unrecoverable shard raises instead (the original shard exception
+    where one exists, ShardSupervisionError for crashes/timeouts)."""
     import pyarrow as pa
 
-    global _CTX
+    params = reader.params
+    # per-scan worker context: inherited by fork inside the dispatch
+    # closure, so concurrent multihost scans can never clobber each other
+    ctx = {"reader": reader, "schema": schema, "prefix": prefix,
+           "is_var_len": is_var_len, "ignore_file_size": ignore_file_size}
 
-    assignments = [a for a in balance(shards, hosts) if a]
+    # canonical order: seq number == reassembly position
+    ordered = sorted(shards, key=lambda s: (s.file_order, s.offset_from))
+    fault_hook = _SHARD_FAULT_HOOK
 
-    _CTX = {"reader": reader, "schema": schema, "prefix": prefix,
-            "is_var_len": is_var_len, "ignore_file_size": ignore_file_size}
-    try:
-        if len(assignments) <= 1:
-            results = [_worker_scan(a) for a in assignments]
-        else:
-            ctx = mp.get_context("fork")
-            with ctx.Pool(processes=len(assignments)) as pool:
-                results = pool.map(_worker_scan, assignments)
-    finally:
-        _CTX = None
+    def scan_fn(shard: WorkShard, seq: int) -> bytes:
+        if fault_hook is not None:
+            fault_hook(shard, seq)
+        return _scan_shard(ctx, shard)
 
-    by_key: Dict[tuple, bytes] = {}
-    for host_result in results:
-        for key, buf in host_result:
-            by_key[key] = buf
+    results, failures, report = supervised_map(
+        scan_fn, ordered, max(hosts, 1),
+        error_policy=params.shard_error_policy,
+        shard_timeout_s=params.shard_timeout_s,
+        shard_max_retries=params.shard_max_retries,
+        speculative_quantile=params.speculative_quantile,
+        scan_deadline_s=params.scan_deadline_s,
+        heartbeat_s=params.heartbeat_interval_s,
+        failure_info=_shard_failure_info)
+
+    # reassembly: ascending seq == canonical shard order; a duplicated
+    # key in the plan (or a raced duplicate result) dedupes
+    # deterministically to the lowest seq and counts a metric instead of
+    # silently last-write-wins overwriting
+    report.setdefault("duplicate_shard_keys", 0)
     tables = []
-    for key in sorted(by_key):
-        with pa.ipc.open_stream(pa.py_buffer(by_key[key])) as rd:
+    seen_keys = set()
+    for seq in sorted(results):
+        key = (ordered[seq].file_order, ordered[seq].offset_from)
+        if key in seen_keys:
+            report["duplicate_shard_keys"] += 1
+            continue
+        seen_keys.add(key)
+        with pa.ipc.open_stream(pa.py_buffer(results[seq])) as rd:
             tables.append(rd.read_all())
-    return tables
+    return tables, failures, report

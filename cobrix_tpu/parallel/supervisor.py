@@ -1,0 +1,556 @@
+"""Supervised shard scheduling: the Spark task-supervision analogue.
+
+The reference gets fault tolerance for free from Spark: failed tasks are
+retried on other executors (`spark.task.maxFailures`), stragglers are
+speculatively re-executed (`spark.speculation`), and a lost executor
+never kills the job. A bare ``mp.Pool.map`` gives none of that — one
+crashed fork loses its tasks and hangs the map, one wedged worker
+serializes the scan. This module supplies the missing supervision for
+the multi-host path (parallel/hosts.py):
+
+* per-shard dispatch over per-worker pipes (no shared queue a dying
+  worker can corrupt), with worker heartbeats and process sentinels for
+  liveness;
+* a per-shard deadline (``shard_timeout_s``): a shard past it is treated
+  as wedged, its worker is killed and respawned, and the shard is
+  re-dispatched;
+* bounded re-dispatch (``shard_max_retries``) of shards from crashed,
+  timed-out, or erroring workers onto surviving or respawned workers;
+* speculative re-execution (``speculative_quantile``): a shard still
+  running past that quantile of observed shard latencies gets a
+  duplicate on an idle worker — first completion wins, duplicates dedupe
+  deterministically by shard sequence number;
+* a whole-scan deadline (``scan_deadline_s``); and
+* a ``shard_error_policy``: ``fail_fast`` re-raises the original shard
+  error (or a :class:`ShardSupervisionError` for crashes/timeouts),
+  ``partial`` returns every completed shard plus a
+  :class:`ShardFailureInfo` ledger for the rest.
+
+Workers are fork-children: ``scan_fn`` (a closure over the compiled
+reader) is inherited by fork, never pickled, and each call gets its own
+worker set — concurrent supervised scans cannot clobber each other.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..reader.diagnostics import ShardErrorPolicy, ShardFailureInfo
+
+# concurrent supervised scans (each on its own caller thread) fork
+# workers independently; serializing the fork itself shrinks the window
+# where one scan forks while another mutates process-global state
+_FORK_LOCK = threading.Lock()
+
+# supervisor poll tick: bounds every wait in the dispatch loop
+_TICK_S = 0.05
+# floor for the speculation latency threshold so near-zero quantiles on
+# tiny shards don't speculate everything
+_MIN_SPECULATION_S = 0.05
+# shard latency samples needed before speculation may trigger
+_MIN_LATENCY_SAMPLES = 2
+
+
+class ShardSupervisionError(RuntimeError):
+    """A shard-level failure the supervisor could not recover from
+    (worker crash / deadline with the retry budget exhausted)."""
+
+
+class ScanDeadlineError(ShardSupervisionError):
+    """The whole-scan deadline (``scan_deadline_s``) expired with shards
+    still outstanding."""
+
+
+def new_report(workers: int) -> dict:
+    """A zeroed supervision-event report (merged into ReadMetrics)."""
+    return {
+        "workers": workers,
+        "dispatches": 0,
+        "re_dispatches": 0,
+        "speculations_launched": 0,
+        "speculations_won": 0,
+        "speculations_wasted": 0,
+        "shard_timeouts": 0,
+        "worker_crashes": 0,
+        "worker_respawns": 0,
+        "shards_completed": 0,
+        "shards_failed": 0,
+        "duplicate_results": 0,
+        "heartbeats": 0,
+    }
+
+
+def _worker_main(worker_id: int, scan_fn, task_r, result_w,
+                 heartbeat_s: float, omp_width: int) -> None:
+    """Worker process body: receive (seq, shard) tasks, send back
+    ("done"/"err", ...) messages plus periodic ("hb", ...) heartbeats.
+    Runs in a fork child; `scan_fn` arrived by memory inheritance.
+
+    The scan loop runs on a FRESH thread, not the fork-inherited main
+    thread: libgomp keeps its worker pool in per-thread TLS, so if the
+    parent ran OpenMP kernels before forking, the child's main thread
+    inherits a pool whose threads no longer exist — its first parallel
+    region docks on them and wedges forever (the intermittent multihost
+    hang CHANGES.md used to carry). A fresh thread has no pool and
+    spawns its own; `omp_width` splits the cores across workers like the
+    pipeline executor does."""
+    import threading
+
+    send_lock = threading.Lock()  # heartbeat + result share one pipe
+    stop = threading.Event()
+
+    def send(msg) -> bool:
+        try:
+            with send_lock:
+                result_w.send(msg)
+            return True
+        except Exception:
+            return False
+
+    def beat() -> None:
+        while not stop.wait(heartbeat_s):
+            if not send(("hb", worker_id)):
+                return
+
+    def scan_loop() -> None:
+        from .. import native
+
+        native.set_thread_omp_width(max(1, omp_width))
+        try:
+            while True:
+                task = task_r.recv()
+                if task is None:
+                    return
+                seq, shard = task
+                try:
+                    payload = scan_fn(shard, seq)
+                except BaseException as exc:
+                    try:
+                        blob = pickle.dumps(exc)
+                    except Exception:
+                        blob = None
+                    send(("err", worker_id, seq, blob,
+                          f"{type(exc).__name__}: {exc}",
+                          traceback.format_exc(limit=8)))
+                else:
+                    send(("done", worker_id, seq, payload))
+        except (EOFError, OSError):
+            return
+
+    threading.Thread(target=beat, name=f"shard-hb-{worker_id}",
+                     daemon=True).start()
+    worker = threading.Thread(target=scan_loop,
+                              name=f"shard-scan-{worker_id}")
+    worker.start()
+    try:
+        worker.join()
+    finally:
+        stop.set()
+
+
+class _Worker:
+    """Parent-side handle: process + its private task/result pipes."""
+
+    __slots__ = ("wid", "proc", "task_w", "result_r", "busy_seq",
+                 "dispatch_t", "speculative", "last_hb")
+
+    def __init__(self, wid, proc, task_w, result_r):
+        self.wid = wid
+        self.proc = proc
+        self.task_w = task_w
+        self.result_r = result_r
+        self.busy_seq: Optional[int] = None
+        self.dispatch_t = 0.0
+        self.speculative = False
+        self.last_hb = time.monotonic()
+
+    def kill(self) -> None:
+        """Terminate without ceremony; private pipes mean a mid-send kill
+        can only corrupt this worker's own (discarded) channel."""
+        try:
+            self.proc.terminate()
+            self.proc.join(1.0)
+            if self.proc.is_alive():
+                self.proc.kill()
+                self.proc.join(1.0)
+        except Exception:
+            pass
+        self.close()
+
+    def close(self) -> None:
+        for conn in (self.task_w, self.result_r):
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+
+def _quantile(sorted_vals: List[float], q: float) -> float:
+    idx = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[idx]
+
+
+def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
+                   error_policy: ShardErrorPolicy,
+                   shard_timeout_s: float = 0.0,
+                   shard_max_retries: int = 2,
+                   speculative_quantile: float = 0.0,
+                   scan_deadline_s: float = 0.0,
+                   heartbeat_s: float = 0.5,
+                   failure_info: Callable[..., ShardFailureInfo],
+                   ) -> Tuple[Dict[int, object], List[ShardFailureInfo],
+                              dict]:
+    """Run ``scan_fn(shard, seq)`` over every shard under supervision.
+
+    Returns ``(results, failures, report)``: ``results`` maps shard
+    sequence number -> payload for completed shards, ``failures`` lists
+    the shards given up on (empty unless ``error_policy='partial'``),
+    and ``report`` is the supervision-event dict (new_report keys).
+
+    ``failure_info(shard, attempts, reason, error)`` builds the ledger
+    entry for a failed shard. fail_fast raises instead: the original
+    (unpickled) exception for shard errors, :class:`ShardSupervisionError`
+    for crashes/timeouts, :class:`ScanDeadlineError` for the scan
+    deadline.
+    """
+    n = len(shards)
+    t0 = time.monotonic()
+    report = new_report(min(workers, n) if n else 0)
+    results: Dict[int, object] = {}
+    failures: List[ShardFailureInfo] = []
+    if n == 0:
+        return results, failures, report
+
+    deadline = t0 + scan_deadline_s if scan_deadline_s > 0 else None
+    max_attempts = 1 + max(0, shard_max_retries)
+
+    if workers <= 1 or n <= 1:
+        _inline_map(scan_fn, shards, results, failures, report,
+                    error_policy, max_attempts, deadline, failure_info)
+        return results, failures, report
+
+    import multiprocessing as mp
+    from multiprocessing.connection import wait as conn_wait
+
+    import os as _os
+
+    ctx = mp.get_context("fork")
+    target_workers = min(workers, n)
+    omp_width = max(1, (_os.cpu_count() or 1) // target_workers)
+
+    attempts_started = [0] * n
+    attempts_failed = [0] * n
+    last_error: List[str] = [""] * n
+    last_exc_blob: List[Optional[bytes]] = [None] * n
+    speculated = [False] * n
+    terminal = [False] * n          # done or failed-for-good
+    active: Dict[int, set] = {s: set() for s in range(n)}  # seq -> wids
+    latencies: List[float] = []
+    # dispatch order: biggest shards first (LPT; unknown sizes — open
+    # ranges or sizeless shard types — sort as 0); canonical seq keys
+    # keep reassembly deterministic regardless
+    pending = deque(sorted(
+        range(n),
+        key=lambda i: (-max(getattr(shards[i], "size", 0) or 0, 0), i)))
+    pool: Dict[int, _Worker] = {}
+    next_wid = [0]
+    fatal: List[Tuple[int, BaseException]] = []
+
+    def spawn(respawn: bool) -> _Worker:
+        wid = next_wid[0]
+        next_wid[0] += 1
+        task_r, task_w = ctx.Pipe(duplex=False)
+        result_r, result_w = ctx.Pipe(duplex=False)
+        proc = ctx.Process(target=_worker_main,
+                           args=(wid, scan_fn, task_r, result_w,
+                                 heartbeat_s, omp_width),
+                           name=f"cobrix-shard-{wid}", daemon=True)
+        with _FORK_LOCK:
+            proc.start()
+        # close the child's ends in the parent so worker death surfaces
+        # as EOF on result_r instead of a silent forever-poll
+        task_r.close()
+        result_w.close()
+        if respawn:
+            report["worker_respawns"] += 1
+        w = _Worker(wid, proc, task_w, result_r)
+        pool[wid] = w
+        return w
+
+    def dispatch(w: _Worker, seq: int, speculative: bool) -> bool:
+        try:
+            w.task_w.send((seq, shards[seq]))
+        except OSError:
+            # the worker died while idle (pipe read end closed): drop it
+            # and report failure so the caller retries on fresh capacity
+            # instead of the whole scan dying on a raw BrokenPipeError
+            drop_worker(w, kill=True)
+            report["worker_crashes"] += 1
+            return False
+        w.busy_seq = seq
+        w.dispatch_t = time.monotonic()
+        w.speculative = speculative
+        active[seq].add(w.wid)
+        attempts_started[seq] += 1
+        report["dispatches"] += 1
+        return True
+
+    def drop_worker(w: _Worker, kill: bool) -> None:
+        if kill:
+            w.kill()
+        else:
+            w.close()
+        pool.pop(w.wid, None)
+        if w.busy_seq is not None:
+            active[w.busy_seq].discard(w.wid)
+            w.busy_seq = None
+
+    def shard_failed(seq: int, reason: str) -> None:
+        """The retry budget for `seq` is gone and no copy is running."""
+        terminal[seq] = True
+        report["shards_failed"] += 1
+        if error_policy.is_partial:
+            failures.append(failure_info(
+                shards[seq], attempts_started[seq], reason,
+                last_error[seq]))
+        else:
+            exc: Optional[BaseException] = None
+            if reason == "error" and last_exc_blob[seq] is not None:
+                try:
+                    exc = pickle.loads(last_exc_blob[seq])
+                except Exception:
+                    exc = None
+            if exc is None:
+                exc = ShardSupervisionError(
+                    f"shard {_shard_desc(shards[seq])} failed "
+                    f"({reason}) after {attempts_started[seq]} "
+                    f"attempt(s): {last_error[seq] or reason}")
+            fatal.append((seq, exc))
+
+    def attempt_failed(seq: int, reason: str, error: str) -> None:
+        attempts_failed[seq] += 1
+        if error:
+            last_error[seq] = error
+        if terminal[seq]:
+            return
+        if attempts_failed[seq] >= max_attempts:
+            if not active[seq]:
+                # no surviving copy can still save the shard
+                shard_failed(seq, reason)
+            return
+        if not active[seq]:
+            report["re_dispatches"] += 1
+            pending.appendleft(seq)
+
+    def handle_done(w: _Worker, seq: int, payload) -> None:
+        was_busy = w.busy_seq == seq
+        if was_busy:
+            active[seq].discard(w.wid)
+            w.busy_seq = None
+        if terminal[seq]:
+            report["duplicate_results"] += 1
+            if w.speculative:
+                report["speculations_wasted"] += 1
+            return
+        terminal[seq] = True
+        results[seq] = payload
+        report["shards_completed"] += 1
+        if was_busy:
+            latencies.append(time.monotonic() - w.dispatch_t)
+            if w.speculative:
+                report["speculations_won"] += 1
+        # losing copies of this shard are now wasted work: reclaim their
+        # workers so re-dispatch/speculation capacity comes back
+        for other_wid in list(active[seq]):
+            loser = pool.get(other_wid)
+            if loser is None:
+                continue
+            if loser.speculative:
+                report["speculations_wasted"] += 1
+            drop_worker(loser, kill=True)
+            spawn(respawn=True)
+        active[seq].clear()
+
+    try:
+        for _ in range(target_workers):
+            spawn(respawn=False)
+
+        while (not fatal
+               and (pending or any(w.busy_seq is not None
+                                   for w in pool.values()))):
+            now = time.monotonic()
+            if deadline is not None and now > deadline:
+                outstanding = [s for s in range(n) if not terminal[s]]
+                if error_policy.is_partial:
+                    for s in outstanding:
+                        last_error[s] = last_error[s] or (
+                            f"scan deadline of {scan_deadline_s}s "
+                            "expired")
+                        shard_failed(s, "scan_deadline")
+                    pending.clear()
+                    break
+                raise ScanDeadlineError(
+                    f"scan deadline of {scan_deadline_s}s expired with "
+                    f"{len(outstanding)} of {n} shard(s) outstanding "
+                    f"(first: {_shard_desc(shards[outstanding[0]])})")
+
+            # 1. receive: results / errors / heartbeats
+            by_conn = {w.result_r: w for w in pool.values()}
+            ready = conn_wait(list(by_conn), timeout=_TICK_S)
+            for conn in ready:
+                w = by_conn[conn]
+                while True:
+                    try:
+                        if not conn.poll(0):
+                            break
+                        msg = conn.recv()
+                    except (EOFError, OSError):
+                        break  # death handled by the liveness sweep
+                    except Exception:
+                        break  # torn message from a dying worker
+                    kind = msg[0]
+                    if kind == "hb":
+                        w.last_hb = time.monotonic()
+                        report["heartbeats"] += 1
+                    elif kind == "done":
+                        handle_done(w, msg[2], msg[3])
+                    elif kind == "err":
+                        _, _, seq, blob, text, _tb = msg
+                        if w.busy_seq == seq:
+                            active[seq].discard(w.wid)
+                            w.busy_seq = None
+                        last_exc_blob[seq] = blob
+                        attempt_failed(seq, "error", text)
+
+            # 2. liveness sweep: crashes and per-shard deadlines
+            for w in list(pool.values()):
+                if not w.proc.is_alive():
+                    seq = w.busy_seq
+                    drop_worker(w, kill=False)
+                    if seq is not None and not terminal[seq]:
+                        report["worker_crashes"] += 1
+                        attempt_failed(
+                            seq, "crash",
+                            f"worker process died (exit code "
+                            f"{w.proc.exitcode}) while scanning shard "
+                            f"{_shard_desc(shards[seq])}")
+                elif (shard_timeout_s > 0 and w.busy_seq is not None
+                        and now - w.dispatch_t > shard_timeout_s):
+                    seq = w.busy_seq
+                    report["shard_timeouts"] += 1
+                    drop_worker(w, kill=True)
+                    attempt_failed(
+                        seq, "timeout",
+                        f"shard {_shard_desc(shards[seq])} exceeded "
+                        f"shard_timeout_s={shard_timeout_s} "
+                        f"(last heartbeat {now - w.last_hb:.1f}s ago)")
+
+            if fatal:
+                break
+
+            # 3. speculation: duplicate stragglers past the latency
+            #    quantile onto idle capacity (first completion wins)
+            if (speculative_quantile > 0
+                    and len(latencies) >= _MIN_LATENCY_SAMPLES):
+                threshold = max(
+                    _quantile(sorted(latencies), speculative_quantile),
+                    _MIN_SPECULATION_S)
+                for w in list(pool.values()):
+                    seq = w.busy_seq
+                    if (seq is None or speculated[seq] or terminal[seq]
+                            or now - w.dispatch_t <= threshold):
+                        continue
+                    idle = next((c for c in pool.values()
+                                 if c.busy_seq is None), None)
+                    if idle is None and len(pool) < target_workers:
+                        idle = spawn(respawn=True)
+                    if idle is None:
+                        break
+                    if dispatch(idle, seq, speculative=True):
+                        speculated[seq] = True
+                        report["speculations_launched"] += 1
+
+            # 4. dispatch pending shards onto idle (or fresh) workers
+            while pending:
+                idle = next((c for c in pool.values()
+                             if c.busy_seq is None), None)
+                if idle is None:
+                    if len(pool) >= target_workers:
+                        break
+                    idle = spawn(respawn=True)
+                seq = pending.popleft()
+                if terminal[seq]:
+                    continue
+                if not dispatch(idle, seq, speculative=False):
+                    # the target worker was dead; keep the shard pending
+                    # and re-evaluate capacity (a fresh fork next pass)
+                    pending.appendleft(seq)
+    finally:
+        for w in list(pool.values()):
+            try:
+                w.task_w.send(None)
+            except Exception:
+                pass
+        for w in list(pool.values()):
+            w.proc.join(0.5)
+            if w.proc.is_alive():
+                w.kill()
+            else:
+                w.close()
+        pool.clear()
+
+    if fatal:
+        fatal.sort(key=lambda f: f[0])
+        raise fatal[0][1]
+    return results, failures, report
+
+
+def _inline_map(scan_fn, shards, results, failures, report, error_policy,
+                max_attempts, deadline, failure_info) -> None:
+    """Degenerate supervision (one worker / one shard): no fork, same
+    retry/deadline/policy semantics, sequential canonical order."""
+    for seq, shard in enumerate(shards):
+        if deadline is not None and time.monotonic() > deadline:
+            for s in range(seq, len(shards)):
+                report["shards_failed"] += 1
+                if error_policy.is_partial:
+                    failures.append(failure_info(
+                        shards[s], 0, "scan_deadline",
+                        "scan deadline expired"))
+            if error_policy.is_partial:
+                return
+            raise ScanDeadlineError(
+                f"scan deadline expired with {len(shards) - seq} "
+                f"shard(s) outstanding")
+        last_exc: Optional[BaseException] = None
+        for _ in range(max_attempts):
+            report["dispatches"] += 1
+            try:
+                results[seq] = scan_fn(shard, seq)
+                report["shards_completed"] += 1
+                last_exc = None
+                break
+            except BaseException as exc:
+                if last_exc is not None:
+                    report["re_dispatches"] += 1
+                last_exc = exc
+        if last_exc is not None:
+            report["shards_failed"] += 1
+            if error_policy.is_partial:
+                failures.append(failure_info(
+                    shard, max_attempts, "error",
+                    f"{type(last_exc).__name__}: {last_exc}"))
+            else:
+                raise last_exc
+
+
+def _shard_desc(shard) -> str:
+    path = getattr(shard, "file_path", None)
+    if path is None:
+        return repr(shard)
+    return (f"{path}[{getattr(shard, 'offset_from', 0)}:"
+            f"{getattr(shard, 'offset_to', -1)}]")

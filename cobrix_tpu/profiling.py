@@ -117,6 +117,10 @@ class ReadMetrics:
     # peak_inflight, wall_s, busy_s, overlap}); None on sequential reads
     stage_busy: Optional[StageTimes] = None
     pipeline: Optional[dict] = None
+    # distributed supervision events (multihost scheduler / pipeline
+    # watchdog): re-dispatches, speculation won/wasted, timeouts, worker
+    # deaths; None when the read ran unsupervised
+    supervision: Optional[dict] = None
     # compile-cache activity DURING this read (copybook parse / field-plan
     # / code-page LUT hits and misses, delta from read start). The
     # counters are process-global: with CONCURRENT read_cobol calls the
@@ -154,6 +158,8 @@ class ReadMetrics:
             out["stage_busy_s"] = self.stage_busy.as_dict()
         if self.pipeline is not None:
             out["pipeline"] = self.pipeline
+        if self.supervision is not None:
+            out["supervision"] = self.supervision
         if self.plan_cache is not None:
             out["plan_cache"] = self.plan_cache
         return out

@@ -54,6 +54,32 @@ class RecordErrorPolicy(Enum):
         return self is RecordErrorPolicy.PERMISSIVE
 
 
+class ShardErrorPolicy(Enum):
+    """What a *shard-level* failure (worker crash, deadline, exhausted
+    re-dispatch) does to a distributed scan. Orthogonal to
+    :class:`RecordErrorPolicy`, which governs malformed records *within*
+    a healthy shard."""
+
+    FAIL_FAST = "fail_fast"
+    PARTIAL = "partial"
+
+    @classmethod
+    def parse(cls, value: "str | ShardErrorPolicy") -> "ShardErrorPolicy":
+        if isinstance(value, cls):
+            return value
+        try:
+            return cls(str(value).strip().lower())
+        except ValueError:
+            valid = ", ".join(repr(p.value) for p in cls)
+            raise ValueError(
+                f"Invalid value '{value}' for 'shard_error_policy' option. "
+                f"Valid policies: {valid}.") from None
+
+    @property
+    def is_partial(self) -> bool:
+        return self is ShardErrorPolicy.PARTIAL
+
+
 DEFAULT_RESYNC_WINDOW = 64 * 1024
 DEFAULT_LEDGER_CAP = 100
 
@@ -106,6 +132,36 @@ class CorruptRecordInfo:
         }
 
 
+@dataclass(frozen=True)
+class ShardFailureInfo:
+    """One shard the supervised distributed scan could not complete.
+
+    Produced by the shard supervisor (parallel/supervisor.py) and the
+    pipeline watchdog (engine/pipeline.py) under
+    ``shard_error_policy='partial'`` — the rows of this byte range are
+    MISSING from the returned tables, and this entry says which bytes,
+    why, and after how many attempts."""
+
+    file: str
+    offset_from: int
+    offset_to: int         # -1 = to end of file
+    record_index: int      # Record_Id seed of the lost shard
+    attempts: int          # dispatch attempts consumed (speculation incl.)
+    reason: str            # 'crash' | 'timeout' | 'error' | 'scan_deadline'
+    error: str = ""        # last error message observed for the shard
+
+    def as_dict(self) -> dict:
+        return {
+            "file": self.file,
+            "offset_from": self.offset_from,
+            "offset_to": self.offset_to,
+            "record_index": self.record_index,
+            "attempts": self.attempts,
+            "reason": self.reason,
+            "error": self.error,
+        }
+
+
 @dataclass
 class ReadDiagnostics:
     """Per-read error ledger: counts always, entries up to `max_entries`."""
@@ -115,8 +171,10 @@ class ReadDiagnostics:
     bytes_skipped: int = 0      # bytes discarded by resynchronization
     resyncs: int = 0            # successful header resynchronizations
     io_retries: int = 0         # storage reads retried by the IO layer
+    shards_failed: int = 0      # shards lost by the distributed scan
     max_entries: int = DEFAULT_LEDGER_CAP
     entries: List[CorruptRecordInfo] = dc_field(default_factory=list)
+    shard_failures: List[ShardFailureInfo] = dc_field(default_factory=list)
 
     @property
     def entries_truncated(self) -> bool:
@@ -137,6 +195,12 @@ class ReadDiagnostics:
         self.record(CorruptRecordInfo(file, offset, length, reason,
                                       hex_snapshot(header)))
 
+    def record_shard_failure(self, info: ShardFailureInfo) -> None:
+        """A shard the distributed scan gave up on (partial policy)."""
+        self.shards_failed += 1
+        if len(self.shard_failures) < self.max_entries:
+            self.shard_failures.append(info)
+
     def merge(self, other: Optional["ReadDiagnostics"]) -> "ReadDiagnostics":
         if other is None:
             return self
@@ -145,9 +209,13 @@ class ReadDiagnostics:
         self.bytes_skipped += other.bytes_skipped
         self.resyncs += other.resyncs
         self.io_retries += other.io_retries
+        self.shards_failed += other.shards_failed
         room = self.max_entries - len(self.entries)
         if room > 0:
             self.entries.extend(other.entries[:room])
+        room = self.max_entries - len(self.shard_failures)
+        if room > 0:
+            self.shard_failures.extend(other.shard_failures[:room])
         return self
 
     @classmethod
@@ -162,6 +230,7 @@ class ReadDiagnostics:
         to finish."""
         out = cls(max_entries=max_entries)
         entries: List[CorruptRecordInfo] = []
+        failures: List[ShardFailureInfo] = []
         for ledger in ledgers:
             if ledger is None:
                 continue
@@ -170,17 +239,21 @@ class ReadDiagnostics:
             out.bytes_skipped += ledger.bytes_skipped
             out.resyncs += ledger.resyncs
             out.io_retries += ledger.io_retries
+            out.shards_failed += ledger.shards_failed
             entries.extend(ledger.entries)
+            failures.extend(ledger.shard_failures)
         entries.sort(key=lambda e: (
             e.file, e.offset,
             -1 if e.record_index is None else e.record_index))
         out.entries = entries[:max_entries]
+        failures.sort(key=lambda f: (f.file, f.offset_from))
+        out.shard_failures = failures[:max_entries]
         return out
 
     @property
     def is_clean(self) -> bool:
         return (self.corrupt_records == 0 and self.bytes_skipped == 0
-                and self.io_retries == 0)
+                and self.io_retries == 0 and self.shards_failed == 0)
 
     def as_dict(self) -> dict:
         return {
@@ -189,8 +262,10 @@ class ReadDiagnostics:
             "bytes_skipped": self.bytes_skipped,
             "resyncs": self.resyncs,
             "io_retries": self.io_retries,
+            "shards_failed": self.shards_failed,
             "entries_truncated": self.entries_truncated,
             "entries": [e.as_dict() for e in self.entries],
+            "shard_failures": [f.as_dict() for f in self.shard_failures],
         }
 
     def to_json(self) -> str:
@@ -205,7 +280,8 @@ class ReadDiagnostics:
                    records_dropped=d.get("records_dropped", 0),
                    bytes_skipped=d.get("bytes_skipped", 0),
                    resyncs=d.get("resyncs", 0),
-                   io_retries=d.get("io_retries", 0))
+                   io_retries=d.get("io_retries", 0),
+                   shards_failed=d.get("shards_failed", 0))
         diag.entries = [
             CorruptRecordInfo(
                 file=e.get("file", ""), offset=e.get("offset", -1),
@@ -213,4 +289,13 @@ class ReadDiagnostics:
                 header_snapshot=e.get("header_snapshot", ""),
                 record_index=e.get("record_index"))
             for e in d.get("entries", [])]
+        diag.shard_failures = [
+            ShardFailureInfo(
+                file=f.get("file", ""),
+                offset_from=f.get("offset_from", 0),
+                offset_to=f.get("offset_to", -1),
+                record_index=f.get("record_index", 0),
+                attempts=f.get("attempts", 0),
+                reason=f.get("reason", ""), error=f.get("error", ""))
+            for f in d.get("shard_failures", [])]
         return diag

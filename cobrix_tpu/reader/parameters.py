@@ -22,6 +22,7 @@ from .diagnostics import (
     DEFAULT_LEDGER_CAP,
     DEFAULT_RESYNC_WINDOW,
     RecordErrorPolicy,
+    ShardErrorPolicy,
 )
 
 DEFAULT_FILE_RECORD_ID_INCREMENT = 2 ** 32      # reference reader Constants.scala:28
@@ -117,6 +118,28 @@ class ReaderParameters:
     # backpressure bound: chunks concurrently held in flight (raw bytes +
     # decoded columns). 0 = workers + 2
     pipeline_max_inflight: int = 0
+    # -- distributed supervision (parallel/supervisor.py + engine
+    # watchdog; the Spark task-retry/speculation analogue) ---------------
+    # what a shard-level failure (worker crash, deadline, exhausted
+    # re-dispatch) does to the scan: fail_fast raises, partial returns the
+    # completed shards plus a ShardFailureInfo ledger on ReadDiagnostics
+    shard_error_policy: ShardErrorPolicy = ShardErrorPolicy.FAIL_FAST
+    # per-shard (multihost) / per-chunk (pipeline) wall deadline; a shard
+    # past it is treated as wedged — worker killed + shard re-dispatched.
+    # 0 = no deadline (crash detection stays on)
+    shard_timeout_s: float = 0.0
+    # re-dispatches allowed per shard after crash/timeout/error before the
+    # shard counts as failed (total attempts = 1 + shard_max_retries)
+    shard_max_retries: int = 2
+    # straggler speculation: once enough shard latencies are observed, a
+    # shard still running past this quantile of completed latencies gets a
+    # duplicate dispatched on an idle worker; first completion wins,
+    # duplicates dedupe by shard key. 0 = off (Spark's default too)
+    speculative_quantile: float = 0.0
+    # whole-scan wall deadline across plan+dispatch+reassembly. 0 = none
+    scan_deadline_s: float = 0.0
+    # worker heartbeat period (multihost supervision; liveness telemetry)
+    heartbeat_interval_s: float = 0.5
 
     def resolved_pipeline_workers(self) -> int:
         """Effective worker count: 0 = sequential, negative = auto."""

@@ -8,12 +8,17 @@ few reads before recovering. The fault-tolerance test matrix
 `record_error_policy` through these injectors; they are permanent test
 infrastructure, not throwaway helpers.
 
-All injectors are pure: they take `bytes` and return corrupted `bytes`
-plus (where useful) the corruption site, so assertions can check the
-ledger points at the right offset.
+All byte-level injectors are pure: they take `bytes` and return
+corrupted `bytes` plus (where useful) the corruption site, so assertions
+can check the ledger points at the right offset. `ShardFaultPlan` at the
+bottom breaks *workers* instead of bytes (crash / hang / straggle /
+error per shard) — the supervision test matrix
+(tests/test_supervision.py, tools/chaoscheck.py) drives the shard
+supervisor through it.
 """
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -140,3 +145,116 @@ def register_flaky_backend(scheme: str, data: bytes,
     source = FlakySource(data, **kwargs)
     register_stream_backend(scheme, lambda path: source)
     return source
+
+
+# -- distributed-supervision fault injection -----------------------------
+#
+# The injectors below break WORKERS, not bytes: a multihost worker
+# process crashes mid-shard (os._exit), wedges past its deadline
+# (sleep), straggles (sleep-then-succeed), or raises — the profiles the
+# shard supervisor (parallel/supervisor.py) must recover from. A
+# ShardFaultPlan installs itself as the hosts-module fault hook; fork
+# children inherit it, so no pickling. "Once" faults coordinate across
+# worker processes (the re-dispatched attempt runs in a DIFFERENT fork)
+# through O_CREAT|O_EXCL marker files in a shared state dir: exactly one
+# attempt fires the fault, every later attempt sails through — which is
+# precisely the transient-failure profile recovery tests need.
+
+
+class ShardFaultPlan:
+    """Per-shard fault plan keyed by shard sequence number (the shard's
+    position in the supervisor's canonical (file_order, offset) order).
+
+        plan = ShardFaultPlan(state_dir)
+        plan.crash(1)              # worker scanning shard 1 dies once
+        plan.hang(2, 120.0)        # shard 2 wedges once (kill+redispatch)
+        plan.slow(0, 3.0)          # shard 0 straggles (speculation bait)
+        plan.error(3, once=False)  # shard 3 raises on EVERY attempt
+        with plan.installed():
+            read_cobol(..., hosts=2)
+    """
+
+    def __init__(self, state_dir: str):
+        self.state_dir = str(state_dir)
+        self._faults: dict = {}
+
+    def crash(self, seq: int, once: bool = True,
+              exit_code: int = 42) -> "ShardFaultPlan":
+        """Worker death mid-shard: os._exit — no exception, no cleanup,
+        exactly how an OOM-killed or segfaulted executor goes."""
+        self._faults[seq] = ("crash", float(exit_code), once)
+        return self
+
+    def hang(self, seq: int, seconds: float = 3600.0,
+             once: bool = True) -> "ShardFaultPlan":
+        """Worker wedge: sleep far past the shard deadline so the
+        supervisor must kill + re-dispatch."""
+        self._faults[seq] = ("hang", seconds, once)
+        return self
+
+    def slow(self, seq: int, seconds: float,
+             once: bool = True) -> "ShardFaultPlan":
+        """Straggler: delay, then scan normally. With `once`, a
+        speculative duplicate of the shard runs at full speed — the
+        first-completion-wins race the speculation tests pin."""
+        self._faults[seq] = ("slow", seconds, once)
+        return self
+
+    def error(self, seq: int, message: str = "injected shard error",
+              once: bool = False) -> "ShardFaultPlan":
+        """Deterministic in-shard exception (a poison shard when
+        once=False: every re-dispatch fails too)."""
+        self._faults[seq] = ("error", message, once)
+        return self
+
+    def fired(self, seq: int) -> bool:
+        """True once the fault for `seq` has fired in some worker."""
+        return os.path.exists(self._marker(seq))
+
+    def _marker(self, seq: int) -> str:
+        return os.path.join(self.state_dir, f"shard_fault_{seq}")
+
+    def _claim(self, seq: int) -> bool:
+        """Atomically claim a once-fault across worker processes."""
+        try:
+            fd = os.open(self._marker(seq),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        os.close(fd)
+        return True
+
+    def __call__(self, shard, seq: int) -> None:
+        """Runs inside the worker immediately before the shard scan."""
+        import time
+
+        fault = self._faults.get(seq)
+        if fault is None:
+            return
+        kind, arg, once = fault
+        if once and not self._claim(seq):
+            return
+        if not once:
+            self._claim(seq)  # leave a fired() breadcrumb anyway
+        if kind == "crash":
+            os._exit(int(arg))
+        elif kind in ("hang", "slow"):
+            time.sleep(float(arg))
+        elif kind == "error":
+            raise RuntimeError(f"{arg} (shard seq {seq})")
+
+    def installed(self):
+        """Context manager installing this plan as the multihost fault
+        hook (and uninstalling on exit, even on test failure)."""
+        import contextlib
+
+        from ..parallel import hosts
+
+        @contextlib.contextmanager
+        def _ctx():
+            hosts.set_shard_fault_hook(self)
+            try:
+                yield self
+            finally:
+                hosts.set_shard_fault_hook(None)
+        return _ctx()

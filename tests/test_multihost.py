@@ -12,6 +12,17 @@ from cobrix_tpu import read_cobol
 from cobrix_tpu.testing.generators import (EXP1_COPYBOOK, EXP2_COPYBOOK,
                                            generate_exp1, generate_exp2)
 
+from util import hard_timeout
+
+
+@pytest.fixture(autouse=True)
+def _no_hang(request):
+    """Every multihost test runs under a hard SIGALRM deadline: if a
+    fork/pipe/queue wait is ever unbounded again, CI fails loud with a
+    TimeoutError instead of hanging the whole run."""
+    with hard_timeout(120, request.node.name):
+        yield
+
 
 @pytest.fixture
 def multiseg_files(tmp_path):

@@ -1,0 +1,482 @@
+"""Supervised distributed execution: crash recovery, shard re-dispatch,
+stragglers, deadlines — the Spark task-supervision semantics
+(task retry / speculation / partial results) for both distributed paths:
+
+* the multi-host process scheduler (parallel/supervisor.py): injected
+  worker crashes, hangs past the shard deadline, stragglers, poison
+  shards, and whole-scan deadlines, under fail_fast and partial policies
+  on both fixed-length and variable-length inputs, asserting full row
+  parity with a clean single-process read wherever recovery is promised;
+* the pipeline executor watchdog (engine/pipeline.py): re-queue-once,
+  per-chunk and whole-scan deadlines, stuck-stage reporting, bounded
+  shutdown.
+
+Every test runs under a hard SIGALRM deadline (tests/util.hard_timeout):
+a supervision bug can fail these tests but can never hang CI.
+"""
+import os
+import tempfile
+import threading
+import time
+
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.engine.pipeline import (PipelineExecutor,
+                                        PipelineTimeoutError)
+from cobrix_tpu.parallel.supervisor import (ScanDeadlineError,
+                                            ShardSupervisionError)
+from cobrix_tpu.reader.diagnostics import (ReadDiagnostics,
+                                           ShardErrorPolicy,
+                                           ShardFailureInfo)
+from cobrix_tpu.testing.faults import ShardFaultPlan
+from cobrix_tpu.testing.generators import (EXP1_COPYBOOK, EXP2_COPYBOOK,
+                                           generate_exp1, generate_exp2)
+
+from util import hard_timeout
+
+
+@pytest.fixture(autouse=True)
+def _no_hang(request):
+    limit = 900 if request.node.get_closest_marker("slow") else 120
+    with hard_timeout(limit, request.node.name):
+        yield
+
+
+@pytest.fixture
+def state_dir(tmp_path):
+    return str(tmp_path / "fault_state")
+
+
+@pytest.fixture
+def plan(state_dir):
+    os.makedirs(state_dir, exist_ok=True)
+    return ShardFaultPlan(state_dir)
+
+
+VARLEN_BASE = dict(copybook_contents=EXP2_COPYBOOK,
+                   is_record_sequence="true",
+                   segment_field="SEGMENT-ID",
+                   redefine_segment_id_map="STATIC-DETAILS => C",
+                   redefine_segment_id_map_1="CONTACTS => P",
+                   segment_id_prefix="SUP",
+                   generate_record_id="true")
+
+
+@pytest.fixture(scope="module")
+def varlen_files():
+    d = tempfile.mkdtemp(prefix="supervision_")
+    for i, (n, seed) in enumerate([(1200, 13), (800, 14)]):
+        with open(os.path.join(d, f"part{i}.dat"), "wb") as f:
+            f.write(generate_exp2(n, seed=seed))
+    return os.path.join(d, "*.dat")
+
+
+@pytest.fixture(scope="module")
+def varlen_clean(varlen_files):
+    return read_cobol(varlen_files, **VARLEN_BASE).to_arrow()
+
+
+@pytest.fixture(scope="module")
+def fixed_file():
+    d = tempfile.mkdtemp(prefix="supervision_fixed_")
+    p = os.path.join(d, "fixed.dat")
+    with open(p, "wb") as f:
+        f.write(generate_exp1(301, seed=21).tobytes())
+    return p
+
+
+def sup(data):
+    return data.metrics.as_dict()["supervision"]
+
+
+# -- worker crash: re-dispatch onto a respawned worker, full parity ------
+
+def test_worker_crash_recovery_varlen(varlen_files, varlen_clean, plan):
+    plan.crash(1)
+    with plan.installed():
+        data = read_cobol(varlen_files, hosts="2",
+                          input_split_records="400", **VARLEN_BASE)
+    assert data.to_arrow().equals(varlen_clean)
+    report = sup(data)
+    assert report["worker_crashes"] >= 1
+    assert report["re_dispatches"] >= 1
+    assert report["worker_respawns"] >= 1
+    assert report["shards_failed"] == 0
+    assert data.diagnostics is None  # recovered fail_fast read is clean
+
+
+def test_worker_crash_recovery_fixed(fixed_file, plan):
+    kw = dict(copybook_contents=EXP1_COPYBOOK)
+    clean = read_cobol(fixed_file, **kw).to_arrow()
+    plan.crash(0)
+    with plan.installed():
+        data = read_cobol(fixed_file, hosts="2", **kw)
+    assert data.to_arrow().equals(clean)
+    assert sup(data)["worker_crashes"] >= 1
+
+
+# -- worker hang: shard deadline kills + re-dispatches -------------------
+
+def test_worker_hang_redispatched_after_deadline(varlen_files,
+                                                 varlen_clean, plan):
+    plan.hang(2, seconds=60.0)
+    with plan.installed():
+        t0 = time.monotonic()
+        data = read_cobol(varlen_files, hosts="2",
+                          input_split_records="400", shard_timeout_s="2",
+                          **VARLEN_BASE)
+        elapsed = time.monotonic() - t0
+    assert data.to_arrow().equals(varlen_clean)
+    report = sup(data)
+    assert report["shard_timeouts"] >= 1
+    assert report["re_dispatches"] >= 1
+    assert elapsed < 60  # the hang was cut short, not waited out
+
+
+# -- straggler: speculative duplicate wins, duplicates dedupe ------------
+
+def test_straggler_speculation_first_completion_wins(varlen_files,
+                                                     varlen_clean, plan):
+    plan.slow(1, seconds=20.0)  # once: the speculative copy runs clean
+    with plan.installed():
+        t0 = time.monotonic()
+        data = read_cobol(varlen_files, hosts="2",
+                          input_split_records="400",
+                          speculative_quantile="0.5", **VARLEN_BASE)
+        elapsed = time.monotonic() - t0
+    assert data.to_arrow().equals(varlen_clean)
+    report = sup(data)
+    assert report["speculations_launched"] >= 1
+    assert report["speculations_won"] >= 1
+    assert elapsed < 20  # the straggler did not serialize the scan
+
+
+# -- poison shard: fail_fast raises the original error, partial ledgers --
+
+def test_poison_shard_fail_fast_raises_original(varlen_files, plan):
+    plan.error(0, message="injected shard error", once=False)
+    with plan.installed():
+        with pytest.raises(RuntimeError, match="injected shard error"):
+            read_cobol(varlen_files, hosts="2", input_split_records="400",
+                       shard_max_retries="1", **VARLEN_BASE)
+
+
+def test_poison_shard_partial_returns_rest_plus_ledger(varlen_files,
+                                                       varlen_clean,
+                                                       plan):
+    plan.error(0, once=False)
+    with plan.installed():
+        data = read_cobol(varlen_files, hosts="2",
+                          input_split_records="400",
+                          shard_error_policy="partial",
+                          shard_max_retries="1", **VARLEN_BASE)
+    table = data.to_arrow()
+    assert 0 < table.num_rows < varlen_clean.num_rows
+    d = data.diagnostics
+    assert d is not None and d.shards_failed == 1
+    failure = d.shard_failures[0]
+    assert failure.reason == "error"
+    assert "injected shard error" in failure.error
+    assert failure.attempts == 2  # initial + one re-dispatch
+    # the completed shards are byte-faithful: the missing rows are
+    # exactly the failed shard's contiguous prefix of file 0
+    clean_ids = set(varlen_clean.column("Record_Id").to_pylist())
+    part_ids = set(table.column("Record_Id").to_pylist())
+    missing = clean_ids - part_ids
+    assert part_ids <= clean_ids and missing
+    assert min(missing) == 0 and max(missing) == len(missing) - 1
+
+
+def test_persistent_crash_partial(varlen_files, varlen_clean, plan):
+    plan.crash(1, once=False)
+    with plan.installed():
+        data = read_cobol(varlen_files, hosts="2",
+                          input_split_records="400",
+                          shard_error_policy="partial",
+                          shard_max_retries="1", **VARLEN_BASE)
+    d = data.diagnostics
+    assert d is not None and d.shards_failed == 1
+    assert d.shard_failures[0].reason == "crash"
+    assert sup(data)["worker_crashes"] >= 2  # every attempt died
+    assert 0 < data.to_arrow().num_rows < varlen_clean.num_rows
+
+
+def test_persistent_crash_fail_fast_raises_supervision_error(
+        varlen_files, plan):
+    plan.crash(1, once=False)
+    with plan.installed():
+        with pytest.raises(ShardSupervisionError, match="crash"):
+            read_cobol(varlen_files, hosts="2", input_split_records="400",
+                       shard_max_retries="1", **VARLEN_BASE)
+
+
+# -- whole-scan deadline -------------------------------------------------
+
+def test_scan_deadline_fail_fast(varlen_files, plan):
+    plan.hang(1, seconds=60.0, once=False)
+    t0 = time.monotonic()
+    with plan.installed():
+        with pytest.raises(ScanDeadlineError, match="deadline"):
+            read_cobol(varlen_files, hosts="2", input_split_records="400",
+                       scan_deadline_s="2", **VARLEN_BASE)
+    assert time.monotonic() - t0 < 30
+
+
+def test_scan_deadline_partial(varlen_files, varlen_clean, plan):
+    plan.hang(1, seconds=60.0, once=False)
+    t0 = time.monotonic()
+    with plan.installed():
+        data = read_cobol(varlen_files, hosts="2",
+                          input_split_records="400", scan_deadline_s="2",
+                          shard_error_policy="partial", **VARLEN_BASE)
+    assert time.monotonic() - t0 < 30
+    d = data.diagnostics
+    assert d is not None and d.shards_failed >= 1
+    assert {f.reason for f in d.shard_failures} == {"scan_deadline"}
+    assert 0 < data.to_arrow().num_rows < varlen_clean.num_rows
+
+
+# -- satellite regressions ----------------------------------------------
+
+def test_duplicate_shard_keys_dedupe_deterministically(varlen_files,
+                                                       varlen_clean):
+    """A duplicated shard in the plan (speculation/re-dispatch aftermath,
+    or a planner bug) must dedupe to one result + a metric, not silently
+    last-write-wins overwrite."""
+    import pyarrow as pa
+
+    from cobrix_tpu.api import (CobolOutputSchema, _plan_var_len_shards,
+                                parse_options)
+    from cobrix_tpu.parallel.hosts import multihost_scan
+    from cobrix_tpu.reader.var_len_reader import VarLenReader
+
+    params, _ = parse_options(dict(VARLEN_BASE))
+    reader = VarLenReader(EXP2_COPYBOOK, params)
+    files = sorted(
+        os.path.join(os.path.dirname(varlen_files), f)
+        for f in os.listdir(os.path.dirname(varlen_files))
+        if f.endswith(".dat"))
+    shards = _plan_var_len_shards(reader, files, params)
+    assert len(shards) >= 2
+    schema = CobolOutputSchema(
+        reader.copybook, policy=params.schema_policy,
+        generate_record_id=True, generate_seg_id_field_count=0,
+        segment_id_prefix="")
+    tables, failures, report = multihost_scan(
+        reader, list(shards) + [shards[0]], True, schema, 2, "SUP")
+    assert report["duplicate_shard_keys"] == 1
+    assert not failures
+    merged = pa.concat_tables(tables)
+    assert merged.num_rows == varlen_clean.num_rows
+
+
+def test_concurrent_multihost_scans_do_not_clobber(tmp_path):
+    """Two multihost scans in flight at once: the worker context is
+    per-scan (fork closure), not a module global — each must return its
+    own rows (the old `_CTX` global made this a race). The scans run
+    with supervision deadlines enabled: forking from a threaded parent
+    can wedge a child under load, and recovering from exactly that
+    (kill + re-dispatch on a fresh fork) is the supervisor's job."""
+    kw = dict(copybook_contents=EXP1_COPYBOOK, shard_timeout_s="15",
+              scan_deadline_s="90")
+    paths, singles = [], []
+    for i, n in enumerate((180, 260)):
+        p = str(tmp_path / f"c{i}.dat")
+        with open(p, "wb") as f:
+            f.write(generate_exp1(n, seed=30 + i).tobytes())
+        paths.append(p)
+        singles.append(read_cobol(p, **kw).to_arrow())
+    outputs = [None, None]
+    errors = []
+
+    def scan(i):
+        try:
+            outputs[i] = read_cobol(paths[i], hosts="2", **kw).to_arrow()
+        except BaseException as exc:  # surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=scan, args=(i,), daemon=True)
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=100)
+    assert not errors
+    for i in range(2):
+        assert outputs[i] is not None and outputs[i].equals(singles[i])
+
+
+def test_shard_failure_ledger_roundtrip():
+    d = ReadDiagnostics()
+    d.record_shard_failure(ShardFailureInfo(
+        file="/data/x.dat", offset_from=100, offset_to=900,
+        record_index=4, attempts=3, reason="timeout", error="wedged"))
+    back = ReadDiagnostics.from_json(d.to_json())
+    assert back.shards_failed == 1
+    assert back.shard_failures[0] == d.shard_failures[0]
+    assert not back.is_clean
+    merged = ReadDiagnostics.merged([back, ReadDiagnostics()])
+    assert merged.shards_failed == 1
+
+
+def test_supervision_option_validation():
+    kw = dict(copybook_contents=EXP1_COPYBOOK)
+    with pytest.raises(ValueError, match="speculative_quantile"):
+        read_cobol("/nonexistent", speculative_quantile="1.5", **kw)
+    with pytest.raises(ValueError, match="shard_timeout_s"):
+        read_cobol("/nonexistent", shard_timeout_s="-1", **kw)
+    with pytest.raises(ValueError, match="shard_max_retries"):
+        read_cobol("/nonexistent", shard_max_retries="-1", **kw)
+    with pytest.raises(ValueError, match="shard_error_policy"):
+        read_cobol("/nonexistent", shard_error_policy="maybe", **kw)
+    with pytest.raises(ValueError, match="scan_deadline_s"):
+        read_cobol("/nonexistent", scan_deadline_s="-2", **kw)
+
+
+def test_supervision_options_pedantic_accepted(fixed_file):
+    data = read_cobol(fixed_file, copybook_contents=EXP1_COPYBOOK,
+                      hosts="2", pedantic="true",
+                      shard_error_policy="partial", shard_timeout_s="30",
+                      shard_max_retries="1", speculative_quantile="0.9",
+                      scan_deadline_s="60", heartbeat_interval_s="0.2")
+    assert len(data) == 301
+
+
+# -- pipeline executor watchdog (thread path, same discipline) -----------
+
+def _task(i, proc):
+    return ((lambda: i), proc)
+
+
+def test_pipeline_requeues_chunk_once():
+    failed_once = []
+
+    def proc(x):
+        if x == 1 and not failed_once:
+            failed_once.append(x)
+            raise RuntimeError("transient chunk failure")
+        return x * 10
+
+    ex = PipelineExecutor(2)
+    assert ex.run([_task(i, proc) for i in range(4)]) == [0, 10, 20, 30]
+    assert ex.report["chunk_retries"] == 1
+
+
+def test_pipeline_second_failure_is_fatal_fail_fast():
+    def proc(x):
+        if x == 1:
+            raise RuntimeError("poison chunk")
+        return x
+
+    ex = PipelineExecutor(2)
+    with pytest.raises(RuntimeError, match="poison chunk"):
+        ex.run([_task(i, proc) for i in range(3)])
+    assert ex.report["chunk_retries"] == 1
+
+
+def test_pipeline_partial_drops_failed_chunk_with_ledger():
+    def proc(x):
+        if x == 0:
+            raise ValueError("poison chunk 0")
+        return x
+
+    ex = PipelineExecutor(2, error_policy=ShardErrorPolicy.PARTIAL)
+    out = ex.run([_task(i, proc) for i in range(3)])
+    assert out == [None, 1, 2]
+    assert [f.reason for f in ex.shard_failures] == ["error"]
+    assert "poison chunk 0" in ex.shard_failures[0].error
+
+
+def test_pipeline_chunk_deadline_fail_fast_names_stage():
+    def proc(x):
+        if x == 1:
+            time.sleep(30)
+        return x
+
+    ex = PipelineExecutor(2, chunk_timeout_s=1.0)
+    t0 = time.monotonic()
+    with pytest.raises(PipelineTimeoutError, match="decode"):
+        ex.run([_task(i, proc) for i in range(3)])
+    assert time.monotonic() - t0 < 15  # bounded: no indefinite join
+
+
+def test_pipeline_chunk_deadline_partial_respawns_worker():
+    def proc(x):
+        if x == 1:
+            time.sleep(30)
+        return x
+
+    ex = PipelineExecutor(2, chunk_timeout_s=1.0,
+                          error_policy=ShardErrorPolicy.PARTIAL)
+    out = ex.run([_task(i, proc) for i in range(5)])
+    assert out == [0, None, 2, 3, 4]
+    assert ex.report["chunk_timeouts"] == 1
+    assert ex.report["respawned_workers"] >= 1
+    assert [f.reason for f in ex.shard_failures] == ["timeout"]
+
+
+def test_pipeline_scan_deadline_bounded():
+    def proc(x):
+        time.sleep(30)
+        return x
+
+    ex = PipelineExecutor(2, scan_deadline_s=1.0)
+    t0 = time.monotonic()
+    with pytest.raises(PipelineTimeoutError, match="scan deadline"):
+        ex.run([_task(i, proc) for i in range(2)])
+    assert time.monotonic() - t0 < 15
+
+
+def test_pipeline_stall_reports_stuck_stage():
+    """A wedged assembler (no deadlines configured) trips the stall
+    backstop and the error names the stuck stage instead of hanging."""
+    def proc(x):
+        return x
+
+    def finalize(result):
+        time.sleep(60)
+
+    ex = PipelineExecutor(1, stall_timeout_s=1.5)
+    with pytest.raises(PipelineTimeoutError, match="assemble"):
+        ex.run([((lambda: 0), proc, finalize)])
+
+
+# -- chaoscheck smoke (the hosts x fault grid stays behind `slow`) -------
+
+def test_chaoscheck_quick():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/chaoscheck.py", "--records", "1200"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_chaoscheck_sweep():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/chaoscheck.py", "--records", "4800",
+         "--sweep"],
+        capture_output=True, text=True, timeout=1200)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_pipelined_read_supervision_knobs_end_to_end(tmp_path):
+    """The pipeline watchdog knobs thread through read_cobol (parity run
+    with generous deadlines — supervision on, nothing to trip)."""
+    p = str(tmp_path / "pipe.dat")
+    with open(p, "wb") as f:
+        f.write(generate_exp1(400, seed=5).tobytes())
+    kw = dict(copybook_contents=EXP1_COPYBOOK)
+    clean = read_cobol(p, **kw).to_arrow()
+    data = read_cobol(p, pipeline_workers="2", chunk_size_mb="0.1",
+                      shard_timeout_s="60", scan_deadline_s="120",
+                      shard_error_policy="partial", **kw)
+    assert data.to_arrow().equals(clean)
+    assert data.diagnostics is None  # nothing failed -> clean read

@@ -1,8 +1,37 @@
 """Shared helpers for golden-parity tests."""
+import contextlib
 import glob
 import os
+import signal
 
 REFERENCE_DATA = "/root/reference/data"
+
+
+@contextlib.contextmanager
+def hard_timeout(seconds: float, label: str = "test"):
+    """SIGALRM-backed hard per-test deadline: a hung test FAILS loud
+    (TimeoutError with `label`) instead of wedging the whole CI run.
+    Main-thread only (pytest runs tests there); plain pass-through where
+    SIGALRM is unavailable. The distributed-execution tests wrap
+    themselves in this so no fork/pipe bug can ever hang the suite —
+    the in-code deadlines (shard_timeout_s / scan_deadline_s) are the
+    first line of defense, this is the backstop."""
+    if not hasattr(signal, "SIGALRM"):  # pragma: no cover - non-POSIX
+        yield
+        return
+
+    def on_alarm(signum, frame):
+        raise TimeoutError(
+            f"{label} exceeded the hard {seconds:.0f}s test deadline "
+            "(a distributed wait is unbounded somewhere)")
+
+    previous = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, previous)
 
 
 def read_copybook(name: str) -> str:

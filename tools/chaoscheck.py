@@ -1,0 +1,150 @@
+"""Chaos smoke check: multihost scans under injected faults -> recovery + parity.
+
+Drives the supervised distributed scheduler (cobrix_tpu/parallel/
+supervisor.py) through the worker-fault injectors (testing/faults.
+ShardFaultPlan): a clean baseline read, then the same multihost scan
+under an injected worker crash, a wedged worker (shard deadline), a
+straggler (speculation), and a poison shard under the partial policy —
+asserting full row parity wherever recovery is promised and a populated
+shard-failure ledger where it is not. Prints one line per scenario with
+the supervision events (re-dispatches, speculation won/wasted, timeouts,
+worker deaths), mirroring corruptcheck/pipecheck.
+
+    python tools/chaoscheck.py                  # quick: ~2k records
+    python tools/chaoscheck.py --records 20000  # bigger input
+    python tools/chaoscheck.py --hosts 3        # wider worker pool
+    python tools/chaoscheck.py --sweep          # hosts x fault grid
+                                                # (slow; tier-1 runs quick)
+
+Exit code 0 = every scenario recovered/ledgered as specified; 1 = any
+parity mismatch, missed ledger, or (worst) hang — the whole run is also
+wall-clock-bounded per scenario by the in-code deadlines.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+BASE = dict(is_record_sequence="true",
+            segment_field="SEGMENT-ID",
+            redefine_segment_id_map="STATIC-DETAILS => C",
+            redefine_segment_id_map_1="CONTACTS => P",
+            segment_id_prefix="CHAOS",
+            generate_record_id="true")
+
+
+def _dataset(records: int, workdir: str) -> str:
+    from cobrix_tpu.testing.generators import generate_exp2
+
+    for i, seed in enumerate((11, 12)):
+        with open(os.path.join(workdir, f"part{i}.dat"), "wb") as f:
+            f.write(generate_exp2(records // 2, seed=seed))
+    return os.path.join(workdir, "*.dat")
+
+
+def _scenarios(hosts: int):
+    """(name, plan_builder, extra_options, expects_full_parity)."""
+    from cobrix_tpu.testing.faults import ShardFaultPlan
+
+    def crash(p: ShardFaultPlan):
+        return p.crash(1)
+
+    def hang(p: ShardFaultPlan):
+        return p.hang(2, seconds=120.0)
+
+    def straggle(p: ShardFaultPlan):
+        return p.slow(1, seconds=30.0)
+
+    def poison(p: ShardFaultPlan):
+        return p.error(0, once=False)
+
+    return [
+        ("worker_crash", crash, dict(), True),
+        ("worker_hang", hang, dict(shard_timeout_s="3"), True),
+        ("straggler", straggle, dict(speculative_quantile="0.5"), True),
+        ("poison_partial", poison,
+         dict(shard_error_policy="partial", shard_max_retries="1"), False),
+    ]
+
+
+def run_scenario(name, build_plan, extra, expect_parity, glob, clean,
+                 hosts: int, split: int) -> bool:
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing.faults import ShardFaultPlan
+
+    plan = build_plan(ShardFaultPlan(tempfile.mkdtemp(prefix="chaos_")))
+    kw = dict(BASE, copybook_contents=_copybook(), hosts=str(hosts),
+              input_split_records=str(split), **extra)
+    t0 = time.perf_counter()
+    with plan.installed():
+        data = read_cobol(glob, **kw)
+    dt = time.perf_counter() - t0
+    table = data.to_arrow()
+    report = data.metrics.as_dict().get("supervision", {})
+    events = {k: v for k, v in report.items()
+              if v and k not in ("workers", "dispatches", "heartbeats",
+                                 "shards_completed")}
+    if expect_parity:
+        ok = table.equals(clean)
+        verdict = "parity" if ok else "PARITY MISMATCH"
+    else:
+        d = data.diagnostics
+        ok = (d is not None and d.shards_failed >= 1
+              and len(d.shard_failures) >= 1
+              and 0 < table.num_rows < clean.num_rows)
+        verdict = (f"partial {table.num_rows}/{clean.num_rows} rows, "
+                   f"{d.shards_failed if d else 0} shard(s) ledgered"
+                   if ok else "LEDGER/PARTIAL CHECK FAILED")
+    print(f"{name:<16} {dt:6.2f}s | {verdict:<34} | {events}")
+    return ok
+
+
+def _copybook() -> str:
+    from cobrix_tpu.testing.generators import EXP2_COPYBOOK
+
+    return EXP2_COPYBOOK
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--records", type=int, default=2400,
+                    help="total records across the two input files")
+    ap.add_argument("--hosts", type=int, default=2,
+                    help="worker processes for the supervised scans")
+    ap.add_argument("--split", type=int, default=0,
+                    help="records per shard (default: records/6)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run a hosts x fault grid (slow)")
+    args = ap.parse_args()
+
+    from cobrix_tpu import read_cobol
+
+    split = args.split or max(100, args.records // 6)
+    workdir = tempfile.mkdtemp(prefix="chaoscheck_")
+    glob = _dataset(args.records, workdir)
+    clean = read_cobol(glob, copybook_contents=_copybook(),
+                       **BASE).to_arrow()
+    print(f"dataset: {args.records} records, clean rows {clean.num_rows}, "
+          f"split {split} records/shard")
+
+    ok = True
+    host_counts = (2, 3, 4) if args.sweep else (args.hosts,)
+    for hosts in host_counts:
+        if args.sweep:
+            print(f"--- hosts={hosts}")
+        for name, build, extra, parity in _scenarios(hosts):
+            ok &= run_scenario(name, build, extra, parity, glob, clean,
+                               hosts, split)
+    print("OK: every injected fault recovered or ledgered as specified"
+          if ok else "FAILED: recovery/ledger check failed")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
