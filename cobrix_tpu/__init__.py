@@ -12,6 +12,7 @@ from .reader.diagnostics import (ReadDiagnostics, RecordErrorPolicy,
                                  ShardErrorPolicy, ShardFailureInfo)
 from .reader.handlers import (DictHandler, JsonHandler, RecordHandler,
                               TupleHandler)
+from .obs import ScanProgress, Tracer, prometheus_text
 from .profiling import ReadMetrics, profile_trace
 from .reader.stream import (ByteRangeSource, open_stream,
                             register_stream_backend)
@@ -49,6 +50,9 @@ __all__ = [
     "register_stream_backend",
     "ReadMetrics",
     "profile_trace",
+    "ScanProgress",
+    "Tracer",
+    "prometheus_text",
     "ReadDiagnostics",
     "RecordErrorPolicy",
     "ShardErrorPolicy",

@@ -283,6 +283,9 @@ def parse_options(options: Dict[str, object],
         scan_deadline_s=float(opts.get("scan_deadline_s", "") or 0.0),
         heartbeat_interval_s=float(
             opts.get("heartbeat_interval_s", "") or 0.5),
+        trace_file=opts.get("trace_file", "") or "",
+        progress_interval_s=float(
+            opts.get("progress_interval_s", "") or 0.5),
     )
     # recognized keys consumed later by read_cobol — mark used before the
     # pedantic unused-key audit runs
@@ -372,6 +375,24 @@ def _validate_options(opts: Options, params: ReaderParameters,
         raise ValueError(
             f"Invalid 'heartbeat_interval_s' of "
             f"{params.heartbeat_interval_s}; it must be positive.")
+    if params.progress_interval_s < 0:
+        raise ValueError(
+            f"Invalid 'progress_interval_s' of "
+            f"{params.progress_interval_s}; it must be >= 0 "
+            "(0 invokes the callback on every completed chunk).")
+    if params.trace_file:
+        # fail BEFORE the scan, not after minutes of decode: the trace is
+        # written at read end, so an unwritable destination would
+        # otherwise discard a fully successful read
+        trace_dir = os.path.dirname(params.trace_file) or "."
+        if not os.path.isdir(trace_dir):
+            raise ValueError(
+                f"Invalid 'trace_file' '{params.trace_file}': directory "
+                f"'{trace_dir}' does not exist.")
+        if not os.access(trace_dir, os.W_OK):
+            raise ValueError(
+                f"Invalid 'trace_file' '{params.trace_file}': directory "
+                f"'{trace_dir}' is not writable.")
     seg = params.multisegment
     if seg and seg.field_parent_map and seg.segment_level_ids:
         raise ValueError(
@@ -568,10 +589,30 @@ def _scan_var_len(reader, files, params, backend: str, prefix: str,
     shards; shards decode concurrently (each from its own bounded stream,
     Record_Id seeded from the index entry) and results reassemble in
     record order."""
+    from .obs.context import activate as obs_activate
+    from .obs.context import current as obs_current
+
+    obs = obs_current()
+    tracer = obs.tracer if obs is not None else None
+    progress = obs.progress if obs is not None else None
     with stage(metrics, "plan_index"):
         shards = _plan_var_len_shards(reader, files, params, retry, on_retry)
     if metrics is not None:
         metrics.shards = len(shards)
+    if progress is not None:
+        progress.set_plan(chunks_total=len(shards))
+    shard_times = None
+    if tracer is not None:
+        # tracing on: per-stage spans from inside the readers (read /
+        # frame / decode) via a tracer-wired StageTimes, published on
+        # the read metrics like the pipelined path's
+        from .profiling import StageTimes
+
+        shard_times = StageTimes(tracer=tracer)
+        if metrics is not None and metrics.stage_busy is None:
+            metrics.stage_busy = shard_times
+        if progress is not None and progress.stage_times is None:
+            progress.stage_times = shard_times
 
     def scan(shard) -> "FileResult":
         max_bytes = (0 if shard.offset_to < 0
@@ -583,27 +624,61 @@ def _scan_var_len(reader, files, params, backend: str, prefix: str,
                 stream, file_id=shard.file_order, backend=backend,
                 segment_id_prefix=prefix,
                 start_record_id=shard.record_index,
-                starting_file_offset=shard.offset_from)
+                starting_file_offset=shard.offset_from,
+                stage_times=shard_times)
+
+    def run_shard(indexed) -> "FileResult":
+        seq, shard = indexed
+        # re-activate the read's ObsContext: pool threads must attribute
+        # cache events and spans to this read, not to nothing
+        with obs_activate(obs):
+            if progress is not None:
+                progress.chunk_started()
+            if tracer is not None:
+                with tracer.span("shard", "shard",
+                                 args={"seq": seq,
+                                       "file": shard.file_path,
+                                       "offset_from": shard.offset_from,
+                                       "offset_to": shard.offset_to}):
+                    result = scan(shard)
+            else:
+                result = scan(shard)
+        if progress is not None:
+            from .engine.chunks import shard_progress_bytes
+
+            progress.chunk_done(bytes_done=shard_progress_bytes(shard),
+                                records=result.n_rows)
+        return result
 
     if len(shards) == 1 or parallelism <= 1:
-        return [scan(s) for s in shards]
+        return [run_shard(s) for s in enumerate(shards)]
     from concurrent.futures import ThreadPoolExecutor
 
     with ThreadPoolExecutor(max_workers=min(parallelism, len(shards))) as ex:
-        return list(ex.map(scan, shards))
+        return list(ex.map(run_shard, enumerate(shards)))
 
 
 def read_cobol(path=None,
                copybook: Optional[str] = None,
                copybook_contents=None,
                backend: str = "numpy",
+               progress_callback=None,
                **options) -> CobolData:
     """Read mainframe file(s) into decoded rows.
 
     `copybook` is a path (or list of paths) to copybook file(s);
     `copybook_contents` passes the text directly. Remaining keyword options
     use the reference's option names (README.md:1070-1155).
+
+    `progress_callback`: optional callable receiving monotonic
+    `obs.ScanProgress` snapshots while the scan runs (throttled by the
+    `progress_interval_s` option; the final `done=True` snapshot always
+    fires). The `trace_file` option writes a Chrome-trace/Perfetto JSON
+    of the whole scan — see the README's Observability section.
     """
+    if progress_callback is not None and not callable(progress_callback):
+        raise ValueError("'progress_callback' must be callable (it "
+                         "receives ScanProgress snapshots).")
     # exclusive-source validation before any option is consumed
     # ('copybook'/'copybook_contents' are named parameters and can never
     # reach **options; only 'copybooks' arrives as an option key —
@@ -677,23 +752,118 @@ def read_cobol(path=None,
     # fixed-length reader never generates them)
     seg_count = (len(params.multisegment.segment_level_ids)
                  if params.multisegment and is_var_len else 0)
-    results: List[FileResult] = []
-    copybook_obj: Optional[Copybook] = None
     metrics = ReadMetrics(files=len(files), backend=backend,
                           hosts=max(hosts, 1))
     metrics.bytes_read = sum(
         os.path.getsize(f) for f in files
         if path_scheme(f) in (None, "file") and os.path.exists(f))
 
-    if hosts > 1:
-        if backend != "numpy":
-            raise ValueError(
-                f"hosts={hosts} runs worker processes on the native/numpy "
-                f"kernels; backend={backend!r} is not supported there "
-                f"(drop `hosts` for the {backend!r} backend)")
-        return _read_cobol_multihost(files, copybook_contents, params,
-                                     hosts, seg_count,
-                                     debug_ignore_file_size, metrics)
+    # the read's observability context: per-read cache-counter scope
+    # always; tracer/progress only when asked for. Activated on this
+    # thread and re-activated by every pool the scan fans out to.
+    from .obs.context import activate as obs_activate
+
+    obs_ctx = _build_obs_context(params, metrics, progress_callback)
+    try:
+        with obs_activate(obs_ctx):
+            if hosts > 1:
+                if backend != "numpy":
+                    raise ValueError(
+                        f"hosts={hosts} runs worker processes on the "
+                        f"native/numpy kernels; backend={backend!r} is "
+                        f"not supported there (drop `hosts` for the "
+                        f"{backend!r} backend)")
+                data = _read_cobol_multihost(
+                    files, copybook_contents, params, hosts, seg_count,
+                    debug_ignore_file_size, metrics)
+            else:
+                data = _read_cobol_single_host(
+                    files, copybook_contents, params, backend, seg_count,
+                    parallelism, pipe_workers, use_pipeline, is_var_len,
+                    debug_ignore_file_size, metrics)
+    except BaseException:
+        # a failed scan still flushes its telemetry: the final done=True
+        # progress snapshot fires (a progress bar must not freeze) and
+        # the PARTIAL trace — exactly what diagnoses the failure — is
+        # written; flush errors never mask the scan's own exception
+        _abort_obs(obs_ctx, params)
+        raise
+    _finish_obs(obs_ctx, params, data)
+    return data
+
+
+def _build_obs_context(params: ReaderParameters, metrics: ReadMetrics,
+                       progress_callback):
+    """The read's ObsContext: tracer when `trace_file` is set, progress
+    tracker when a callback was passed, the default metrics registry's
+    scan metric set, and the metrics object's per-read cache scope."""
+    from .obs.context import ObsContext
+    from .obs.metrics import scan_metrics
+
+    tracer = None
+    if params.trace_file:
+        from .obs.trace import Tracer
+
+        tracer = Tracer()
+        metrics.tracer = tracer
+    progress = None
+    if progress_callback is not None:
+        from .obs.progress import ProgressTracker
+
+        progress = ProgressTracker(
+            progress_callback, bytes_total=metrics.bytes_read,
+            min_interval_s=params.progress_interval_s)
+    return ObsContext(tracer=tracer, metrics=scan_metrics(),
+                      progress=progress,
+                      cache_scope=metrics.cache_scope)
+
+
+def _finish_obs(obs_ctx, params: ReaderParameters, data) -> None:
+    """End-of-read observability: the final done=True progress snapshot
+    and the Chrome-trace artifact (metrics.finalize already closed the
+    scan-root span and captured the span list)."""
+    if obs_ctx.progress is not None:
+        obs_ctx.progress.finish(records_total=len(data))
+    if obs_ctx.tracer is not None and params.trace_file:
+        try:
+            obs_ctx.tracer.write_chrome_trace(params.trace_file)
+        except OSError:
+            # the destination was validated up front, but it can still
+            # vanish (or the disk fill) during a long scan — a lost
+            # trace must not discard a fully successful read
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "failed to write trace_file %r; the read succeeded",
+                params.trace_file, exc_info=True)
+
+
+def _abort_obs(obs_ctx, params: ReaderParameters) -> None:
+    """Best-effort telemetry flush when the scan raised: every step is
+    individually guarded so nothing here can shadow the real error."""
+    if obs_ctx.progress is not None:
+        try:
+            obs_ctx.progress.finish()
+        except Exception:
+            pass
+    if obs_ctx.tracer is not None and params.trace_file:
+        try:
+            obs_ctx.tracer.write_chrome_trace(params.trace_file)
+        except Exception:
+            pass
+
+
+def _read_cobol_single_host(files, copybook_contents,
+                            params: ReaderParameters, backend: str,
+                            seg_count: int, parallelism: int,
+                            pipe_workers: int, use_pipeline: bool,
+                            is_var_len: bool,
+                            debug_ignore_file_size: bool,
+                            metrics: ReadMetrics) -> "CobolData":
+    """The in-process execution paths (sequential, threaded shard scan,
+    chunked pipeline) — read_cobol minus option parsing and multihost."""
+    results: List[FileResult] = []
+    copybook_obj: Optional[Copybook] = None
 
     with stage(metrics, "parse_copybook"):
         if is_var_len:
@@ -855,9 +1025,20 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
                             ignore_file_size: bool,
                             retry: Optional[RetryPolicy] = None,
                             on_retry=None) -> List["FileResult"]:
+    from .obs.context import current as obs_current
     from .reader.stream import open_stream, path_scheme
 
     from .engine.chunks import fixed_file_chunkable
+
+    obs = obs_current()
+    progress = obs.progress if obs is not None else None
+
+    def track(result, nbytes: int) -> "FileResult":
+        if progress is not None:
+            progress.chunk_started()
+            progress.chunk_done(bytes_done=nbytes,
+                                records=result.n_rows)
+        return result
 
     rs = reader.record_size
     if path_scheme(file_path) in (None, "file"):
@@ -869,10 +1050,11 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
     # pipelined-vs-sequential parity guarantee needs one split rule
     if not fixed_file_chunkable(size, rs, params, FIXED_READ_CHUNK_BYTES,
                                 ignore_file_size):
-        return [reader.read_result(
+        return [track(reader.read_result(
             _read_file_bytes(file_path, retry, on_retry), backend=backend,
             file_id=file_order, first_record_id=base_record_id,
-            input_file_name=file_path, ignore_file_size=ignore_file_size)]
+            input_file_name=file_path, ignore_file_size=ignore_file_size),
+            size)]
     chunk_bytes = max(rs, (FIXED_READ_CHUNK_BYTES // rs) * rs)
     results: List[FileResult] = []
     done = 0
@@ -883,11 +1065,11 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
                 break
             if len(data) % rs and done + len(data) < size:
                 raise IOError(f"Short read from {file_path} at {done}")
-            results.append(reader.read_result(
+            results.append(track(reader.read_result(
                 data, backend=backend, file_id=file_order,
                 first_record_id=base_record_id + done // rs,
                 input_file_name=file_path,
-                ignore_file_size=ignore_file_size))
+                ignore_file_size=ignore_file_size), len(data)))
             done += len(data)
     return results
 

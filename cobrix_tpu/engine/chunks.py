@@ -25,6 +25,22 @@ from ..reader.parameters import DEFAULT_FILE_RECORD_ID_INCREMENT
 from ..reader.stream import RetryPolicy, open_stream, path_scheme
 
 
+def shard_progress_bytes(shard) -> int:
+    """Best-effort byte size of a var-len shard for progress/telemetry
+    accounting: closed ranges are exact; an open tail range
+    (offset_to < 0 = 'to end of file') falls back to the local file
+    size, so bytes_done can actually reach bytes_total."""
+    if shard.offset_to >= 0:
+        return max(0, shard.offset_to - shard.offset_from)
+    if path_scheme(shard.file_path) in (None, "file"):
+        try:
+            return max(0, os.path.getsize(shard.file_path)
+                       - shard.offset_from)
+        except OSError:
+            return 0
+    return 0
+
+
 @dataclass(frozen=True)
 class FixedChunk:
     """One fixed-length unit of pipelined work: a record-aligned byte

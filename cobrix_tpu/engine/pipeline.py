@@ -46,6 +46,9 @@ import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence, Tuple
 
+from ..obs.context import activate as obs_activate
+from ..obs.context import current as obs_current
+from ..obs.trace import maybe_parent
 from ..profiling import ReadMetrics, StageTimes
 from ..reader.diagnostics import ShardErrorPolicy, ShardFailureInfo
 from ..reader.stream import RetryPolicy, open_stream
@@ -127,8 +130,16 @@ class PipelineExecutor:
         self.failure_info = failure_info or _default_failure_info
         self.shard_failures: List[ShardFailureInfo] = []
         self.report: dict = {}
+        # the read's observability context, captured on the constructing
+        # thread (read_cobol activated it there) and re-activated on
+        # every stage thread this executor spawns — spans, progress, and
+        # cache counters all attribute across the pool
+        self.obs = obs_current()
+        if self.obs is not None and self.obs.tracer is not None:
+            self.stage_times.tracer = self.obs.tracer
 
-    def run(self, tasks: Sequence[tuple]) -> List[object]:
+    def run(self, tasks: Sequence[tuple],
+            chunk_meta: Optional[Sequence[dict]] = None) -> List[object]:
         n = len(tasks)
         results: List[object] = [None] * n
         if n == 0:
@@ -160,11 +171,46 @@ class PipelineExecutor:
         progress_t = [time.monotonic()]
         peak_queue = [0]
 
+        obs = self.obs
+        tracer = obs.tracer if obs is not None else None
+        progress = obs.progress if obs is not None else None
+        scan_m = obs.metrics if obs is not None else None
+        if progress is not None:
+            progress.set_plan(chunks_total=n)
+            if progress.stage_times is None:
+                progress.stage_times = self.stage_times
+        # per-chunk logical span (async across stage threads): id minted
+        # at first dispatch, one "chunk" span recorded at terminal state
+        chunk_span = [0] * n
+        chunk_t0 = [0.0] * n
+
         def touch() -> None:
             progress_t[0] = time.monotonic()
 
         def terminal(i: int) -> bool:
             return state[i] in ("done", "failed")
+
+        def chunk_terminal_obs(i: int, failed: bool) -> None:
+            """Telemetry for a chunk reaching a terminal state (called
+            outside the lock): span close, latency sample, progress."""
+            t1 = time.perf_counter()
+            if tracer is not None and chunk_span[i]:
+                tracer.record_span(
+                    "chunk", "chunk", chunk_t0[i], t1,
+                    parent=tracer.root_id, span_id=chunk_span[i],
+                    args={"chunk": i, "attempts": attempts[i],
+                          "failed": failed})
+            if scan_m is not None and chunk_t0[i]:
+                scan_m["chunk_latency"].observe(t1 - chunk_t0[i])
+            if progress is not None:
+                if failed:
+                    progress.chunk_failed()
+                else:
+                    meta = (chunk_meta[i] if chunk_meta is not None
+                            else None)
+                    progress.chunk_done(
+                        bytes_done=(meta or {}).get("bytes", 0),
+                        records=getattr(results[i], "n_rows", 0) or 0)
 
         def fail_chunk(i: int, reason: str, exc: BaseException) -> None:
             """Retry budget exhausted (or hard abort) for chunk i."""
@@ -181,6 +227,10 @@ class PipelineExecutor:
                 else:
                     errors.append((i, exc))
                     stop.set()
+            if tracer is not None:
+                tracer.instant("chunk_failed", "supervision",
+                               args={"chunk": i, "reason": reason})
+            chunk_terminal_obs(i, failed=True)
             touch()
 
         def attempt_failed(i: int, reason: str,
@@ -196,6 +246,9 @@ class PipelineExecutor:
                     counters["chunk_retries"] += 1
                     requeue = True
             if requeue:
+                if tracer is not None:
+                    tracer.instant("chunk_retry", "supervision",
+                                   args={"chunk": i, "reason": reason})
                 retry_dq.append((i, tasks[i]))
                 touch()
             else:
@@ -204,6 +257,7 @@ class PipelineExecutor:
         def chunk_decoded(i: int, result: object, finalize_fn) -> bool:
             """Record a finished decode; False if the chunk was already
             terminal (late result from an abandoned worker — discard)."""
+            done = False
             with lock:
                 if terminal(i) or stop.is_set():
                     return False
@@ -213,6 +267,9 @@ class PipelineExecutor:
                 else:
                     state[i] = "done"
                     inflight.pop(i, None)
+                    done = True
+            if done:
+                chunk_terminal_obs(i, failed=False)
             touch()
             return True
 
@@ -226,14 +283,28 @@ class PipelineExecutor:
             return False
 
         def run_read(i: int, task) -> object:
+            first = False
             with lock:
                 if terminal(i):
                     return None
                 attempts[i] += 1
                 state[i] = "running"
                 inflight[i] = ("read", time.monotonic())
-            with self.stage_times.timed("read"):
-                return task[0]()
+                # first-dispatch sentinel is chunk_t0, NOT the span id
+                # (which only exists when tracing is on): a retried chunk
+                # must neither re-count as started nor reset its latency
+                # clock — the histogram is first-dispatch -> terminal in
+                # both modes
+                if chunk_t0[i] == 0.0:
+                    first = True
+                    chunk_t0[i] = time.perf_counter()
+                if tracer is not None and chunk_span[i] == 0:
+                    chunk_span[i] = tracer.new_id()
+            if first and progress is not None:
+                progress.chunk_started()
+            with maybe_parent(tracer, chunk_span[i]):
+                with self.stage_times.timed("read"):
+                    return task[0]()
 
         def reader_loop() -> None:
             for i, task in enumerate(tasks):
@@ -298,7 +369,8 @@ class PipelineExecutor:
                             _close_payload(payload)
                             continue
                         inflight[i] = ("decode", time.monotonic())
-                    result = task[1](payload)
+                    with maybe_parent(tracer, chunk_span[i]):
+                        result = task[1](payload)
                 except BaseException as exc:
                     attempt_failed(i, "error", exc)
                     continue
@@ -328,26 +400,40 @@ class PipelineExecutor:
                         continue
                     inflight[i] = ("assemble", time.monotonic())
                 try:
-                    finalize_fn(result)
+                    with maybe_parent(tracer, chunk_span[i]):
+                        finalize_fn(result)
                 except BaseException as exc:
                     # assembly is deterministic — no retry
                     attempts[i] = attempts[i] or 1
                     fail_chunk(i, "error", exc)
                     continue
+                done = False
                 with lock:
                     if not terminal(i):
                         state[i] = "done"
                         inflight.pop(i, None)
+                        done = True
+                if done:
+                    chunk_terminal_obs(i, failed=False)
                 touch()
 
-        reader = threading.Thread(target=reader_loop,
+        def obs_target(fn):
+            """Stage-thread entry: the read's ObsContext (tracer parentage,
+            cache counters, progress) re-activated on this thread."""
+            def entry():
+                with obs_activate(obs):
+                    fn()
+            return entry
+
+        wrapped_worker_loop = obs_target(worker_loop)
+        reader = threading.Thread(target=obs_target(reader_loop),
                                   name="cobrix-pipe-read", daemon=True)
-        workers = [threading.Thread(target=worker_loop,
+        workers = [threading.Thread(target=wrapped_worker_loop,
                                     name=f"cobrix-pipe-{k}", daemon=True)
                    for k in range(self.workers)]
         finalizer = None
         if has_finalize:
-            finalizer = threading.Thread(target=finalizer_loop,
+            finalizer = threading.Thread(target=obs_target(finalizer_loop),
                                          name="cobrix-pipe-assemble",
                                          daemon=True)
             finalizer.start()
@@ -358,7 +444,24 @@ class PipelineExecutor:
         # -- the watchdog / supervision loop (runs on the caller's
         # thread): every wait below is bounded by _TICK_S ---------------
         deadline_exc: Optional[BaseException] = None
+        last_depth_sample = 0.0
+        # this run's last contribution to the (process-global) in-flight
+        # gauge: updates are DELTAS so concurrent scans compose instead
+        # of clobbering each other with absolute writes
+        gauge_inflight = 0
         while True:
+            if scan_m is not None:
+                now_s = time.monotonic()
+                # backpressure-queue depth samples at a coarse cadence
+                # (the watchdog ticks at 25ms; sampling every tick would
+                # just histogram the sampler)
+                if now_s - last_depth_sample >= 0.2:
+                    last_depth_sample = now_s
+                    scan_m["queue_depth"].observe(q.qsize())
+                    with lock:
+                        now_inflight = len(inflight)
+                    scan_m["inflight"].inc(now_inflight - gauge_inflight)
+                    gauge_inflight = now_inflight
             with lock:
                 all_terminal = all(terminal(i) for i in range(n))
                 if errors:
@@ -375,7 +478,7 @@ class PipelineExecutor:
             if self.chunk_timeout_s > 0:
                 self._enforce_chunk_deadline(
                     now, lock, inflight, counters, fail_chunk, workers,
-                    worker_loop)
+                    wrapped_worker_loop)
                 with lock:
                     if errors:
                         break
@@ -398,6 +501,8 @@ class PipelineExecutor:
             _drain_fq(fq)
             stuck += _join_bounded([finalizer], _JOIN_GRACE_S)
 
+        if scan_m is not None:
+            scan_m["inflight"].inc(-gauge_inflight)
         wall = time.monotonic() - t_start
         busy = sum(self.stage_times.busy_s.values())
         self.report = {
@@ -438,6 +543,7 @@ class PipelineExecutor:
                         i, attempts[i], "scan_deadline",
                         str(deadline_exc)))
                     results[i] = None
+                    chunk_terminal_obs(i, failed=True)
             self.report.update(counters)
         return results
 
@@ -469,6 +575,11 @@ class PipelineExecutor:
                 alive = sum(1 for t in workers if t.is_alive())
                 if alive >= self.workers:
                     counters["respawned_workers"] += 1
+                    if (self.obs is not None
+                            and self.obs.tracer is not None):
+                        self.obs.tracer.instant(
+                            "worker_respawn", "supervision",
+                            args={"chunk": i, "stage": stage_name})
                     t = threading.Thread(
                         target=worker_loop,
                         name=f"cobrix-pipe-r{counters['respawned_workers']}",
@@ -653,7 +764,8 @@ def pipelined_fixed_scan(reader, files, params, backend: str,
                                           ex.stage_times))
                 if assemble else None)
     results = ex.run([(read_fn(c), process_fn(c), finalize)
-                      for c in chunks])
+                      for c in chunks],
+                     chunk_meta=[{"bytes": c.nbytes} for c in chunks])
     ex.attach(metrics)
     if metrics is not None:
         metrics.shards = max(metrics.shards, len(chunks))
@@ -713,7 +825,10 @@ def pipelined_var_len_scan(reader, shards, params, backend: str,
     finalize = ((lambda result: _assemble(result, output_schema,
                                           ex.stage_times))
                 if assemble else None)
-    results = ex.run([(read_fn(s), process_fn(s), finalize)
-                      for s in shards])
+    from .chunks import shard_progress_bytes
+
+    results = ex.run(
+        [(read_fn(s), process_fn(s), finalize) for s in shards],
+        chunk_meta=[{"bytes": shard_progress_bytes(s)} for s in shards])
     ex.attach(metrics)
     return results, ex.shard_failures

@@ -1,0 +1,47 @@
+"""cobrix_tpu.obs — unified scan telemetry.
+
+Three planes over every execution path (sequential, threaded shard scan,
+chunked pipeline, forked multihost):
+
+* **trace** — `Tracer` spans (scan -> shard -> chunk -> stage) with
+  Chrome-trace/Perfetto JSON export (`trace_file=` read option) and
+  cross-process merge with clock-offset correction;
+* **metrics** — `MetricsRegistry` counters/gauges/histograms with
+  Prometheus text exposition (`prometheus_text()`);
+* **progress** — monotonic `ScanProgress` snapshots pushed to a
+  `progress_callback` while the scan runs.
+
+`tools/traceview.py` summarizes a trace file (critical path, stage
+utilization, straggler table).
+"""
+from .context import ObsContext, activate, current
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+    prometheus_text,
+    scan_metrics,
+)
+from .progress import ProgressTracker, ScanProgress
+from .trace import Tracer, clock_sample, maybe_parent, maybe_span
+
+__all__ = [
+    "ObsContext",
+    "activate",
+    "current",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "prometheus_text",
+    "scan_metrics",
+    "ProgressTracker",
+    "ScanProgress",
+    "Tracer",
+    "clock_sample",
+    "maybe_parent",
+    "maybe_span",
+]

@@ -1,0 +1,345 @@
+"""Metrics registry with Prometheus text exposition.
+
+Counters, gauges, and histograms covering the scan plane: bytes and
+records scanned, chunk latency quantiles, backpressure queue depth
+samples, record-length distribution, compile-cache hits, and supervision
+events. One process-global default registry feeds a standard
+Prometheus text exposition (`prometheus_text()`), so an operator can
+serve it from any HTTP handler; per-read deltas stay on
+`ReadMetrics.as_dict()` as before.
+
+Design constraints: no external client library (the container pins
+dependencies), thread-safe under one registry lock (metric updates are
+per-chunk / per-read, never per-record — the only per-record data, the
+record-length histogram, is batch-observed from numpy arrays), and
+labels kept to the counter type where the scan actually needs them
+(supervision/cache events by name).
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render bare, floats as repr."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int) or (isinstance(v, float) and v.is_integer()):
+        return str(int(v))
+    return repr(float(v))
+
+
+def _label_str(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+class Counter:
+    """Monotonic counter, optionally labeled. `labels(**kv)` returns the
+    child for one label combination; unlabeled counters inc directly."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str, registry: "MetricsRegistry",
+                 label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._registry = registry
+        self._values: Dict[Tuple[Tuple[str, str], ...], float] = {}
+        if not self.label_names:
+            self._values[()] = 0.0
+
+    def labels(self, **kv) -> "_CounterChild":
+        if tuple(sorted(kv)) != tuple(sorted(self.label_names)):
+            raise ValueError(
+                f"counter {self.name} expects labels "
+                f"{self.label_names}, got {tuple(kv)}")
+        key = tuple((k, str(kv[k])) for k in self.label_names)
+        return _CounterChild(self, key)
+
+    def inc(self, v: float = 1.0) -> None:
+        if self.label_names:
+            raise ValueError(
+                f"counter {self.name} is labeled; use .labels(...).inc()")
+        with self._registry._lock:
+            self._values[()] += v
+
+    def _inc_key(self, key, v: float) -> None:
+        with self._registry._lock:
+            self._values[key] = self._values.get(key, 0.0) + v
+
+    def value(self, **kv) -> float:
+        key = tuple((k, str(kv[k])) for k in self.label_names)
+        with self._registry._lock:
+            return self._values.get(key, 0.0)
+
+    def _samples(self) -> Iterable[str]:
+        for key in sorted(self._values):
+            yield (f"{self.name}{_label_str(key)} "
+                   f"{_fmt(self._values[key])}")
+
+
+class _CounterChild:
+    __slots__ = ("_parent", "_key")
+
+    def __init__(self, parent: Counter, key):
+        self._parent = parent
+        self._key = key
+
+    def inc(self, v: float = 1.0) -> None:
+        self._parent._inc_key(self._key, v)
+
+
+class Gauge:
+    """Last-written value (queue depth, in-flight chunks)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str, registry: "MetricsRegistry"):
+        self.name = name
+        self.help = help
+        self._registry = registry
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._registry._lock:
+            self._value = float(v)
+
+    def inc(self, v: float = 1.0) -> None:
+        with self._registry._lock:
+            self._value += v
+
+    def dec(self, v: float = 1.0) -> None:
+        self.inc(-v)
+
+    def value(self) -> float:
+        with self._registry._lock:
+            return self._value
+
+    def _samples(self) -> Iterable[str]:
+        yield f"{self.name} {_fmt(self._value)}"
+
+
+# default latency-ish buckets (seconds); record-length callers pass
+# byte-scaled buckets instead
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Histogram:
+    """Fixed-bucket histogram (Prometheus semantics: cumulative buckets,
+    `_sum`, `_count`) with an approximate quantile read-back for the
+    progress/summary paths."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, registry: "MetricsRegistry",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        self._registry = registry
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self._counts = [0] * (len(self.buckets) + 1)  # +1 = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: float) -> None:
+        idx = bisect.bisect_left(self.buckets, v)
+        with self._registry._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._count += 1
+
+    def observe_many(self, values) -> None:
+        """Batch observation from a numpy array (the record-length path:
+        one searchsorted over the shard's lengths, never a Python loop
+        per record)."""
+        import numpy as np
+
+        arr = np.asarray(values, dtype=np.float64).ravel()
+        if arr.size == 0:
+            return
+        idx = np.searchsorted(self.buckets, arr, side="left")
+        binned = np.bincount(idx, minlength=len(self.buckets) + 1)
+        total = float(arr.sum())
+        with self._registry._lock:
+            for i, c in enumerate(binned):
+                self._counts[i] += int(c)
+            self._sum += total
+            self._count += int(arr.size)
+
+    def observe_repeat(self, v: float, count: int) -> None:
+        """`count` observations of the same value (fixed-length records:
+        one bucket add instead of materializing n identical samples)."""
+        if count <= 0:
+            return
+        idx = bisect.bisect_left(self.buckets, v)
+        with self._registry._lock:
+            self._counts[idx] += count
+            self._sum += v * count
+            self._count += count
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Approximate quantile from bucket boundaries (upper bound of
+        the bucket containing the q-th observation); None when empty."""
+        with self._registry._lock:
+            if self._count == 0:
+                return None
+            target = q * self._count
+            seen = 0
+            for i, c in enumerate(self._counts):
+                seen += c
+                if seen >= target and c:
+                    return (self.buckets[i] if i < len(self.buckets)
+                            else self.buckets[-1])
+            return self.buckets[-1]
+
+    def snapshot(self) -> dict:
+        with self._registry._lock:
+            return {"count": self._count, "sum": self._sum}
+
+    def state(self) -> tuple:
+        """(bucket counts, sum, count) — the picklable form a forked
+        multihost worker ships home so its observations reach the
+        parent's registry."""
+        with self._registry._lock:
+            return (list(self._counts), self._sum, self._count)
+
+    def merge_state(self, state: tuple) -> None:
+        """Fold a worker's `state()` into this histogram (same metric,
+        same bucket layout by construction — both sides build it from
+        scan_metrics)."""
+        counts, total, n = state
+        if len(counts) != len(self._counts):
+            return  # bucket layouts diverged (mixed versions): drop
+        with self._registry._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._sum += total
+            self._count += n
+
+    def _samples(self) -> Iterable[str]:
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            cum += self._counts[i]
+            yield f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}'
+        cum += self._counts[-1]
+        yield f'{self.name}_bucket{{le="+Inf"}} {cum}'
+        yield f"{self.name}_sum {_fmt(self._sum)}"
+        yield f"{self.name}_count {self._count}"
+
+
+class MetricsRegistry:
+    """Named metric collection with idempotent registration (the scan
+    paths call `counter(...)` per read; the first call creates, later
+    calls return the same metric object)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: "Dict[str, object]" = {}
+
+    def _get_or_create(self, cls, name, help, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name} already registered as "
+                        f"{type(m).__name__}")
+                return m
+            m = cls(name, help, self, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                label_names: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help,
+                                   label_names=label_names)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    def exposition(self) -> str:
+        """Prometheus text exposition format 0.0.4 of every metric. The
+        whole render holds the registry lock (reentrant) so a scrape
+        racing concurrent observe() calls still sees each histogram's
+        buckets/_sum/_count from one instant — never a +Inf bucket that
+        disagrees with its own _count."""
+        lines: List[str] = []
+        with self._lock:
+            for name, m in sorted(self._metrics.items()):
+                if m.help:
+                    lines.append(f"# HELP {name} {m.help}")
+                lines.append(f"# TYPE {name} {m.kind}")
+                lines.extend(m._samples())
+        return "\n".join(lines) + "\n"
+
+
+_default = MetricsRegistry()
+
+
+def default_registry() -> MetricsRegistry:
+    """The process-global registry every read reports into."""
+    return _default
+
+
+def prometheus_text() -> str:
+    """Exposition of the default registry (serve this from /metrics)."""
+    return _default.exposition()
+
+
+# -- the scan plane's standard metrics (created on first use) --------------
+
+RECORD_LENGTH_BUCKETS = (32, 64, 128, 256, 512, 1024, 4096, 16384,
+                         65536, 1 << 20)
+QUEUE_DEPTH_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64)
+
+
+def scan_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The named metric set the execution paths update; one dict so call
+    sites don't repeat names/help text."""
+    r = registry or _default
+    return {
+        "scans": r.counter(
+            "cobrix_scans_total", "Completed read_cobol scans"),
+        "bytes": r.counter(
+            "cobrix_scan_bytes_total", "Input bytes scanned"),
+        "records": r.counter(
+            "cobrix_scan_records_total", "Records decoded"),
+        "chunk_latency": r.histogram(
+            "cobrix_chunk_latency_seconds",
+            "Per-chunk wall latency through the pipeline executor"),
+        "queue_depth": r.histogram(
+            "cobrix_queue_depth",
+            "Backpressure queue depth samples (pipeline executor)",
+            buckets=QUEUE_DEPTH_BUCKETS),
+        "inflight": r.gauge(
+            "cobrix_inflight_chunks",
+            "Chunks currently in flight in the pipeline executor"),
+        "record_length": r.histogram(
+            "cobrix_record_length_bytes",
+            "Framed record length distribution",
+            buckets=RECORD_LENGTH_BUCKETS),
+        "cache": r.counter(
+            "cobrix_plan_cache_events_total",
+            "Compile-cache lookups by cache and outcome",
+            label_names=("cache", "result")),
+        "supervision": r.counter(
+            "cobrix_supervision_events_total",
+            "Distributed-supervision events by type",
+            label_names=("event",)),
+    }

@@ -48,10 +48,12 @@ def set_shard_fault_hook(hook: Optional[Callable]) -> None:
     _SHARD_FAULT_HOOK = hook
 
 
-def _scan_shard(ctx: dict, shard: WorkShard) -> bytes:
+def _scan_shard(ctx: dict, shard: WorkShard,
+                stage_times=None) -> bytes:
     """Scan ONE shard (in a worker process or inline) and return its
     decoded table as Arrow IPC bytes, shard error ledger attached as
-    schema metadata."""
+    schema metadata. `stage_times`: optional profiling.StageTimes (the
+    tracing path attributes read/frame/decode busy inside the worker)."""
     import pyarrow as pa
 
     from ..reader.diagnostics import ReadDiagnostics
@@ -75,7 +77,8 @@ def _scan_shard(ctx: dict, shard: WorkShard) -> bytes:
                 stream, file_id=shard.file_order, backend="numpy",
                 segment_id_prefix=ctx["prefix"],
                 start_record_id=shard.record_index,
-                starting_file_offset=shard.offset_from)
+                starting_file_offset=shard.offset_from,
+                stage_times=stage_times)
     else:
         with open_stream(shard.file_path, start_offset=shard.offset_from,
                          maximum_bytes=max_bytes, retry=retry,
@@ -85,7 +88,8 @@ def _scan_shard(ctx: dict, shard: WorkShard) -> bytes:
             data, backend="numpy", file_id=shard.file_order,
             first_record_id=shard.record_index,
             input_file_name=shard.file_path,
-            ignore_file_size=ctx["ignore_file_size"])
+            ignore_file_size=ctx["ignore_file_size"],
+            stage_times=stage_times)
     table = result.to_arrow(ctx["schema"])
     diag = getattr(result, "diagnostics", None)
     if retries:
@@ -175,10 +179,84 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
     ordered = sorted(shards, key=lambda s: (s.file_order, s.offset_from))
     fault_hook = _SHARD_FAULT_HOOK
 
-    def scan_fn(shard: WorkShard, seq: int) -> bytes:
+    # observability: the read's context, captured on the caller's thread
+    # (read_cobol activated it there). Workers are fork children — they
+    # build their OWN tracer and ship (spans, clock) home alongside the
+    # shard payload; the parent merges onto one timeline with clock-
+    # offset correction. Supervisor scheduling events feed the same
+    # tracer as instants plus the supervision-event counter.
+    from ..obs.context import current as obs_current
+
+    obs = obs_current()
+    tracer = obs.tracer if obs is not None else None
+    progress = obs.progress if obs is not None else None
+    scan_m = obs.metrics if obs is not None else None
+    trace_root = tracer.root_id if tracer is not None else 0
+    if progress is not None:
+        progress.set_plan(chunks_total=len(ordered))
+    from ..engine.chunks import shard_progress_bytes
+
+    shard_bytes = [shard_progress_bytes(s) for s in ordered]
+
+    def scan_fn(shard: WorkShard, seq: int) -> tuple:
         if fault_hook is not None:
             fault_hook(shard, seq)
-        return _scan_shard(ctx, shard)
+        # worker-local observability: fork children cannot write the
+        # parent's registry or cache scope, so each shard scan collects
+        # its own (tracer spans, record-length histogram, cache events)
+        # and ships the state home on the result pipe for merging
+        from ..obs.context import ObsContext
+        from ..obs.context import activate as obs_activate
+        from ..obs.metrics import MetricsRegistry, scan_metrics
+        from ..plan.cache import CacheStatsScope
+        from ..profiling import StageTimes
+
+        wt = None
+        st = None
+        if tracer is not None:
+            from ..obs.trace import Tracer
+
+            wt = Tracer(process_name=f"shard-worker-{os.getpid()}")
+            st = StageTimes(tracer=wt)
+        wm = scan_metrics(MetricsRegistry())
+        ws = CacheStatsScope()
+        wctx = ObsContext(tracer=wt, metrics=wm, cache_scope=ws)
+        with obs_activate(wctx):
+            if wt is not None:
+                with wt.span("shard", "shard", parent=trace_root,
+                             args={"seq": seq, "file": shard.file_path,
+                                   "offset_from": shard.offset_from,
+                                   "offset_to": shard.offset_to,
+                                   "record_index": shard.record_index}):
+                    payload = _scan_shard(ctx, shard, stage_times=st)
+            else:
+                payload = _scan_shard(ctx, shard, stage_times=st)
+        return (payload, {
+            "pid": os.getpid(),
+            "trace": wt.export_state() if wt is not None else None,
+            "cache": ws.stats,
+            "record_length": wm["record_length"].state(),
+        })
+
+    started = set()  # observer runs on the supervisor thread only
+
+    def observer(event: str, fields: dict) -> None:
+        if scan_m is not None:
+            scan_m["supervision"].labels(event=event).inc()
+        if tracer is not None:
+            tracer.instant(event, "supervision", args=fields,
+                           parent=trace_root)
+        if progress is not None:
+            seq = fields.get("seq")
+            if event == "dispatch" and seq not in started:
+                # first dispatch only: re-dispatches and speculative
+                # copies must not inflate the in-flight count
+                started.add(seq)
+                progress.chunk_started()
+            elif event == "shard_done" and seq is not None:
+                progress.chunk_done(bytes_done=shard_bytes[seq])
+            elif event == "shard_failed":
+                progress.chunk_failed()
 
     results, failures, report = supervised_map(
         scan_fn, ordered, max(hosts, 1),
@@ -188,7 +266,9 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
         speculative_quantile=params.speculative_quantile,
         scan_deadline_s=params.scan_deadline_s,
         heartbeat_s=params.heartbeat_interval_s,
-        failure_info=_shard_failure_info)
+        failure_info=_shard_failure_info,
+        observer=(observer if (tracer is not None or scan_m is not None
+                               or progress is not None) else None))
 
     # reassembly: ascending seq == canonical shard order; a duplicated
     # key in the plan (or a raced duplicate result) dedupes
@@ -200,9 +280,40 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
     for seq in sorted(results):
         key = (ordered[seq].file_order, ordered[seq].offset_from)
         if key in seen_keys:
+            # duplicate-key shards contribute NO rows, so their
+            # telemetry blob is dropped too — record-length and cache
+            # counts stay consistent with the returned data
             report["duplicate_shard_keys"] += 1
             continue
         seen_keys.add(key)
-        with pa.ipc.open_stream(pa.py_buffer(results[seq])) as rd:
-            tables.append(rd.read_all())
+        payload = results[seq]
+        if isinstance(payload, tuple):
+            # (ipc_bytes, worker obs blob): fold the worker's spans onto
+            # the parent timeline (clock-offset corrected) and its
+            # record-length/cache events into the parent registry/scope
+            payload, blob = payload
+            if tracer is not None and blob.get("trace"):
+                tracer.merge(*blob["trace"])
+            forked = blob.get("pid") != os.getpid()
+            if scan_m is not None and blob.get("record_length"):
+                # always: the shard observed into its worker-LOCAL
+                # registry (forked or inline), never this one
+                scan_m["record_length"].merge_state(
+                    blob["record_length"])
+            if (obs is not None and obs.cache_scope is not None
+                    and blob.get("cache")):
+                from ..plan.cache import absorb_scope
+
+                # the per-read scope never saw the shard's lookups; the
+                # process-global counters did IFF the shard ran inline
+                absorb_scope(obs.cache_scope, blob["cache"],
+                             bump_global=forked)
+        with pa.ipc.open_stream(pa.py_buffer(payload)) as rd:
+            table = rd.read_all()
+        if progress is not None:
+            # rows are only countable here (workers ship IPC bytes, not
+            # counts): records_done climbs shard by shard through
+            # reassembly instead of jumping at the final snapshot
+            progress.add_records(table.num_rows)
+        tables.append(table)
     return tables, failures, report

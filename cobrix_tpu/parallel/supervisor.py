@@ -156,7 +156,7 @@ class _Worker:
     """Parent-side handle: process + its private task/result pipes."""
 
     __slots__ = ("wid", "proc", "task_w", "result_r", "busy_seq",
-                 "dispatch_t", "speculative", "last_hb")
+                 "dispatch_t", "speculative", "last_hb", "hb_flagged")
 
     def __init__(self, wid, proc, task_w, result_r):
         self.wid = wid
@@ -167,6 +167,9 @@ class _Worker:
         self.dispatch_t = 0.0
         self.speculative = False
         self.last_hb = time.monotonic()
+        # one heartbeat-miss telemetry event per silence window (reset
+        # when the next heartbeat lands)
+        self.hb_flagged = False
 
     def kill(self) -> None:
         """Terminate without ceremony; private pipes mean a mid-send kill
@@ -202,6 +205,7 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
                    scan_deadline_s: float = 0.0,
                    heartbeat_s: float = 0.5,
                    failure_info: Callable[..., ShardFailureInfo],
+                   observer: Optional[Callable[[str, dict], None]] = None,
                    ) -> Tuple[Dict[int, object], List[ShardFailureInfo],
                               dict]:
     """Run ``scan_fn(shard, seq)`` over every shard under supervision.
@@ -216,6 +220,13 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
     (unpickled) exception for shard errors, :class:`ShardSupervisionError`
     for crashes/timeouts, :class:`ScanDeadlineError` for the scan
     deadline.
+
+    ``observer(event, fields)`` — optional telemetry tap, invoked from
+    the supervisor thread for every scheduling event (dispatch,
+    re_dispatch, speculation, shard_timeout, heartbeat_miss,
+    worker_crash, worker_kill, worker_respawn, shard_done, shard_failed).
+    Purely observational: exceptions are swallowed and it can never
+    change scheduling decisions.
     """
     n = len(shards)
     t0 = time.monotonic()
@@ -225,12 +236,21 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
     if n == 0:
         return results, failures, report
 
+    def note(event: str, **fields) -> None:
+        if observer is None:
+            return
+        try:
+            observer(event, fields)
+        except Exception:
+            pass
+
     deadline = t0 + scan_deadline_s if scan_deadline_s > 0 else None
     max_attempts = 1 + max(0, shard_max_retries)
 
     if workers <= 1 or n <= 1:
         _inline_map(scan_fn, shards, results, failures, report,
-                    error_policy, max_attempts, deadline, failure_info)
+                    error_policy, max_attempts, deadline, failure_info,
+                    note)
         return results, failures, report
 
     import multiprocessing as mp
@@ -277,6 +297,7 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
         result_w.close()
         if respawn:
             report["worker_respawns"] += 1
+            note("worker_respawn", wid=wid)
         w = _Worker(wid, proc, task_w, result_r)
         pool[wid] = w
         return w
@@ -290,13 +311,17 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
             # instead of the whole scan dying on a raw BrokenPipeError
             drop_worker(w, kill=True)
             report["worker_crashes"] += 1
+            note("worker_crash", wid=w.wid, seq=None)
             return False
         w.busy_seq = seq
         w.dispatch_t = time.monotonic()
         w.speculative = speculative
+        w.hb_flagged = False
         active[seq].add(w.wid)
         attempts_started[seq] += 1
         report["dispatches"] += 1
+        note("dispatch", seq=seq, wid=w.wid, speculative=speculative,
+             shard=_shard_desc(shards[seq]))
         return True
 
     def drop_worker(w: _Worker, kill: bool) -> None:
@@ -313,6 +338,8 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
         """The retry budget for `seq` is gone and no copy is running."""
         terminal[seq] = True
         report["shards_failed"] += 1
+        note("shard_failed", seq=seq, reason=reason,
+             attempts=attempts_started[seq])
         if error_policy.is_partial:
             failures.append(failure_info(
                 shards[seq], attempts_started[seq], reason,
@@ -344,6 +371,7 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
             return
         if not active[seq]:
             report["re_dispatches"] += 1
+            note("re_dispatch", seq=seq, reason=reason)
             pending.appendleft(seq)
 
     def handle_done(w: _Worker, seq: int, payload) -> None:
@@ -363,6 +391,10 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
             latencies.append(time.monotonic() - w.dispatch_t)
             if w.speculative:
                 report["speculations_won"] += 1
+        note("shard_done", seq=seq, wid=w.wid,
+             latency_s=(round(time.monotonic() - w.dispatch_t, 6)
+                        if was_busy else None),
+             speculative=w.speculative)
         # losing copies of this shard are now wasted work: reclaim their
         # workers so re-dispatch/speculation capacity comes back
         for other_wid in list(active[seq]):
@@ -371,6 +403,8 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
                 continue
             if loser.speculative:
                 report["speculations_wasted"] += 1
+            note("worker_kill", wid=loser.wid, seq=seq,
+                 reason="duplicate_loser")
             drop_worker(loser, kill=True)
             spawn(respawn=True)
         active[seq].clear()
@@ -415,6 +449,7 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
                     kind = msg[0]
                     if kind == "hb":
                         w.last_hb = time.monotonic()
+                        w.hb_flagged = False
                         report["heartbeats"] += 1
                     elif kind == "done":
                         handle_done(w, msg[2], msg[3])
@@ -433,6 +468,8 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
                     drop_worker(w, kill=False)
                     if seq is not None and not terminal[seq]:
                         report["worker_crashes"] += 1
+                        note("worker_crash", wid=w.wid, seq=seq,
+                             exitcode=w.proc.exitcode)
                         attempt_failed(
                             seq, "crash",
                             f"worker process died (exit code "
@@ -442,12 +479,28 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
                         and now - w.dispatch_t > shard_timeout_s):
                     seq = w.busy_seq
                     report["shard_timeouts"] += 1
+                    note("shard_timeout", seq=seq, wid=w.wid,
+                         elapsed_s=round(now - w.dispatch_t, 3),
+                         hb_age_s=round(now - w.last_hb, 3))
+                    note("worker_kill", wid=w.wid, seq=seq,
+                         reason="timeout")
                     drop_worker(w, kill=True)
                     attempt_failed(
                         seq, "timeout",
                         f"shard {_shard_desc(shards[seq])} exceeded "
                         f"shard_timeout_s={shard_timeout_s} "
                         f"(last heartbeat {now - w.last_hb:.1f}s ago)")
+                elif (observer is not None and not w.hb_flagged
+                        and w.busy_seq is not None
+                        and now - w.last_hb
+                        > max(3.0 * heartbeat_s, 1.0)):
+                    # telemetry only: the worker went silent longer than
+                    # three beats — flagged once per silence window, no
+                    # scheduling consequence (the deadline sweep above
+                    # owns enforcement)
+                    w.hb_flagged = True
+                    note("heartbeat_miss", wid=w.wid, seq=w.busy_seq,
+                         hb_age_s=round(now - w.last_hb, 3))
 
             if fatal:
                 break
@@ -473,6 +526,8 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
                     if dispatch(idle, seq, speculative=True):
                         speculated[seq] = True
                         report["speculations_launched"] += 1
+                        note("speculation", seq=seq, wid=idle.wid,
+                             threshold_s=round(threshold, 3))
 
             # 4. dispatch pending shards onto idle (or fresh) workers
             while pending:
@@ -510,13 +565,16 @@ def supervised_map(scan_fn: Callable, shards: Sequence, workers: int, *,
 
 
 def _inline_map(scan_fn, shards, results, failures, report, error_policy,
-                max_attempts, deadline, failure_info) -> None:
+                max_attempts, deadline, failure_info,
+                note=lambda event, **fields: None) -> None:
     """Degenerate supervision (one worker / one shard): no fork, same
     retry/deadline/policy semantics, sequential canonical order."""
     for seq, shard in enumerate(shards):
         if deadline is not None and time.monotonic() > deadline:
             for s in range(seq, len(shards)):
                 report["shards_failed"] += 1
+                note("shard_failed", seq=s, reason="scan_deadline",
+                     attempts=0)
                 if error_policy.is_partial:
                     failures.append(failure_info(
                         shards[s], 0, "scan_deadline",
@@ -527,19 +585,28 @@ def _inline_map(scan_fn, shards, results, failures, report, error_policy,
                 f"scan deadline expired with {len(shards) - seq} "
                 f"shard(s) outstanding")
         last_exc: Optional[BaseException] = None
-        for _ in range(max_attempts):
+        for attempt in range(max_attempts):
             report["dispatches"] += 1
+            note("dispatch", seq=seq, wid=None, speculative=False,
+                 shard=_shard_desc(shard))
+            t_dispatch = time.monotonic()
             try:
                 results[seq] = scan_fn(shard, seq)
                 report["shards_completed"] += 1
+                note("shard_done", seq=seq, wid=None,
+                     latency_s=round(time.monotonic() - t_dispatch, 6),
+                     speculative=False)
                 last_exc = None
                 break
             except BaseException as exc:
                 if last_exc is not None:
                     report["re_dispatches"] += 1
+                    note("re_dispatch", seq=seq, reason="error")
                 last_exc = exc
         if last_exc is not None:
             report["shards_failed"] += 1
+            note("shard_failed", seq=seq, reason="error",
+                 attempts=max_attempts)
             if error_policy.is_partial:
                 failures.append(failure_info(
                     shard, max_attempts, "error",

@@ -49,12 +49,68 @@ _LUT_CACHE: Dict[str, np.ndarray] = {}
 _PARSE_CAP = 16
 _PLAN_CAP = 64
 
-_stats = {
-    "parse_hits": 0, "parse_misses": 0,
-    "plan_hits": 0, "plan_misses": 0,
-    "lut_hits": 0, "lut_misses": 0,
-    "decoder_hits": 0, "decoder_misses": 0,
-}
+_STAT_KEYS = (
+    "parse_hits", "parse_misses",
+    "plan_hits", "plan_misses",
+    "lut_hits", "lut_misses",
+    "decoder_hits", "decoder_misses",
+)
+
+_stats = dict.fromkeys(_STAT_KEYS, 0)
+
+# per-read counter scopes, installed per THREAD: every thread working
+# for one read (the caller, the shard pool, the pipeline stage threads)
+# activates the read's scope, so concurrent read_cobol calls attribute
+# their own lookups exactly instead of polluting each other through a
+# process-global delta (the documented cross-read contamination the old
+# ReadMetrics baseline snapshot carried)
+_scope_tls = threading.local()
+
+
+class CacheStatsScope:
+    """One read's cache-event counters. Mutated only under `_lock`
+    (every stat bump already holds it), so one scope object is safely
+    shared by all of the read's threads."""
+
+    __slots__ = ("stats",)
+
+    def __init__(self):
+        self.stats = dict.fromkeys(_STAT_KEYS, 0)
+
+
+def activate_scope(scope: Optional[CacheStatsScope]):
+    """Install `scope` as this thread's counter sink; returns the
+    previous scope for `deactivate_scope`."""
+    prev = getattr(_scope_tls, "scope", None)
+    _scope_tls.scope = scope
+    return prev
+
+
+def deactivate_scope(prev) -> None:
+    _scope_tls.scope = prev
+
+
+def _bump(key: str) -> None:
+    """Count one cache event globally and into the active per-read
+    scope. Caller must hold `_lock`."""
+    _stats[key] += 1
+    scope = getattr(_scope_tls, "scope", None)
+    if scope is not None:
+        scope.stats[key] += 1
+
+
+def absorb_scope(scope: CacheStatsScope, stats: Dict[str, int],
+                 bump_global: bool = True) -> None:
+    """Fold a forked worker's scope stats into a parent-side scope —
+    and, for true fork children (`bump_global`), into the process-global
+    counters, which never saw the child's lookups. Inline-executed
+    shards already bumped the globals in this process and pass False."""
+    with _lock:
+        for k, v in stats.items():
+            if k in scope.stats and v:
+                scope.stats[k] += v
+                if bump_global:
+                    _stats[k] += v
 
 
 def note_decoder(hit: bool) -> None:
@@ -62,7 +118,7 @@ def note_decoder(hit: bool) -> None:
     decoder_for_segment) — a hit means the plan, kernel groups, and any
     jit program were all reused without touching the caches below."""
     with _lock:
-        _stats["decoder_hits" if hit else "decoder_misses"] += 1
+        _bump("decoder_hits" if hit else "decoder_misses")
 
 
 def cache_stats() -> Dict[str, int]:
@@ -148,9 +204,9 @@ def copybook_for_params(copybook_contents, params):
         cached = _PARSE_LRU.get(key)
         if cached is not None:
             _PARSE_LRU.move_to_end(key)
-            _stats["parse_hits"] += 1
+            _bump("parse_hits")
             return cached
-        _stats["parse_misses"] += 1
+        _bump("parse_misses")
 
     seg = params.multisegment
     copybooks = [
@@ -239,9 +295,9 @@ def cached_compile_plan(copybook, active_segment: Optional[str] = None,
         entry = _PLAN_LRU.get(key)
         if entry is not None and entry[0] is copybook:
             _PLAN_LRU.move_to_end(key)
-            _stats["plan_hits"] += 1
+            _bump("plan_hits")
             return _clone_plan(entry[1])
-        _stats["plan_misses"] += 1
+        _bump("plan_misses")
     plan = compile_plan(copybook, active_segment, select=select)
     with _lock:
         _PLAN_LRU[key] = (copybook, plan)
@@ -259,9 +315,9 @@ def cached_code_page_lut(name: str) -> np.ndarray:
     with _lock:
         lut = _LUT_CACHE.get(name)
         if lut is not None:
-            _stats["lut_hits"] += 1
+            _bump("lut_hits")
             return lut
-        _stats["lut_misses"] += 1
+        _bump("lut_misses")
     from ..encoding.codepages import code_page_lut_u16
 
     lut = code_page_lut_u16(name)

@@ -16,6 +16,11 @@ and index counts). The TPU-native equivalents here:
   pipelined execution engine (cobrix_tpu.engine) — wall time alone cannot
   attribute a pipeline win, because overlapped stages each burn close to
   the full wall on a busy pool; busy/wall is the overlap factor.
+
+The host-side scan timeline (trace spans, Chrome-trace export, metrics
+registry, live progress) lives in `cobrix_tpu.obs`; ReadMetrics carries
+its per-read artifacts (`spans`, `plan_cache` via a per-read cache
+scope) and publishes read totals into the default registry.
 """
 from __future__ import annotations
 
@@ -69,11 +74,15 @@ class StageTimes:
     factor reported in ReadMetrics. A plain dict read-modify-write races
     across threads; the lock makes each accumulation atomic."""
 
-    __slots__ = ("_lock", "busy_s")
+    __slots__ = ("_lock", "busy_s", "tracer")
 
-    def __init__(self):
+    def __init__(self, tracer=None):
         self._lock = threading.Lock()
         self.busy_s: Dict[str, float] = {}
+        # optional obs.Tracer: when set, every timed stage also lands on
+        # the scan timeline as a span (parent = the thread's current
+        # chunk/shard span). None costs one attribute check per stage.
+        self.tracer = tracer
 
     def add(self, name: str, seconds: float) -> None:
         with self._lock:
@@ -85,7 +94,10 @@ class StageTimes:
         try:
             yield
         finally:
-            self.add(name, time.perf_counter() - t0)
+            t1 = time.perf_counter()
+            self.add(name, t1 - t0)
+            if self.tracer is not None:
+                self.tracer.record_span(name, "stage", t0, t1)
 
     def as_dict(self) -> Dict[str, float]:
         with self._lock:
@@ -122,27 +134,60 @@ class ReadMetrics:
     # deaths; None when the read ran unsupervised
     supervision: Optional[dict] = None
     # compile-cache activity DURING this read (copybook parse / field-plan
-    # / code-page LUT hits and misses, delta from read start). The
-    # counters are process-global: with CONCURRENT read_cobol calls the
-    # delta includes the other reads' lookups in the window — exact for
-    # the common one-read-at-a-time case, an upper bound otherwise
+    # / code-page LUT hits and misses). Counted through a per-read
+    # CacheStatsScope that every thread working for the read activates
+    # (obs.context), so concurrent read_cobol calls attribute their own
+    # lookups exactly — never each other's
     plan_cache: Optional[dict] = None
+    # finished obs.Tracer span records when the read traced (trace_file
+    # or an explicitly attached tracer); None otherwise
+    spans: Optional[list] = None
 
     def __post_init__(self):
-        from .plan.cache import cache_stats
+        from .plan.cache import CacheStatsScope
 
-        self._cache_baseline = cache_stats()
+        self._timings_lock = threading.Lock()
+        self.cache_scope = CacheStatsScope()
+        # optional obs.Tracer for the read (set by read_cobol when
+        # tracing is on); stage() timers double as scan-level spans
+        self.tracer = None
+
+    def add_timing(self, name: str, seconds: float) -> None:
+        """Accumulate wall time for one named stage. Locked: pipelined
+        reads hit the same metrics object from multiple stage threads."""
+        with self._timings_lock:
+            self.timings_s[name] = (self.timings_s.get(name, 0.0)
+                                    + seconds)
 
     def finalize(self, data, shards: int) -> None:
         """Attach this metrics object to a finished CobolData."""
-        from .plan.cache import cache_stats
-
         self.shards = max(self.shards, shards)
         self.records = len(data)
-        now = cache_stats()
-        self.plan_cache = {k: now[k] - self._cache_baseline.get(k, 0)
-                           for k in now}
+        self.plan_cache = dict(self.cache_scope.stats)
+        if self.tracer is not None:
+            self.tracer.finish_root(args={
+                "files": self.files, "shards": self.shards,
+                "records": self.records, "bytes": self.bytes_read,
+                "backend": self.backend, "hosts": self.hosts})
+            self.spans = list(self.tracer.spans)
+        self._publish_registry()
         data.metrics = self
+
+    def _publish_registry(self) -> None:
+        """Fold this read into the process-global metrics registry
+        (obs.metrics.default_registry): scan/bytes/records totals plus
+        the read's cache events, so a Prometheus scrape sees the fleet
+        aggregate without touching per-read objects."""
+        from .obs.metrics import scan_metrics
+
+        m = scan_metrics()
+        m["scans"].inc()
+        m["bytes"].inc(self.bytes_read)
+        m["records"].inc(self.records)
+        for key, count in (self.plan_cache or {}).items():
+            if count:
+                cache, _, result = key.rpartition("_")
+                m["cache"].labels(cache=cache, result=result).inc(count)
 
     def as_dict(self) -> dict:
         out = {
@@ -162,6 +207,8 @@ class ReadMetrics:
             out["supervision"] = self.supervision
         if self.plan_cache is not None:
             out["plan_cache"] = self.plan_cache
+        if self.spans is not None:
+            out["span_count"] = len(self.spans)
         return out
 
 
@@ -175,9 +222,14 @@ class _Stage:
         return self
 
     def __exit__(self, *exc):
-        self.metrics.timings_s[self.name] = (
-            self.metrics.timings_s.get(self.name, 0.0)
-            + time.perf_counter() - self._t0)
+        t1 = time.perf_counter()
+        # locked accumulation: the pipelined executor runs stages of the
+        # same read on multiple threads, and a bare dict read-modify-write
+        # here loses increments under that interleaving
+        self.metrics.add_timing(self.name, t1 - self._t0)
+        tracer = self.metrics.tracer
+        if tracer is not None:
+            tracer.record_span(self.name, "phase", self._t0, t1)
 
 
 def stage(metrics: Optional[ReadMetrics], name: str):

@@ -20,6 +20,7 @@ from .diagnostics import (
     RecordErrorPolicy,
     hex_snapshot,
 )
+from ..obs.context import current as obs_current
 from ..profiling import timed_stage
 from .extractors import DecodeOptions, extract_record
 from .parameters import ReaderParameters
@@ -193,6 +194,10 @@ class FixedLenReader:
         with timed_stage(stage_times, "decode"):
             batch = self.decoder(backend).decode(trimmed, lengths=lengths)
         n = batch.n_records
+        obs = obs_current()
+        if obs is not None and obs.metrics is not None and n:
+            obs.metrics["record_length"].observe_repeat(
+                self.record_size, n)
         positions = np.arange(n, dtype=np.int64)
         result.n_rows = n
         result.segments.append(SegmentBatch(

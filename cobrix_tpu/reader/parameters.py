@@ -140,6 +140,16 @@ class ReaderParameters:
     scan_deadline_s: float = 0.0
     # worker heartbeat period (multihost supervision; liveness telemetry)
     heartbeat_interval_s: float = 0.5
+    # -- observability (cobrix_tpu.obs) ----------------------------------
+    # Chrome-trace/Perfetto JSON output path: when set, the read records
+    # trace spans on every execution path (scan -> shard -> chunk ->
+    # stage, supervisor events) — including forked multihost workers,
+    # merged onto one timeline — and writes the file at read end. '' =
+    # tracing off (the ~zero-overhead default)
+    trace_file: str = ""
+    # minimum seconds between progress_callback invocations (the final
+    # done=True snapshot always fires)
+    progress_interval_s: float = 0.5
 
     def resolved_pipeline_workers(self) -> int:
         """Effective worker count: 0 = sequential, negative = auto."""

@@ -23,6 +23,7 @@ import numpy as np
 from ..copybook.ast import Group, Primitive
 from ..copybook.copybook import Copybook
 from ..plan.cache import copybook_for_params, decoder_cache_for
+from ..obs.context import current as obs_current
 from ..profiling import timed_stage
 from .columnar import ColumnarDecoder, decoder_for_segment
 from .extractors import (
@@ -836,6 +837,11 @@ class VarLenReader:
             if seg_field is not None:
                 segment_ids = self._segment_ids_vectorized(
                     data, offsets, lengths, seg_field)
+        obs = obs_current()
+        if obs is not None and obs.metrics is not None and len(lengths):
+            # record-length distribution (one vectorized bucket count per
+            # shard, never a per-record loop)
+            obs.metrics["record_length"].observe_many(lengths)
         return data, base, offsets, lengths, segment_ids, corrupt_reasons
 
     def _segment_ids_vectorized(self, data, offsets, lengths,

@@ -1,0 +1,491 @@
+"""Unified scan telemetry (cobrix_tpu.obs): span parent/child integrity
+across threads and forked multihost workers, Chrome-trace JSON schema
+validity, Prometheus exposition format, progress-callback monotonicity,
+and the tracing-off zero-overhead fast path."""
+import json
+import re
+import threading
+import time
+
+import pytest
+
+from cobrix_tpu import prometheus_text, read_cobol
+from cobrix_tpu.obs import (
+    MetricsRegistry,
+    ObsContext,
+    ProgressTracker,
+    Tracer,
+    activate,
+    current,
+    maybe_span,
+)
+from cobrix_tpu.profiling import ReadMetrics, StageTimes
+from cobrix_tpu.testing.generators import (
+    EXP1_COPYBOOK,
+    EXP2_COPYBOOK,
+    generate_exp1,
+    generate_exp2,
+)
+from tests.util import hard_timeout
+
+EXP2_KW = dict(copybook_contents=EXP2_COPYBOOK, is_record_sequence="true",
+               segment_field="SEGMENT-ID",
+               redefine_segment_id_map="STATIC-DETAILS => C",
+               redefine_segment_id_map_1="CONTACTS => P",
+               segment_id_prefix="OBS")
+
+
+def _spans_by_id(events):
+    spans = [e for e in events if e.get("ph") == "X"]
+    return spans, {e["args"]["span_id"]: e for e in spans}
+
+
+# -- trace spans: threads --------------------------------------------------
+
+def test_pipelined_trace_span_parentage(tmp_path):
+    """Chunk spans parent to the scan root; stage spans recorded on the
+    pipeline's worker/assembler THREADS parent to their chunk span — the
+    parent relationship survives crossing the thread pool."""
+    p = tmp_path / "exp1.dat"
+    p.write_bytes(generate_exp1(400, seed=21).tobytes())
+    tf = str(tmp_path / "scan.trace.json")
+    out = read_cobol(str(p), copybook_contents=EXP1_COPYBOOK,
+                     pipeline_workers="2", chunk_size_mb="0.05",
+                     trace_file=tf)
+    assert len(out) == 400
+    events = json.load(open(tf))["traceEvents"]
+    spans, by_id = _spans_by_id(events)
+    roots = [e for e in spans if e["cat"] == "scan"]
+    assert len(roots) == 1
+    root_id = roots[0]["args"]["span_id"]
+    chunks = [e for e in spans if e["cat"] == "chunk"]
+    assert len(chunks) >= 2  # the tiny chunk size forced a multi-chunk plan
+    assert all(c["args"]["parent_id"] == root_id for c in chunks)
+    chunk_ids = {c["args"]["span_id"] for c in chunks}
+    stages = [e for e in spans if e["cat"] == "stage"]
+    assert stages and all(s["args"]["parent_id"] in chunk_ids
+                          for s in stages)
+    # stages ran on more than one thread, yet parentage held
+    assert len({(e["pid"], e["tid"]) for e in stages}) >= 2
+    # the read's metrics carry the span list too
+    assert out.metrics.spans is not None
+    assert out.metrics.as_dict()["span_count"] == len(out.metrics.spans)
+
+
+def test_sequential_var_len_trace_has_shard_spans(tmp_path):
+    p = tmp_path / "exp2.dat"
+    p.write_bytes(generate_exp2(3000, seed=22))
+    tf = str(tmp_path / "scan.trace.json")
+    out = read_cobol(str(p), input_split_records="800", trace_file=tf,
+                     **EXP2_KW)
+    assert len(out) == 3000
+    events = json.load(open(tf))["traceEvents"]
+    spans, _ = _spans_by_id(events)
+    root_id = [e for e in spans if e["cat"] == "scan"][0]["args"]["span_id"]
+    shards = [e for e in spans if e["cat"] == "shard"]
+    assert len(shards) >= 3
+    assert all(s["args"]["parent_id"] == root_id for s in shards)
+
+
+# -- trace spans: forked multihost workers ---------------------------------
+
+def test_multihost_trace_merges_worker_spans(tmp_path):
+    """One multihost scan -> ONE Chrome trace containing spans from >= 2
+    forked worker processes, shard spans parented to the parent's scan
+    root and stage spans to their shard (the acceptance criterion)."""
+    with hard_timeout(240, "multihost trace"):
+        p = tmp_path / "exp2.dat"
+        p.write_bytes(generate_exp2(4000, seed=23))
+        tf = str(tmp_path / "scan.trace.json")
+        out = read_cobol(str(p), hosts="2", input_split_records="800",
+                         trace_file=tf, **EXP2_KW)
+        assert len(out) == 4000
+        events = json.load(open(tf))["traceEvents"]
+        spans, _ = _spans_by_id(events)
+        root = [e for e in spans if e["cat"] == "scan"][0]
+        shard_spans = [e for e in spans if e["cat"] == "shard"]
+        worker_pids = {e["pid"] for e in shard_spans}
+        assert len(worker_pids) >= 2, "spans from fewer than 2 workers"
+        assert root["pid"] not in worker_pids  # workers are forks
+        root_id = root["args"]["span_id"]
+        assert all(s["args"]["parent_id"] == root_id for s in shard_spans)
+        shard_ids = {s["args"]["span_id"] for s in shard_spans}
+        stages = [e for e in spans if e["cat"] == "stage"]
+        assert stages and all(s["args"]["parent_id"] in shard_ids
+                              for s in stages)
+        # clock-offset corrected: worker spans sit inside the scan window
+        t0, t1 = root["ts"], root["ts"] + root["dur"]
+        slack = 0.05e6  # 50ms of cross-process clock-pair jitter
+        for s in shard_spans:
+            assert t0 - slack <= s["ts"] <= t1 + slack
+        # supervisor events landed as instants
+        assert any(e["ph"] == "i" and e["name"] == "dispatch"
+                   for e in events)
+
+
+def test_clock_offset_correction_unit():
+    """merge() maps a worker's perf timeline onto the host's using the
+    shared wall clock: a worker whose perf_counter base differs by X
+    lands exactly X later/earlier after correction."""
+    host = Tracer()
+    t = time.perf_counter()
+    spans = [(123, host.root_id, "shard", "shard", "X", t, t + 1.0,
+              9999, 1, None)]
+    # fabricate a worker whose perf clock reads 100s BEHIND the host's
+    skew = 100.0
+    worker_clock = (time.time(), time.perf_counter() - skew)
+    host.merge(spans, worker_clock)
+    merged = [s for s in host.spans if s[0] == 123][0]
+    assert abs(merged[5] - (t + skew)) < 0.05
+
+
+def test_span_ids_unique_across_tracers_in_one_process():
+    """Multiple Tracers in one process (one per shard in a multihost
+    worker) share the process-wide id counter — ids never collide."""
+    a, b = Tracer(), Tracer()
+    ids = {a.root_id, b.root_id}
+    for _ in range(50):
+        ids.add(a.new_id())
+        ids.add(b.new_id())
+    assert len(ids) == 102
+
+
+def test_multihost_worker_metrics_ship_home(tmp_path):
+    """Worker-side record-length observations and compile-cache events
+    reach the parent's registry and the read's plan_cache — hosts>1
+    reads are not blind spots in the fleet metrics."""
+    from cobrix_tpu.obs import scan_metrics
+
+    with hard_timeout(240, "multihost metrics"):
+        before = scan_metrics()["record_length"].snapshot()["count"]
+        p = tmp_path / "exp2.dat"
+        p.write_bytes(generate_exp2(3000, seed=33))
+        out = read_cobol(str(p), hosts="2", input_split_records="800",
+                         **EXP2_KW)
+        after = scan_metrics()["record_length"].snapshot()["count"]
+        assert after - before >= 3000  # every framed record counted
+        stats = out.metrics.as_dict()["plan_cache"]
+        # the workers' per-shard decoder lookups came home
+        assert stats["decoder_hits"] + stats["decoder_misses"] >= 1
+
+
+# -- Chrome-trace schema ---------------------------------------------------
+
+def test_chrome_trace_schema_validity(tmp_path):
+    p = tmp_path / "exp1.dat"
+    p.write_bytes(generate_exp1(64, seed=24).tobytes())
+    tf = str(tmp_path / "scan.trace.json")
+    read_cobol(str(p), copybook_contents=EXP1_COPYBOOK, trace_file=tf)
+    doc = json.load(open(tf))
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for e in doc["traceEvents"]:
+        assert e["ph"] in ("X", "i", "M")
+        assert isinstance(e["pid"], int)
+        if e["ph"] == "M":
+            assert e["name"] in ("process_name", "thread_name")
+            continue
+        assert isinstance(e["name"], str) and e["name"]
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+        args = e["args"]
+        assert "span_id" in args and "parent_id" in args
+
+
+# -- Prometheus exposition -------------------------------------------------
+
+def test_prometheus_exposition_format(tmp_path):
+    p = tmp_path / "exp1.dat"
+    p.write_bytes(generate_exp1(32, seed=25).tobytes())
+    read_cobol(str(p), copybook_contents=EXP1_COPYBOOK)
+    text = prometheus_text()
+    assert re.search(r"^# TYPE cobrix_scans_total counter$", text, re.M)
+    assert re.search(r"^cobrix_scans_total \d+$", text, re.M)
+    assert re.search(r"^# TYPE cobrix_record_length_bytes histogram$",
+                     text, re.M)
+    assert re.search(
+        r'^cobrix_record_length_bytes_bucket\{le="\+Inf"\} \d+$',
+        text, re.M)
+    assert re.search(r"^cobrix_record_length_bytes_count \d+$", text, re.M)
+    # labeled counter sample syntax
+    assert re.search(r'^cobrix_plan_cache_events_total\{cache="parse",'
+                     r'result="(hit|miss)(es)?"\} \d+$', text, re.M) \
+        or "cobrix_plan_cache_events_total{" in text
+    # every non-comment line is `name[{labels}] value`
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            continue
+        assert re.match(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? '
+                        r'-?\d+(\.\d+)?([eE][+-]?\d+)?$', line), line
+
+
+def test_histogram_bucket_cumulativity():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_test", "t", buckets=(1, 2, 4))
+    for v in (0.5, 1.5, 3, 8, 0.1):
+        h.observe(v)
+    lines = reg.exposition().splitlines()
+    buckets = [int(ln.rsplit(" ", 1)[1]) for ln in lines
+               if ln.startswith("h_test_bucket")]
+    assert buckets == sorted(buckets)       # cumulative, nondecreasing
+    assert buckets[-1] == 5                 # +Inf sees every observation
+    assert h.quantile(0.5) is not None
+
+
+# -- live progress ---------------------------------------------------------
+
+def test_progress_callback_monotonic_pipelined(tmp_path):
+    p = tmp_path / "exp1.dat"
+    p.write_bytes(generate_exp1(400, seed=26).tobytes())
+    snaps = []
+    out = read_cobol(str(p), copybook_contents=EXP1_COPYBOOK,
+                     pipeline_workers="2", chunk_size_mb="0.05",
+                     progress_callback=snaps.append,
+                     progress_interval_s="0")
+    assert len(snaps) >= 2
+    _assert_monotonic(snaps)
+    final = snaps[-1]
+    assert final.done and final.records_done == len(out)
+    assert final.bytes_done == final.bytes_total > 0
+    assert final.chunks_done == final.chunks_total >= 2
+    assert final.chunks_inflight == 0
+    assert final.stage_busy_s.get("decode", 0) > 0
+
+
+def test_progress_callback_multihost(tmp_path):
+    with hard_timeout(240, "multihost progress"):
+        p = tmp_path / "exp2.dat"
+        p.write_bytes(generate_exp2(3000, seed=27))
+        snaps = []
+        out = read_cobol(str(p), hosts="2", input_split_records="800",
+                         progress_callback=snaps.append,
+                         progress_interval_s="0", **EXP2_KW)
+        assert snaps and snaps[-1].done
+        _assert_monotonic(snaps)
+        assert snaps[-1].records_done == len(out) == 3000
+        assert snaps[-1].chunks_done >= 3
+
+
+def test_progress_callback_exception_never_breaks_scan(tmp_path):
+    p = tmp_path / "exp1.dat"
+    p.write_bytes(generate_exp1(16, seed=28).tobytes())
+
+    def boom(progress):
+        raise RuntimeError("broken progress bar")
+
+    out = read_cobol(str(p), copybook_contents=EXP1_COPYBOOK,
+                     progress_callback=boom, progress_interval_s="0")
+    assert len(out) == 16
+
+
+def test_progress_bytes_reach_total_on_var_len_tail_shard(tmp_path):
+    """The last index shard of a var-len file is an open range
+    (offset_to=-1): its bytes must still count, so bytes_done converges
+    to bytes_total instead of plateauing below it."""
+    p = tmp_path / "exp2.dat"
+    raw = generate_exp2(3000, seed=34)
+    p.write_bytes(raw)
+    snaps = []
+    read_cobol(str(p), input_split_records="800",
+               progress_callback=snaps.append, progress_interval_s="0",
+               **EXP2_KW)
+    final = snaps[-1]
+    assert final.bytes_total == len(raw)
+    assert final.bytes_done == final.bytes_total
+
+
+def test_trace_file_unwritable_fails_before_scan(tmp_path):
+    p = tmp_path / "exp1.dat"
+    p.write_bytes(generate_exp1(16, seed=35).tobytes())
+    with pytest.raises(ValueError, match="trace_file"):
+        read_cobol(str(p), copybook_contents=EXP1_COPYBOOK,
+                   trace_file=str(tmp_path / "no" / "such" / "t.json"))
+
+
+def test_failed_scan_still_writes_partial_trace_and_final_progress(
+        tmp_path):
+    """A scan that raises under fail_fast still flushes telemetry: the
+    done=True progress snapshot fires and the partial trace (the thing
+    that diagnoses the failure) lands on disk."""
+    p = tmp_path / "bad.dat"
+    p.write_bytes(generate_exp1(4, seed=36).tobytes() + b"\x00\x01\x02")
+    tf = str(tmp_path / "fail.trace.json")
+    snaps = []
+    with pytest.raises(ValueError):
+        read_cobol(str(p), copybook_contents=EXP1_COPYBOOK,
+                   trace_file=tf, progress_callback=snaps.append,
+                   progress_interval_s="0")
+    assert snaps and snaps[-1].done
+    doc = json.load(open(tf))
+    assert doc["traceEvents"]
+
+
+def test_progress_callback_must_be_callable(tmp_path):
+    p = tmp_path / "exp1.dat"
+    p.write_bytes(generate_exp1(16, seed=29).tobytes())
+    with pytest.raises(ValueError, match="progress_callback"):
+        read_cobol(str(p), copybook_contents=EXP1_COPYBOOK,
+                   progress_callback="not-a-function")
+
+
+def _assert_monotonic(snaps):
+    for a, b in zip(snaps, snaps[1:]):
+        assert b.bytes_done >= a.bytes_done
+        assert b.records_done >= a.records_done
+        assert b.chunks_done >= a.chunks_done
+        assert b.chunks_failed >= a.chunks_failed
+        assert b.elapsed_s >= a.elapsed_s
+        assert b.chunks_inflight >= 0
+
+
+def test_progress_tracker_thread_safety():
+    tracker = ProgressTracker(lambda p: None, bytes_total=8000,
+                              chunks_total=80, min_interval_s=0.0)
+
+    def work():
+        for _ in range(20):
+            tracker.chunk_started()
+            tracker.chunk_done(bytes_done=100, records=10)
+
+    threads = [threading.Thread(target=work) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tracker.finish()
+    snap = tracker.snapshot(done=True)
+    assert snap.chunks_done == 80
+    assert snap.bytes_done == 8000
+    assert snap.records_done == 800
+
+
+def test_progress_retried_chunk_counts_once_without_tracer():
+    """A chunk that fails once and succeeds on re-dispatch is ONE chunk
+    to the progress tracker even with tracing off (regression: the
+    first-dispatch sentinel used to be the trace span id, so without a
+    tracer every retry re-fired chunk_started and inflight drifted)."""
+    from cobrix_tpu.engine.pipeline import PipelineExecutor
+
+    snaps = []
+    tracker = ProgressTracker(snaps.append, min_interval_s=0.0)
+    failed_once = []
+
+    def flaky(payload):
+        if not failed_once:
+            failed_once.append(1)
+            raise RuntimeError("transient")
+        return payload
+
+    ctx = ObsContext(progress=tracker)
+    with activate(ctx):
+        ex = PipelineExecutor(2, chunk_retries=1)
+    results = ex.run([((lambda: 1), flaky), ((lambda: 2), (lambda p: p))])
+    assert results == [1, 2]
+    snap = tracker.snapshot()
+    assert snap.chunks_done == 2          # not 2 + a phantom retry
+    assert snap.chunks_inflight == 0      # no drift from the retry
+    _assert_monotonic(snaps)
+
+
+# -- tracing-off fast path -------------------------------------------------
+
+def test_tracing_off_zero_allocation_fast_path(tmp_path):
+    """With tracing off, maybe_span returns ONE shared null context (no
+    allocation per call) and a read records no spans at all."""
+    assert maybe_span(None, "a") is maybe_span(None, "b")
+    st = StageTimes()             # no tracer attached
+    with st.timed("read"):
+        pass
+    assert st.tracer is None
+    p = tmp_path / "exp1.dat"
+    p.write_bytes(generate_exp1(16, seed=30).tobytes())
+    out = read_cobol(str(p), copybook_contents=EXP1_COPYBOOK)
+    assert out.metrics.spans is None
+    assert out.metrics.tracer is None
+    assert "span_count" not in out.metrics.as_dict()
+
+
+# -- satellite regression: racy accumulations ------------------------------
+
+def test_read_metrics_timings_accumulation_is_locked():
+    """profiling._Stage routes through ReadMetrics.add_timing under a
+    lock: concurrent accumulation from many threads loses nothing."""
+    m = ReadMetrics()
+    n_threads, n_iter = 8, 2000
+
+    def work():
+        for _ in range(n_iter):
+            m.add_timing("scan", 0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert m.timings_s["scan"] == pytest.approx(
+        n_threads * n_iter * 0.001, rel=1e-6)
+
+
+def test_cache_scope_isolated_between_concurrent_reads(tmp_path):
+    """Two concurrent reads each see their OWN cache events (the old
+    process-global delta attributed both reads' lookups to whichever
+    finished last)."""
+    p1 = tmp_path / "a.dat"
+    p2 = tmp_path / "b.dat"
+    p1.write_bytes(generate_exp1(32, seed=31).tobytes())
+    p2.write_bytes(generate_exp1(32, seed=32).tobytes())
+    read_cobol(str(p1), copybook_contents=EXP1_COPYBOOK)  # warm caches
+    outs = [None, None]
+
+    def read(i, path):
+        outs[i] = read_cobol(path, copybook_contents=EXP1_COPYBOOK)
+
+    threads = [threading.Thread(target=read, args=(0, str(p1))),
+               threading.Thread(target=read, args=(1, str(p2)))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for out in outs:
+        stats = out.metrics.as_dict()["plan_cache"]
+        # each read did exactly one parse lookup (a hit) — were the
+        # counters still process-global deltas, one read would see both
+        assert stats["parse_hits"] == 1
+        assert stats["parse_misses"] == 0
+
+
+def test_obs_context_thread_locality():
+    ctx = ObsContext()
+    seen = []
+    with activate(ctx):
+        assert current() is ctx
+        t = threading.Thread(target=lambda: seen.append(current()))
+        t.start()
+        t.join()
+    assert seen == [None]         # other threads are not contaminated
+    assert current() is None      # deactivated on exit
+
+
+# -- traceview smoke (the multihost sweep stays behind `slow`) -------------
+
+def test_traceview_smoke_quick():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/traceview.py", "--smoke"],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_traceview_smoke_sweep():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/traceview.py", "--smoke", "--sweep"],
+        capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout

@@ -1,0 +1,249 @@
+"""Summarize a cobrix_tpu Chrome-trace file: critical path, per-stage
+utilization, straggler table, supervision events.
+
+The trace comes from the `trace_file=` read option (cobrix_tpu.obs) and
+opens graphically in chrome://tracing or https://ui.perfetto.dev; this
+tool is the terminal view — what took the time, which shard straggled,
+what the supervisor did — without leaving the shell.
+
+    python tools/traceview.py scan.trace.json     # summarize a trace
+    python tools/traceview.py --smoke             # self-check: run a
+                                                  # small traced scan and
+                                                  # assert the summary
+                                                  # parses (CI smoke,
+                                                  # like pipecheck)
+    python tools/traceview.py --smoke --sweep     # + multihost profile
+                                                  # (slow; tier-1 runs
+                                                  # the quick smoke)
+
+Exit code 0 = summary produced (and, under --smoke, sanity checks hold);
+1 = malformed trace or failed smoke assertion.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_events(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise ValueError("not a Chrome trace: no traceEvents array")
+    return events
+
+
+def summarize(events: List[dict]) -> dict:
+    """Structured summary of one trace (the dict `main` prints)."""
+    spans = [e for e in events if e.get("ph") == "X"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    if not spans:
+        raise ValueError("trace contains no complete ('X') spans")
+
+    by_id: Dict[int, dict] = {}
+    children: Dict[int, List[dict]] = defaultdict(list)
+    for e in spans:
+        args = e.get("args") or {}
+        sid = args.get("span_id")
+        if sid is not None:
+            by_id[sid] = e
+    for e in spans:
+        if e.get("cat") == "phase":
+            # phase timers (parse_copybook/plan_index/scan) wrap whole
+            # sections and would shadow the real chunk/stage chain in
+            # the critical-path walk; they still show as lanes in the
+            # trace viewer
+            continue
+        args = e.get("args") or {}
+        parent = args.get("parent_id")
+        if parent in by_id:
+            children[parent].append(e)
+
+    roots = [e for e in spans if e.get("cat") == "scan"]
+    root = max(roots, key=lambda e: e.get("dur", 0)) if roots else None
+    wall_us = (root["dur"] if root is not None
+               else max(e["ts"] + e.get("dur", 0) for e in spans)
+               - min(e["ts"] for e in spans))
+    wall_s = wall_us / 1e6 if wall_us else 0.0
+
+    # per-stage busy: thread-summed duration by stage name (overlapped
+    # stages exceed wall — utilization > 1 means real overlap)
+    stage_busy: Dict[str, float] = defaultdict(float)
+    for e in spans:
+        if e.get("cat") == "stage":
+            stage_busy[e["name"]] += e.get("dur", 0) / 1e6
+    utilization = {k: round(v / wall_s, 3) if wall_s else 0.0
+                   for k, v in stage_busy.items()}
+
+    # straggler table: work units (shards/chunks) by descending duration
+    units = [e for e in spans if e.get("cat") in ("shard", "chunk")]
+    units.sort(key=lambda e: -e.get("dur", 0))
+    stragglers = []
+    mean_us = (sum(e.get("dur", 0) for e in units) / len(units)
+               if units else 0.0)
+    for e in units[:10]:
+        args = e.get("args") or {}
+        stragglers.append({
+            "kind": e.get("cat"),
+            "id": args.get("seq", args.get("chunk")),
+            "file": args.get("file"),
+            "pid": e.get("pid"),
+            "dur_s": round(e.get("dur", 0) / 1e6, 6),
+            "x_mean": (round(e.get("dur", 0) / mean_us, 2)
+                       if mean_us else None),
+        })
+
+    # critical path: end-anchored walk from the scan root — at each level
+    # follow the child that FINISHED last (the span the wall actually
+    # waited on), e.g. scan -> straggler chunk -> its assemble stage
+    critical = []
+    if root is not None:
+        node = root
+        while node is not None:
+            args = node.get("args") or {}
+            critical.append({
+                "name": node["name"], "cat": node.get("cat"),
+                "id": args.get("seq", args.get("chunk")),
+                "dur_s": round(node.get("dur", 0) / 1e6, 6),
+                "pid": node.get("pid"),
+            })
+            kids = children.get(args.get("span_id"), [])
+            node = (max(kids, key=lambda e: e["ts"] + e.get("dur", 0))
+                    if kids else None)
+
+    sup_events: Dict[str, int] = defaultdict(int)
+    for e in instants:
+        sup_events[e["name"]] += 1
+
+    return {
+        "wall_s": round(wall_s, 6),
+        "spans": len(spans),
+        "processes": len({e.get("pid") for e in spans}),
+        "threads": len({(e.get("pid"), e.get("tid")) for e in spans}),
+        "stage_busy_s": {k: round(v, 6)
+                         for k, v in sorted(stage_busy.items())},
+        "stage_utilization": dict(sorted(utilization.items())),
+        "work_units": len(units),
+        "stragglers": stragglers,
+        "critical_path": critical,
+        "supervision_events": dict(sorted(sup_events.items())),
+    }
+
+
+def print_summary(s: dict) -> None:
+    print(f"wall {s['wall_s']:.3f}s | {s['spans']} spans | "
+          f"{s['processes']} process(es), {s['threads']} thread lane(s) | "
+          f"{s['work_units']} work unit(s)")
+    if s["stage_busy_s"]:
+        print("stage        busy_s    utilization")
+        for k in s["stage_busy_s"]:
+            print(f"  {k:<10} {s['stage_busy_s'][k]:>8.3f}    "
+                  f"{s['stage_utilization'][k]:>5.2f}x")
+    if s["critical_path"]:
+        chain = " -> ".join(
+            f"{n['name']}"
+            + (f"[{n['id']}]" if n.get("id") is not None else "")
+            + f"({n['dur_s']:.3f}s)"
+            for n in s["critical_path"])
+        print(f"critical path: {chain}")
+    if s["stragglers"]:
+        print("top stragglers (kind id dur_s x_mean pid file):")
+        for t in s["stragglers"][:5]:
+            print(f"  {t['kind']:<6} {str(t['id']):<4} "
+                  f"{t['dur_s']:>8.4f}  "
+                  f"{t['x_mean'] if t['x_mean'] is not None else '-':>6} "
+                  f" {t['pid']}  {t['file'] or ''}")
+    if s["supervision_events"]:
+        evs = " ".join(f"{k}={v}"
+                       for k, v in s["supervision_events"].items())
+        print(f"supervision: {evs}")
+
+
+def _smoke(sweep: bool) -> int:
+    """Generate small traced scans and assert the summary parses — the
+    end-to-end self-check CI runs (pipecheck/chaoscheck style)."""
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing.generators import (
+        EXP1_COPYBOOK,
+        EXP2_COPYBOOK,
+        generate_exp1,
+        generate_exp2,
+    )
+
+    ok = True
+    cases = [("exp1_pipelined",
+              generate_exp1(600, seed=11).tobytes(),
+              dict(copybook_contents=EXP1_COPYBOOK, pipeline_workers="2",
+                   chunk_size_mb="0.05"))]
+    if sweep:
+        cases.append(
+            ("exp2_multihost", generate_exp2(4000, seed=11),
+             dict(copybook_contents=EXP2_COPYBOOK,
+                  is_record_sequence="true", segment_field="SEGMENT-ID",
+                  redefine_segment_id_map="STATIC-DETAILS => C",
+                  redefine_segment_id_map_1="CONTACTS => P",
+                  hosts="2", input_split_records="800")))
+    for name, data, kw in cases:
+        with tempfile.NamedTemporaryFile(suffix=".dat",
+                                         delete=False) as f:
+            f.write(data)
+            path = f.name
+        trace_path = path + ".trace.json"
+        try:
+            out = read_cobol(path, trace_file=trace_path, **kw)
+            summary = summarize(load_events(trace_path))
+            print(f"--- {name}: {len(out)} rows")
+            print_summary(summary)
+            good = bool(summary["spans"] > 0 and summary["wall_s"] > 0
+                        and summary["stage_busy_s"]
+                        and len(summary["critical_path"]) >= 2)
+            if name == "exp2_multihost":
+                good &= summary["processes"] >= 3  # parent + 2 workers
+            if not good:
+                print(f"SMOKE FAILED for {name}: {summary}")
+            ok &= good
+        finally:
+            os.unlink(path)
+            if os.path.exists(trace_path):
+                os.unlink(trace_path)
+    print("OK: traceview smoke passed" if ok
+          else "FAILED: traceview smoke")
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", nargs="?", help="Chrome-trace JSON to view")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as one JSON object")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-check: run a traced scan and summarize it")
+    ap.add_argument("--sweep", action="store_true",
+                    help="with --smoke: add the multihost profile (slow)")
+    args = ap.parse_args()
+    if args.smoke:
+        return _smoke(args.sweep)
+    if not args.trace:
+        ap.error("a trace file (or --smoke) is required")
+    try:
+        summary = summarize(load_events(args.trace))
+    except (ValueError, OSError, json.JSONDecodeError) as exc:
+        print(f"FAILED: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        print_summary(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
