@@ -1,4 +1,4 @@
-"""Benchmark: exp3 multisegment-wide decode throughput (MB/s).
+"""Benchmark: exp3 multisegment-wide scan throughput (MB/s).
 
 Reproduces the reference's north-star workload (BASELINE.md exp3:
 RDW variable-length multisegment file; wide 'C' segments with
@@ -7,11 +7,13 @@ interleaved with 64-byte 'P' contact segments). Reference single-core
 throughput is ~8.0 MB/s (performance/exp3_multiseg_wide.csv); the
 vs_baseline field is measured MB/s / 8.0.
 
-Pipeline timed end-to-end: RDW record framing (host) -> per-segment batch
-packing (host) -> columnar kernel decode (device) -> typed column arrays
-on host. Data generation and jit warmup are excluded; row/JSON
-materialization is excluded (columnar output is the product, as Parquet
-columns are for the reference).
+The HEADLINE is the honest end-to-end conversion: file -> RDW framing
+-> segment split -> kernel decode -> Arrow table, timed exactly like
+the reference job produced Parquet columns. The kernel-only framing +
+decode measurement (no Arrow assembly; the number earlier rounds
+headlined) stays alongside as `decode_only` — comparing IT against the
+full-conversion baseline overstates, so `vs_baseline` uses the
+end-to-end value. Data generation and jit warmup are excluded.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -443,6 +445,74 @@ def run(backend: str, mb_target: float) -> dict:
     }
 
 
+def run_exp3_to_arrow(mb_target: float) -> dict:
+    """exp3 multiseg-wide END-TO-END: file -> RDW framing -> segment
+    split -> decode -> Arrow table, the same span the reference's
+    8.0 MB/s covers (its job wrote Parquet columns, not raw decodes).
+    Best of pipelined and sequential, like exp1/exp2."""
+    import tempfile
+
+    from cobrix_tpu.testing.generators import EXP3_COPYBOOK, generate_exp3
+
+    est_per_record = 16072 * 0.33 + 68 * 0.67
+    n_records = max(64, int(mb_target * 1024 * 1024 / est_per_record))
+    raw = generate_exp3(n_records, seed=100)
+    mb = len(raw) / (1024 * 1024)
+    kw = dict(copybook_contents=EXP3_COPYBOOK, is_record_sequence="true",
+              segment_field="SEGMENT-ID",
+              redefine_segment_id_map="STATIC-DETAILS => C",
+              redefine_segment_id_map_1="CONTACTS => P")
+    path = None
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+            f.write(raw)
+            path = f.name
+        # either variant alone carries the metric: one failing must not
+        # drop the honest headline back to the decode-only comparison
+        seq_best = pipe_best = None
+        table = None
+        try:
+            seq_best, table, _ = _best_to_arrow(path, kw)
+        except Exception as exc:
+            _log(f"exp3 sequential to_arrow failed: {exc}")
+        try:
+            pipe_best, table, _ = _best_to_arrow(
+                path, dict(kw, **_pipeline_kw()))
+        except Exception as exc:
+            _log(f"exp3 pipelined to_arrow failed: {exc}")
+    finally:
+        if path:
+            os.unlink(path)
+    if table is None:
+        raise RuntimeError("both exp3 to_arrow variants failed")
+    best = min(t for t in (seq_best, pipe_best) if t)
+    result = {
+        "metric": "exp3_multiseg_wide_to_arrow",
+        "value": round(mb / best, 2),
+        "unit": "MB/s",
+        "vs_baseline": round(mb / best / BASELINE_MBPS, 2),
+        "rows_per_s": int(table.num_rows / best),
+        "pipelined_MBps": (round(mb / pipe_best, 1) if pipe_best else None),
+        "sequential_MBps": (round(mb / seq_best, 1) if seq_best else None),
+    }
+    _log(f"exp3 end-to-end to_arrow: {result}")
+    return result
+
+
+def _headline(decode_only: dict, e2e: dict) -> dict:
+    """Merge the two exp3 measurements into the emitted headline: the
+    honest end-to-end number carries `value`/`vs_baseline`; the
+    kernel-only number rides along as `decode_only`. A failed e2e run
+    falls back to the decode headline with the error recorded."""
+    if "value" not in e2e:
+        out = dict(decode_only)
+        out["to_arrow"] = e2e  # the error record — never silently lost
+        return out
+    out = dict(e2e)
+    out["decode_only"] = decode_only
+    return out
+
+
 def _pipeline_kw() -> dict:
     """Pipeline knobs for the bench: auto worker count, chunks sized so
     the default 40MB inputs split ~10 ways (overridable via env)."""
@@ -687,6 +757,16 @@ def main():
     side = _side_metrics(mb_target)
     if result is None:
         result = run(backend, mb_target)
+    # the honest headline: end-to-end Arrow conversion of the same
+    # workload (the decode-only number overstates vs the full-conversion
+    # baseline — VERDICT flagged the comparison)
+    try:
+        e2e = run_exp3_to_arrow(mb_target)
+    except Exception as exc:
+        _log(f"exp3 to_arrow timing failed: {exc}")
+        e2e = {"metric": "exp3_multiseg_wide_to_arrow",
+               "error": str(exc)[:400]}
+    result = _headline(result, e2e)
 
     if not platform:
         # the tunnel was down at bench start — re-probe now that the CPU

@@ -15,7 +15,8 @@ from .reader.handlers import (DictHandler, JsonHandler, RecordHandler,
 from .obs import ScanProgress, Tracer, prometheus_text
 from .profiling import ReadMetrics, profile_trace
 from .reader.stream import (ByteRangeSource, open_stream,
-                            register_stream_backend)
+                            register_stream_backend, source_size)
+from .io import IoConfig, register_fsspec_backend
 from .copybook.datatypes import (
     CommentPolicy,
     DebugFieldsPolicy,
@@ -48,6 +49,9 @@ __all__ = [
     "ByteRangeSource",
     "open_stream",
     "register_stream_backend",
+    "source_size",
+    "IoConfig",
+    "register_fsspec_backend",
     "ReadMetrics",
     "profile_trace",
     "ScanProgress",

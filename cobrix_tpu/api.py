@@ -271,6 +271,10 @@ def parse_options(options: Dict[str, object],
             opts.get_int("io_retry_max_delay_ms", 2000)) / 1000.0,
         io_retry_deadline=float(
             opts.get_int("io_retry_deadline_ms", 30000)) / 1000.0,
+        cache_dir=opts.get("cache_dir", "") or "",
+        cache_max_mb=float(opts.get("cache_max_mb", "") or 1024.0),
+        prefetch_blocks=opts.get_int("prefetch_blocks", 2),
+        io_block_mb=float(opts.get("io_block_mb", "") or 8.0),
         pipeline_workers=opts.get_int("pipeline_workers", 0),
         pipeline_chunk_mb=float(opts.get("chunk_size_mb", "") or 16.0),
         pipeline_max_inflight=opts.get_int("max_inflight_chunks", 0),
@@ -294,7 +298,12 @@ def parse_options(options: Dict[str, object],
     opts.get_int("hosts", 0)
     # HDFS-locality knobs (LocalityParameters.scala:21-30): accepted for
     # workload compatibility; shard placement here has no HDFS block
-    # topology to optimize (SURVEY.md §2.5 — locality consciously dropped)
+    # topology to optimize (SURVEY.md §2.5 — locality consciously
+    # dropped). `optimize_allocation` maps to the idle re-allocation
+    # pass of the static planner (parallel.planner.balance,
+    # LocationBalancer.scala:42-66 analogue) for callers that use it;
+    # the supervised multihost scheduler load-balances dynamically and
+    # needs no static pass
     opts.get_bool("improve_locality", True)
     opts.get_bool("optimize_allocation")
     _validate_options(opts, params, streaming)
@@ -344,6 +353,25 @@ def _validate_options(opts: Options, params: ReaderParameters,
         raise ValueError(
             f"Invalid 'io_retry_attempts' of {params.io_retry_attempts}; "
             "at least one attempt is required.")
+    if params.cache_max_mb < 0:
+        raise ValueError(
+            f"Invalid 'cache_max_mb' of {params.cache_max_mb}; it must "
+            "be >= 0 (0 = unbounded).")
+    if params.prefetch_blocks < 0:
+        raise ValueError(
+            f"Invalid 'prefetch_blocks' of {params.prefetch_blocks}; it "
+            "must be >= 0 (0 disables read-ahead).")
+    if params.io_block_mb <= 0:
+        raise ValueError(
+            f"Invalid 'io_block_mb' of {params.io_block_mb}; it must be "
+            "a positive block size in megabytes.")
+    if params.cache_dir:
+        cache_parent = os.path.dirname(
+            os.path.abspath(params.cache_dir)) or "."
+        if not os.path.isdir(cache_parent):
+            raise ValueError(
+                f"Invalid 'cache_dir' '{params.cache_dir}': parent "
+                f"directory '{cache_parent}' does not exist.")
     if params.pipeline_chunk_mb <= 0:
         raise ValueError(
             f"Invalid 'chunk_size_mb' of {params.pipeline_chunk_mb}; "
@@ -412,15 +440,22 @@ def _validate_options(opts: Options, params: ReaderParameters,
 def list_input_files(path) -> List[str]:
     """Recursive globbed listing skipping hidden files, stable order
     (reference FileUtils.scala:54-228, getListFilesWithOrder)."""
-    from .reader.stream import normalize_local, path_scheme
+    from .reader.stream import normalize_local, path_scheme, stream_lister
 
     paths = [path] if isinstance(path, str) else list(path)
     out: List[str] = []
     for p in paths:
-        if path_scheme(p) not in (None, "file"):
-            # registry-backed storage: the path is passed through verbatim
-            # (listing/globbing is the backend's concern)
-            out.append(p)
+        scheme = path_scheme(p)
+        if scheme not in (None, "file"):
+            # registry-backed storage: backends with a listing capability
+            # (the fsspec adapter and anything registered with `lister=`)
+            # expand directories/globs remotely; others pass through
+            # verbatim as one input
+            lister = stream_lister(scheme)
+            if lister is not None:
+                out.extend(lister(p))
+            else:
+                out.append(p)
             continue
         # file:// never propagates past listing: downstream os.path
         # consumers see plain local paths
@@ -567,21 +602,55 @@ def _retry_policy(params: ReaderParameters) -> RetryPolicy:
                        deadline=params.io_retry_deadline)
 
 
+def _io_config(params: ReaderParameters):
+    """The read's remote-IO configuration (None = all features off)."""
+    from .io.config import IoConfig
+
+    return IoConfig.from_params(params)
+
+
+def _total_input_bytes(files: Sequence[str], io_stats=None) -> int:
+    """Input bytes across local AND backend-resolved files (progress
+    totals + throughput metrics); sizing failures never fail the read —
+    an unknown size just reports as 0. Remote sizes seed the read's
+    metadata memo (this runs before the obs context activates), so the
+    planners and validators downstream reuse them without another
+    backend round trip."""
+    from .reader.stream import source_size
+
+    memo = io_stats.memo if io_stats is not None else None
+    total = 0
+    for f in files:
+        try:
+            if path_scheme(f) in (None, "file"):
+                if os.path.exists(f):
+                    total += os.path.getsize(f)
+            else:
+                size = source_size(f)
+                if memo is not None:
+                    memo[("size", f)] = size
+                total += size
+        except Exception:
+            continue
+    return total
+
+
 def _plan_var_len_shards(reader, files, params,
                          retry: Optional[RetryPolicy] = None,
-                         on_retry=None) -> List["WorkShard"]:
+                         on_retry=None, io=None) -> List["WorkShard"]:
     """Byte-range shard plan for a variable-length read (the sparse-index
     chunk planner, engine/chunks.py). Shared by the in-process threaded
     scan, the pipelined executor, and the multi-host (process) executor."""
     from .engine.chunks import plan_var_len_chunks
 
-    return plan_var_len_chunks(reader, files, params, retry, on_retry)
+    return plan_var_len_chunks(reader, files, params, retry, on_retry,
+                               io=io)
 
 
 def _scan_var_len(reader, files, params, backend: str, prefix: str,
                   parallelism: int, metrics=None,
                   retry: Optional[RetryPolicy] = None,
-                  on_retry=None) -> List["FileResult"]:
+                  on_retry=None, io=None) -> List["FileResult"]:
     """The indexed parallel scan — the reference's flagship execution
     strategy (CobolScanners.buildScanForVarLenIndex, CobolScanners.scala:
     38-55 + IndexBuilder.buildIndex, IndexBuilder.scala:49-66): a sparse
@@ -596,7 +665,8 @@ def _scan_var_len(reader, files, params, backend: str, prefix: str,
     tracer = obs.tracer if obs is not None else None
     progress = obs.progress if obs is not None else None
     with stage(metrics, "plan_index"):
-        shards = _plan_var_len_shards(reader, files, params, retry, on_retry)
+        shards = _plan_var_len_shards(reader, files, params, retry,
+                                      on_retry, io)
     if metrics is not None:
         metrics.shards = len(shards)
     if progress is not None:
@@ -619,7 +689,7 @@ def _scan_var_len(reader, files, params, backend: str, prefix: str,
                      else shard.offset_to - shard.offset_from)
         with open_stream(shard.file_path, start_offset=shard.offset_from,
                          maximum_bytes=max_bytes, retry=retry,
-                         on_retry=on_retry) as stream:
+                         on_retry=on_retry, io=io) as stream:
             return reader.read_result_columnar(
                 stream, file_id=shard.file_order, backend=backend,
                 segment_id_prefix=prefix,
@@ -754,9 +824,8 @@ def read_cobol(path=None,
                  if params.multisegment and is_var_len else 0)
     metrics = ReadMetrics(files=len(files), backend=backend,
                           hosts=max(hosts, 1))
-    metrics.bytes_read = sum(
-        os.path.getsize(f) for f in files
-        if path_scheme(f) in (None, "file") and os.path.exists(f))
+    metrics.bytes_read = _total_input_bytes(files, metrics.io_stats)
+    io_cfg = _io_config(params)
 
     # the read's observability context: per-read cache-counter scope
     # always; tracer/progress only when asked for. Activated on this
@@ -780,7 +849,7 @@ def read_cobol(path=None,
                 data = _read_cobol_single_host(
                     files, copybook_contents, params, backend, seg_count,
                     parallelism, pipe_workers, use_pipeline, is_var_len,
-                    debug_ignore_file_size, metrics)
+                    debug_ignore_file_size, metrics, io_cfg)
     except BaseException:
         # a failed scan still flushes its telemetry: the final done=True
         # progress snapshot fires (a progress bar must not freeze) and
@@ -815,7 +884,8 @@ def _build_obs_context(params: ReaderParameters, metrics: ReadMetrics,
             min_interval_s=params.progress_interval_s)
     return ObsContext(tracer=tracer, metrics=scan_metrics(),
                       progress=progress,
-                      cache_scope=metrics.cache_scope)
+                      cache_scope=metrics.cache_scope,
+                      io_stats=metrics.io_stats)
 
 
 def _finish_obs(obs_ctx, params: ReaderParameters, data) -> None:
@@ -859,7 +929,8 @@ def _read_cobol_single_host(files, copybook_contents,
                             pipe_workers: int, use_pipeline: bool,
                             is_var_len: bool,
                             debug_ignore_file_size: bool,
-                            metrics: ReadMetrics) -> "CobolData":
+                            metrics: ReadMetrics,
+                            io=None) -> "CobolData":
     """The in-process execution paths (sequential, threaded shard scan,
     chunked pipeline) — read_cobol minus option parsing and multihost."""
     results: List[FileResult] = []
@@ -905,7 +976,7 @@ def _read_cobol_single_host(files, copybook_contents,
                               if params.is_permissive else None)
                     reasons: dict = {}
                     with open_stream(file_path, retry=retry,
-                                     on_retry=on_retry) as stream:
+                                     on_retry=on_retry, io=io) as stream:
                         result = rows_file_result(list(
                             reader.iter_rows(
                                 stream, file_id=file_order,
@@ -924,26 +995,26 @@ def _read_cobol_single_host(files, copybook_contents,
 
                 with stage(metrics, "plan_index"):
                     shards = _plan_var_len_shards(reader, files, params,
-                                                  retry, on_retry)
+                                                  retry, on_retry, io)
                 metrics.shards = len(shards)
                 results, failed = pipelined_var_len_scan(
                     reader, shards, params, backend, prefix, schema,
                     pipe_workers, metrics=metrics, retry=retry,
-                    on_retry=on_retry)
+                    on_retry=on_retry, io=io)
                 shard_failures.extend(failed)
                 results = [r for r in results if r is not None]
             else:
                 results = _scan_var_len(reader, files, params, backend,
                                         prefix, parallelism,
                                         metrics=metrics, retry=retry,
-                                        on_retry=on_retry)
+                                        on_retry=on_retry, io=io)
         elif use_pipeline:
             from .engine.pipeline import pipelined_fixed_scan
 
             results, failed = pipelined_fixed_scan(
                 reader, files, params, backend, schema, pipe_workers,
                 ignore_file_size=debug_ignore_file_size, metrics=metrics,
-                retry=retry, on_retry=on_retry)
+                retry=retry, on_retry=on_retry, io=io)
             shard_failures.extend(failed)
             results = [r for r in results if r is not None]
         else:
@@ -953,7 +1024,8 @@ def _read_cobol_single_host(files, copybook_contents,
                     ledger = (params.new_diagnostics()
                               if params.is_permissive else None)
                     reasons = {}
-                    data = _read_file_bytes(file_path, retry, on_retry)
+                    data = _read_file_bytes(file_path, retry, on_retry,
+                                            io)
                     result = rows_file_result(list(
                         reader.iter_rows_host(
                             data, file_id=file_order,
@@ -970,7 +1042,8 @@ def _read_cobol_single_host(files, copybook_contents,
                 else:
                     results.extend(_read_fixed_len_chunked(
                         reader, file_path, params, backend, file_order,
-                        base, debug_ignore_file_size, retry, on_retry))
+                        base, debug_ignore_file_size, retry, on_retry,
+                        io))
 
     data = CobolData.from_results(results, schema, parallelism=parallelism)
     data.diagnostics = _aggregate_diagnostics(params, results,
@@ -1010,13 +1083,14 @@ FIXED_READ_CHUNK_BYTES = 64 * 1024 * 1024
 
 
 def _read_file_bytes(path: str, retry: Optional[RetryPolicy] = None,
-                     on_retry=None):
+                     on_retry=None, io=None):
     """Whole-file bytes-like payload: a read-only mmap memoryview for
     local files (FSStream.next_view), plain bytes otherwise — consumers
     must stick to buffer-protocol operations (len/slice/np.frombuffer)."""
     from .reader.stream import open_stream
 
-    with open_stream(path, retry=retry, on_retry=on_retry) as stream:
+    with open_stream(path, retry=retry, on_retry=on_retry,
+                     io=io) as stream:
         return stream.next_view(stream.size())
 
 
@@ -1024,9 +1098,9 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
                             file_order: int, base_record_id: int,
                             ignore_file_size: bool,
                             retry: Optional[RetryPolicy] = None,
-                            on_retry=None) -> List["FileResult"]:
+                            on_retry=None, io=None) -> List["FileResult"]:
     from .obs.context import current as obs_current
-    from .reader.stream import open_stream, path_scheme
+    from .reader.stream import open_stream, source_size
 
     from .engine.chunks import fixed_file_chunkable
 
@@ -1041,24 +1115,22 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
         return result
 
     rs = reader.record_size
-    if path_scheme(file_path) in (None, "file"):
-        size = os.path.getsize(file_path)
-    else:
-        with open_stream(file_path, retry=retry, on_retry=on_retry) as s:
-            size = s.size()
+    size = source_size(file_path, retry=retry, on_retry=on_retry)
     # the SAME predicate drives the pipelined chunk planner — the
     # pipelined-vs-sequential parity guarantee needs one split rule
     if not fixed_file_chunkable(size, rs, params, FIXED_READ_CHUNK_BYTES,
                                 ignore_file_size):
         return [track(reader.read_result(
-            _read_file_bytes(file_path, retry, on_retry), backend=backend,
+            _read_file_bytes(file_path, retry, on_retry, io),
+            backend=backend,
             file_id=file_order, first_record_id=base_record_id,
             input_file_name=file_path, ignore_file_size=ignore_file_size),
             size)]
     chunk_bytes = max(rs, (FIXED_READ_CHUNK_BYTES // rs) * rs)
     results: List[FileResult] = []
     done = 0
-    with open_stream(file_path, retry=retry, on_retry=on_retry) as stream:
+    with open_stream(file_path, retry=retry, on_retry=on_retry,
+                     io=io) as stream:
         while done < size:
             data = stream.next_view(min(chunk_bytes, size - done))
             if not data:
@@ -1096,7 +1168,8 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
             prefix = ""
     with stage(metrics, "plan_index"):
         if is_var_len:
-            shards = _plan_var_len_shards(reader, files, params)
+            shards = _plan_var_len_shards(reader, files, params,
+                                          io=_io_config(params))
         else:
             shards = plan_fixed_len_shards(reader, files, params, hosts)
     schema = CobolOutputSchema(

@@ -22,7 +22,7 @@ from typing import List, Optional
 
 from ..reader.index import file_index_entries
 from ..reader.parameters import DEFAULT_FILE_RECORD_ID_INCREMENT
-from ..reader.stream import RetryPolicy, open_stream, path_scheme
+from ..reader.stream import RetryPolicy, path_scheme, source_size
 
 
 def shard_progress_bytes(shard) -> int:
@@ -53,14 +53,6 @@ class FixedChunk:
     nbytes: int            # bytes to read (0 = to end of file)
     first_record_id: int   # Record_Id of the chunk's first record
     whole_file: bool       # single-chunk file (offset trims / odd tails)
-
-
-def _file_size(file_path: str, retry: Optional[RetryPolicy] = None,
-               on_retry=None) -> int:
-    if path_scheme(file_path) in (None, "file"):
-        return os.path.getsize(file_path)
-    with open_stream(file_path, retry=retry, on_retry=on_retry) as s:
-        return s.size()
 
 
 def fixed_file_chunkable(size: int, record_size: int, params,
@@ -96,7 +88,7 @@ def plan_fixed_chunks(reader, files, params, chunk_bytes: int,
     chunks: List[FixedChunk] = []
     for file_order, file_path in enumerate(files):
         base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
-        size = _file_size(file_path, retry, on_retry)
+        size = source_size(file_path, retry=retry, on_retry=on_retry)
         if not fixed_file_chunkable(size, rs, params, chunk_bytes,
                                     ignore_file_size):
             chunks.append(FixedChunk(file_path, file_order, 0, 0, base,
@@ -113,7 +105,7 @@ def plan_fixed_chunks(reader, files, params, chunk_bytes: int,
 
 def plan_var_len_chunks(reader, files, params,
                         retry: Optional[RetryPolicy] = None,
-                        on_retry=None) -> List["WorkShard"]:
+                        on_retry=None, io=None) -> List["WorkShard"]:
     """Byte-range shard plan for a variable-length read: the sparse index
     per file turns the sequential record stream into shards; files
     without a useful index become one whole-file shard. Shared by the
@@ -127,7 +119,7 @@ def plan_var_len_chunks(reader, files, params,
         entries = None
         if params.is_index_generation_needed:
             entries = file_index_entries(reader, file_path, file_order,
-                                         params, retry, on_retry)
+                                         params, retry, on_retry, io=io)
         if entries is not None and len(entries) > 1:
             # an open-ended last entry (-1) flows into the shard unchanged:
             # streams bound it to the file end themselves, so no extra

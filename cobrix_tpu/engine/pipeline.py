@@ -713,7 +713,8 @@ def pipelined_fixed_scan(reader, files, params, backend: str,
                          metrics: Optional[ReadMetrics] = None,
                          retry: Optional[RetryPolicy] = None,
                          on_retry=None,
-                         assemble: bool = True
+                         assemble: bool = True,
+                         io=None
                          ) -> Tuple[List["FileResult"],
                                     List[ShardFailureInfo]]:
     """Fixed-length files through the chunk pipeline: record-aligned byte
@@ -741,7 +742,7 @@ def pipelined_fixed_scan(reader, files, params, backend: str,
         def read() -> object:
             with open_stream(c.file_path, start_offset=c.offset,
                              maximum_bytes=c.nbytes, retry=retry,
-                             on_retry=on_retry) as stream:
+                             on_retry=on_retry, io=io) as stream:
                 want = stream.size() - c.offset
                 data = stream.next_view(want)
             if len(data) != want and not c.whole_file:
@@ -777,7 +778,8 @@ def pipelined_var_len_scan(reader, shards, params, backend: str,
                            metrics: Optional[ReadMetrics] = None,
                            retry: Optional[RetryPolicy] = None,
                            on_retry=None,
-                           assemble: bool = True
+                           assemble: bool = True,
+                           io=None
                            ) -> Tuple[List["FileResult"],
                                       List[ShardFailureInfo]]:
     """Variable-length shards (sparse-index byte ranges) through the
@@ -806,7 +808,7 @@ def pipelined_var_len_scan(reader, shards, params, backend: str,
             return open_stream(shard.file_path,
                                start_offset=shard.offset_from,
                                maximum_bytes=max_bytes, retry=retry,
-                               on_retry=on_retry)
+                               on_retry=on_retry, io=io)
         return read
 
     def process_fn(shard):

@@ -1,0 +1,47 @@
+"""Remote-storage IO: fsspec byte-range backend, read-ahead, caching.
+
+The scheme registry in `reader.stream` defines *how* a storage backend
+plugs in (a `ByteRangeSource` per URL); this package supplies the
+production implementations the engine runs against object storage:
+
+* `fsspec_source` — a ByteRangeSource over any fsspec filesystem
+  (`s3://`, `gs://`, `memory://`, ...), plus listing/size resolution so
+  remote *directory* scans route through the backend. Unregistered
+  schemes fall back to fsspec automatically when the protocol exists.
+* `prefetch`      — `ReadAheadSource`: a bounded pool fetching the next
+  N blocks ahead of the consumer (with range coalescing), so network
+  latency overlaps framing/decode instead of serializing with it.
+* `blockcache`    — `BlockCache` + `CachingSource`: a persistent
+  on-disk LRU block cache keyed by (url, file fingerprint, range);
+  repeated scans of hot remote files skip the network entirely.
+* `index_store`   — `SparseIndexStore`: the variable-length sparse
+  index persisted per file *version*, so the inherently-sequential
+  indexing pass runs once and warm re-scans go straight to parallel
+  shard planning.
+* `config`        — `IoConfig` (the read's knobs) and `wrap_source`
+  (the composition point `open_stream` calls).
+* `stats`         — `IoStats`, the per-read counter bag surfaced on
+  `ReadMetrics.as_dict()["io"]` and folded into the obs registry.
+"""
+from .config import IoConfig, wrap_source
+from .blockcache import BlockCache, CachingSource
+from .fsspec_source import (FsspecSource, fsspec_listing, open_fsspec_source,
+                            register_fsspec_backend)
+from .index_store import SparseIndexStore, index_config_fingerprint
+from .prefetch import ReadAheadSource
+from .stats import IoStats
+
+__all__ = [
+    "IoConfig",
+    "wrap_source",
+    "BlockCache",
+    "CachingSource",
+    "FsspecSource",
+    "fsspec_listing",
+    "open_fsspec_source",
+    "register_fsspec_backend",
+    "SparseIndexStore",
+    "index_config_fingerprint",
+    "ReadAheadSource",
+    "IoStats",
+]

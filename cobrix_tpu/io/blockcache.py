@@ -1,0 +1,337 @@
+"""Persistent on-disk block cache for remote byte-range sources.
+
+Layout under `<cache_dir>/blocks/`:
+
+    <h(url)>-<h(fingerprint)>/          one *generation* per file version
+        meta.json                       {url, fingerprint} (debuggability)
+        <start>-<end>.blk               one cached block, aligned ranges
+
+The fingerprint (etag / ukey / size+mtime — whatever the backend can
+produce, `ByteRangeSource.fingerprint()`) keys the generation: a changed
+remote file hashes to a NEW generation directory, and stale generations
+of the same url are removed on open, so invalidation is structural, not
+a TTL guess.
+
+Cross-process safety: block writes go through a temp file + `os.replace`
+(atomic on POSIX), readers treat a vanished file as a miss, and two
+processes writing the same block converge on identical bytes (ranges are
+deterministic slices of an immutable file version). LRU eviction is by
+file mtime — hits re-touch their block — with a bounded rescan whenever
+the tracked total passes the budget.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import shutil
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..reader.stream import ByteRangeSource
+from .stats import IoStats
+
+_logger = logging.getLogger(__name__)
+
+
+def _h(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()[:20]
+
+
+def read_span(inner: ByteRangeSource, start: int, end: int) -> bytes:
+    """Read [start, end) from `inner`, re-issuing on short reads (the
+    readFully loop shared by the block cache and the prefetcher —
+    aligned cache blocks must only ever be written complete). Stops at
+    storage EOF: the result may still be short when the backend serves
+    fewer bytes than size() promised."""
+    data = b""
+    while len(data) < end - start:
+        chunk = inner.read(start + len(data), end - start - len(data))
+        if not chunk:
+            break
+        data += chunk
+    return data
+
+
+class BlockCache:
+    """The on-disk store (one shared instance per cache root — see
+    `shared_block_cache`). Counters land on whichever read is active
+    when a write/eviction happens (`current_io_stats`), so one instance
+    serves concurrent reads without cross-attributing."""
+
+    def __init__(self, cache_dir: str, max_bytes: int = 0):
+        self.root = os.path.join(cache_dir, "blocks")
+        self.max_bytes = max(0, int(max_bytes))  # 0 = unbounded
+        self._lock = threading.Lock()
+        self._approx_total = -1  # lazily measured on first budget check
+        self._gen_resolved: set = set()  # generation dirs already swept
+        os.makedirs(self.root, exist_ok=True)
+
+    # -- generation management ------------------------------------------
+
+    def generation_dir(self, url: str, fingerprint: str) -> str:
+        """This file version's directory, creating it and sweeping stale
+        generations of the same url (the 'changed file invalidates the
+        block plane' contract). Resolved once per (url, fingerprint):
+        per-chunk stream opens skip the directory sweep."""
+        url_h = _h(url)
+        gen = os.path.join(self.root, f"{url_h}-{_h(fingerprint)}")
+        with self._lock:
+            # isdir guards the revert case: a swept generation whose
+            # fingerprint comes BACK (file restored) must be recreated
+            if gen in self._gen_resolved and os.path.isdir(gen):
+                return gen
+        try:
+            for name in os.listdir(self.root):
+                stale = os.path.join(self.root, name)
+                if name.startswith(url_h + "-") and stale != gen:
+                    shutil.rmtree(stale, ignore_errors=True)
+                    with self._lock:
+                        self._gen_resolved.discard(stale)
+        except OSError:
+            pass
+        if not os.path.isdir(gen):
+            os.makedirs(gen, exist_ok=True)
+            self._write_atomic(
+                os.path.join(gen, "meta.json"),
+                json.dumps({"url": url, "fingerprint": fingerprint},
+                           sort_keys=True).encode())
+        with self._lock:
+            self._gen_resolved.add(gen)
+        return gen
+
+    # -- block IO --------------------------------------------------------
+
+    @staticmethod
+    def _block_path(gen_dir: str, start: int, end: int) -> str:
+        return os.path.join(gen_dir, f"{start}-{end}.blk")
+
+    def has(self, gen_dir: str, start: int, end: int) -> bool:
+        """Cheap presence probe (no read, no LRU touch) — used by the
+        coalescing scan to size one fetch over a run of missing blocks."""
+        return os.path.exists(self._block_path(gen_dir, start, end))
+
+    def get(self, gen_dir: str, start: int, end: int) -> Optional[bytes]:
+        path = self._block_path(gen_dir, start, end)
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return None  # missing OR evicted mid-race: a miss either way
+        if len(data) != end - start:
+            # torn write from a crashed process predating the atomic
+            # rename, or an eviction race — drop it and refetch
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+        try:
+            os.utime(path)  # LRU touch
+        except OSError:
+            pass
+        return data
+
+    def put(self, gen_dir: str, start: int, end: int, data: bytes,
+            io_stats: Optional[IoStats] = None) -> None:
+        """`io_stats` is the owning read's bag, passed by the caller:
+        puts land on prefetch-pool threads where no obs context is
+        active, so thread-local lookup would lose the counts."""
+        if len(data) != end - start:
+            return  # short tail reads are served but never cached
+        path = self._block_path(gen_dir, start, end)
+        if os.path.exists(path):
+            return
+        try:
+            self._write_atomic(path, data)
+        except OSError as exc:  # a full cache disk must not fail the scan
+            _logger.warning("block cache write failed for %s: %s", path, exc)
+            return
+        if io_stats is not None:
+            io_stats.bump("block_put_bytes", len(data))
+        self._account(len(data), io_stats)
+
+    @staticmethod
+    def _write_atomic(path: str, data: bytes) -> None:
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                   prefix=".tmp-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    # -- LRU budget ------------------------------------------------------
+
+    def _scan_blocks(self) -> List[Tuple[float, int, str]]:
+        """(mtime, size, path) of every cached block under the root."""
+        out = []
+        for dirpath, _dirs, files in os.walk(self.root):
+            for name in files:
+                if not name.endswith(".blk"):
+                    continue
+                path = os.path.join(dirpath, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                out.append((st.st_mtime, st.st_size, path))
+        return out
+
+    def _account(self, added: int,
+                 io_stats: Optional[IoStats] = None) -> None:
+        if self.max_bytes <= 0:
+            return
+        with self._lock:
+            if self._approx_total < 0:
+                self._approx_total = sum(
+                    s for _, s, _ in self._scan_blocks())
+            else:
+                self._approx_total += added
+            if self._approx_total <= self.max_bytes:
+                return
+            # over budget: rescan (other processes write too) and evict
+            # oldest-touched blocks until under
+            blocks = sorted(self._scan_blocks())
+            total = sum(s for _, s, _ in blocks)
+            for _mtime, size, path in blocks:
+                if total <= self.max_bytes:
+                    break
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue
+                total -= size
+                if io_stats is not None:
+                    io_stats.bump("block_evictions")
+            self._approx_total = total
+
+
+_SHARED_LOCK = threading.Lock()
+_SHARED: Dict[str, "BlockCache"] = {}
+
+
+def shared_block_cache(cache_dir: str, max_bytes: int) -> BlockCache:
+    """ONE BlockCache per cache root per process: per-chunk stream opens
+    reuse the instance (and its warm generation/size accounting) instead
+    of re-sweeping the cache tree every open. Reads that configure
+    different budgets for the same root share the instance — the
+    last-configured budget wins, so accounting stays coherent (two
+    instances with independent totals could not enforce either
+    budget)."""
+    root = os.path.abspath(cache_dir)
+    with _SHARED_LOCK:
+        cache = _SHARED.get(root)
+        if cache is None:
+            cache = BlockCache(cache_dir, max_bytes)
+            _SHARED[root] = cache
+        else:
+            cache.max_bytes = max(0, int(max_bytes))
+        return cache
+
+
+class CachingSource(ByteRangeSource):
+    """ByteRangeSource wrapper serving aligned blocks from a BlockCache,
+    fetching misses from the inner source (consecutive missing blocks
+    coalesce into ONE inner read) and writing them through."""
+
+    def __init__(self, inner: ByteRangeSource, url: str, cache: BlockCache,
+                 block_bytes: int, io_stats: Optional[IoStats] = None,
+                 fingerprint: Optional[str] = None):
+        self._inner = inner
+        self._url = url
+        self._cache = cache
+        self._block = max(1, int(block_bytes))
+        self._io_stats = io_stats
+        self._size = inner.size()
+        # the fingerprint probe pins the file version this cache
+        # generation serves; a changed file opens a NEW generation.
+        # Callers holding a per-read memo pass it in (one metadata round
+        # trip per read, not per chunk open)
+        self._fingerprint = fingerprint or inner.fingerprint()
+        self._gen_dir = cache.generation_dir(url, self._fingerprint)
+
+    def size(self) -> int:
+        return self._size
+
+    @property
+    def name(self) -> str:
+        return self._inner.name or self._url
+
+    def fingerprint(self) -> str:
+        # the pinned version, NOT a delegation: the sparse-index store
+        # probes the stream's source, and re-probing the backend per
+        # stream open would undo the per-read memo
+        return self._fingerprint
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def _block_range(self, idx: int) -> Tuple[int, int]:
+        start = idx * self._block
+        return start, min(start + self._block, self._size)
+
+    def _fetch_blocks(self, first: int, last: int) -> bytes:
+        """One inner read spanning blocks [first, last] (coalesced),
+        split and written through per aligned block."""
+        start = first * self._block
+        end = min((last + 1) * self._block, self._size)
+        data = read_span(self._inner, start, end)
+        if self._io_stats is not None:
+            self._io_stats.bump("bytes_fetched", len(data))
+        for idx in range(first, last + 1):
+            bs, be = self._block_range(idx)
+            piece = data[bs - start:be - start]
+            if len(piece) == be - bs:
+                self._cache.put(self._gen_dir, bs, be, piece,
+                                io_stats=self._io_stats)
+        return data
+
+    def read(self, offset: int, n: int) -> bytes:
+        if offset >= self._size or n <= 0:
+            return b""
+        n = min(n, self._size - offset)
+        first = offset // self._block
+        last = (offset + n - 1) // self._block
+        parts: List[bytes] = []
+        idx = first
+        while idx <= last:
+            bs, be = self._block_range(idx)
+            cached = self._cache.get(self._gen_dir, bs, be)
+            if cached is not None:
+                if self._io_stats is not None:
+                    self._io_stats.bump("block_hits")
+                    self._io_stats.bump("bytes_from_cache", len(cached))
+                parts.append(cached)
+                idx += 1
+                continue
+            # coalesce the run of consecutive missing blocks
+            run_end = idx
+            while (run_end < last
+                   and not self._cache.has(self._gen_dir,
+                                           *self._block_range(run_end + 1))):
+                run_end += 1
+            if self._io_stats is not None:
+                self._io_stats.bump("block_misses", run_end - idx + 1)
+            fetched = self._fetch_blocks(idx, run_end)
+            parts.append(fetched)
+            span = (min((run_end + 1) * self._block, self._size)
+                    - idx * self._block)
+            if len(fetched) < span:
+                # storage served less than size() promised (truncated
+                # object under an unchanged fingerprint): STOP — joining
+                # later cached blocks after a short part would shift
+                # their bytes to wrong offsets. A short read is the
+                # anomaly upper layers already know how to handle.
+                break
+            idx = run_end + 1
+        data = b"".join(parts)
+        lead = offset - first * self._block
+        return data[lead:lead + n]

@@ -1,0 +1,104 @@
+"""IoConfig: one read's remote-IO knobs, and the source composition.
+
+`open_stream` resolves a backend factory into a raw ByteRangeSource;
+`wrap_source` stacks the io layers onto it:
+
+    backend source  ->  CachingSource (persistent disk blocks)
+                    ->  ReadAheadSource (in-memory read-ahead)
+
+in that order, so prefetches warm the persistent cache and cache hits
+never spend pool threads. Local files bypass the stack entirely —
+FSStream already reads through the OS page cache, which IS the local
+block cache.
+
+The per-read IoStats sink is captured from the active ObsContext at
+wrap time (open_stream runs on a thread the read activated), so counts
+from the prefetch pool's internal threads still land on the right read.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from ..reader.stream import ByteRangeSource
+
+MEGABYTE = 1024 * 1024
+
+
+@dataclass(frozen=True)
+class IoConfig:
+    """Remote-IO configuration for one read (reader.parameters carries
+    the user-facing option spellings)."""
+
+    cache_dir: str = ""              # '' = no persistent cache planes
+    cache_max_bytes: int = 1024 * MEGABYTE
+    prefetch_depth: int = 2          # blocks of read-ahead; 0 = off
+    block_bytes: int = 8 * MEGABYTE  # cache + read-ahead granularity
+
+    @classmethod
+    def from_params(cls, params) -> Optional["IoConfig"]:
+        """The read's IoConfig, or None when every io feature is off
+        (plain buffered backend reads, exactly the pre-io behavior)."""
+        cache_dir = getattr(params, "cache_dir", "") or ""
+        prefetch = int(getattr(params, "prefetch_blocks", 0))
+        if not cache_dir and prefetch <= 0:
+            return None
+        return cls(
+            cache_dir=cache_dir,
+            cache_max_bytes=int(
+                float(getattr(params, "cache_max_mb", 1024.0)) * MEGABYTE),
+            prefetch_depth=prefetch,
+            block_bytes=max(1, int(
+                float(getattr(params, "io_block_mb", 8.0)) * MEGABYTE)),
+        )
+
+    @property
+    def cache_enabled(self) -> bool:
+        return bool(self.cache_dir)
+
+    @property
+    def prefetch_enabled(self) -> bool:
+        return self.prefetch_depth > 0
+
+
+def wrap_source(source: ByteRangeSource, url: str,
+                io: Optional[IoConfig],
+                chunk_size: int,
+                start_offset: int = 0,
+                maximum_bytes: int = 0) -> Tuple[ByteRangeSource, int]:
+    """Stack the configured io layers onto a backend source; returns
+    (wrapped source, effective stream chunk size). With read-ahead on,
+    the stream chunk shrinks to one block so the consumer's fills stay
+    behind the prefetcher instead of swallowing the whole window in one
+    giant read; the prefetch window stops at the consumer's byte-range
+    bound so shard streams never fetch their neighbors' bytes."""
+    if io is None:
+        return source, chunk_size
+    from .stats import current_io_stats
+
+    io_stats = current_io_stats()
+    if io.cache_enabled:
+        from .blockcache import CachingSource, shared_block_cache
+
+        cache = shared_block_cache(io.cache_dir, io.cache_max_bytes)
+        # one fingerprint probe (a backend metadata round trip) per
+        # read, not per chunk-stream open
+        fingerprint = None
+        if io_stats is not None:
+            key = ("fingerprint", url)
+            fingerprint = io_stats.memo.get(key)
+            if fingerprint is None:
+                fingerprint = source.fingerprint()
+                io_stats.memo[key] = fingerprint
+        source = CachingSource(source, url, cache, io.block_bytes,
+                               io_stats=io_stats, fingerprint=fingerprint)
+    if io.prefetch_enabled:
+        from .prefetch import ReadAheadSource
+
+        limit = (start_offset + maximum_bytes) if maximum_bytes > 0 else 0
+        source = ReadAheadSource(source, io.block_bytes,
+                                 io.prefetch_depth, io_stats=io_stats,
+                                 count_fetch_bytes=not io.cache_enabled,
+                                 limit=limit)
+        chunk_size = min(chunk_size, io.block_bytes)
+    return source, chunk_size

@@ -1,0 +1,237 @@
+"""fsspec byte-range backend: any `scheme://` URL fsspec can open.
+
+The reference streams mainframe files from HDFS/S3 through Hadoop's
+FSDataInputStream (FileStreamer.scala:37-130); the Python ecosystem's
+equivalent of that pluggable-filesystem layer is fsspec, so one adapter
+covers `s3://`, `gs://`, `az://`, `hdfs://`, `memory://`, `http(s)://`
+and anything else with an installed protocol.
+
+Design points:
+
+* **Stateless range reads.** Every read is `fs.cat_file(path, start,
+  end)` — no long-lived file handle, so a source object never carries
+  an fd across a fork. The filesystem object itself is rebuilt lazily
+  per process (`skip_instance_cache` after a pid change): fsspec's
+  class-level instance cache would otherwise hand a forked multihost
+  worker its parent's live connections.
+* **Fingerprints**, not timestamps-as-config: `fs.ukey()` (etag/inode
+  hash) when the backend implements it, else a size+mtime/etag digest
+  from `fs.info()` — the key the block cache and sparse-index store
+  version their entries by.
+* **Listing and sizing** route through the same filesystem, so a remote
+  *directory* (or glob) scan works end to end: `fsspec_listing` mirrors
+  the local lister's hidden-file rules and deterministic order.
+* fsspec is an **optional dependency**: everything imports lazily and a
+  missing module surfaces one actionable ImportError, not a stack of
+  attribute errors.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Optional
+
+from ..reader.stream import (ByteRangeSource, path_scheme,
+                             register_stream_backend)
+
+_IMPORT_HINT = (
+    "reading '{url}' requires the optional dependency 'fsspec' "
+    "(pip install fsspec; object stores also need their protocol "
+    "package, e.g. s3fs or gcsfs)")
+
+
+def _fsspec(url: str):
+    try:
+        import fsspec
+    except ImportError as exc:
+        raise ImportError(_IMPORT_HINT.format(url=url)) from exc
+    return fsspec
+
+
+# the pid whose fsspec class-level instance cache is trustworthy: a
+# forked child inherits this module (and fsspec's cache) from the
+# parent, so a pid mismatch means every cached filesystem may hold the
+# parent's sockets/event-loop threads — async backends (s3fs/gcsfs)
+# wedge forever on them. Detected here because the inherited value
+# still names the parent.
+_INSTANCE_CACHE_PID = os.getpid()
+
+
+def _inherited_cache() -> bool:
+    return os.getpid() != _INSTANCE_CACHE_PID
+
+
+def _fresh_fs(fsspec, url: str, **storage_options):
+    """A filesystem built OUTSIDE fsspec's instance cache (fork-safe)."""
+    scheme = path_scheme(url) or "file"
+    fs = fsspec.filesystem(scheme, skip_instance_cache=True,
+                           **storage_options)
+    return fs, fs._strip_protocol(url)
+
+
+def _split(url: str):
+    """(filesystem, backend path) for one URL; the filesystem comes from
+    fsspec's per-process instance cache — bypassed in a forked child,
+    where the cache is the parent's."""
+    fsspec = _fsspec(url)
+    if _inherited_cache():
+        return _fresh_fs(fsspec, url)
+    fs, path = fsspec.core.url_to_fs(url)
+    return fs, path
+
+
+def known_protocol(scheme: str) -> bool:
+    """True when fsspec (if installed) knows `scheme` — the gate for
+    auto-registering a backend for an unhandled URL scheme."""
+    try:
+        from fsspec.registry import known_implementations, registry
+    except ImportError:
+        return False
+    return scheme in registry or scheme in known_implementations
+
+
+class FsspecSource(ByteRangeSource):
+    """ByteRangeSource over one fsspec URL. Fork-safe: the filesystem
+    object is (re)built lazily whenever the owning pid changes."""
+
+    def __init__(self, url: str, **storage_options):
+        self._url = url
+        self._options = storage_options
+        self._fs = None
+        self._path = None
+        self._pid = -1
+        self._size: Optional[int] = None
+        self._fingerprint: Optional[str] = None
+
+    def _filesystem(self):
+        pid = os.getpid()
+        if self._fs is None or pid != self._pid:
+            fsspec = _fsspec(self._url)
+            if (self._fs is None and not self._options
+                    and not _inherited_cache()):
+                fs, path = fsspec.core.url_to_fs(self._url)
+            else:
+                # bypass the instance cache when this source object
+                # crossed a fork, when the whole PROCESS inherited the
+                # cache from a fork parent (a source built fresh in a
+                # worker would otherwise resolve to the parent's live
+                # filesystem), or with explicit options: a cached object
+                # may hold another process's sockets/event loops
+                fs, path = _fresh_fs(fsspec, self._url, **self._options)
+            self._fs, self._path, self._pid = fs, path, pid
+        return self._fs, self._path
+
+    def size(self) -> int:
+        if self._size is None:
+            fs, path = self._filesystem()
+            self._size = int(fs.size(path))
+        return self._size
+
+    def read(self, offset: int, n: int) -> bytes:
+        size = self.size()
+        if offset >= size or n <= 0:
+            return b""
+        fs, path = self._filesystem()
+        return fs.cat_file(path, start=offset,
+                           end=min(offset + n, size))
+
+    def fingerprint(self) -> str:
+        """Stable content-version key: ukey when the backend has one,
+        else a digest of the info() entry's etag/checksum/mtime/size."""
+        if self._fingerprint is None:
+            fs, path = self._filesystem()
+            try:
+                self._fingerprint = str(fs.ukey(path))
+            except (NotImplementedError, AttributeError, OSError):
+                info = fs.info(path)
+                token = repr((info.get("ETag") or info.get("etag")
+                              or info.get("checksum"),
+                              info.get("mtime") or info.get("created")
+                              or info.get("LastModified"),
+                              info.get("size")))
+                self._fingerprint = hashlib.sha256(
+                    token.encode("utf-8", "replace")).hexdigest()
+        return self._fingerprint
+
+    @property
+    def name(self) -> str:
+        return self._url
+
+    def close(self) -> None:
+        self._fs = None  # stateless reads: nothing else to release
+
+
+def open_fsspec_source(url: str, **storage_options) -> FsspecSource:
+    """Open one fsspec URL as a ByteRangeSource (raises the actionable
+    ImportError immediately when fsspec is missing, and the backend's
+    own error when the object does not exist)."""
+    source = FsspecSource(url, **storage_options)
+    source.size()  # existence probe: fail at open, not first read
+    return source
+
+
+def _hidden(rel_path: str) -> bool:
+    """Mirror the local lister: any path component below the listing
+    root starting with '.' or '_' hides the file."""
+    return any(part.startswith((".", "_"))
+               for part in rel_path.split("/") if part)
+
+
+def fsspec_listing(url: str) -> List[str]:
+    """Recursive file listing of one fsspec URL (file, directory, or
+    glob) with local-lister semantics: hidden files skipped, stable
+    sorted order, FileNotFoundError when nothing matches. Returned
+    entries are full URLs of the same scheme."""
+    fs, path = _split(url)
+    scheme = path_scheme(url)
+
+    def rebuild(p: str) -> str:
+        # keep backend-absolute paths absolute ('local:///tmp/x' must
+        # not collapse to 'local://tmp/x', a cwd-relative read)
+        return f"{scheme}://{p}"
+
+    def expand_dir(root: str) -> List[str]:
+        files = []
+        root_norm = root.rstrip("/")
+        for p in fs.find(root_norm):
+            rel = p[len(root_norm):].lstrip("/")
+            if not _hidden(rel):
+                files.append(p)
+        return files
+
+    if fs.isfile(path):
+        return [rebuild(path)]
+    if fs.isdir(path):
+        return [rebuild(p) for p in sorted(expand_dir(path))]
+    matched = sorted(fs.glob(path))
+    if not matched:
+        raise FileNotFoundError(f"Input path does not exist: {url}")
+    out: List[str] = []
+    for m in matched:
+        if os.path.basename(str(m).rstrip("/")).startswith((".", "_")):
+            continue
+        if fs.isdir(m):
+            out.extend(rebuild(p) for p in sorted(expand_dir(str(m))))
+        else:
+            out.append(rebuild(str(m)))
+    return out
+
+
+def fsspec_size(url: str) -> int:
+    """Byte size of one fsspec URL (the listing/planning sizer)."""
+    fs, path = _split(url)
+    return int(fs.size(path))
+
+
+def register_fsspec_backend(scheme: str, **storage_options) -> None:
+    """Register `scheme://` to resolve through fsspec (source + lister +
+    sizer). `open_stream`/`list_input_files` call this automatically for
+    any scheme fsspec knows, so it is only needed to pin non-default
+    `storage_options` (credentials, endpoints) to a scheme."""
+    if storage_options:
+        def factory(url: str) -> FsspecSource:
+            return open_fsspec_source(url, **storage_options)
+    else:
+        factory = open_fsspec_source
+    register_stream_backend(scheme, factory, lister=fsspec_listing,
+                            sizer=fsspec_size)

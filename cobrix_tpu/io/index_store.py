@@ -1,0 +1,135 @@
+"""Persisted sparse indexes: the sequential VRL pass runs once per
+file version.
+
+The variable-length sparse index (reader/index.py) is the bridge from
+an inherently-sequential record stream to parallel byte-range shards —
+and it is the one pass that cannot be parallelized, so on a remote file
+it costs a full sequential download before any shard decodes. This
+store persists the computed entries under `<cache_dir>/index/`, keyed
+by:
+
+* the file's **content fingerprint** (`ByteRangeSource.fingerprint()` —
+  etag/ukey/size+mtime), so a changed file can never serve a stale
+  index, and
+* a **configuration fingerprint** covering everything that shapes index
+  generation: the copybook parse fingerprint (text + parse options) and
+  every framing/split parameter — two reads with different split sizes
+  or RDW settings key to different entries.
+
+Entries are stored without their file_id: multi-file ordering is a
+property of the *read*, not the file, so the loader re-stamps the
+current file_order. Writes are atomic (temp + rename) and loads treat
+any malformed/incompatible payload as a miss, so concurrent processes
+can share one cache directory safely.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import tempfile
+from typing import List, Optional
+
+from ..reader.index import SparseIndexEntry
+
+_logger = logging.getLogger(__name__)
+
+# bump when the payload layout changes: old files become misses
+_FORMAT = 1
+
+
+def index_config_fingerprint(reader, params) -> str:
+    """Digest of every input that shapes sparse-index generation for
+    one reader configuration (anything missing here risks serving an
+    index computed under different framing — enumerate generously)."""
+    seg = params.multisegment
+    token = repr((
+        _FORMAT,
+        getattr(reader, "copybook_fingerprint", None),
+        params.input_split_records,
+        params.input_split_size_mb,
+        params.is_record_sequence,
+        params.is_rdw_big_endian,
+        params.is_rdw_part_of_record_length,
+        params.rdw_adjustment,
+        params.record_length_override,
+        params.length_field_name,
+        params.is_text,
+        params.variable_size_occurs,
+        params.record_extractor,
+        params.re_additional_info,
+        params.record_header_parser,
+        params.rhp_additional_info,
+        params.start_offset,
+        params.end_offset,
+        params.file_start_offset,
+        params.file_end_offset,
+        params.record_error_policy,
+        params.resync_window_bytes,
+        (seg.segment_id_field, tuple(seg.segment_level_ids),
+         tuple(sorted(seg.field_parent_map.items())),
+         tuple(sorted(seg.segment_id_redefine_map.items())))
+        if seg else None,
+    ))
+    return hashlib.sha256(token.encode("utf-8", "replace")).hexdigest()
+
+
+class SparseIndexStore:
+    def __init__(self, cache_dir: str):
+        self.root = os.path.join(cache_dir, "index")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, url: str, config_fp: str) -> str:
+        h = hashlib.sha256(
+            f"{url}\x00{config_fp}".encode("utf-8", "replace"))
+        return os.path.join(self.root, h.hexdigest()[:40] + ".json")
+
+    def load(self, url: str, fingerprint: str, config_fp: str,
+             file_id: int) -> Optional[List[SparseIndexEntry]]:
+        """The persisted entries for this (url, file version, config),
+        re-stamped with the caller's file_id — or None (miss: absent,
+        stale fingerprint, or unreadable)."""
+        try:
+            with open(self._path(url, config_fp), encoding="utf-8") as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if (payload.get("format") != _FORMAT
+                or payload.get("url") != url
+                or payload.get("fingerprint") != fingerprint
+                or payload.get("config") != config_fp):
+            return None
+        try:
+            return [SparseIndexEntry(int(offset_from), int(offset_to),
+                                     file_id, int(record_index))
+                    for offset_from, offset_to, record_index
+                    in payload["entries"]]
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    def save(self, url: str, fingerprint: str, config_fp: str,
+             entries: List[SparseIndexEntry]) -> None:
+        """Persist one file version's entries (atomic; best-effort — a
+        full disk degrades to re-indexing, never to a failed read)."""
+        payload = {
+            "format": _FORMAT,
+            "url": url,
+            "fingerprint": fingerprint,
+            "config": config_fp,
+            "entries": [[e.offset_from, e.offset_to, e.record_index]
+                        for e in entries],
+        }
+        path = self._path(url, config_fp)
+        try:
+            fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".tmp-")
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError as exc:
+            _logger.warning("sparse-index save failed for %s: %s",
+                            url, exc)
+            try:
+                os.unlink(tmp)
+            except (OSError, UnboundLocalError):
+                pass

@@ -26,14 +26,16 @@ _tls = threading.local()
 class ObsContext:
     """The read's observability bundle (any member may be None)."""
 
-    __slots__ = ("tracer", "metrics", "progress", "cache_scope")
+    __slots__ = ("tracer", "metrics", "progress", "cache_scope",
+                 "io_stats")
 
     def __init__(self, tracer=None, metrics: Optional[dict] = None,
-                 progress=None, cache_scope=None):
+                 progress=None, cache_scope=None, io_stats=None):
         self.tracer = tracer
         self.metrics = metrics      # obs.metrics.scan_metrics() dict
         self.progress = progress    # obs.progress.ProgressTracker
         self.cache_scope = cache_scope  # plan.cache.CacheStatsScope
+        self.io_stats = io_stats    # io.stats.IoStats (remote IO planes)
 
 
 def current() -> Optional[ObsContext]:

@@ -342,4 +342,18 @@ def scan_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "cobrix_supervision_events_total",
             "Distributed-supervision events by type",
             label_names=("event",)),
+        # -- remote-storage io (cobrix_tpu.io) --------------------------
+        "io_cache": r.counter(
+            "cobrix_io_cache_events_total",
+            "Persistent-cache lookups by plane (block/index) and outcome",
+            label_names=("plane", "result")),
+        "prefetch": r.counter(
+            "cobrix_io_prefetch_total",
+            "Read-ahead prefetches by outcome "
+            "(issued/hit/wait/unused)",
+            label_names=("result",)),
+        "remote_bytes": r.counter(
+            "cobrix_io_remote_bytes_total",
+            "Bytes fetched from remote storage backends",
+            label_names=("source",)),
     }

@@ -56,6 +56,7 @@ def _scan_shard(ctx: dict, shard: WorkShard,
     tracing path attributes read/frame/decode busy inside the worker)."""
     import pyarrow as pa
 
+    from ..io.config import IoConfig
     from ..reader.diagnostics import ReadDiagnostics
     from ..reader.stream import RetryPolicy, open_stream
 
@@ -65,6 +66,10 @@ def _scan_shard(ctx: dict, shard: WorkShard,
                         base_delay=params.io_retry_base_delay,
                         max_delay=params.io_retry_max_delay,
                         deadline=params.io_retry_deadline)
+    # built IN the worker: the fsspec adapter rebuilds its filesystem
+    # per pid and the prefetch pool spawns lazily, so every worker owns
+    # its connections and threads — nothing crosses the fork
+    io = IoConfig.from_params(params)
     retries: List[int] = []
     on_retry = lambda: retries.append(1)  # noqa: E731
     max_bytes = (0 if shard.offset_to < 0
@@ -72,7 +77,7 @@ def _scan_shard(ctx: dict, shard: WorkShard,
     if ctx["is_var_len"]:
         with open_stream(shard.file_path, start_offset=shard.offset_from,
                          maximum_bytes=max_bytes, retry=retry,
-                         on_retry=on_retry) as stream:
+                         on_retry=on_retry, io=io) as stream:
             result = reader.read_result_columnar(
                 stream, file_id=shard.file_order, backend="numpy",
                 segment_id_prefix=ctx["prefix"],
@@ -82,7 +87,7 @@ def _scan_shard(ctx: dict, shard: WorkShard,
     else:
         with open_stream(shard.file_path, start_offset=shard.offset_from,
                          maximum_bytes=max_bytes, retry=retry,
-                         on_retry=on_retry) as stream:
+                         on_retry=on_retry, io=io) as stream:
             data = stream.next(stream.size() - shard.offset_from)
         result = reader.read_result(
             data, backend="numpy", file_id=shard.file_order,
@@ -118,17 +123,26 @@ def plan_fixed_len_shards(reader, files: Sequence[str], params,
     (the binaryRecords analogue, CobolScanners.scala:92). Files the split
     cannot handle faithfully — file headers/footers, sizes that do not
     divide by the record stride (the divisibility error must fire exactly
-    as in a single-process read), or sub-record files — stay whole."""
+    as in a single-process read), or sub-record files — stay whole.
+    Remote files split too when their backend can size them (the fsspec
+    adapter and any backend registered with `sizer=`); a failed size
+    probe degrades to one whole-file shard, never to a failed plan."""
     from ..reader.parameters import DEFAULT_FILE_RECORD_ID_INCREMENT
-    from ..reader.stream import path_scheme
+    from ..reader.stream import path_scheme, source_size
 
     shards: List[WorkShard] = []
     rs = reader.record_size  # effective stride: overrides + start/end pad
     for file_order, file_path in enumerate(files):
         base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
         is_local = path_scheme(file_path) in (None, "file")
-        size = os.path.getsize(file_path) if is_local else -1
-        splittable = (is_local and hosts > 1 and size >= 2 * rs
+        if is_local:
+            size = os.path.getsize(file_path)
+        else:
+            try:
+                size = source_size(file_path)
+            except Exception:
+                size = -1
+        splittable = (hosts > 1 and size >= 2 * rs
                       and size % rs == 0
                       and not params.file_start_offset
                       and not params.file_end_offset)
@@ -205,6 +219,7 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
         # parent's registry or cache scope, so each shard scan collects
         # its own (tracer spans, record-length histogram, cache events)
         # and ships the state home on the result pipe for merging
+        from ..io.stats import IoStats
         from ..obs.context import ObsContext
         from ..obs.context import activate as obs_activate
         from ..obs.metrics import MetricsRegistry, scan_metrics
@@ -220,7 +235,9 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
             st = StageTimes(tracer=wt)
         wm = scan_metrics(MetricsRegistry())
         ws = CacheStatsScope()
-        wctx = ObsContext(tracer=wt, metrics=wm, cache_scope=ws)
+        wio = IoStats()
+        wctx = ObsContext(tracer=wt, metrics=wm, cache_scope=ws,
+                          io_stats=wio)
         with obs_activate(wctx):
             if wt is not None:
                 with wt.span("shard", "shard", parent=trace_root,
@@ -235,6 +252,7 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
             "pid": os.getpid(),
             "trace": wt.export_state() if wt is not None else None,
             "cache": ws.stats,
+            "io": wio.as_dict(),
             "record_length": wm["record_length"].state(),
         })
 
@@ -308,6 +326,12 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
                 # process-global counters did IFF the shard ran inline
                 absorb_scope(obs.cache_scope, blob["cache"],
                              bump_global=forked)
+            if (obs is not None and obs.io_stats is not None
+                    and blob.get("io")):
+                # like record_length: the shard counted into its
+                # worker-LOCAL IoStats whether forked or inline, so the
+                # merge is unconditional
+                obs.io_stats.merge(blob["io"])
         with pa.ipc.open_stream(pa.py_buffer(payload)) as rd:
             table = rd.read_all()
         if progress is not None:

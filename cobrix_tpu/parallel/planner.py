@@ -48,11 +48,20 @@ def shards_from_index(file_path: str, file_order: int,
     return out
 
 
-def balance(shards: Sequence[WorkShard], n_hosts: int
-            ) -> List[List[WorkShard]]:
+def balance(shards: Sequence[WorkShard], n_hosts: int,
+            reallocate_idle: bool = False) -> List[List[WorkShard]]:
     """Greedy LPT bin packing of shards onto hosts by byte size — the
     LocationBalancer analogue (no locality term: TPU hosts read from
-    shared storage, so only load balance matters)."""
+    shared storage, so only load balance matters).
+
+    `reallocate_idle` adds the second LocationBalancer pass
+    (LocationBalancer.scala:42-66): queued entries move from the
+    most-loaded host to hosts left idle by the primary assignment (the
+    common trigger: remote whole-file shards of unknown size report -1,
+    weigh 0 under LPT, and pile onto one host). Once no host is idle,
+    only unknown-size shards keep equalizing by count — moving a
+    KNOWN-size shard onto a byte-heavier host would worsen the makespan
+    LPT already optimized."""
     if n_hosts <= 0:
         raise ValueError("n_hosts must be positive")
     assignments: List[List[WorkShard]] = [[] for _ in range(n_hosts)]
@@ -63,16 +72,53 @@ def balance(shards: Sequence[WorkShard], n_hosts: int
         load, host = heapq.heappop(heap)
         assignments[host].append(shard)
         heapq.heappush(heap, (load + max(shard.size, 0), host))
+    if reallocate_idle:
+        # equalize by COUNT until no host holds 2+ more shards than
+        # another. Moving the donor's last-queued entry mirrors the
+        # reference's re-assignment of pending (not in-flight)
+        # partitions; donors always keep >= 1.
+        while True:
+            busiest = max(range(n_hosts),
+                          key=lambda h: (len(assignments[h]), -h))
+            laziest = min(range(n_hosts),
+                          key=lambda h: (len(assignments[h]), h))
+            if len(assignments[busiest]) - len(assignments[laziest]) < 2:
+                break
+            donor = assignments[busiest]
+            if assignments[laziest]:
+                # receiver already works: only an unknown-size shard
+                # (weight 0 to LPT) may keep equalizing — moving real
+                # bytes onto a byte-heavier host worsens the makespan
+                movable = next((i for i in range(len(donor) - 1, -1, -1)
+                                if donor[i].size < 0), None)
+                if movable is None:
+                    break
+                assignments[laziest].append(donor.pop(movable))
+            else:
+                assignments[laziest].append(donor.pop())
     # deterministic per-host order: by (file_order, offset)
     for a in assignments:
         a.sort(key=lambda s: (s.file_order, s.offset_from))
     return assignments
 
 
-def plan_files(files: Sequence[str], n_hosts: int) -> List[List[WorkShard]]:
+def plan_files(files: Sequence[str], n_hosts: int,
+               reallocate_idle: bool = False) -> List[List[WorkShard]]:
     """Whole-file sharding (fixed-length / no-index path): one shard per
-    file, balanced across hosts."""
+    file, balanced across hosts. Remote files size through their storage
+    backend; an unsizable file enters at size -1 (unknown), which is
+    exactly the case `reallocate_idle` redistributes."""
+    from ..reader.stream import path_scheme, source_size
+
+    def size_of(f: str) -> int:
+        try:
+            return (os.path.getsize(f)
+                    if path_scheme(f) in (None, "file")
+                    else source_size(f))
+        except Exception:
+            return -1
+
     shards = [
-        WorkShard(f, order, 0, os.path.getsize(f), 0)
+        WorkShard(f, order, 0, size_of(f), 0)
         for order, f in enumerate(files)]
-    return balance(shards, n_hosts)
+    return balance(shards, n_hosts, reallocate_idle=reallocate_idle)

@@ -185,6 +185,20 @@ def _parse_key(contents: Tuple[str, ...], params) -> str:
     ))
 
 
+def parse_fingerprint(copybook_contents, params) -> str:
+    """Stable hex digest of (copybook text, parse-relevant options) —
+    the copybook component of the persisted sparse-index key
+    (cobrix_tpu.io.index_store): two runs, or two processes, configured
+    identically fingerprint identically."""
+    import hashlib
+
+    contents_list = ([copybook_contents]
+                     if isinstance(copybook_contents, str)
+                     else list(copybook_contents))
+    key = _parse_key(tuple(contents_list), params)
+    return hashlib.sha256(key.encode("utf-8", "replace")).hexdigest()
+
+
 def copybook_for_params(copybook_contents, params):
     """Parse (or fetch) the Copybook for one reader configuration.
 

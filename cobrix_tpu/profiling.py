@@ -139,15 +139,23 @@ class ReadMetrics:
     # (obs.context), so concurrent read_cobol calls attribute their own
     # lookups exactly — never each other's
     plan_cache: Optional[dict] = None
+    # remote-storage io counters (block/index cache hits, prefetch
+    # utilization, bytes fetched — cobrix_tpu.io); None when the read
+    # never touched the io layer
+    io: Optional[dict] = None
     # finished obs.Tracer span records when the read traced (trace_file
     # or an explicitly attached tracer); None otherwise
     spans: Optional[list] = None
 
     def __post_init__(self):
+        from .io.stats import IoStats
         from .plan.cache import CacheStatsScope
 
         self._timings_lock = threading.Lock()
         self.cache_scope = CacheStatsScope()
+        # per-read remote-IO counter bag, activated alongside the cache
+        # scope on every thread working for this read (obs.context)
+        self.io_stats = IoStats()
         # optional obs.Tracer for the read (set by read_cobol when
         # tracing is on); stage() timers double as scan-level spans
         self.tracer = None
@@ -164,6 +172,10 @@ class ReadMetrics:
         self.shards = max(self.shards, shards)
         self.records = len(data)
         self.plan_cache = dict(self.cache_scope.stats)
+        if not self.io_stats.is_zero:
+            self.io = self.io_stats.as_dict()
+            self.io["prefetch_utilization"] = round(
+                self.io_stats.prefetch_utilization, 3)
         if self.tracer is not None:
             self.tracer.finish_root(args={
                 "files": self.files, "shards": self.shards,
@@ -188,6 +200,24 @@ class ReadMetrics:
             if count:
                 cache, _, result = key.rpartition("_")
                 m["cache"].labels(cache=cache, result=result).inc(count)
+        io = self.io or {}
+        for plane in ("block", "index"):
+            for result, label in (("hits", "hit"), ("misses", "miss")):
+                count = io.get(f"{plane}_{result}", 0)
+                if count:
+                    m["io_cache"].labels(
+                        plane=plane, result=label).inc(count)
+        for result, label in (("issued", "issued"), ("hits", "hit"),
+                              ("waits", "wait"), ("unused", "unused")):
+            count = io.get(f"prefetch_{result}", 0)
+            if count:
+                m["prefetch"].labels(result=label).inc(count)
+        if io.get("bytes_fetched"):
+            m["remote_bytes"].labels(source="backend").inc(
+                io["bytes_fetched"])
+        if io.get("bytes_from_cache"):
+            m["remote_bytes"].labels(source="cache").inc(
+                io["bytes_from_cache"])
 
     def as_dict(self) -> dict:
         out = {
@@ -207,6 +237,8 @@ class ReadMetrics:
             out["supervision"] = self.supervision
         if self.plan_cache is not None:
             out["plan_cache"] = self.plan_cache
+        if self.io is not None:
+            out["io"] = self.io
         if self.spans is not None:
             out["span_count"] = len(self.spans)
         return out

@@ -1346,11 +1346,19 @@ class ColumnarDecoder:
 
                     # decode is embarrassingly parallel: each device runs
                     # the fused kernel on its batch shard, no collectives
-                    fused = jax.shard_map(
-                        fused, mesh=mesh,
-                        in_specs=PartitionSpec("data"),
-                        out_specs=PartitionSpec("data"),
-                        check_vma=False)
+                    if hasattr(jax, "shard_map"):
+                        fused = jax.shard_map(
+                            fused, mesh=mesh,
+                            in_specs=PartitionSpec("data"),
+                            out_specs=PartitionSpec("data"),
+                            check_vma=False)
+                    else:  # jax<0.6: experimental home, check_rep spelling
+                        from jax.experimental.shard_map import shard_map
+                        fused = shard_map(
+                            fused, mesh=mesh,
+                            in_specs=PartitionSpec("data"),
+                            out_specs=PartitionSpec("data"),
+                            check_rep=False)
 
         def decode_all(data):
             outs: List[tuple] = [None] * len(kernel_groups)

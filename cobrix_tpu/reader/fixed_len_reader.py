@@ -35,6 +35,12 @@ class FixedLenReader:
         # copybook/options share the Copybook object — and through it the
         # compiled field plans and decoders (plan/cache.py)
         self.copybook = copybook_for_params(copybook_contents, params)
+        # stable copybook identity for the persisted sparse-index key
+        # (io.index_store): survives process restarts, unlike id()
+        from ..plan.cache import parse_fingerprint
+
+        self.copybook_fingerprint = parse_fingerprint(copybook_contents,
+                                                      params)
         self.params = params
         self.segment_redefine_map = dict(
             seg.segment_id_redefine_map) if seg else {}

@@ -46,14 +46,19 @@ class SparseIndexEntry:
 
 
 def file_index_entries(reader, file_path: str, file_order: int, params,
-                       retry=None, on_retry=None
+                       retry=None, on_retry=None, io=None
                        ) -> Optional[List[SparseIndexEntry]]:
     """Sparse index for one file, or None when a single shard suffices —
     the chunk-planning primitive shared by the threaded indexed scan, the
     multi-host executor, and the chunked pipeline engine
     (cobrix_tpu.engine.chunks). The vectorized RDW index is used when the
     configuration allows it; otherwise the generic per-record generator
-    (the reference's only mode, IndexGenerator.scala:33) runs."""
+    (the reference's only mode, IndexGenerator.scala:33) runs.
+
+    With `io.cache_dir` set, computed entries persist in the sparse-index
+    store (cobrix_tpu.io.index_store) keyed by file fingerprint +
+    framing-config fingerprint: the sequential indexing pass runs once
+    per file version, and warm re-scans load the shard plan directly."""
     from .parameters import DEFAULT_INDEX_ENTRY_SIZE_MB, MEGABYTE
     from .stream import open_stream, path_scheme
 
@@ -67,9 +72,40 @@ def file_index_entries(reader, file_path: str, file_order: int, params,
         # the whole file is one shard anyway
         return not explicit and size <= split_mb * MEGABYTE
 
+    store = config_fp = io_stats = None
+    if io is not None and io.cache_enabled:
+        from ..io.index_store import (SparseIndexStore,
+                                      index_config_fingerprint)
+        from ..io.stats import current_io_stats
+
+        store = SparseIndexStore(io.cache_dir)
+        config_fp = index_config_fingerprint(reader, params)
+        io_stats = current_io_stats()
+
+    def from_store(fingerprint: str):
+        cached = store.load(file_path, fingerprint, config_fp, file_order)
+        if io_stats is not None:
+            io_stats.bump("index_hits" if cached is not None
+                          else "index_misses")
+        return cached
+
+    def to_store(fingerprint: str, entries) -> None:
+        if store is not None and entries is not None:
+            store.save(file_path, fingerprint, config_fp, entries)
+            if io_stats is not None:
+                io_stats.bump("index_saves")
+
     if path_scheme(file_path) in (None, "file"):
         if too_small(os.path.getsize(file_path)):
             return None
+        fingerprint = None
+        if store is not None:
+            st = os.stat(file_path)
+            fingerprint = f"local:{st.st_size}:{st.st_mtime_ns}"
+            cached = from_store(fingerprint)
+            if cached is not None:
+                return cached
+        entries = None
         if reader.supports_fast_framing:
             # mmap, not read(): the scan touches the whole file once to
             # find split offsets; materializing it would spike RSS by the
@@ -89,16 +125,30 @@ def file_index_entries(reader, file_path: str, file_order: int, params,
                         # MASK that actionable error with a BufferError —
                         # the map is released when the exception is
                         pass
-            if entries is not None:
-                return entries
-        with open_stream(file_path) as stream:
-            return reader.generate_index(stream, file_order)
-    # registry-backed storage: one stream serves both the size probe and
-    # the index scan (a backend open is typically a network round trip)
-    with open_stream(file_path, retry=retry, on_retry=on_retry) as stream:
+        if entries is None:
+            with open_stream(file_path) as stream:
+                entries = reader.generate_index(stream, file_order)
+        to_store(fingerprint, entries)
+        return entries
+    # registry-backed storage: one stream serves the size probe, the
+    # fingerprint probe, and the index scan (a backend open is typically
+    # a network round trip)
+    with open_stream(file_path, retry=retry, on_retry=on_retry,
+                     io=io) as stream:
         if too_small(stream.size()):
             return None
-        return reader.generate_index(stream, file_order)
+        fingerprint = None
+        if store is not None:
+            source = getattr(stream, "source", None)
+            if source is not None:
+                fingerprint = source.fingerprint()
+                cached = from_store(fingerprint)
+                if cached is not None:
+                    return cached
+        entries = reader.generate_index(stream, file_order)
+    if fingerprint is not None:
+        to_store(fingerprint, entries)
+    return entries
 
 
 def sparse_index_generator(file_id: int,

@@ -105,6 +105,21 @@ class ReaderParameters:
     io_retry_base_delay: float = 0.05   # seconds; doubles per attempt
     io_retry_max_delay: float = 2.0     # per-sleep cap, seconds
     io_retry_deadline: float = 30.0     # overall budget per read, seconds
+    # -- remote storage io (cobrix_tpu.io; registry-backed schemes only,
+    # local files read through the OS page cache) ------------------------
+    # on-disk cache root for the persistent block cache AND the
+    # sparse-index store ('' = both planes off). Safe to share across
+    # processes; entries are keyed by file fingerprint (etag/size/mtime)
+    # and invalidate structurally when the remote file changes
+    cache_dir: str = ""
+    # LRU budget for the block cache, in MB (0 = unbounded)
+    cache_max_mb: float = 1024.0
+    # read-ahead depth: how many blocks a bounded pool fetches ahead of
+    # the consumer (0 = no prefetch). Each stream owns its pool; workers
+    # build theirs after fork, so no threads/fds cross processes
+    prefetch_blocks: int = 2
+    # block granularity (MB) shared by the cache and the prefetcher
+    io_block_mb: float = 8.0
     # -- chunked pipeline executor (cobrix_tpu.engine) -------------------
     # worker threads overlapping read -> frame -> decode -> Arrow assembly
     # across chunks. 0 = today's sequential path (the safe fallback);

@@ -178,6 +178,15 @@ class ByteRangeSource:
         """Up to `n` bytes at `offset` (short reads allowed; b'' at EOF)."""
         raise NotImplementedError
 
+    def fingerprint(self) -> str:
+        """Content-version key for the persistent cache planes
+        (cobrix_tpu.io): two opens of an unchanged object must agree,
+        and a changed object must differ. Backends with real version
+        metadata (etag/ukey/mtime) override; the size-only default
+        misses same-size rewrites, which cache-sensitive backends
+        should not rely on."""
+        return f"size:{self.size()}"
+
     @property
     def name(self) -> str:
         return ""
@@ -232,9 +241,17 @@ def retrying_read(fn: Callable[[], bytes], policy: RetryPolicy,
             elapsed = time.monotonic() - start
             if (attempt >= policy.max_attempts
                     or elapsed >= policy.deadline):
-                raise IOError(
-                    f"{describe} failed after {attempt} attempt(s) over "
-                    f"{elapsed:.2f}s: {exc}") from exc
+                msg = (f"{describe} failed after {attempt} attempt(s) "
+                       f"over {elapsed:.2f}s: {exc}")
+                # a dead backend should fail with its OWN error type
+                # (S3 auth errors, fsspec FileNotFound, ...) so callers
+                # can dispatch on it; fall back to IOError only when the
+                # type cannot carry a plain message
+                try:
+                    raised = type(exc)(msg)
+                except Exception:
+                    raised = IOError(msg)
+                raise raised from exc
             delay = min(policy.delay(attempt),
                         max(policy.deadline - elapsed, 0.0))
             _logger.warning("%s failed (attempt %d/%d): %s — retrying in "
@@ -286,6 +303,12 @@ class BufferedSourceStream(SimpleStream):
     @property
     def input_file_name(self) -> str:
         return self._source.name
+
+    @property
+    def source(self) -> ByteRangeSource:
+        """The underlying byte source (fingerprint probes for the
+        persistent cache planes read it without a second backend open)."""
+        return self._source
 
     def _fill(self, offset: int) -> None:
         want = min(self._chunk_size, self._limit - offset)
@@ -361,18 +384,95 @@ class _LocalFileSource(ByteRangeSource):
         self._f.close()
 
 
-# scheme -> factory(path_without_scheme) -> ByteRangeSource
+# scheme -> factory(full_url) -> ByteRangeSource
 _STREAM_BACKENDS: Dict[str, Callable[[str], ByteRangeSource]] = {}
+# optional per-scheme listing (url -> [urls]) and sizing (url -> bytes):
+# remote directory scans and shard planning route through these instead
+# of os.path, so a remote *directory* read works end to end
+_STREAM_LISTERS: Dict[str, Callable[[str], list]] = {}
+_STREAM_SIZERS: Dict[str, Callable[[str], int]] = {}
 
 
 def register_stream_backend(scheme: str,
-                            factory: Callable[[str], ByteRangeSource]
+                            factory: Callable[[str], ByteRangeSource],
+                            lister: Optional[Callable[[str], list]] = None,
+                            sizer: Optional[Callable[[str], int]] = None
                             ) -> None:
     """Register a storage backend for `scheme://...` paths (the pluggable
     role of the reference's Hadoop FileSystem resolution,
     FileNameUtils/FileStreamer). The factory receives the full path and
-    returns a ByteRangeSource."""
-    _STREAM_BACKENDS[scheme.lower()] = factory
+    returns a ByteRangeSource. `lister` (recursive glob/directory
+    listing returning full URLs) and `sizer` (byte size without opening
+    a stream) are optional capabilities; without them a scheme path is
+    treated as one verbatim input of unknown size."""
+    scheme = scheme.lower()
+    _STREAM_BACKENDS[scheme] = factory
+    if lister is not None:
+        _STREAM_LISTERS[scheme] = lister
+    elif scheme in _STREAM_LISTERS:
+        del _STREAM_LISTERS[scheme]
+    if sizer is not None:
+        _STREAM_SIZERS[scheme] = sizer
+    elif scheme in _STREAM_SIZERS:
+        del _STREAM_SIZERS[scheme]
+
+
+def resolve_stream_backend(scheme: str
+                           ) -> Optional[Callable[[str], ByteRangeSource]]:
+    """The factory for `scheme`, auto-registering the fsspec adapter
+    (cobrix_tpu.io) for any scheme fsspec implements that nothing
+    claimed explicitly. Returns None for a truly unknown scheme."""
+    factory = _STREAM_BACKENDS.get(scheme)
+    if factory is not None:
+        return factory
+    from ..io.fsspec_source import known_protocol, register_fsspec_backend
+
+    if known_protocol(scheme):
+        register_fsspec_backend(scheme)
+        return _STREAM_BACKENDS.get(scheme)
+    return None
+
+
+def stream_lister(scheme: str) -> Optional[Callable[[str], list]]:
+    """The scheme's listing capability (after backend resolution)."""
+    resolve_stream_backend(scheme)
+    return _STREAM_LISTERS.get(scheme)
+
+
+def source_size(path: str, retry: Optional[RetryPolicy] = None,
+                on_retry: Optional[Callable[[], None]] = None) -> int:
+    """Byte size of one input (local or backend-resolved) without
+    building a buffered stream; the planning/validation sizer. A remote
+    size is one backend metadata round trip, so it memoizes on the
+    active read (metrics totals, shard planning, and divisibility
+    validation probe each file once per read, not once each)."""
+    scheme = path_scheme(path)
+    if scheme in (None, "file"):
+        return os.path.getsize(normalize_local(path))
+    from ..io.stats import current_io_stats
+
+    stats = current_io_stats()
+    memo = stats.memo if stats is not None else None
+    if memo is not None:
+        size = memo.get(("size", path))
+        if size is not None:
+            return size
+    sizer = None
+    if resolve_stream_backend(scheme) is not None:
+        sizer = _STREAM_SIZERS.get(scheme)
+    if sizer is not None:
+        if retry is not None:
+            size = retrying_read(lambda: sizer(path), retry,
+                                 describe=f"size probe of '{path}'",
+                                 on_retry=on_retry)
+        else:
+            size = sizer(path)
+    else:
+        with open_stream(path, retry=retry, on_retry=on_retry) as stream:
+            size = stream.size()
+    if memo is not None:
+        memo[("size", path)] = size
+    return size
 
 
 def path_scheme(path: str) -> Optional[str]:
@@ -395,26 +495,37 @@ def normalize_local(path: str) -> str:
 def open_stream(path: str, start_offset: int = 0, maximum_bytes: int = 0,
                 chunk_size: int = DEFAULT_CHUNK_SIZE,
                 retry: Optional[RetryPolicy] = None,
-                on_retry: Optional[Callable[[], None]] = None
-                ) -> SimpleStream:
+                on_retry: Optional[Callable[[], None]] = None,
+                io=None) -> SimpleStream:
     """Open `path` as a SimpleStream: local files use the OS-buffered
-    FSStream; `scheme://` paths resolve through the backend registry and
-    read through the 30MB chunked buffer. `file://` is local. `retry`
-    applies to registry-backed storage only (local file IO is left to the
-    OS); `on_retry` is called once per retried read (diagnostics hook)."""
+    FSStream; `scheme://` paths resolve through the backend registry
+    (falling back to the fsspec adapter for any scheme fsspec knows) and
+    read through the chunked buffer. `file://` is local. `retry` applies
+    to registry-backed storage only (local file IO is left to the OS);
+    `on_retry` is called once per retried read (diagnostics hook).
+    `io` (cobrix_tpu.io.IoConfig) stacks the persistent block cache and
+    the read-ahead prefetcher onto registry-backed sources."""
     scheme = path_scheme(path)
     if scheme in (None, "file"):
         local = path[len("file://"):] if scheme == "file" else path
         return FSStream(local, start_offset=start_offset,
                         maximum_bytes=maximum_bytes)
-    factory = _STREAM_BACKENDS.get(scheme)
+    factory = resolve_stream_backend(scheme)
     if factory is None:
         raise ValueError(
             f"No stream backend registered for scheme {scheme!r} "
-            f"(register one with cobrix_tpu.register_stream_backend)")
+            f"(register one with cobrix_tpu.register_stream_backend; "
+            f"fsspec-known schemes register automatically when fsspec "
+            f"is installed)")
     source = (retrying_read(lambda: factory(path), retry,
                             describe=f"open of '{path}'", on_retry=on_retry)
               if retry is not None else factory(path))
+    if io is not None:
+        from ..io.config import wrap_source
+
+        source, chunk_size = wrap_source(source, path, io, chunk_size,
+                                         start_offset=start_offset,
+                                         maximum_bytes=maximum_bytes)
     return BufferedSourceStream(source, start_offset=start_offset,
                                 maximum_bytes=maximum_bytes,
                                 chunk_size=chunk_size,

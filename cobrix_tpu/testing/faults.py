@@ -147,6 +147,108 @@ def register_flaky_backend(scheme: str, data: bytes,
     return source
 
 
+class ChaosSource(ByteRangeSource):
+    """Network-shaped fault wrapper over ANY ByteRangeSource (including
+    the fsspec adapter) — the composable injector the remote-io test
+    matrix drives the retry + cache + prefetch stack through:
+
+    * `fail_reads` / `fail_every` — transient IOErrors: the first N
+      reads fail, or every k-th read fails (exercises retries landing
+      on prefetch-pool threads, not just the consumer);
+    * `error_type` — what a failure raises (proves 'dead backend fails
+      with the backend's OWN error type' end to end);
+    * `latency_s` — per-read sleep: a slow filesystem (read-ahead must
+      hide it; supervision deadlines must survive it);
+    * `truncate_at` — storage EOF short of the advertised size: reads
+      at/after the cut return b'' while size() keeps promising more —
+      the short-read anomaly BufferedSourceStream re-probes and the
+      framing layer then ledgers as truncation.
+
+    Counters (`read_calls`, `failures_served`, `slept_s`) stay on the
+    wrapper for assertions."""
+
+    def __init__(self, inner: ByteRangeSource, fail_reads: int = 0,
+                 fail_every: int = 0, fail_forever: bool = False,
+                 error_type=IOError, latency_s: float = 0.0,
+                 truncate_at: Optional[int] = None):
+        self._inner = inner
+        self.fail_reads = fail_reads
+        self.fail_every = fail_every
+        self.fail_forever = fail_forever
+        self.error_type = error_type
+        self.latency_s = latency_s
+        self.truncate_at = truncate_at
+        self.read_calls = 0
+        self.failures_served = 0
+        self.slept_s = 0.0
+
+    def size(self) -> int:
+        return self._inner.size()
+
+    def fingerprint(self) -> str:
+        return self._inner.fingerprint()
+
+    @property
+    def name(self) -> str:
+        return self._inner.name
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def read(self, offset: int, n: int) -> bytes:
+        import time
+
+        self.read_calls += 1
+        if self.latency_s:
+            time.sleep(self.latency_s)
+            self.slept_s += self.latency_s
+        should_fail = (self.fail_forever
+                       or self.failures_served < self.fail_reads
+                       or (self.fail_every
+                           and self.read_calls % self.fail_every == 0))
+        if should_fail:
+            self.failures_served += 1
+            raise self.error_type(
+                f"injected fault #{self.failures_served} "
+                f"(offset={offset}, n={n})")
+        if self.truncate_at is not None:
+            if offset >= self.truncate_at:
+                return b""  # storage EOF short of the logical limit
+            n = min(n, self.truncate_at - offset)
+        return self._inner.read(offset, n)
+
+
+def register_chaos_backend(scheme: str, data: bytes,
+                           **kwargs) -> "ChaosSource":
+    """Register `scheme://` serving `data` through one ChaosSource over
+    an in-memory source (returned for counter assertions)."""
+    from ..reader.stream import register_stream_backend
+
+    class _MemSource(ByteRangeSource):
+        def __init__(self, payload: bytes, name: str):
+            self._payload = payload
+            self._name = name
+
+        def size(self) -> int:
+            return len(self._payload)
+
+        def read(self, offset: int, n: int) -> bytes:
+            return self._payload[offset:offset + n]
+
+        def fingerprint(self) -> str:
+            import hashlib
+
+            return hashlib.sha256(self._payload).hexdigest()
+
+        @property
+        def name(self) -> str:
+            return self._name
+
+    source = ChaosSource(_MemSource(data, f"{scheme}://chaos"), **kwargs)
+    register_stream_backend(scheme, lambda path: source)
+    return source
+
+
 # -- distributed-supervision fault injection -----------------------------
 #
 # The injectors below break WORKERS, not bytes: a multihost worker

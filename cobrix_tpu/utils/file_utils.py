@@ -9,21 +9,22 @@ CobolScanners.scala:88).
 """
 from __future__ import annotations
 
-import os
-from typing import List, Sequence, Tuple
+from typing import List, Tuple
 
 from ..api import list_input_files  # noqa: F401  (re-export)
+from ..reader.stream import source_size
 
 
 def find_non_divisible_files(path, divisor: int) -> List[Tuple[str, int]]:
     """(file, size) for every input file whose byte size is not a multiple
     of `divisor` (the record size). Empty list means the fixed-length read
-    is safe."""
+    is safe. Sizes resolve through the storage backend for `scheme://`
+    inputs, so remote directories validate exactly like local ones."""
     if divisor < 1:
         raise ValueError(f"Invalid divisor {divisor}")
     out: List[Tuple[str, int]] = []
     for f in list_input_files(path):
-        size = os.path.getsize(f)
+        size = source_size(f)
         if size % divisor != 0:
             out.append((f, size))
     return out
@@ -34,4 +35,4 @@ def get_number_of_files(path) -> int:
 
 
 def total_size(path) -> int:
-    return sum(os.path.getsize(f) for f in list_input_files(path))
+    return sum(source_size(f) for f in list_input_files(path))

@@ -7,9 +7,10 @@ import pytest
 from cobrix_tpu import read_cobol
 from cobrix_tpu.api import list_input_files, parse_options
 
-from util import REFERENCE_DATA, read_golden_lines
+from util import REFERENCE_DATA, needs_reference_data, read_golden_lines
 
 
+@needs_reference_data
 def test_read_cobol_fixed_length_golden():
     data = read_cobol(
         os.path.join(REFERENCE_DATA, "test1_data"),
@@ -18,6 +19,7 @@ def test_read_cobol_fixed_length_golden():
     assert data.to_json_lines() == read_golden_lines("test1_expected/test1.txt")
 
 
+@needs_reference_data
 def test_read_cobol_multisegment_golden():
     data = read_cobol(
         os.path.join(REFERENCE_DATA, "test4_data"),
@@ -34,6 +36,7 @@ def test_read_cobol_multisegment_golden():
     assert data.to_json_lines()[: len(expected)] == expected
 
 
+@needs_reference_data
 def test_read_cobol_to_pandas():
     data = read_cobol(
         os.path.join(REFERENCE_DATA, "test19_display_num"),
@@ -84,6 +87,7 @@ def test_segment_children_requires_redefine_map():
             "segment-children:0": "COMPANY => DEPT"})
 
 
+@needs_reference_data
 def test_list_input_files_skips_hidden():
     files = list_input_files(os.path.join(REFERENCE_DATA, "test1_data"))
     assert files and all(not os.path.basename(f).startswith((".", "_"))

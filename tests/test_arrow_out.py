@@ -11,7 +11,10 @@ pa = pytest.importorskip("pyarrow")
 from cobrix_tpu import read_cobol
 from cobrix_tpu.reader.arrow_out import rows_to_table
 
-REFERENCE_DATA = "/root/reference/data"
+from util import REFERENCE_DATA, needs_reference_data
+
+# every case in this module reads the reference golden datasets
+pytestmark = needs_reference_data
 
 
 def ref(*parts):

@@ -70,6 +70,41 @@ def test_planner_balances_by_bytes():
         assert h == sorted(h, key=lambda s: (s.file_order, s.offset_from))
 
 
+def test_planner_reallocates_idle_hosts():
+    """The LocationBalancer.scala:42-66 second pass: unknown-size shards
+    (remote whole-file, size -1) all weigh 0 under LPT and pile onto one
+    host; `reallocate_idle` spreads them to hosts left idle."""
+    shards = [WorkShard(f"s3://bucket/f{i}", i, 0, -1, 0)
+              for i in range(6)]
+    piled = balance(shards, 4)
+    assert sum(1 for h in piled if not h) >= 1  # the failure mode
+
+    spread = balance(shards, 4, reallocate_idle=True)
+    assert all(spread), [len(h) for h in spread]
+    assert max(len(h) for h in spread) <= 2
+    # nothing lost or duplicated across hosts
+    seen = sorted(s.file_order for h in spread for s in h)
+    assert seen == list(range(6))
+    # per-host determinism is preserved
+    for h in spread:
+        assert h == sorted(h, key=lambda s: (s.file_order, s.offset_from))
+
+    # more hosts than shards: donors are never drained below one shard
+    sparse = balance(shards[:2], 4, reallocate_idle=True)
+    assert sorted(len(h) for h in sparse) == [0, 0, 1, 1]
+
+    # known-size shards: the knob must not disturb a balanced LPT result
+    sized = [WorkShard(f"f{i}", i, 0, size, 0)
+             for i, size in enumerate([100, 90, 80, 70])]
+    assert balance(sized, 2, reallocate_idle=True) == balance(sized, 2)
+
+    # skewed KNOWN sizes with no idle host: count-equalization must not
+    # move real bytes onto the byte-heaviest host (makespan regression)
+    skewed = [WorkShard(f"f{i}", i, 0, size, 0)
+              for i, size in enumerate([100, 1, 1, 1])]
+    assert balance(skewed, 2, reallocate_idle=True) == balance(skewed, 2)
+
+
 def test_graft_entry_points():
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))

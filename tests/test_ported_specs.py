@@ -10,7 +10,7 @@ import pytest
 
 from cobrix_tpu import parse_copybook, read_cobol
 
-from util import REFERENCE_DATA
+from util import REFERENCE_DATA, needs_reference_data
 
 
 def write(tmp_path, name, payload: bytes) -> str:
@@ -229,6 +229,7 @@ class TestRecordLengthOverride:
                        is_record_sequence="true")
 
 
+@needs_reference_data
 class TestInputFileNameColumn:
     """Reference Test20InputFileNameSpec (golden-data based scenarios)."""
 

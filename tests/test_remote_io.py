@@ -1,0 +1,487 @@
+"""Remote-storage IO subsystem (cobrix_tpu.io): the fsspec byte-range
+backend, read-ahead prefetcher, and persistent block + sparse-index
+cache, exercised end-to-end through `read_cobol`.
+
+The matrix: fsspec `memory://` and backend-routed local `local://` ×
+fixed/VRL framing × sequential/pipelined/multihost execution ×
+network-shaped fault injection (ChaosSource). Remote scans must be
+byte-identical to local scans of the same bytes; warm re-scans of an
+unchanged file must skip both the network (block cache) and the
+sequential indexing pass (sparse-index store); a changed file must
+invalidate both planes.
+"""
+import json
+import os
+import struct
+import subprocess
+import sys
+import tempfile
+import uuid
+
+import pytest
+
+fsspec = pytest.importorskip("fsspec")
+
+from cobrix_tpu import prometheus_text, read_cobol
+from cobrix_tpu.testing.faults import ChaosSource, register_chaos_backend
+
+from util import hard_timeout
+
+FIXED_COPYBOOK = """
+       01  RECORD.
+           05  ID        PIC 9(4).
+           05  NAME      PIC X(8).
+"""
+FIXED_RECORD_BYTES = 12
+
+VRL_COPYBOOK = """
+       01  RECORD.
+           05  ID        PIC 9(4).
+           05  PAYLOAD   PIC X(40).
+"""
+VRL_BODY_BYTES = 44
+VRL_RECORD_BYTES = VRL_BODY_BYTES + 4  # + big-endian RDW
+
+VRL_OPTS = dict(is_record_sequence="true", is_rdw_big_endian="true")
+
+
+def fixed_payload(n: int) -> bytes:
+    return b"".join(
+        f"{i % 10000:04d}{'N%03d' % (i % 1000):<8}".encode("cp037")
+        for i in range(n))
+
+
+def vrl_payload(n: int) -> bytes:
+    out = []
+    for i in range(n):
+        body = f"{i:04d}{'P%02d' % (i % 90):<40}".encode("cp037")
+        out.append(struct.pack(">HH", len(body), 0) + body)
+    return b"".join(out)
+
+
+def mem_write(data: bytes, name: str = "data.dat") -> str:
+    """Write `data` into a unique memory:// directory; returns the URL."""
+    bucket = f"/t{uuid.uuid4().hex[:12]}"
+    fs = fsspec.filesystem("memory")
+    with fs.open(f"{bucket}/{name}", "wb") as f:
+        f.write(data)
+    return f"memory:/{bucket}/{name}"
+
+
+def local_write(tmp_path, data: bytes, name: str = "data.dat") -> str:
+    p = tmp_path / name
+    p.write_bytes(data)
+    return str(p)
+
+
+def io_counters(data) -> dict:
+    return data.metrics.as_dict().get("io") or {}
+
+
+# -- the remote-scan parity matrix ---------------------------------------
+
+
+@pytest.mark.parametrize("fmt", ["fixed", "vrl"])
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_memory_scan_matches_local(tmp_path, fmt, pipeline):
+    """read_cobol('memory://...') must produce rows and Arrow output
+    byte-identical to the local-file scan of the same bytes, with and
+    without the chunked pipeline."""
+    if fmt == "fixed":
+        data, copybook, opts = fixed_payload(4000), FIXED_COPYBOOK, {}
+    else:
+        data, copybook = vrl_payload(4000), VRL_COPYBOOK
+        opts = dict(VRL_OPTS, input_split_size_mb="1")
+    if pipeline:
+        opts = dict(opts, pipeline_workers="2", chunk_size_mb="1")
+    kw = dict(copybook_contents=copybook,
+              prefetch_blocks="2", io_block_mb="0.05", **opts)
+    remote = read_cobol(mem_write(data), **kw)
+    local = read_cobol(local_write(tmp_path, data), **kw)
+    assert remote.to_rows() == local.to_rows()
+    assert remote.to_arrow().equals(local.to_arrow())
+    assert io_counters(remote)["bytes_fetched"] >= len(data)
+
+
+@pytest.mark.parametrize("fmt", ["fixed", "vrl"])
+def test_memory_multihost_scan_matches_local(tmp_path, fmt):
+    """The forked multi-process executor over a remote URL: every worker
+    opens its own backend connection after the fork and the result is
+    identical to the local scan."""
+    with hard_timeout(120, f"multihost remote scan ({fmt})"):
+        if fmt == "fixed":
+            data, copybook, opts = fixed_payload(6000), FIXED_COPYBOOK, {}
+        else:
+            data, copybook = vrl_payload(30000), VRL_COPYBOOK
+            opts = dict(VRL_OPTS, input_split_size_mb="1")
+        kw = dict(copybook_contents=copybook, hosts="2",
+                  shard_timeout_s="60", scan_deadline_s="100",
+                  prefetch_blocks="2", io_block_mb="0.25", **opts)
+        remote = read_cobol(mem_write(data), **kw)
+        local = read_cobol(local_write(tmp_path, data), **kw)
+        assert remote.to_arrow().equals(local.to_arrow())
+        # worker-local io counters ship home over the result pipes
+        assert io_counters(remote)["bytes_fetched"] >= len(data)
+
+
+def test_remote_directory_and_glob_scan():
+    """A remote *directory* (and glob) lists through the backend with
+    the local lister's rules: recursive, hidden files skipped, stable
+    order."""
+    bucket = f"/t{uuid.uuid4().hex[:12]}"
+    fs = fsspec.filesystem("memory")
+    a, b = fixed_payload(100), fixed_payload(200)
+    for name, payload in (("a.dat", a), ("b.dat", b),
+                          (".hidden", b"junk"), ("_meta", b"junk")):
+        with fs.open(f"{bucket}/{name}", "wb") as f:
+            f.write(payload)
+    kw = dict(copybook_contents=FIXED_COPYBOOK)
+    table = read_cobol(f"memory:/{bucket}", **kw).to_arrow()
+    assert table.num_rows == 300  # hidden files skipped
+    glob_table = read_cobol(f"memory:/{bucket}/*.dat", **kw).to_arrow()
+    assert glob_table.equals(table)
+
+
+def test_unknown_scheme_stays_actionable():
+    with pytest.raises(ValueError, match="register_stream_backend"):
+        read_cobol("noproto77://bucket/x.dat",
+                   copybook_contents=FIXED_COPYBOOK)
+
+
+def test_missing_remote_file_raises_backend_error():
+    with pytest.raises(FileNotFoundError):
+        read_cobol(f"memory://absent-{uuid.uuid4().hex}/x.dat",
+                   copybook_contents=FIXED_COPYBOOK)
+
+
+# -- fault injection: the retry machinery against network-shaped failures
+
+
+@pytest.mark.parametrize("fmt", ["fixed", "vrl"])
+@pytest.mark.parametrize("pipeline", [False, True])
+def test_flaky_backend_retries_are_ledgered(fmt, pipeline):
+    """Transient remote failures are retried (backoff) and the retries
+    land on the read's diagnostics ledger; rows are complete."""
+    scheme = f"flky{uuid.uuid4().hex[:8]}"
+    if fmt == "fixed":
+        data, copybook, opts = fixed_payload(2000), FIXED_COPYBOOK, {}
+        n_expected = 2000
+    else:
+        data, copybook = vrl_payload(2000), VRL_COPYBOOK
+        opts = dict(VRL_OPTS)
+        n_expected = 2000
+    if pipeline:
+        opts = dict(opts, pipeline_workers="2", chunk_size_mb="1")
+    source = register_chaos_backend(scheme, data, fail_reads=2)
+    out = read_cobol(f"{scheme}://f.dat", copybook_contents=copybook,
+                     record_error_policy="permissive",
+                     io_retry_attempts="5", io_retry_base_delay_ms="1",
+                     prefetch_blocks="2", io_block_mb="0.05", **opts)
+    assert len(out.to_rows()) == n_expected
+    assert source.failures_served == 2
+    assert out.diagnostics.io_retries >= 2
+
+
+def test_dead_backend_fails_with_its_own_error_type():
+    """A backend that never recovers fails promptly and with the
+    backend's OWN exception type, not a generic IOError wrap."""
+    scheme = f"dead{uuid.uuid4().hex[:8]}"
+    register_chaos_backend(scheme, fixed_payload(100), fail_forever=True,
+                           error_type=ConnectionResetError)
+    with hard_timeout(60, "dead backend"):
+        with pytest.raises(ConnectionResetError):
+            read_cobol(f"{scheme}://f.dat",
+                       copybook_contents=FIXED_COPYBOOK,
+                       io_retry_attempts="2", io_retry_base_delay_ms="1",
+                       io_retry_deadline_ms="500")
+
+
+def test_slow_backend_served_through_prefetch():
+    """A high-latency filesystem stays correct under read-ahead (the
+    pool's fetches overlap the consumer; nothing is lost or reordered)."""
+    scheme = f"slow{uuid.uuid4().hex[:8]}"
+    data = fixed_payload(3000)
+    source = register_chaos_backend(scheme, data, latency_s=0.01)
+    out = read_cobol(f"{scheme}://f.dat", copybook_contents=FIXED_COPYBOOK,
+                     prefetch_blocks="3", io_block_mb="0.01")
+    assert len(out.to_rows()) == 3000
+    assert source.slept_s > 0
+    io = io_counters(out)
+    assert io["prefetch_issued"] > 0
+    assert io["prefetch_hits"] + io["prefetch_waits"] > 0
+
+
+def test_truncating_backend_is_ledgered_not_fatal():
+    """Storage EOF short of the advertised size (a truncating proxy /
+    torn upload): permissive reads ledger the truncation and return the
+    decodable prefix."""
+    scheme = f"trnc{uuid.uuid4().hex[:8]}"
+    data = fixed_payload(1000)
+    cut = 500 * FIXED_RECORD_BYTES + 5  # mid-record
+    register_chaos_backend(scheme, data, truncate_at=cut)
+    out = read_cobol(f"{scheme}://f.dat", copybook_contents=FIXED_COPYBOOK,
+                     record_error_policy="permissive")
+    # 500 clean records + the padded partial one, ledgered as truncated
+    assert len(out.to_rows()) == 501
+    diag = out.diagnostics
+    assert diag.corrupt_records == 1
+    assert any("truncated" in e.reason for e in diag.entries)
+
+
+# -- the persistent cache planes -----------------------------------------
+
+
+def test_warm_vrl_rescan_skips_network_and_index_pass(tmp_path):
+    """THE acceptance path: scan a remote VRL file twice with cache_dir
+    set. The second scan performs zero sequential index passes
+    (sparse-index store hit) and serves blocks from disk (zero backend
+    bytes); a changed file invalidates BOTH planes."""
+    cache = str(tmp_path / "cache")
+    os.makedirs(cache)
+    data = vrl_payload(30000)
+    url = mem_write(data)
+    kw = dict(copybook_contents=VRL_COPYBOOK, cache_dir=cache,
+              prefetch_blocks="2", io_block_mb="0.25",
+              input_split_size_mb="1", **VRL_OPTS)
+
+    cold = read_cobol(url, **kw)
+    cold_io = io_counters(cold)
+    assert cold_io["index_misses"] >= 1 and cold_io["index_saves"] >= 1
+    assert cold_io["bytes_fetched"] >= len(data)
+
+    warm = read_cobol(url, **kw)
+    warm_io = io_counters(warm)
+    assert warm_io["index_hits"] >= 1
+    assert warm_io["index_misses"] == 0  # zero sequential index passes
+    assert warm_io["block_hits"] >= 1
+    assert warm_io["bytes_fetched"] == 0  # the network was never touched
+    assert warm.to_arrow().equals(cold.to_arrow())
+
+    # rewrite the remote object: fingerprint changes, both planes miss
+    fs = fsspec.filesystem("memory")
+    with fs.open(url[len("memory://"):], "wb") as f:
+        f.write(vrl_payload(15000))
+    changed = read_cobol(url, **kw)
+    ch_io = io_counters(changed)
+    assert ch_io["index_hits"] == 0 and ch_io["index_misses"] >= 1
+    assert ch_io["bytes_fetched"] > 0
+    assert changed.to_arrow().num_rows == 15000
+
+
+def test_warm_fixed_rescan_serves_from_block_cache(tmp_path):
+    cache = str(tmp_path / "cache")
+    data = fixed_payload(5000)
+    url = mem_write(data)
+    kw = dict(copybook_contents=FIXED_COPYBOOK, cache_dir=cache,
+              io_block_mb="0.05")
+    cold = read_cobol(url, **kw)
+    warm = read_cobol(url, **kw)
+    assert io_counters(cold)["bytes_fetched"] >= len(data)
+    assert io_counters(warm)["bytes_fetched"] == 0
+    assert io_counters(warm)["block_hits"] >= 1
+    assert warm.to_arrow().equals(cold.to_arrow())
+
+
+def test_block_cache_lru_eviction_under_budget(tmp_path):
+    """A tiny cache budget evicts oldest-touched blocks instead of
+    growing without bound — and the scan still completes."""
+    cache = str(tmp_path / "cache")
+    data = fixed_payload(30000)  # 360 KB
+    url = mem_write(data)
+    out = read_cobol(url, copybook_contents=FIXED_COPYBOOK,
+                     cache_dir=cache, cache_max_mb="0.1",
+                     io_block_mb="0.02")
+    assert len(out.to_rows()) == 30000
+    io = io_counters(out)
+    assert io["block_evictions"] >= 1
+    # on-disk total respects the budget (within one block of slack for
+    # in-flight writes)
+    total = sum(os.path.getsize(os.path.join(dp, f))
+                for dp, _, files in os.walk(cache) for f in files
+                if f.endswith(".blk"))
+    assert total <= int(0.1 * 1024 * 1024) + int(0.02 * 1024 * 1024)
+
+
+def test_short_backend_fetch_never_misaligns_cached_blocks(tmp_path):
+    """A backend serving FEWER bytes than size() promised (truncated
+    object under an unchanged fingerprint) while LATER blocks sit in the
+    cache: the read must surface a short read, never join the cached
+    blocks after the gap (which would shift their bytes to wrong
+    offsets — silent corruption)."""
+    from cobrix_tpu.io.blockcache import CachingSource, shared_block_cache
+
+    class _Mem:
+        def __init__(self, payload, cut=None):
+            self._p, self._cut = payload, cut
+
+        def size(self):
+            return len(self._p)
+
+        def read(self, offset, n):
+            if self._cut is not None:
+                if offset >= self._cut:
+                    return b""
+                n = min(n, self._cut - offset)
+            return self._p[offset:offset + n]
+
+        def fingerprint(self):
+            return "pinned"  # same generation before and after the cut
+
+        name = "mem://short"
+
+        def close(self):
+            pass
+
+    block = 100
+    payload = bytes(range(256)) * 4  # 1024 B = 11 blocks
+    cache = shared_block_cache(str(tmp_path / "c"), 0)
+    warm = CachingSource(_Mem(payload), "mem://short", cache, block)
+    assert warm.read(0, len(payload)) == payload  # caches every block
+
+    # same generation, but storage now stops at byte 150 (mid-block 1);
+    # blocks 2.. are cached. Evict blocks 0-1 so they must be refetched.
+    gen = warm._gen_dir
+    for start in (0, 100):
+        os.unlink(os.path.join(gen, f"{start}-{start + block}.blk"))
+    cut = CachingSource(_Mem(payload, cut=150), "mem://short", cache,
+                        block)
+    got = cut.read(0, len(payload))
+    assert got == payload[:150]  # short, aligned prefix — NOT shifted
+
+
+def test_local_backend_cache_invalidates_on_file_change(tmp_path):
+    """The `local://` route (fsspec local filesystem through the full io
+    stack): warm hit on unchanged file, structural invalidation when the
+    file changes on disk."""
+    cache = str(tmp_path / "cache")
+    p = local_write(tmp_path, fixed_payload(2000))
+    kw = dict(copybook_contents=FIXED_COPYBOOK, cache_dir=cache,
+              io_block_mb="0.01")
+    read_cobol("local://" + p, **kw)
+    warm = read_cobol("local://" + p, **kw)
+    assert io_counters(warm)["bytes_fetched"] == 0
+    # a rewrite (different size) must miss the old generation
+    with open(p, "wb") as f:
+        f.write(fixed_payload(1000))
+    changed = read_cobol("local://" + p, **kw)
+    assert io_counters(changed)["bytes_fetched"] > 0
+    assert changed.to_arrow().num_rows == 1000
+
+
+_TWO_PROC_DRIVER = """
+import json, sys
+sys.path.insert(0, {repo!r})
+from cobrix_tpu import read_cobol
+out = read_cobol("local://" + {path!r},
+                 copybook_contents={copybook!r},
+                 cache_dir={cache!r}, io_block_mb="0.02",
+                 is_record_sequence="true", is_rdw_big_endian="true",
+                 input_split_size_mb="1")
+io = out.metrics.as_dict().get("io") or {{}}
+print(json.dumps({{"rows": out.to_arrow().num_rows, "io": io}}))
+"""
+
+
+def test_two_processes_share_one_cache_dir(tmp_path):
+    """Concurrent cross-process cache access: two fresh processes scan
+    the same file into the same cache_dir at once. Both must succeed
+    with full row counts (atomic block writes: a reader never sees a
+    torn block), and a third warm scan serves fully from the cache they
+    built."""
+    with hard_timeout(180, "two-process cache access"):
+        cache = str(tmp_path / "cache")
+        p = local_write(tmp_path, vrl_payload(30000))
+        script = _TWO_PROC_DRIVER.format(
+            repo=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            path=p, copybook=VRL_COPYBOOK, cache=cache)
+        procs = [subprocess.Popen([sys.executable, "-c", script],
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.PIPE,
+                                  env=dict(os.environ,
+                                           JAX_PLATFORMS="cpu"))
+                 for _ in range(2)]
+        results = []
+        for proc in procs:
+            stdout, stderr = proc.communicate(timeout=150)
+            assert proc.returncode == 0, stderr.decode()[-2000:]
+            results.append(json.loads(stdout))
+        assert all(r["rows"] == 30000 for r in results)
+        # the two processes converged on ONE generation of the file
+        gen_dirs = os.listdir(os.path.join(cache, "blocks"))
+        assert len(gen_dirs) == 1
+        warm = read_cobol("local://" + p, copybook_contents=VRL_COPYBOOK,
+                          cache_dir=cache, io_block_mb="0.02",
+                          input_split_size_mb="1", **VRL_OPTS)
+        io = io_counters(warm)
+        assert io["bytes_fetched"] == 0 and io["index_hits"] >= 1
+
+
+def test_flaky_backend_with_cache_and_prefetch(tmp_path):
+    """The full stack at once: chaos faults below cache below prefetch.
+    Retries recover, blocks persist, and the warm read never touches
+    the flaky backend again."""
+    scheme = f"chao{uuid.uuid4().hex[:8]}"
+    cache = str(tmp_path / "cache")
+    data = fixed_payload(3000)
+    source = register_chaos_backend(scheme, data, fail_reads=2)
+    kw = dict(copybook_contents=FIXED_COPYBOOK, cache_dir=cache,
+              prefetch_blocks="2", io_block_mb="0.02",
+              io_retry_attempts="5", io_retry_base_delay_ms="1")
+    cold = read_cobol(f"{scheme}://f.dat", **kw)
+    assert len(cold.to_rows()) == 3000
+    assert cold.diagnostics.io_retries >= 2
+    calls_after_cold = source.read_calls
+    warm = read_cobol(f"{scheme}://f.dat", **kw)
+    assert warm.to_arrow().equals(cold.to_arrow())
+    assert source.read_calls == calls_after_cold  # served from disk
+    assert io_counters(warm)["bytes_fetched"] == 0
+
+
+# -- observability surface ----------------------------------------------
+
+
+def test_io_counters_reach_metrics_and_prometheus(tmp_path):
+    cache = str(tmp_path / "cache")
+    out = read_cobol(mem_write(fixed_payload(2000)),
+                     copybook_contents=FIXED_COPYBOOK, cache_dir=cache,
+                     prefetch_blocks="2", io_block_mb="0.01")
+    io = io_counters(out)
+    assert io["bytes_fetched"] > 0 and io["block_misses"] > 0
+    assert 0.0 <= io["prefetch_utilization"] <= 1.0
+    text = prometheus_text()
+    assert "cobrix_io_cache_events_total" in text
+    assert "cobrix_io_remote_bytes_total" in text
+
+
+# -- iocheck smoke (the prefetch x block grid stays behind `slow`) -------
+
+def test_iocheck_quick():
+    proc = subprocess.run(
+        [sys.executable, "tools/iocheck.py", "--mb", "1"],
+        capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_iocheck_sweep():
+    proc = subprocess.run(
+        [sys.executable, "tools/iocheck.py", "--mb", "4", "--sweep"],
+        capture_output=True, text=True, timeout=1800)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "OK" in proc.stdout
+
+
+def test_local_plain_paths_never_touch_io_layer(tmp_path):
+    """Plain local files keep the pre-io fast path: no io counters, no
+    cache writes, even with the knobs set (the OS page cache IS the
+    local block cache)."""
+    p = local_write(tmp_path, fixed_payload(500))
+    cache = str(tmp_path / "cache")
+    out = read_cobol(p, copybook_contents=FIXED_COPYBOOK,
+                     cache_dir=cache, prefetch_blocks="2")
+    assert len(out.to_rows()) == 500
+    assert "io" not in out.metrics.as_dict()
+    assert not os.path.exists(os.path.join(cache, "blocks")) or \
+        not os.listdir(os.path.join(cache, "blocks"))

@@ -14,7 +14,10 @@ import pytest
 
 from cobrix_tpu import read_cobol
 
-from util import REFERENCE_DATA
+from util import REFERENCE_DATA, needs_reference_data
+
+# the parity matrix runs against the reference golden datasets
+pytestmark = needs_reference_data
 
 
 def ref(p):

@@ -4,7 +4,24 @@ import glob
 import os
 import signal
 
+import pytest
+
 REFERENCE_DATA = "/root/reference/data"
+
+# decorator for tests that touch the reference golden fixtures via
+# explicit paths (tests calling read_copybook/read_binary/
+# read_golden_lines skip automatically): on machines without the
+# dataset the parity matrix SKIPS visibly instead of failing
+needs_reference_data = pytest.mark.skipif(
+    not os.path.isdir(REFERENCE_DATA),
+    reason=f"reference golden fixtures absent ({REFERENCE_DATA}): "
+           "parity against the upstream dataset cannot run here")
+
+
+def require_reference_data():
+    """Skip the calling test when the golden dataset is absent."""
+    if not os.path.isdir(REFERENCE_DATA):
+        pytest.skip(f"reference golden fixtures absent ({REFERENCE_DATA})")
 
 
 @contextlib.contextmanager
@@ -35,12 +52,14 @@ def hard_timeout(seconds: float, label: str = "test"):
 
 
 def read_copybook(name: str) -> str:
+    require_reference_data()
     with open(os.path.join(REFERENCE_DATA, name), encoding="utf-8") as f:
         return f.read()
 
 
 def read_binary(name: str) -> bytes:
     """Read a data file; reference data entries may be directories of .bin files."""
+    require_reference_data()
     path = os.path.join(REFERENCE_DATA, name)
     if os.path.isdir(path):
         chunks = []
@@ -56,5 +75,6 @@ def read_binary(name: str) -> bytes:
 
 
 def read_golden_lines(name: str):
+    require_reference_data()
     with open(os.path.join(REFERENCE_DATA, name), encoding="iso-8859-1") as f:
         return f.read().splitlines()

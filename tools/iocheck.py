@@ -1,0 +1,197 @@
+"""Remote-IO smoke check: fsspec scan -> cache warm-up -> parity diff.
+
+Drives the remote-storage subsystem (cobrix_tpu.io) end to end against
+an in-memory fsspec filesystem on the two bench profiles — exp1
+fixed-length and exp2 RDW multisegment:
+
+  1. remote (`memory://`) scan vs local-file scan of the same bytes:
+     rows + Arrow must be identical;
+  2. cold scan with `cache_dir=` -> warm scan: the warm read must fetch
+     ZERO backend bytes, and a VRL warm read must also skip the
+     sequential index pass (sparse-index store hit);
+  3. a changed remote object must invalidate both cache planes;
+  4. a flaky backend (injected transient faults) must retry to a clean,
+     identical result with the retries on the ledger.
+
+    python tools/iocheck.py                 # quick: ~4 MB per profile
+    python tools/iocheck.py --mb 32         # bigger inputs
+    python tools/iocheck.py --sweep         # prefetch x block-size grid
+                                            # (slow; tier-1 runs quick)
+
+Exit code 0 = all parity + cache-plane checks hold; 1 = any mismatch.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import time
+import uuid
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _profiles(mb: float):
+    from cobrix_tpu.testing.generators import (
+        EXP1_COPYBOOK,
+        EXP2_COPYBOOK,
+        generate_exp1,
+        generate_exp2,
+    )
+
+    n1 = max(64, int(mb * 1024 * 1024) // 1493)
+    n2 = max(1000, int(mb * 1024 * 1024 / 66))
+    return [
+        ("exp1_fixed", generate_exp1(n1, seed=7).tobytes(),
+         dict(copybook_contents=EXP1_COPYBOOK), False),
+        ("exp2_rdw", generate_exp2(n2, seed=7),
+         dict(copybook_contents=EXP2_COPYBOOK, is_record_sequence="true",
+              segment_field="SEGMENT-ID",
+              redefine_segment_id_map="STATIC-DETAILS => C",
+              redefine_segment_id_map_1="CONTACTS => P",
+              input_split_size_mb="1",
+              segment_id_prefix="IO"), True),
+    ]
+
+
+def _mem_url(data: bytes) -> str:
+    import fsspec
+
+    bucket = f"/iocheck-{uuid.uuid4().hex[:10]}"
+    fs = fsspec.filesystem("memory")
+    with fs.open(f"{bucket}/data.dat", "wb") as f:
+        f.write(data)
+    return f"memory:/{bucket}/data.dat"
+
+
+def _io(result) -> dict:
+    return result.metrics.as_dict().get("io") or {}
+
+
+def check_profile(name: str, data: bytes, kw: dict, is_vrl: bool,
+                  prefetch: str, block_mb: str) -> bool:
+    from cobrix_tpu import read_cobol
+
+    mb = len(data) / (1024 * 1024)
+    url = _mem_url(data)
+    cache = tempfile.mkdtemp(prefix="iocheck-cache-")
+    path = None
+    ok = True
+
+    def fail(msg: str) -> None:
+        nonlocal ok
+        ok = False
+        print(f"{'':<12} FAILED: {msg}")
+
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+            f.write(data)
+            path = f.name
+        io_kw = dict(kw, prefetch_blocks=prefetch, io_block_mb=block_mb)
+        local = read_cobol(path, **kw)
+
+        t0 = time.perf_counter()
+        remote = read_cobol(url, **io_kw)
+        remote_s = time.perf_counter() - t0
+        if not remote.to_arrow().equals(local.to_arrow()):
+            fail("remote scan diverged from local scan")
+
+        cache_kw = dict(io_kw, cache_dir=cache)
+        cold = read_cobol(url, **cache_kw)
+        t0 = time.perf_counter()
+        warm = read_cobol(url, **cache_kw)
+        warm_s = time.perf_counter() - t0
+        cold_io, warm_io = _io(cold), _io(warm)
+        if not warm.to_arrow().equals(local.to_arrow()):
+            fail("warm cached scan diverged")
+        if warm_io.get("bytes_fetched", -1) != 0:
+            fail(f"warm scan fetched {warm_io.get('bytes_fetched')} "
+                 "backend bytes (expected 0)")
+        if is_vrl and (warm_io.get("index_hits", 0) < 1
+                       or warm_io.get("index_misses", 0) != 0):
+            fail(f"warm VRL scan re-indexed: {warm_io}")
+
+        # changed object invalidates both planes
+        import fsspec
+
+        half = len(data) // 2
+        with fsspec.filesystem("memory").open(
+                url[len("memory://"):], "wb") as f:
+            f.write(data[:half])
+        changed = read_cobol(url, **dict(
+            cache_kw, record_error_policy="permissive"))
+        ch_io = _io(changed)
+        if ch_io.get("bytes_fetched", 0) <= 0:
+            fail("changed object served stale cached bytes")
+        if is_vrl and ch_io.get("index_hits", 0) != 0:
+            fail("changed object served a stale sparse index")
+
+        # flaky backend: transient faults retry to an identical result
+        from cobrix_tpu.testing.faults import register_chaos_backend
+
+        scheme = f"ioq{uuid.uuid4().hex[:8]}"
+        register_chaos_backend(scheme, data, fail_reads=2)
+        flaky = read_cobol(f"{scheme}://data.dat", **dict(
+            io_kw, io_retry_attempts="5", io_retry_base_delay_ms="1"))
+        if not flaky.to_arrow().equals(local.to_arrow()):
+            fail("flaky-backend scan diverged after retries")
+        if (flaky.diagnostics is None
+                or flaky.diagnostics.io_retries < 2):
+            fail("flaky-backend retries missing from the ledger")
+
+        util = warm_io.get("prefetch_utilization", cold_io.get(
+            "prefetch_utilization", 0.0))
+        print(f"{name:<12} {mb:7.1f} MB | remote {mb / remote_s:7.1f} MB/s"
+              f" | warm {mb / warm_s:7.1f} MB/s | "
+              f"fetched {cold_io.get('bytes_fetched', 0) / 1e6:.1f} MB"
+              f" -> 0 MB | prefetch util {util:.2f}")
+        planes = (f"block {warm_io.get('block_hits', 0)} hit / "
+                  f"index {warm_io.get('index_hits', 0)} hit"
+                  if warm_io else "io layer off")
+        print(f"{'':<12} warm planes: {planes} | "
+              f"retries ledgered: {flaky.diagnostics.io_retries}")
+        return ok
+    finally:
+        if path:
+            os.unlink(path)
+        shutil.rmtree(cache, ignore_errors=True)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mb", type=float, default=4.0,
+                    help="approx input size per profile (MB)")
+    ap.add_argument("--prefetch", default="2",
+                    help="prefetch_blocks for the remote reads")
+    ap.add_argument("--block-mb", default="0.5",
+                    help="io_block_mb cache/read-ahead granularity")
+    ap.add_argument("--sweep", action="store_true",
+                    help="run a prefetch x block-size grid (slow)")
+    args = ap.parse_args()
+
+    try:
+        import fsspec  # noqa: F401
+    except ImportError:
+        print("SKIP: fsspec is not installed (the remote-io subsystem "
+              "is optional; pip install fsspec)")
+        return 0
+
+    ok = True
+    for name, data, kw, is_vrl in _profiles(args.mb):
+        if args.sweep:
+            for p in ("0", "1", "4"):
+                for b in ("0.1", args.block_mb, "2.0"):
+                    print(f"--- {name} prefetch={p} io_block_mb={b}")
+                    ok &= check_profile(name, data, kw, is_vrl, p, b)
+        else:
+            ok &= check_profile(name, data, kw, is_vrl,
+                                args.prefetch, args.block_mb)
+    print("OK: remote scans identical, cache planes verified" if ok
+          else "FAILED: remote-io checks diverged")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
