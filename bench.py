@@ -847,9 +847,101 @@ def run_hierarchical_side_metric(mb_target: float) -> dict:
     return result
 
 
+def run_serve_side_metric(mb_target: float) -> dict:
+    """exp_serve: the streaming serving tier (cobrix_tpu.serve) vs the
+    in-process read, same exp1 input. Two numbers matter: streamed
+    end-to-end MB/s (decode + Arrow IPC framing + TCP loopback + client
+    reassembly — the tax a serving client pays over `to_arrow()`), and
+    time-to-first-batch, which must land BELOW the one-shot latency:
+    that gap is the whole point of streaming delivery (a client renders
+    after one chunk decodes, not after the whole table exists)."""
+    import tempfile
+
+    from cobrix_tpu.serve import ScanServer, stream_scan
+    from cobrix_tpu.testing.generators import EXP1_COPYBOOK, generate_exp1
+
+    n_records = max(64, int(mb_target * 1024 * 1024) // 1493)
+    data = generate_exp1(n_records, seed=100)
+    mb = data.nbytes / (1024 * 1024)
+    path = None
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+            f.write(data.tobytes())
+            path = f.name
+        # both sides run the SAME pipelined config, chunked ~8 ways:
+        # streaming only wins first-batch latency when the scan has
+        # several chunks to deliver incrementally, and the one-shot
+        # reference must not differ in anything but delivery
+        kw = dict(copybook_contents=EXP1_COPYBOOK,
+                  pipeline_workers=os.environ.get(
+                      "BENCH_PIPELINE_WORKERS", "-1"),
+                  chunk_size_mb=os.environ.get(
+                      "BENCH_SERVE_CHUNK_MB", str(max(1, round(mb / 8)))))
+        # in-process reference; its warmup also warms the compile caches
+        # the server shares, so neither side pays the parse
+        one_shot_s, table, _ = _best_to_arrow(path, kw)
+        srv = ScanServer(enable_http=False).start()
+        errors = []
+        try:
+            # rows/batches come from the best-total run so throughput
+            # fields all describe ONE run; first-batch is best-of-runs
+            # like every other latency in this file
+            best = None  # (total, rows, batches)
+            best_first = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                first = None
+                rows = batches = 0
+                with stream_scan(srv.address, path, tenant="bench",
+                                 **kw) as stream:
+                    for batch in stream:
+                        if first is None:
+                            first = time.perf_counter() - t0
+                        rows += batch.num_rows
+                        batches += 1
+                total = time.perf_counter() - t0
+                if rows != table.num_rows:
+                    errors.append(f"streamed {rows} rows != in-process "
+                                  f"{table.num_rows}")
+                if best is None or total < best[0]:
+                    best = (total, rows, batches)
+                if first is not None and (best_first is None
+                                          or first < best_first):
+                    best_first = first
+        finally:
+            srv.stop()
+    finally:
+        if path:
+            os.unlink(path)
+    best_total, rows, batches = best
+    if best_first is None:
+        best_first = best_total
+    result = {
+        "metric": "exp_serve_streamed_to_arrow",
+        "value": round(mb / best_total, 1),
+        "unit": "MB/s",
+        "rows": rows,
+        "batches": batches,
+        "one_shot_s": round(one_shot_s, 4),
+        "stream_total_s": round(best_total, 4),
+        "stream_vs_in_process": round(one_shot_s / best_total, 2),
+        "first_batch_s": round(best_first, 4),
+        # >1.0 = the stream's first batch beat the whole one-shot read
+        # (the acceptance bar; asserted hard in tools/servecheck.py)
+        "first_batch_speedup": round(one_shot_s / best_first, 2),
+    }
+    if best_first >= one_shot_s:
+        errors.append(f"first batch at {best_first:.3f}s did NOT beat "
+                      f"the {one_shot_s:.3f}s one-shot read")
+    if errors:  # every failure survives into the JSON, none overwritten
+        result["error"] = "; ".join(errors)
+    _log(f"side metric exp_serve: {result}")
+    return result
+
+
 def _side_metrics(mb_target: float) -> dict:
-    """exp1/exp2/hierarchical profiles as named JSON fields; a side-metric
-    failure must never break the headline bench."""
+    """exp1/exp2/hierarchical/serving profiles as named JSON fields; a
+    side-metric failure must never break the headline bench."""
     side = {}
     try:
         side["exp1"] = run_exp1_side_metric(min(mb_target, 40.0))
@@ -864,6 +956,10 @@ def _side_metrics(mb_target: float) -> dict:
             min(mb_target, 16.0))
     except Exception as exc:
         _log(f"hierarchical side metric failed: {exc}")
+    try:
+        side["exp_serve"] = run_serve_side_metric(min(mb_target, 24.0))
+    except Exception as exc:
+        _log(f"exp_serve side metric failed: {exc}")
     return side
 
 

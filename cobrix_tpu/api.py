@@ -290,6 +290,7 @@ def parse_options(options: Dict[str, object],
         trace_file=opts.get("trace_file", "") or "",
         progress_interval_s=float(
             opts.get("progress_interval_s", "") or 0.5),
+        stream_batch_rows=opts.get_int("stream_batch_rows", 0),
     )
     # recognized keys consumed later by read_cobol — mark used before the
     # pedantic unused-key audit runs
@@ -408,6 +409,10 @@ def _validate_options(opts: Options, params: ReaderParameters,
             f"Invalid 'progress_interval_s' of "
             f"{params.progress_interval_s}; it must be >= 0 "
             "(0 invokes the callback on every completed chunk).")
+    if params.stream_batch_rows < 0:
+        raise ValueError(
+            f"Invalid 'stream_batch_rows' of {params.stream_batch_rows}; "
+            "it must be >= 0 (0 streams one batch per assembled chunk).")
     if params.trace_file:
         # fail BEFORE the scan, not after minutes of decode: the trace is
         # written at read end, so an unwritable destination would
@@ -733,6 +738,7 @@ def read_cobol(path=None,
                copybook_contents=None,
                backend: str = "numpy",
                progress_callback=None,
+               batch_callback=None,
                **options) -> CobolData:
     """Read mainframe file(s) into decoded rows.
 
@@ -745,10 +751,30 @@ def read_cobol(path=None,
     `progress_interval_s` option; the final `done=True` snapshot always
     fires). The `trace_file` option writes a Chrome-trace/Perfetto JSON
     of the whole scan — see the README's Observability section.
+
+    `batch_callback(chunk_index, table)`: optional streaming tap — each
+    assembled per-chunk Arrow table is handed out as soon as it exists.
+    On the pipelined paths (`pipeline_workers` != 0) batches arrive
+    WHILE later chunks are still decoding (chunk completion order;
+    re-order by `chunk_index` if record order matters — indexes come
+    from the scan plan). A chunk that terminally fails under a partial
+    shard policy delivers `(chunk_index, None)` — the gap is permanent;
+    reorder buffers must flush past it instead of waiting (may arrive
+    on a different thread than table deliveries). Other execution paths
+    deliver the per-file/shard tables after the scan, in order. The
+    concatenation of all delivered tables in index order equals
+    `to_arrow()` minus the diagnostics schema metadata. A callback
+    exception aborts the scan under fail_fast (ledgers the chunk under
+    a partial shard policy) — the serving tier relies on that to cancel
+    scans whose client went away.
     """
     if progress_callback is not None and not callable(progress_callback):
         raise ValueError("'progress_callback' must be callable (it "
                          "receives ScanProgress snapshots).")
+    if batch_callback is not None and not callable(batch_callback):
+        raise ValueError("'batch_callback' must be callable (it receives "
+                         "(chunk_index, pyarrow.Table) pairs).")
+    batch_tap = _BatchTap(batch_callback) if batch_callback else None
     # exclusive-source validation before any option is consumed
     # ('copybook'/'copybook_contents' are named parameters and can never
     # reach **options; only 'copybooks' arrives as an option key —
@@ -849,7 +875,8 @@ def read_cobol(path=None,
                 data = _read_cobol_single_host(
                     files, copybook_contents, params, backend, seg_count,
                     parallelism, pipe_workers, use_pipeline, is_var_len,
-                    debug_ignore_file_size, metrics, io_cfg)
+                    debug_ignore_file_size, metrics, io_cfg,
+                    batch_tap=batch_tap)
     except BaseException:
         # a failed scan still flushes its telemetry: the final done=True
         # progress snapshot fires (a progress bar must not freeze) and
@@ -858,7 +885,44 @@ def read_cobol(path=None,
         _abort_obs(obs_ctx, params)
         raise
     _finish_obs(obs_ctx, params, data)
+    if batch_tap is not None and batch_tap.count == 0:
+        # execution paths without an incremental tap (sequential,
+        # threaded shard scan, host backend, multihost) still honor the
+        # streaming contract: the per-result tables go out now, in
+        # record order — the callback sees the same batches, just with
+        # one-shot latency
+        batch_tap.emit_data(data)
     return data
+
+
+class _BatchTap:
+    """Adapter between the engine's `on_batch(index, table)` hook and a
+    user `batch_callback`: counts deliveries so read_cobol knows whether
+    the incremental path already streamed, and provides the whole-result
+    fallback for paths without a mid-scan tap."""
+
+    __slots__ = ("callback", "count")
+
+    def __init__(self, callback):
+        self.callback = callback
+        self.count = 0
+
+    def emit(self, index: int, table) -> None:
+        if table is not None:
+            # failed-chunk signals (table=None) forward but don't count
+            # as deliveries — an all-chunks-failed pipelined scan must
+            # still take the whole-result fallback below
+            self.count += 1
+        self.callback(index, table)
+
+    def emit_data(self, data: "CobolData") -> None:
+        """Per-result tables of a finished read, in record order."""
+        if data._arrow_tables is not None:
+            for i, table in enumerate(data._arrow_tables):
+                self.emit(i, table)
+        elif data._results:
+            for i, result in enumerate(data._results):
+                self.emit(i, result.to_arrow(data.output_schema))
 
 
 def _build_obs_context(params: ReaderParameters, metrics: ReadMetrics,
@@ -930,9 +994,10 @@ def _read_cobol_single_host(files, copybook_contents,
                             is_var_len: bool,
                             debug_ignore_file_size: bool,
                             metrics: ReadMetrics,
-                            io=None) -> "CobolData":
+                            io=None, batch_tap=None) -> "CobolData":
     """The in-process execution paths (sequential, threaded shard scan,
     chunked pipeline) — read_cobol minus option parsing and multihost."""
+    on_batch = batch_tap.emit if batch_tap is not None else None
     results: List[FileResult] = []
     copybook_obj: Optional[Copybook] = None
 
@@ -1000,7 +1065,7 @@ def _read_cobol_single_host(files, copybook_contents,
                 results, failed = pipelined_var_len_scan(
                     reader, shards, params, backend, prefix, schema,
                     pipe_workers, metrics=metrics, retry=retry,
-                    on_retry=on_retry, io=io)
+                    on_retry=on_retry, io=io, on_batch=on_batch)
                 shard_failures.extend(failed)
                 results = [r for r in results if r is not None]
             else:
@@ -1014,7 +1079,7 @@ def _read_cobol_single_host(files, copybook_contents,
             results, failed = pipelined_fixed_scan(
                 reader, files, params, backend, schema, pipe_workers,
                 ignore_file_size=debug_ignore_file_size, metrics=metrics,
-                retry=retry, on_retry=on_retry, io=io)
+                retry=retry, on_retry=on_retry, io=io, on_batch=on_batch)
             shard_failures.extend(failed)
             results = [r for r in results if r is not None]
         else:

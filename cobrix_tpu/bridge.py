@@ -1,159 +1,101 @@
-"""Arrow-IPC bridge: the JVM/Spark integration surface.
+"""Arrow-IPC bridge: the JVM/Spark integration surface (compat shim).
 
 The reference is consumed from Spark as a DataSource
 (`za.co.absa.cobrix.spark.cobol.source.DefaultSource`,
 DefaultSource.scala:36), and BASELINE.json's north star names
 `.option("decoder_backend", "tpu")` on that DataSource as the
-integration shape. This framework is Python/JAX-native; the bridge is
-the minimal viable seam that lets a JVM/Spark (or any Arrow-speaking)
-caller reach the TPU decode service without a JNI build:
+integration shape. The original bridge here was a one-shot
+request/response server that materialized the whole table before
+replying; it is now a thin shim over the streaming serving tier
+(`cobrix_tpu.serve`):
 
-- a threaded TCP server wraps `read_cobol` and answers each request
-  with an Arrow IPC stream (the wire format Spark's `mapInArrow` /
-  `fromArrow` consume natively);
-- requests are one JSON object: `{"files": [...], "options": {...}}` —
-  `options` is exactly the `read_cobol` option surface (the same ~45
-  option names the reference's `CobolParametersParser` accepts);
-- one request maps naturally onto one Spark partition: an executor task
-  asks for its file (or its `file_start_offset`/`maximum_bytes` shard)
-  and streams record batches straight into the task's Arrow buffer.
+* `BridgeServer` IS a `serve.ScanServer` with the bridge's defaults
+  (no HTTP sidecar, permissive quotas) — same `start()`/`stop()`/
+  `address` surface, but record batches stream as chunks decode, a
+  scan failing MID-stream reaches the client as a structured error
+  frame instead of a dead socket, and concurrent callers share the
+  process-wide block/index/plan caches;
+* `read_remote` keeps its signature and one-table return, assembled
+  client-side from the stream — now with connect retry/backoff and a
+  read timeout (RetryPolicy semantics), so a vanished server raises
+  instead of blocking forever.
 
-See `examples/pyspark_bridge.py` for the Spark-side consumer shape.
-
-Wire protocol (deliberately trivial — no Flight dependency in the
-image): request = 4-byte big-endian length + UTF-8 JSON; response =
-1 status byte (`b"A"` Arrow stream follows / `b"E"` 4-byte length +
-JSON error follows), then the payload.
+One Spark partition still maps to one request: an executor task asks
+for its file (or `file_start_offset`/`maximum_bytes` shard) and feeds
+the batches straight into its Arrow buffer — see
+examples/pyspark_bridge.py. New integrations should use
+`cobrix_tpu.serve.stream_scan` directly for incremental consumption,
+tenancy, and progress frames.
 """
 from __future__ import annotations
 
-import json
-import socket
-import socketserver
-import struct
-import threading
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
+
+from .reader.stream import RetryPolicy
+from .serve.admission import TenantQuota
+from .serve.client import fetch_table
+from .serve.protocol import ServeError
+from .serve.server import ScanServer
 
 
-MAX_REQUEST_BYTES = 16 * 1024 * 1024  # requests are small JSON; cap DoS
+class BridgeServer(ScanServer):
+    """Threaded streaming decode service (the Spark-facing endpoint).
+    Usage: `srv = BridgeServer().start()` ... `srv.stop()` — `start()`
+    runs the accept loop in a daemon thread (a bare constructor does
+    NOT serve); `srv.address` is the bound (host, port)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 server_options: Optional[dict] = None):
+        # bridge defaults: one anonymous tenant, generous quota, no
+        # HTTP sidecar — run a full ScanServer for the quota/obs knobs
+        super().__init__(
+            host, port,
+            default_quota=TenantQuota(max_concurrent=16, max_queued=64),
+            max_concurrent_scans=32,
+            server_options=server_options,
+            enable_http=False)
 
 
-def _recv_exact(sock_file, n: int) -> bytes:
-    buf = sock_file.read(n)
-    if buf is None or len(buf) != n:
-        raise ConnectionError("peer closed mid-frame")
-    return buf
-
-
-class _Handler(socketserver.StreamRequestHandler):
-    def handle(self):
-        try:  # any failure -> structured error, never a bare socket close
-            import pyarrow as pa
-
-            from .api import read_cobol
-
-            (length,) = struct.unpack(">I", _recv_exact(self.rfile, 4))
-            if length > MAX_REQUEST_BYTES:
-                raise ValueError(f"request frame of {length} bytes exceeds "
-                                 f"the {MAX_REQUEST_BYTES} byte cap")
-            req = json.loads(_recv_exact(self.rfile, length))
-            files = req["files"]
-            options = dict(req.get("options") or {})
-            table = read_cobol(files if len(files) > 1 else files[0],
-                               **options).to_arrow()
-            # schema probes / previews: cap the rows that cross the wire
-            # (the decode itself runs on this host either way)
-            max_records = req.get("max_records")
-            if max_records is not None:
-                table = table.slice(0, int(max_records))
-        except Exception as exc:
-            payload = json.dumps(
-                {"error": f"{type(exc).__name__}: {exc}"}).encode()
-            try:
-                self.wfile.write(b"E" + struct.pack(">I", len(payload))
-                                 + payload)
-            except OSError:
-                pass  # peer already gone
-            return
-        try:
-            self.wfile.write(b"A")
-            with pa.ipc.new_stream(self.wfile, table.schema) as writer:
-                writer.write_table(table)
-        except OSError:
-            pass  # peer disconnected mid-stream — nothing left to tell it
-
-
-class BridgeServer(socketserver.ThreadingTCPServer):
-    """Threaded Arrow-IPC decode service. Usage:
-    `srv = BridgeServer().start()` ... `srv.stop()` — `start()` runs the
-    accept loop in a daemon thread (a bare constructor or `with` block
-    does NOT serve); `srv.address` is the bound (host, port)."""
-
-    allow_reuse_address = True
-    daemon_threads = True
-
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        super().__init__((host, port), _Handler)
-        self._thread: Optional[threading.Thread] = None
-
-    @property
-    def address(self):
-        return self.server_address
-
-    def start(self) -> "BridgeServer":
-        self._thread = threading.Thread(target=self.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-        return self
-
-    def stop(self) -> None:
-        if self._thread is not None:  # shutdown() deadlocks when
-            self.shutdown()           # serve_forever never ran
-            self._thread.join(timeout=5)
-        self.server_close()
-
-
-def read_remote(address, files: Sequence[str], max_records: Optional[int]
-                = None, **options):
+def read_remote(address: Tuple[str, int], files: Sequence[str],
+                max_records: Optional[int] = None,
+                connect_retry: Optional[RetryPolicy] = None,
+                read_timeout_s: float = 300.0,
+                **options):
     """Client: fetch one decoded Arrow table from a bridge server.
-    `files`: input paths as the SERVER sees them. `max_records`: cap the
-    rows returned (schema probes). Raises RuntimeError with the server's
-    error message on failure."""
-    import pyarrow as pa
 
-    if isinstance(files, str):
-        files = [files]
-    req = json.dumps({"files": list(files), "options": options,
-                      "max_records": max_records}).encode()
-    with socket.create_connection(address) as sock:
-        f = sock.makefile("rwb")
-        f.write(struct.pack(">I", len(req)) + req)
-        f.flush()
-        status = _recv_exact(f, 1)
-        if status == b"E":
-            (length,) = struct.unpack(">I", _recv_exact(f, 4))
-            err = json.loads(_recv_exact(f, length))
-            raise RuntimeError(f"bridge error: {err['error']}")
-        if status != b"A":
-            raise ConnectionError(f"unexpected status byte {status!r}")
-        with pa.ipc.open_stream(f) as reader:
-            return reader.read_all()
+    `files`: input paths as the SERVER sees them. `max_records`: cap
+    the rows returned (schema probes). `connect_retry` follows
+    RetryPolicy semantics (default: 3 attempts with backoff over 10s);
+    `read_timeout_s` bounds every socket read so a dead server raises
+    instead of hanging. Raises RuntimeError with the server's error
+    message on failure (the historical contract — ServeError is a
+    RuntimeError carrying `.code`)."""
+    try:
+        return fetch_table(address, files, max_records=max_records,
+                           connect_retry=connect_retry,
+                           read_timeout_s=read_timeout_s, **options)
+    except ServeError as exc:
+        # historical message shape: tests and callers match on
+        # 'bridge error: ...'
+        raise ServeError(f"bridge error: {exc}", code=exc.code) from exc
 
 
 def main(argv=None) -> None:
     """`python -m cobrix_tpu.bridge [--host H] [--port P]`"""
     import argparse
+    import time
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=8815)
     args = ap.parse_args(argv)
-    srv = BridgeServer(args.host, args.port)
+    srv = BridgeServer(args.host, args.port).start()
     print(f"cobrix_tpu bridge serving on {srv.address}", flush=True)
     try:
-        srv.serve_forever()
+        while True:
+            time.sleep(3600)
     except KeyboardInterrupt:
-        srv.server_close()
+        srv.stop()
 
 
 if __name__ == "__main__":

@@ -129,6 +129,13 @@ class PipelineExecutor:
         # failure_info(index, attempts, reason, error) -> ShardFailureInfo
         self.failure_info = failure_info or _default_failure_info
         self.shard_failures: List[ShardFailureInfo] = []
+        # on_chunk_failed(index): best-effort tap notified when a chunk
+        # terminally fails under the partial policy — streaming
+        # consumers holding later chunks in a reorder buffer need to
+        # know the gap is PERMANENT, or they buffer against it forever
+        # (serve.session.OrderedBatchEmitter). May fire from any stage
+        # thread; exceptions are swallowed (the chunk already failed)
+        self.on_chunk_failed: Optional[Callable] = None
         self.report: dict = {}
         # the read's observability context, captured on the constructing
         # thread (read_cobol activated it there) and re-activated on
@@ -227,6 +234,12 @@ class PipelineExecutor:
                 else:
                     errors.append((i, exc))
                     stop.set()
+            if self.error_policy.is_partial \
+                    and self.on_chunk_failed is not None:
+                try:
+                    self.on_chunk_failed(i)
+                except Exception:
+                    pass  # the chunk is already ledgered
             if tracer is not None:
                 tracer.instant("chunk_failed", "supervision",
                                args={"chunk": i, "reason": reason})
@@ -695,6 +708,30 @@ def _assemble(result, output_schema, stage_times: StageTimes):
     return result
 
 
+def _finalizers(count: int, output_schema, ex: PipelineExecutor,
+                assemble: bool, on_batch):
+    """Per-chunk finalize closures. With `on_batch` set, each assembled
+    chunk's Arrow table is handed out incrementally as
+    `on_batch(chunk_index, table)` — the streaming tap the serving tier
+    rides (first-batch latency instead of whole-table latency). Calls
+    are serialized (one dedicated assembly thread) but arrive in chunk
+    COMPLETION order; consumers that need record order re-order by
+    index (serve.session.OrderedBatchEmitter). An on_batch exception
+    fails the chunk like any assembly error: fail_fast aborts the scan
+    (a dead client must cancel its scan), partial ledgers the chunk."""
+    if not assemble:
+        return [None] * count
+
+    def make(i: int):
+        def finalize(result) -> None:
+            _assemble(result, output_schema, ex.stage_times)
+            if on_batch is not None:
+                on_batch(i, result._arrow_cache)
+        return finalize
+
+    return [make(i) for i in range(count)]
+
+
 def _executor_for(params, workers: int,
                   failure_info: Callable) -> PipelineExecutor:
     """An executor wired with the read's supervision knobs."""
@@ -714,7 +751,8 @@ def pipelined_fixed_scan(reader, files, params, backend: str,
                          retry: Optional[RetryPolicy] = None,
                          on_retry=None,
                          assemble: bool = True,
-                         io=None
+                         io=None,
+                         on_batch=None
                          ) -> Tuple[List["FileResult"],
                                     List[ShardFailureInfo]]:
     """Fixed-length files through the chunk pipeline: record-aligned byte
@@ -723,7 +761,8 @@ def pipelined_fixed_scan(reader, files, params, backend: str,
     sequential `_read_fixed_len_chunked` path (same chunkability rules,
     same per-chunk `read_result` decode). Returns (results, failures);
     a failed chunk under the partial policy leaves a None result slot
-    and a ledger entry."""
+    and a ledger entry. `on_batch(chunk_index, table)` taps each
+    assembled chunk out incrementally (see `_finalizers`)."""
     chunk_bytes = max(1, int(params.pipeline_chunk_mb * 1024 * 1024))
     chunks = plan_fixed_chunks(reader, files, params, chunk_bytes,
                                ignore_file_size, retry, on_retry)
@@ -761,11 +800,15 @@ def pipelined_fixed_scan(reader, files, params, backend: str,
                 stage_times=ex.stage_times)
         return process
 
-    finalize = ((lambda result: _assemble(result, output_schema,
-                                          ex.stage_times))
-                if assemble else None)
-    results = ex.run([(read_fn(c), process_fn(c), finalize)
-                      for c in chunks],
+    finalizers = _finalizers(len(chunks), output_schema, ex, assemble,
+                             on_batch)
+    if assemble and on_batch is not None:
+        # a terminally-failed chunk (partial policy) surfaces to the
+        # batch tap as (index, None): the gap is permanent, streamers
+        # may flush past it
+        ex.on_chunk_failed = lambda i: on_batch(i, None)
+    results = ex.run([(read_fn(c), process_fn(c), fin)
+                      for c, fin in zip(chunks, finalizers)],
                      chunk_meta=[{"bytes": c.nbytes} for c in chunks])
     ex.attach(metrics)
     if metrics is not None:
@@ -779,7 +822,8 @@ def pipelined_var_len_scan(reader, shards, params, backend: str,
                            retry: Optional[RetryPolicy] = None,
                            on_retry=None,
                            assemble: bool = True,
-                           io=None
+                           io=None,
+                           on_batch=None
                            ) -> Tuple[List["FileResult"],
                                       List[ShardFailureInfo]]:
     """Variable-length shards (sparse-index byte ranges) through the
@@ -787,7 +831,8 @@ def pipelined_var_len_scan(reader, shards, params, backend: str,
     (api._scan_var_len), so record framing, Record_Ids, and per-shard
     ledgers match; the pipeline only overlaps stage execution and adds
     the per-shard Arrow assembly stage. Returns (results, failures) like
-    pipelined_fixed_scan."""
+    pipelined_fixed_scan; `on_batch` taps assembled shards out the same
+    way."""
 
     def failure_info(index, attempts, reason, error):
         s = shards[index]
@@ -824,13 +869,15 @@ def pipelined_var_len_scan(reader, shards, params, backend: str,
                 stream.close()
         return process
 
-    finalize = ((lambda result: _assemble(result, output_schema,
-                                          ex.stage_times))
-                if assemble else None)
+    finalizers = _finalizers(len(shards), output_schema, ex, assemble,
+                             on_batch)
+    if assemble and on_batch is not None:
+        ex.on_chunk_failed = lambda i: on_batch(i, None)
     from .chunks import shard_progress_bytes
 
     results = ex.run(
-        [(read_fn(s), process_fn(s), finalize) for s in shards],
+        [(read_fn(s), process_fn(s), fin)
+         for s, fin in zip(shards, finalizers)],
         chunk_meta=[{"bytes": shard_progress_bytes(s)} for s in shards])
     ex.attach(metrics)
     return results, ex.shard_failures

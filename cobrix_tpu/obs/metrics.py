@@ -357,3 +357,56 @@ def scan_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "Bytes fetched from remote storage backends",
             label_names=("source",)),
     }
+
+
+# queue-wait / first-batch latency buckets for the serving tier: finer
+# at the low end than DEFAULT_BUCKETS (an admitted-without-queueing scan
+# waits microseconds) but with the same multi-second tail
+SERVE_WAIT_BUCKETS = (0.0001, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
+                      0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+def serve_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The serving tier's metric set (cobrix_tpu.serve): per-tenant
+    admission counters, streamed volume, and the queue-wait /
+    first-batch histograms. Same idempotent-registration contract as
+    `scan_metrics`, so every ScanServer in the process shares one set
+    and `/metrics` serves the fleet aggregate."""
+    r = registry or _default
+    return {
+        "admitted": r.counter(
+            "cobrix_serve_scans_admitted_total",
+            "Scans admitted past the admission controller, by tenant",
+            label_names=("tenant",)),
+        "rejected": r.counter(
+            "cobrix_serve_scans_rejected_total",
+            "Scans rejected by the admission controller, "
+            "by tenant and reason",
+            label_names=("tenant", "reason")),
+        "completed": r.counter(
+            "cobrix_serve_scans_completed_total",
+            "Streamed scans finished, by tenant and outcome (ok/error)",
+            label_names=("tenant", "outcome")),
+        "active": r.gauge(
+            "cobrix_serve_active_scans",
+            "Scans currently admitted and running"),
+        "queued": r.gauge(
+            "cobrix_serve_queued_scans",
+            "Scans waiting in the fair-share admission queue"),
+        "streamed_bytes": r.counter(
+            "cobrix_serve_streamed_bytes_total",
+            "Arrow IPC bytes streamed to clients, by tenant",
+            label_names=("tenant",)),
+        "streamed_batches": r.counter(
+            "cobrix_serve_streamed_batches_total",
+            "Arrow record batches streamed to clients, by tenant",
+            label_names=("tenant",)),
+        "queue_wait": r.histogram(
+            "cobrix_serve_queue_wait_seconds",
+            "Admission-queue wait per admitted scan",
+            buckets=SERVE_WAIT_BUCKETS),
+        "first_batch": r.histogram(
+            "cobrix_serve_first_batch_seconds",
+            "Time from admission to the first streamed batch",
+            buckets=SERVE_WAIT_BUCKETS),
+    }

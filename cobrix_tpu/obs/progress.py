@@ -43,6 +43,31 @@ class ScanProgress:
                        / self.chunks_total)
         return None
 
+    def as_dict(self) -> dict:
+        """JSON-safe form — the wire shape of a serving-tier progress
+        frame (serve/protocol.py); `from_dict` round-trips it."""
+        return {
+            "bytes_total": self.bytes_total,
+            "bytes_done": self.bytes_done,
+            "records_done": self.records_done,
+            "chunks_total": self.chunks_total,
+            "chunks_done": self.chunks_done,
+            "chunks_failed": self.chunks_failed,
+            "chunks_inflight": self.chunks_inflight,
+            "elapsed_s": self.elapsed_s,
+            "eta_s": self.eta_s,
+            "stage_busy_s": dict(self.stage_busy_s),
+            "done": self.done,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ScanProgress":
+        """Rebuild a snapshot from `as_dict()` output. Unknown keys from
+        a newer server are dropped so mixed client/server versions keep
+        rendering progress instead of raising."""
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
 
 class ProgressTracker:
     """Thread-safe accumulation + throttled callback dispatch.

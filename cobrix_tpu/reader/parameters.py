@@ -165,6 +165,12 @@ class ReaderParameters:
     # minimum seconds between progress_callback invocations (the final
     # done=True snapshot always fires)
     progress_interval_s: float = 0.5
+    # -- streaming delivery (batch_callback / cobrix_tpu.serve) ----------
+    # cap on rows per emitted Arrow record batch when results stream out
+    # incrementally (a serving client shouldn't receive one giant batch
+    # per 16 MB chunk if it renders incrementally). 0 = one batch per
+    # assembled chunk/file table
+    stream_batch_rows: int = 0
 
     def resolved_pipeline_workers(self) -> int:
         """Effective worker count: 0 = sequential, negative = auto."""

@@ -1,0 +1,40 @@
+"""cobrix_tpu.serve — the multi-tenant streaming serving tier.
+
+Turns the library into a deployable service: long-lived scan servers
+streaming Arrow record batches as the pipelined engine assembles them
+(first-batch latency), with per-tenant admission control, weighted fair
+queueing, shared warm cache planes, and `/metrics` + `/healthz`
+endpoints. See the README's "Serving tier" section and
+examples/serving_app.py for the horizontal-scale recipe.
+
+    server:  srv = ScanServer(server_options={"cache_dir": "/cache"})
+             srv.start()
+    client:  for batch in stream_scan(srv.address, "s3://bucket/f.dat",
+                                      copybook_contents=BOOK,
+                                      tenant="etl"): ...
+"""
+from .admission import AdmissionController, AdmissionRejected, TenantQuota
+from .client import ScanStream, connect, fetch_table, stream_scan
+from .flight import flight_available
+from .http import ObsHttpServer
+from .protocol import ProtocolError, ServeError
+from .server import ScanServer
+from .session import OrderedBatchEmitter, ScanRequest, ScanSession
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionRejected",
+    "TenantQuota",
+    "ScanStream",
+    "connect",
+    "fetch_table",
+    "stream_scan",
+    "flight_available",
+    "ObsHttpServer",
+    "ProtocolError",
+    "ServeError",
+    "ScanServer",
+    "OrderedBatchEmitter",
+    "ScanRequest",
+    "ScanSession",
+]

@@ -1,0 +1,4 @@
+"""`python -m cobrix_tpu.serve` — run a scan server from the CLI."""
+from .server import main
+
+main()

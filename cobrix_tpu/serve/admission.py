@@ -1,0 +1,303 @@
+"""Admission control: per-tenant quotas + weighted fair-share queueing.
+
+The workload-management shape of production query services (PAPERS.md:
+"Amazon Redshift re-invented" WLM): a scan is either admitted
+immediately (tenant below its concurrency quota AND the server below
+its global cap), queued (bounded depth, bounded wait), or rejected with
+a structured reason. When capacity frees, the next scan is picked by
+weighted fair share — the waiting tenant with the smallest
+served-work/weight virtual time goes first, so a tenant flooding the
+queue cannot starve the others, and a tenant with weight 2 drains twice
+as fast as one with weight 1.
+
+The second quota dimension is bytes: `max_inflight_bytes` bounds how
+much assembled-but-not-yet-written Arrow data one tenant's scans may
+hold (the streaming reorder buffer + frames being written). Producers
+BLOCK on the byte gate — backpressure, not rejection — and time out
+into a scan error only after `byte_wait_timeout_s` of zero drain (a
+stuck client must not pin server memory forever).
+
+Everything is condition-variable based and deadline-bounded: no wait in
+this module is infinite.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from ..obs.metrics import serve_metrics
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """One tenant's admission limits."""
+
+    # scans this tenant may run concurrently
+    max_concurrent: int = 4
+    # scans this tenant may hold waiting in the admission queue; the
+    # (max_queued + 1)-th concurrent request is REJECTED, not queued
+    max_queued: int = 16
+    # fair-share weight (2.0 drains the queue twice as fast as 1.0)
+    weight: float = 1.0
+    # bytes of assembled Arrow data this tenant's scans may hold
+    # in flight toward clients before producers block (0 = unbounded)
+    max_inflight_bytes: int = 256 * 1024 * 1024
+
+
+class AdmissionRejected(Exception):
+    """Structured admission refusal; `reason` is machine-readable."""
+
+    def __init__(self, tenant: str, reason: str, detail: str):
+        super().__init__(detail)
+        self.tenant = tenant
+        self.reason = reason
+
+
+class _Waiter:
+    __slots__ = ("tenant", "granted", "abandoned")
+
+    def __init__(self, tenant: str):
+        self.tenant = tenant
+        self.granted = False
+        self.abandoned = False
+
+
+class AdmissionController:
+    """Admission decisions for one server process.
+
+    `admit(tenant)` blocks (fairly, up to `queue_timeout_s`) until the
+    scan may run and returns a ticket to pass to `release`; it raises
+    AdmissionRejected when the tenant's queue is full or the wait times
+    out. One controller serves every front-end (TCP, flight) of a
+    ScanServer."""
+
+    def __init__(self, default_quota: Optional[TenantQuota] = None,
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 max_concurrent_scans: int = 16,
+                 queue_timeout_s: float = 30.0,
+                 byte_wait_timeout_s: float = 60.0,
+                 metrics: Optional[dict] = None):
+        self.default_quota = default_quota or TenantQuota()
+        self.quotas = dict(quotas or {})
+        self.max_concurrent_scans = max(1, int(max_concurrent_scans))
+        self.queue_timeout_s = max(0.0, float(queue_timeout_s))
+        self.byte_wait_timeout_s = max(0.0, float(byte_wait_timeout_s))
+        self._m = metrics if metrics is not None else serve_metrics()
+        self._cond = threading.Condition()
+        self._active: Dict[str, int] = {}
+        # per-tenant FIFO of waiters; OrderedDict keeps tenant order
+        # deterministic when virtual times tie
+        self._queues: "OrderedDict[str, deque]" = OrderedDict()
+        # weighted fair share: work served per tenant / weight. New or
+        # returning tenants start at the current floor so an idle spell
+        # doesn't bank unbounded credit
+        self._vtime: Dict[str, float] = {}
+        self._inflight_bytes: Dict[str, int] = {}
+
+    def quota(self, tenant: str) -> TenantQuota:
+        return self.quotas.get(tenant, self.default_quota)
+
+    # -- scan admission --------------------------------------------------
+
+    def admit(self, tenant: str) -> _Waiter:
+        """Block until this scan may run; returns the ticket for
+        `release`. Raises AdmissionRejected (queue_full /
+        queue_timeout) — never hangs past `queue_timeout_s`."""
+        quota = self.quota(tenant)
+        t0 = time.monotonic()
+        with self._cond:
+            if self._can_run_locked(tenant, quota) \
+                    and not self._queues.get(tenant):
+                self._grant_locked(tenant)
+                self._observe_admit(tenant, t0)
+                return _Waiter(tenant)
+            q = self._queues.setdefault(tenant, deque())
+            if len(q) >= quota.max_queued:
+                self._m["rejected"].labels(
+                    tenant=tenant, reason="queue_full").inc()
+                raise AdmissionRejected(
+                    tenant, "queue_full",
+                    f"tenant '{tenant}' already has {quota.max_concurrent}"
+                    f" active scan(s) and {len(q)} queued "
+                    f"(max_queued={quota.max_queued}); retry later")
+            waiter = _Waiter(tenant)
+            q.append(waiter)
+            self._m["queued"].inc()
+            try:
+                deadline = t0 + self.queue_timeout_s
+                while not waiter.granted:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        waiter.abandoned = True
+                        self._remove_waiter_locked(tenant, waiter)
+                        self._prune_vtime_locked(tenant)
+                        self._m["rejected"].labels(
+                            tenant=tenant, reason="queue_timeout").inc()
+                        raise AdmissionRejected(
+                            tenant, "queue_timeout",
+                            f"scan for tenant '{tenant}' waited "
+                            f"{self.queue_timeout_s:.1f}s in the "
+                            "admission queue without a free slot")
+                    self._cond.wait(remaining)
+            finally:
+                self._m["queued"].dec()
+            self._observe_admit(tenant, t0)
+            return waiter
+
+    def release(self, ticket: _Waiter) -> None:
+        with self._cond:
+            tenant = ticket.tenant
+            self._active[tenant] = max(0, self._active.get(tenant, 0) - 1)
+            if not self._active[tenant]:
+                self._active.pop(tenant)
+            self._m["active"].dec()
+            self._wake_next_locked()
+            self._prune_vtime_locked(tenant)
+
+    def _prune_vtime_locked(self, tenant: str) -> None:
+        """Drop a fully-idle tenant's virtual time. Keeping it would (a)
+        grow the dict one entry per tenant name ever seen and (b) make
+        the stale entry the fair-share floor, handing the tenant banked
+        credit when it returns — the opposite of the floor's intent. A
+        returning tenant re-enters at the floor of the tenants actually
+        competing."""
+        if not self._active.get(tenant) and not self._queues.get(tenant):
+            self._vtime.pop(tenant, None)
+
+    def _observe_admit(self, tenant: str, t0: float) -> None:
+        self._m["admitted"].labels(tenant=tenant).inc()
+        self._m["queue_wait"].observe(time.monotonic() - t0)
+
+    def _can_run_locked(self, tenant: str, quota: TenantQuota) -> bool:
+        total = sum(self._active.values())
+        return (total < self.max_concurrent_scans
+                and self._active.get(tenant, 0) < quota.max_concurrent)
+
+    def _grant_locked(self, tenant: str) -> None:
+        self._active[tenant] = self._active.get(tenant, 0) + 1
+        self._m["active"].inc()
+        # fair-share bookkeeping: one admitted scan = 1/weight of
+        # virtual work, floored at the current minimum so returning
+        # tenants don't replay banked idle time
+        weight = max(1e-6, self.quota(tenant).weight)
+        floor = min(self._vtime.values()) if self._vtime else 0.0
+        self._vtime[tenant] = max(self._vtime.get(tenant, floor),
+                                  floor) + 1.0 / weight
+
+    def _remove_waiter_locked(self, tenant: str, waiter: _Waiter) -> None:
+        q = self._queues.get(tenant)
+        if q:
+            try:
+                q.remove(waiter)
+            except ValueError:
+                pass
+            if not q:
+                self._queues.pop(tenant, None)
+
+    def _wake_next_locked(self) -> None:
+        """Grant freed capacity to queued waiters, tenant-fairly: among
+        tenants whose head-of-queue could run, pick the one with the
+        lowest virtual time."""
+        while True:
+            best = None
+            for tenant, q in self._queues.items():
+                if not q:
+                    continue
+                if not self._can_run_locked(tenant, self.quota(tenant)):
+                    continue
+                floor = min(self._vtime.values()) if self._vtime else 0.0
+                vt = self._vtime.get(tenant, floor)
+                if best is None or vt < best[1]:
+                    best = (tenant, vt)
+            if best is None:
+                break
+            tenant = best[0]
+            waiter = self._queues[tenant].popleft()
+            if not self._queues[tenant]:
+                self._queues.pop(tenant, None)
+            if waiter.abandoned:
+                continue
+            waiter.granted = True
+            self._grant_locked(tenant)
+        self._cond.notify_all()
+
+    # -- the in-flight byte gate ----------------------------------------
+
+    def acquire_bytes(self, tenant: str, n: int,
+                      timeout_s: Optional[float] = None) -> None:
+        """Block until `n` more in-flight bytes fit the tenant's budget
+        (backpressure on the assembly stage). A single batch larger
+        than the whole budget is admitted alone rather than deadlocking.
+        Raises TimeoutError after `timeout_s` (default
+        `byte_wait_timeout_s`) without drain — callers that can create
+        drain themselves (OrderedBatchEmitter flushing past a
+        newly-failed chunk) pass short slices and retry."""
+        budget = self.quota(tenant).max_inflight_bytes
+        if budget <= 0 or n <= 0:
+            return
+        wait_s = (self.byte_wait_timeout_s if timeout_s is None
+                  else max(0.0, float(timeout_s)))
+        deadline = time.monotonic() + wait_s
+        last_held = None
+        with self._cond:
+            while True:
+                held = self._inflight_bytes.get(tenant, 0)
+                if held + n <= budget or held == 0:
+                    self._inflight_bytes[tenant] = held + n
+                    return
+                if last_held is not None and held < last_held:
+                    # the client IS draining, just slowly: observed
+                    # progress re-arms the clock — the timeout fires
+                    # only after byte_wait_timeout_s of ZERO drain, as
+                    # documented
+                    deadline = time.monotonic() + wait_s
+                last_held = held
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"tenant '{tenant}' held {held} in-flight bytes "
+                        f"against a {budget} byte budget for "
+                        f"{wait_s:.0f}s without drain "
+                        "(client too slow or gone)")
+                self._cond.wait(min(remaining, 0.5))
+
+    def inflight_bytes(self, tenant: str) -> int:
+        """Current charged bytes — lets slice-waiting callers
+        (OrderedBatchEmitter._acquire_gate) observe drain progress
+        across their own short acquire attempts."""
+        with self._cond:
+            return self._inflight_bytes.get(tenant, 0)
+
+    def release_bytes(self, tenant: str, n: int) -> None:
+        if n <= 0:
+            return
+        with self._cond:
+            held = self._inflight_bytes.get(tenant, 0)
+            held = max(0, held - n)
+            if held:
+                self._inflight_bytes[tenant] = held
+            else:
+                self._inflight_bytes.pop(tenant, None)
+            self._cond.notify_all()
+
+    # -- introspection (healthz) ----------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._cond:
+            tenants = sorted(set(self._active) | set(self._queues)
+                             | set(self._inflight_bytes))
+            return {
+                "active_scans": sum(self._active.values()),
+                "queued_scans": sum(len(q) for q in
+                                    self._queues.values()),
+                "max_concurrent_scans": self.max_concurrent_scans,
+                "tenants": {
+                    t: {"active": self._active.get(t, 0),
+                        "queued": len(self._queues.get(t, ())),
+                        "inflight_bytes":
+                            self._inflight_bytes.get(t, 0)}
+                    for t in tenants},
+            }

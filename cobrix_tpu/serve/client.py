@@ -1,0 +1,270 @@
+"""Streaming client for the scan server.
+
+`stream_scan(...)` is the incremental surface: a `ScanStream` you
+iterate for record batches as the server produces them (first batch
+after one chunk decodes, not after the whole table). `fetch_table(...)`
+is the one-shot convenience the bridge shim rides: iterate to the end,
+concatenate, and re-attach the ReadDiagnostics schema metadata from the
+trailer so the result is byte-identical to an in-process
+`read_cobol(...).to_arrow()`.
+
+Timeouts follow RetryPolicy semantics (reader/stream.py): connect
+attempts retry with exponential backoff + jitter under an overall
+deadline; established-stream reads get a per-read socket timeout so a
+dead server surfaces as an error, never a hang.
+"""
+from __future__ import annotations
+
+import io
+import json
+import socket
+import struct
+import time
+from typing import Callable, Iterator, Optional, Sequence, Tuple
+
+from ..reader.stream import RetryPolicy
+from ..obs.progress import ScanProgress
+from .protocol import (
+    FRAME_DATA,
+    FRAME_ERROR,
+    FRAME_FINAL,
+    FRAME_PROGRESS,
+    FRAME_REQUEST,
+    ProtocolError,
+    ServeError,
+    parse_json,
+    raise_error_frame,
+    read_frame,
+    write_json_frame,
+)
+
+DEFAULT_READ_TIMEOUT_S = 300.0
+
+
+def connect(address: Tuple[str, int],
+            retry: Optional[RetryPolicy] = None,
+            connect_timeout_s: float = 10.0) -> socket.socket:
+    """TCP connect with RetryPolicy backoff (None = 3 attempts over a
+    10s deadline — transient listener restarts behind a balancer
+    should not fail a scan)."""
+    policy = retry or RetryPolicy(max_attempts=3, base_delay=0.1,
+                                  max_delay=2.0, deadline=10.0)
+    attempt = 0
+    t0 = time.monotonic()
+    while True:
+        attempt += 1
+        try:
+            return socket.create_connection(
+                address, timeout=connect_timeout_s)
+        except OSError as exc:
+            elapsed = time.monotonic() - t0
+            if (attempt >= policy.max_attempts
+                    or elapsed >= policy.deadline):
+                raise ConnectionError(
+                    f"could not connect to scan server {address} after "
+                    f"{attempt} attempt(s) over {elapsed:.1f}s: "
+                    f"{exc}") from exc
+            time.sleep(policy.delay(attempt))
+
+
+class _FrameStream(io.RawIOBase):
+    """File-like view over the connection's 'D' payloads, dispatching
+    interleaved control frames: pyarrow's IPC reader pulls record-batch
+    bytes out of this, while progress frames reach the callback and an
+    error frame raises ServeError from whatever read triggered it."""
+
+    def __init__(self, sock_file, on_progress: Optional[Callable]):
+        self._f = sock_file
+        self._on_progress = on_progress
+        self._current = memoryview(b"")
+        self._eos = False
+        self.summary: Optional[dict] = None
+
+    def readable(self) -> bool:
+        return True
+
+    def _next_payload(self) -> bool:
+        """Advance to the next data payload; False at stream end (the
+        'F' trailer was consumed)."""
+        while True:
+            ftype, payload = read_frame(self._f)
+            if ftype == FRAME_DATA:
+                if payload:
+                    self._current = memoryview(payload)
+                    return True
+                continue
+            if ftype == FRAME_PROGRESS:
+                if self._on_progress is not None:
+                    try:
+                        self._on_progress(
+                            ScanProgress.from_dict(parse_json(payload)))
+                    except Exception:
+                        self._on_progress = None  # broken bar, once
+                continue
+            if ftype == FRAME_FINAL:
+                self.summary = parse_json(payload)
+                self._eos = True
+                return False
+            if ftype == FRAME_ERROR:
+                raise_error_frame(parse_json(payload))
+            raise ProtocolError(f"unexpected frame {ftype!r} in stream")
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            raise io.UnsupportedOperation("unbounded read")
+        out = bytearray()
+        while len(out) < n:
+            if not self._current:
+                if self._eos or not self._next_payload():
+                    break
+            take = min(n - len(out), len(self._current))
+            out += self._current[:take]
+            self._current = self._current[take:]
+        return bytes(out)
+
+    def readinto(self, b) -> int:
+        data = self.read(len(b))
+        b[: len(data)] = data
+        return len(data)
+
+    def drain_trailer(self) -> None:
+        """Consume frames after the Arrow end-of-stream marker until
+        the 'F' trailer (pyarrow stops reading at EOS; the trailer
+        frames are still on the wire)."""
+        while not self._eos:
+            if not self._next_payload():
+                break
+
+
+class ScanStream:
+    """One streamed scan: iterate for `pyarrow.RecordBatch`es.
+
+    After exhaustion, `summary` holds the server trailer (rows, bytes,
+    diagnostics JSON, per-scan io/plan-cache metrics). `table()`
+    collects the whole stream — with the diagnostics re-attached — into
+    the one-shot-identical pyarrow Table; call it INSTEAD of iterating
+    (batches are only retained when `table()` drives the stream — plain
+    iteration stays O(one batch) in client memory, which is the point
+    of streaming). `schema` is available once the first batch arrives
+    (or immediately after iteration starts on an empty result)."""
+
+    def __init__(self, sock: socket.socket,
+                 on_progress: Optional[Callable] = None):
+        self._sock = sock
+        self._f = sock.makefile("rb")
+        self._frames = _FrameStream(self._f, on_progress)
+        self._reader = None
+        self._batches: list = []
+        self._collect = False
+        self._streamed_any = False
+        self.schema = None
+
+    @property
+    def summary(self) -> Optional[dict]:
+        return self._frames.summary
+
+    def __iter__(self) -> Iterator:
+        import pyarrow as pa
+
+        if self._reader is None:
+            self._reader = pa.ipc.open_stream(self._frames)
+            self.schema = self._reader.schema
+        while True:
+            try:
+                batch = self._reader.read_next_batch()
+            except StopIteration:
+                break
+            if self._collect:
+                self._batches.append(batch)
+            else:
+                self._streamed_any = True
+            yield batch
+        self._frames.drain_trailer()
+        self.close()
+
+    def table(self):
+        """The full result as one pyarrow Table, diagnostics metadata
+        attached. Collects every batch, so call it up front — a stream
+        already partially consumed by iteration cannot be rebuilt (the
+        yielded batches were deliberately not retained)."""
+        import pyarrow as pa
+
+        if self._streamed_any:
+            raise RuntimeError(
+                "stream already partially consumed by iteration; "
+                "table() must drive the stream from the start "
+                "(iterate OR collect, not both)")
+        self._collect = True
+        for _ in self:
+            pass
+        table = pa.Table.from_batches(self._batches, schema=self.schema)
+        summary = self.summary or {}
+        if summary.get("diagnostics"):
+            metadata = dict(table.schema.metadata or {})
+            metadata[b"cobrix_tpu.read_diagnostics"] = \
+                summary["diagnostics"].encode()
+            table = table.replace_schema_metadata(metadata)
+        return table
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "ScanStream":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def stream_scan(address: Tuple[str, int], files,
+                tenant: str = "default",
+                max_records: Optional[int] = None,
+                progress_callback: Optional[Callable] = None,
+                connect_retry: Optional[RetryPolicy] = None,
+                connect_timeout_s: float = 10.0,
+                read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+                **options) -> ScanStream:
+    """Open one streamed scan against a ScanServer.
+
+    `files`: input path(s) as the SERVER sees them; `options` is the
+    read_cobol option surface (minus server-owned keys). Pass
+    `progress_callback` to receive live `ScanProgress` snapshots (the
+    opt-in progress frames). Returns a ScanStream to iterate."""
+    if isinstance(files, (str, bytes)):
+        files = [files]
+    sock = connect(address, retry=connect_retry,
+                   connect_timeout_s=connect_timeout_s)
+    try:
+        sock.settimeout(read_timeout_s if read_timeout_s
+                        and read_timeout_s > 0 else None)
+        f = sock.makefile("wb")
+        write_json_frame(f, FRAME_REQUEST, {
+            "tenant": tenant,
+            "files": list(files),
+            "options": options,
+            "max_records": max_records,
+            "progress": progress_callback is not None,
+        })
+        f.flush()
+    except BaseException:
+        sock.close()
+        raise
+    return ScanStream(sock, on_progress=progress_callback)
+
+
+def fetch_table(address: Tuple[str, int], files,
+                tenant: str = "default",
+                max_records: Optional[int] = None,
+                **kwargs):
+    """One-shot convenience: stream the scan and return the assembled
+    pyarrow Table (byte-identical to in-process `to_arrow()`)."""
+    with stream_scan(address, files, tenant=tenant,
+                     max_records=max_records, **kwargs) as stream:
+        return stream.table()

@@ -1,0 +1,159 @@
+"""Optional Arrow Flight front-end over the same serving core.
+
+When `pyarrow.flight` is importable (it is an optional pyarrow
+extension — the frame protocol in serve/protocol.py never requires
+it), `FlightScanServer` exposes the identical handler core as a Flight
+service: a `do_get` ticket carries the same JSON request the 'R' frame
+does, admission control and the streaming session are shared (one
+AdmissionController, one metrics registry), and batches stream out as
+a Flight record-batch stream. Standard Flight tooling (`pyarrow.flight
+.connect(...).do_get(...)`) can then consume scans with zero custom
+client code.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from typing import Dict, Optional
+
+from ..obs.metrics import serve_metrics
+from .admission import AdmissionController, AdmissionRejected, TenantQuota
+from .session import ScanRequest, ScanSession
+
+
+def flight_available() -> bool:
+    try:
+        import pyarrow.flight  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+# one sentinel per stream end; exceptions travel as themselves
+_EOS = object()
+
+
+class FlightScanServer:
+    """`FlightScanServer(...)` wraps a pyarrow.flight server around the
+    serving core. Construction raises ImportError when the flight
+    extension is absent — gate with `flight_available()`."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 default_quota: Optional[TenantQuota] = None,
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 max_concurrent_scans: int = 16,
+                 queue_timeout_s: float = 30.0,
+                 server_options: Optional[dict] = None):
+        import pyarrow.flight as flight
+
+        metrics = serve_metrics()
+        controller = AdmissionController(
+            default_quota=default_quota, quotas=quotas,
+            max_concurrent_scans=max_concurrent_scans,
+            queue_timeout_s=queue_timeout_s, metrics=metrics)
+        outer_options = dict(server_options or {})
+
+        class _Server(flight.FlightServerBase):
+            def do_get(self, context, ticket):
+                try:
+                    request = ScanRequest(
+                        json.loads(ticket.ticket.decode()))
+                except Exception as exc:
+                    raise flight.FlightServerError(
+                        f"malformed ticket: {exc}")
+                try:
+                    admission = controller.admit(request.tenant)
+                except AdmissionRejected as exc:
+                    # flight's closest match to the structured 'E'
+                    # rejection frame
+                    raise flight.FlightUnavailableError(
+                        f"rejected ({exc.reason}): {exc}")
+                out: "queue.Queue" = queue.Queue(maxsize=4)
+                # set when the Flight stream stops pulling (client done
+                # or GONE — GeneratorStream closes the generator, its
+                # finally fires): the scan worker must then ABORT, not
+                # block on the full queue forever with the admission
+                # slot held
+                consumer_gone = threading.Event()
+                session = ScanSession(request,
+                                      server_options=outer_options,
+                                      controller=controller)
+
+                def deliver(item) -> None:
+                    while True:
+                        if consumer_gone.is_set():
+                            raise ConnectionError(
+                                "flight peer stopped consuming "
+                                "mid-stream")
+                        try:
+                            out.put(item, timeout=0.5)
+                            return
+                        except queue.Full:
+                            continue
+
+                def run_scan():
+                    try:
+                        session.run(deliver)
+                        deliver(_EOS)
+                    except BaseException as exc:
+                        try:
+                            deliver(exc)
+                        except ConnectionError:
+                            pass  # peer gone — nothing left to tell it
+                    finally:
+                        controller.release(admission)
+
+                worker = threading.Thread(
+                    target=run_scan, name="cobrix-serve-flight-scan",
+                    daemon=True)
+                worker.start()
+                first = out.get()
+                if isinstance(first, BaseException):
+                    consumer_gone.set()
+                    raise flight.FlightServerError(
+                        f"{type(first).__name__}: {first}")
+
+                def batches(first_table):
+                    try:
+                        item = first_table
+                        while item is not _EOS:
+                            if isinstance(item, BaseException):
+                                raise item
+                            for batch in item.to_batches():
+                                yield batch
+                            item = out.get()
+                    finally:
+                        consumer_gone.set()
+
+                if first is _EOS:
+                    schema = session.result_schema
+                    import pyarrow as pa
+
+                    return flight.RecordBatchStream(
+                        pa.Table.from_batches([], schema=schema))
+                return flight.GeneratorStream(first.schema,
+                                              batches(first))
+
+        self._server = _Server(
+            location=f"grpc://{host}:{port}")
+        self.controller = controller
+        self.metrics = metrics
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def start(self) -> "FlightScanServer":
+        self._thread = threading.Thread(target=self._server.serve,
+                                        name="cobrix-serve-flight",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,85 @@
+"""`/metrics` + `/healthz` for a serving process.
+
+A deliberately tiny HTTP sidecar (stdlib http.server, daemon threads)
+bound next to the scan port: `/metrics` serves the process-global
+Prometheus exposition (`obs.metrics.prometheus_text()` — scan totals,
+cache planes, AND the per-tenant serving counters), `/healthz` serves a
+JSON liveness document with the admission controller's live snapshot.
+Scrapers and load balancers hit these without touching the scan
+protocol, so a wedged scan plane still answers health checks.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Tuple
+
+from ..obs.metrics import prometheus_text
+
+
+class ObsHttpServer:
+    """`ObsHttpServer(snapshot_fn).start()` ... `.stop()`; `address` is
+    the bound (host, port)."""
+
+    def __init__(self, snapshot_fn: Optional[Callable[[], dict]] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._t0 = time.monotonic()
+        snapshot = snapshot_fn or (lambda: {})
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    code = 200
+                elif path == "/healthz":
+                    doc = {"status": "ok",
+                           "uptime_s": round(
+                               time.monotonic() - outer._t0, 3)}
+                    try:
+                        doc.update(snapshot())
+                    except Exception as exc:  # health must still answer
+                        doc["status"] = "degraded"
+                        doc["error"] = f"{type(exc).__name__}: {exc}"
+                    body = (json.dumps(doc, sort_keys=True) + "\n") \
+                        .encode()
+                    ctype = "application/json"
+                    code = 200 if doc["status"] == "ok" else 500
+                else:
+                    body = b"not found\n"
+                    ctype = "text/plain"
+                    code = 404
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # scrapes are not news
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address
+
+    def start(self) -> "ObsHttpServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="cobrix-serve-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._server.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._server.server_close()

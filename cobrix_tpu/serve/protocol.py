@@ -1,0 +1,188 @@
+"""The serving tier's wire protocol: length-prefixed typed frames.
+
+Arrow Flight is the shape this protocol mimics (record-batch streams
+with interleaved app metadata, PAPERS.md "Arrow Flight RPC"), without
+requiring the flight extension in the image: every frame is
+
+    1 byte frame type + 4 byte big-endian payload length + payload
+
+so any language with sockets can speak it. Frame types:
+
+    client -> server
+      'R'  request            JSON: {tenant, files, options,
+                                     max_records, progress}
+    server -> client
+      'D'  data               raw Arrow IPC *stream* bytes (the
+                              concatenation of every D payload is one
+                              well-formed IPC stream: schema message,
+                              record batches, end-of-stream marker)
+      'P'  progress           JSON ScanProgress.as_dict() (opt-in via
+                              the request's "progress" flag; throttled
+                              server-side by `progress_interval_s`)
+      'F'  final summary      JSON: {rows, tables, bytes, diagnostics,
+                                     metrics, ...} — the stream's
+                              trailer (serve/session.py builds it);
+                              arrives after the IPC end-of-stream
+      'E'  error              JSON: {error, code} — terminal; the
+                              connection closes after it
+
+A stream therefore ends in exactly one of 'F' (success) or 'E'
+(failure): a scan failing mid-stream surfaces as a structured error,
+never as a peer hanging in a blocking read. Data payloads are split at
+`MAX_DATA_FRAME` so control frames can interleave at bounded latency.
+"""
+from __future__ import annotations
+
+import json
+import struct
+from typing import Optional, Tuple
+
+# requests and control frames are small JSON; cap DoS
+MAX_CONTROL_BYTES = 16 * 1024 * 1024
+# one Arrow IPC fragment per data frame; progress/error frames can slot
+# between fragments of a large chunk
+MAX_DATA_FRAME = 8 * 1024 * 1024
+
+FRAME_REQUEST = b"R"
+FRAME_DATA = b"D"
+FRAME_PROGRESS = b"P"
+FRAME_FINAL = b"F"
+FRAME_ERROR = b"E"
+
+_CONTROL_FRAMES = (FRAME_REQUEST, FRAME_PROGRESS, FRAME_FINAL,
+                   FRAME_ERROR)
+
+
+class ProtocolError(ConnectionError):
+    """The peer sent bytes that are not a well-formed frame."""
+
+
+class ClientGone(ConnectionError):
+    """A frame write failed: the peer vanished mid-stream. Distinct
+    from scan errors — which may themselves be OSErrors (storage
+    faults!) — so the server can tell 'nothing left to tell the client'
+    from 'the client is owed a structured error frame'."""
+
+
+class ServeError(RuntimeError):
+    """A structured server-side error ('E' frame), re-raised client
+    side. `code` classifies it:
+
+    * ``rejected``    — admission control refused the scan (quota /
+                        queue full / queue timeout); retryable later
+    * ``scan_error``  — the scan itself failed (bad options, corrupt
+                        input, storage fault)
+    * ``protocol``    — malformed request
+    """
+
+    def __init__(self, message: str, code: str = "scan_error"):
+        super().__init__(message)
+        self.code = code
+
+
+def read_exact(sock_file, n: int) -> bytes:
+    """Read exactly n bytes or raise (a peer that died mid-frame must
+    surface as an error, not an infinite wait — callers arm socket
+    timeouts for the 'peer alive but silent' case)."""
+    buf = sock_file.read(n)
+    if buf is None or len(buf) != n:
+        raise ConnectionError("peer closed mid-frame")
+    return buf
+
+
+def read_frame(sock_file,
+               max_bytes: int = MAX_CONTROL_BYTES
+               ) -> Tuple[bytes, bytes]:
+    """One (frame_type, payload) off the wire."""
+    header = read_exact(sock_file, 5)
+    ftype = header[:1]
+    (length,) = struct.unpack(">I", header[1:])
+    if ftype not in _CONTROL_FRAMES and ftype != FRAME_DATA:
+        raise ProtocolError(f"unknown frame type {ftype!r}")
+    if length > max_bytes:
+        raise ProtocolError(
+            f"{ftype!r} frame of {length} bytes exceeds the "
+            f"{max_bytes} byte cap")
+    return ftype, read_exact(sock_file, length)
+
+
+def write_frame(sock_file, ftype: bytes, payload: bytes) -> None:
+    sock_file.write(ftype + struct.pack(">I", len(payload)) + payload)
+
+
+def write_json_frame(sock_file, ftype: bytes, obj) -> None:
+    write_frame(sock_file, ftype, json.dumps(obj).encode())
+
+
+def write_data(sock_file, payload: bytes) -> int:
+    """Arrow IPC bytes as one or more 'D' frames; returns frames
+    written."""
+    frames = 0
+    view = memoryview(payload)
+    while True:
+        chunk, view = view[:MAX_DATA_FRAME], view[MAX_DATA_FRAME:]
+        write_frame(sock_file, FRAME_DATA, bytes(chunk))
+        frames += 1
+        if not view:
+            return frames
+
+
+def parse_json(payload: bytes) -> dict:
+    try:
+        obj = json.loads(payload)
+    except ValueError as exc:
+        raise ProtocolError(f"malformed JSON frame: {exc}") from exc
+    if not isinstance(obj, dict):
+        raise ProtocolError("JSON frame payload must be an object")
+    return obj
+
+
+def error_payload(exc: BaseException,
+                  code: str = "scan_error") -> dict:
+    return {"error": f"{type(exc).__name__}: {exc}", "code": code}
+
+
+def raise_error_frame(payload: dict) -> None:
+    """Client side: re-raise an 'E' frame as ServeError."""
+    raise ServeError(str(payload.get("error", "unknown server error")),
+                     code=str(payload.get("code", "scan_error")))
+
+
+class FrameWriter:
+    """Thread-safe frame emission over one connection: progress frames
+    fire from scan stage threads while the assembly thread writes data
+    frames — every frame must hit the wire whole."""
+
+    def __init__(self, sock_file):
+        import threading
+
+        self._f = sock_file
+        self._lock = threading.Lock()
+        self.bytes_written = 0
+
+    def data(self, payload: bytes) -> int:
+        try:
+            with self._lock:
+                frames = write_data(self._f, payload)
+                self._f.flush()
+        except (OSError, ValueError) as exc:  # ValueError: closed wfile
+            raise ClientGone(f"peer gone mid-stream: {exc}") from exc
+        self.bytes_written += len(payload)
+        return frames
+
+    def json(self, ftype: bytes, obj) -> None:
+        try:
+            with self._lock:
+                write_json_frame(self._f, ftype, obj)
+                self._f.flush()
+        except (OSError, ValueError) as exc:
+            raise ClientGone(f"peer gone mid-stream: {exc}") from exc
+
+    def try_json(self, ftype: bytes, obj) -> bool:
+        """Best-effort control frame (progress, or an error to a peer
+        that may already be gone)."""
+        try:
+            self.json(ftype, obj)
+            return True
+        except (OSError, ValueError):
+            return False

@@ -1,0 +1,323 @@
+"""The long-lived multi-tenant scan server.
+
+`ScanServer` is the deployable surface ROADMAP item 3 asks for: a
+threaded TCP front-end speaking the frame protocol (serve/protocol.py),
+an admission controller with per-tenant quotas and weighted fair
+queueing (serve/admission.py), the streaming scan session
+(serve/session.py), and an HTTP sidecar for `/metrics` + `/healthz`
+(serve/http.py). Every scan in the process shares the process-wide
+planes: ONE block cache + sparse-index store per `cache_dir`
+(io.blockcache.shared_block_cache), ONE copybook/field-plan/code-page
+compile cache (plan/cache.py), ONE metrics registry — so tenant B's
+warm scan reuses tenant A's cached blocks and compiled plans.
+
+Horizontal scale is N of these processes sharing one `cache_dir`
+behind any TCP balancer (the caches are cross-process safe —
+examples/serving_app.py is the recipe).
+"""
+from __future__ import annotations
+
+import socketserver
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from ..obs.metrics import serve_metrics
+from .admission import AdmissionController, AdmissionRejected, TenantQuota
+from .http import ObsHttpServer
+from .protocol import (
+    FRAME_ERROR,
+    FRAME_FINAL,
+    FRAME_PROGRESS,
+    FRAME_REQUEST,
+    ClientGone,
+    FrameWriter,
+    ProtocolError,
+    ServeError,
+    error_payload,
+    parse_json,
+    read_frame,
+)
+from .session import ScanRequest, ScanSession
+
+# a connected peer must send its request frame within this window; a
+# half-open socket must not pin a handler thread
+REQUEST_READ_TIMEOUT_S = 30.0
+
+
+class _ArrowFrameSink:
+    """File-like sink pyarrow's IPC writer writes into; bytes forward
+    to the connection as 'D' frames. Buffered per write_table call:
+    the writer emits many small writes (message headers, buffers) per
+    batch — one flush turns them into one-ish wire frame."""
+
+    def __init__(self, writer: FrameWriter, metrics: dict, tenant: str):
+        self._writer = writer
+        self._metrics = metrics
+        self._tenant = tenant
+        self._buf: list = []
+        self.closed = False
+
+    def write(self, data) -> int:
+        self._buf.append(bytes(data))
+        return len(data)
+
+    def flush_frames(self) -> None:
+        if not self._buf:
+            return
+        payload = b"".join(self._buf)
+        self._buf.clear()
+        self._writer.data(payload)
+        self._metrics["streamed_bytes"].labels(
+            tenant=self._tenant).inc(len(payload))
+
+    def flush(self) -> None:  # pyarrow may call it; framing is explicit
+        pass
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class _StreamingTableWriter:
+    """Lazily-opened Arrow IPC stream over the frame sink: the schema
+    message goes out with the first table, each table becomes one or
+    more record batches (`stream_batch_rows` caps rows per batch), and
+    `close()` writes the IPC end-of-stream marker."""
+
+    def __init__(self, sink: _ArrowFrameSink, metrics: dict, tenant: str,
+                 max_chunksize: Optional[int]):
+        self._sink = sink
+        self._metrics = metrics
+        self._tenant = tenant
+        self._max_chunksize = max_chunksize or None
+        self._writer = None
+        self.first_batch_t: Optional[float] = None
+
+    def write_table(self, table) -> None:
+        import pyarrow as pa
+
+        if self._writer is None:
+            self._writer = pa.ipc.new_stream(self._sink, table.schema)
+        if self.first_batch_t is None:
+            self.first_batch_t = time.monotonic()
+        self._writer.write_table(table, max_chunksize=self._max_chunksize)
+        # arithmetic, not a second to_batches() pass over the table the
+        # writer just chunked
+        n = (1 if not self._max_chunksize
+             else max(1, -(-table.num_rows // self._max_chunksize)))
+        self._metrics["streamed_batches"].labels(
+            tenant=self._tenant).inc(n)
+        self._sink.flush_frames()
+
+    def close(self, fallback_schema=None) -> None:
+        """End the IPC stream; a scan that emitted nothing still sends
+        a valid empty stream when the schema is known."""
+        import pyarrow as pa
+
+        if self._writer is None:
+            if fallback_schema is None:
+                return
+            self._writer = pa.ipc.new_stream(self._sink, fallback_schema)
+        self._writer.close()
+        self._sink.flush_frames()
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        server: "ScanServer" = self.server  # type: ignore[assignment]
+        writer = FrameWriter(self.wfile)
+        tenant = "unknown"
+        try:
+            self.connection.settimeout(REQUEST_READ_TIMEOUT_S)
+            ftype, payload = read_frame(self.rfile)
+            if ftype != FRAME_REQUEST:
+                raise ProtocolError(
+                    f"expected a request frame, got {ftype!r}")
+            request = ScanRequest(parse_json(payload))
+            tenant = request.tenant
+            # the scan may legitimately run long between frames, but no
+            # single SEND may block unboundedly: a connected peer that
+            # stops reading would otherwise wedge this handler in a TCP
+            # write forever — admission slot, byte gate, and assembly
+            # thread all pinned. A stalled send times out (an OSError),
+            # becomes ClientGone, and cancels the scan.
+            self.connection.settimeout(server.send_timeout_s or None)
+        except Exception as exc:
+            writer.try_json(FRAME_ERROR, error_payload(exc, "protocol"))
+            return
+        try:
+            ticket = server.controller.admit(tenant)
+        except AdmissionRejected as exc:
+            writer.try_json(FRAME_ERROR, {
+                "error": f"AdmissionRejected: {exc}",
+                "code": "rejected", "reason": exc.reason,
+                "tenant": exc.tenant})
+            return
+        t_admit = time.monotonic()
+        m = server.metrics
+        sink = _ArrowFrameSink(writer, m, tenant)
+        table_writer = _StreamingTableWriter(
+            sink, m, tenant,
+            server.stream_batch_rows(request))
+        outcome = "error"
+        try:
+            session = ScanSession(
+                request, server_options=server.server_options,
+                controller=server.controller,
+                on_progress=(lambda p: writer.try_json(
+                    FRAME_PROGRESS, p.as_dict())))
+            summary = session.run(table_writer.write_table)
+            table_writer.close(fallback_schema=session.result_schema)
+            summary["bytes"] = writer.bytes_written
+            if table_writer.first_batch_t is not None:
+                first = table_writer.first_batch_t - t_admit
+                summary["first_batch_s"] = round(first, 6)
+                m["first_batch"].observe(first)
+            writer.json(FRAME_FINAL, summary)
+            outcome = "ok"
+        except ClientGone:
+            # peer went away mid-stream — the frame write raised inside
+            # the batch callback and cancelled the scan; nothing left to
+            # tell the client. (Only ClientGone means that: a scan can
+            # itself die of an OSError — storage faults are IOErrors —
+            # and those MUST still become an 'E' frame below.)
+            pass
+        except Exception as exc:
+            # scan failure with the peer still connected: a structured
+            # error frame, never a silent close (the pre-serve bridge
+            # left clients blocked in a read here). A ServeError keeps
+            # its own code (request hygiene failures are 'protocol')
+            code = exc.code if isinstance(exc, ServeError) \
+                else "scan_error"
+            writer.try_json(FRAME_ERROR, error_payload(exc, code))
+        finally:
+            server.controller.release(ticket)
+            m["completed"].labels(tenant=tenant, outcome=outcome).inc()
+
+
+class ScanServer(socketserver.ThreadingTCPServer):
+    """Multi-tenant streaming scan service.
+
+    Usage: ``srv = ScanServer(quotas={...}).start()`` ...
+    ``srv.stop()``. `start()` runs the accept loop (and the HTTP obs
+    sidecar) in daemon threads; `srv.address` is the scan endpoint,
+    `srv.http_address` the `/metrics` + `/healthz` endpoint.
+
+    `server_options` are read_cobol options forced onto every scan
+    (e.g. ``{"cache_dir": "/var/cache/cobrix"}`` — the shared-plane
+    pin); client options ride underneath them.
+    """
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 default_quota: Optional[TenantQuota] = None,
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 max_concurrent_scans: int = 16,
+                 queue_timeout_s: float = 30.0,
+                 send_timeout_s: float = 120.0,
+                 server_options: Optional[dict] = None,
+                 http_host: Optional[str] = None, http_port: int = 0,
+                 enable_http: bool = True):
+        super().__init__((host, port), _Handler)
+        # max seconds ONE frame write may block on a non-reading peer
+        # before the scan is cancelled as ClientGone (0 = unbounded)
+        self.send_timeout_s = max(0.0, float(send_timeout_s))
+        self.metrics = serve_metrics()
+        self.controller = AdmissionController(
+            default_quota=default_quota, quotas=quotas,
+            max_concurrent_scans=max_concurrent_scans,
+            queue_timeout_s=queue_timeout_s, metrics=self.metrics)
+        self.server_options = dict(server_options or {})
+        self._http: Optional[ObsHttpServer] = None
+        if enable_http:
+            self._http = ObsHttpServer(
+                snapshot_fn=self.controller.snapshot,
+                host=http_host if http_host is not None else host,
+                port=http_port)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- knobs ----------------------------------------------------------
+
+    def stream_batch_rows(self, request: ScanRequest) -> Optional[int]:
+        """Rows-per-record-batch cap for one request: the client's
+        `stream_batch_rows` option (validated by parse_options during
+        the scan), else the server default (None = per-chunk). Presence
+        check, not truthiness — an explicit client 0 means 'one batch
+        per chunk' and must not fall through to the server default."""
+        raw = request.options.get("stream_batch_rows")
+        if raw is None:
+            raw = self.server_options.get("stream_batch_rows")
+        try:
+            n = int(str(raw)) if raw is not None else 0
+        except ValueError:
+            n = 0
+        return n if n > 0 else None
+
+    # -- lifecycle ------------------------------------------------------
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server_address
+
+    @property
+    def http_address(self) -> Optional[Tuple[str, int]]:
+        return self._http.address if self._http is not None else None
+
+    def start(self) -> "ScanServer":
+        if self._http is not None:
+            self._http.start()
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="cobrix-serve-accept",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:  # shutdown() deadlocks when
+            self.shutdown()           # serve_forever never ran
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.server_close()
+        if self._http is not None:
+            self._http.stop()
+
+
+def main(argv=None) -> None:
+    """``python -m cobrix_tpu.serve [--host H] [--port P] [--http-port P]
+    [--cache-dir DIR] [--max-concurrent N]``"""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="cobrix_tpu multi-tenant streaming scan server")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8816)
+    ap.add_argument("--http-port", type=int, default=8817)
+    ap.add_argument("--cache-dir", default="",
+                    help="shared block/index cache root (pins every "
+                         "scan to one warm plane)")
+    ap.add_argument("--max-concurrent", type=int, default=16)
+    ap.add_argument("--tenant-concurrent", type=int, default=4,
+                    help="default per-tenant concurrent-scan quota")
+    args = ap.parse_args(argv)
+    server_options = ({"cache_dir": args.cache_dir} if args.cache_dir
+                      else None)
+    srv = ScanServer(
+        args.host, args.port,
+        default_quota=TenantQuota(max_concurrent=args.tenant_concurrent),
+        max_concurrent_scans=args.max_concurrent,
+        server_options=server_options,
+        http_port=args.http_port)
+    print(f"cobrix_tpu serving scans on {srv.address}, "
+          f"obs on {srv.http_address}", flush=True)
+    srv.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

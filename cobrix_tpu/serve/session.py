@@ -1,0 +1,284 @@
+"""One streamed scan: request -> read_cobol -> ordered Arrow batches.
+
+`ScanSession` owns everything between a parsed request and the emitted
+record batches, independent of transport (the TCP frame server and the
+optional Flight front-end both drive it):
+
+* option hygiene — client options are the read_cobol option surface,
+  minus the server-owned keys (`trace_file` writes server disk,
+  `hosts` forks server processes); the server's own option overrides
+  (shared `cache_dir`, pipeline defaults) merge on top, so every
+  tenant's scans land on the same process-wide block/index/plan caches;
+* the streaming tap — the scan runs with `batch_callback`, so on the
+  pipelined paths the first batch leaves the server after ONE chunk
+  decodes (first-batch latency), not after the whole table exists;
+* record order — the tap delivers chunks in completion order; the
+  OrderedBatchEmitter re-orders by chunk index so the client's
+  concatenated stream is row-identical to `to_arrow()`;
+* memory bounds — every buffered-or-being-written byte is charged to
+  the tenant's `max_inflight_bytes` via the admission controller's byte
+  gate (backpressure, then a structured timeout — never an unbounded
+  reorder buffer). Keep the byte budget above the pipeline's in-flight
+  window (workers+2 chunks) or the gate can fire on a healthy scan;
+* the trailer — rows/batches/bytes, the ReadDiagnostics ledger JSON
+  (re-attached client-side so streamed tables carry byte-identical
+  schema metadata), and the read's io/plan-cache metrics, so a client
+  can assert warm-cache behavior without server shell access.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .protocol import ServeError
+
+# option keys a client may NOT set: they reach server-local resources
+# (filesystem paths, process topology) that belong to the operator
+SERVER_OWNED_OPTIONS = ("trace_file", "cache_dir", "cache_max_mb",
+                        "hosts")
+
+# streaming wants the pipelined engine (that is where first-batch
+# latency comes from); a request may still override explicitly
+DEFAULT_STREAM_OPTIONS = {"pipeline_workers": "-1"}
+
+
+class ScanRequest:
+    """Validated request payload (the 'R' frame JSON)."""
+
+    def __init__(self, payload: dict):
+        files = payload.get("files")
+        if not files or not isinstance(files, (list, tuple)):
+            raise ServeError("request must carry a non-empty 'files' "
+                             "list", code="protocol")
+        self.files: List[str] = [str(f) for f in files]
+        options = payload.get("options") or {}
+        if not isinstance(options, dict):
+            raise ServeError("'options' must be an object",
+                             code="protocol")
+        self.options: Dict[str, object] = dict(options)
+        self.tenant = str(payload.get("tenant") or "default")
+        max_records = payload.get("max_records")
+        self.max_records: Optional[int] = (None if max_records is None
+                                           else int(max_records))
+        self.want_progress = bool(payload.get("progress"))
+
+    def read_kwargs(self, server_options: Optional[dict]) -> dict:
+        """The effective read_cobol option map: defaults, then client
+        options minus server-owned keys, then the operator's overrides
+        (the operator always wins — that is what pins every tenant to
+        one shared cache_dir)."""
+        kw = dict(DEFAULT_STREAM_OPTIONS)
+        for key, value in self.options.items():
+            if key in SERVER_OWNED_OPTIONS:
+                raise ServeError(
+                    f"option '{key}' is server-owned and cannot be set "
+                    "by a serving client", code="protocol")
+            kw[key] = value
+        kw.update(server_options or {})
+        return kw
+
+
+class OrderedBatchEmitter:
+    """Re-orders the batch tap's (chunk_index, table) stream into chunk
+    order and forwards each table to `write_table`. Table deliveries
+    all arrive on one thread (the pipeline's dedicated assembly thread,
+    or the caller's for the fallback path); `(index, None)`
+    failed-chunk signals may arrive on OTHER threads and mark the index
+    a permanent gap, so buffered later chunks drain instead of pinning
+    the byte gate until the scan ends. Gaps discovered only at scan end
+    are skipped at `finish()` — either way the emitted rows are exactly
+    what `to_arrow()` would return. The byte gate provides cross-scan
+    backpressure."""
+
+    # acquire slice while gap-stalled: long enough to not spin, short
+    # enough to notice a failed-chunk signal promptly
+    _GATE_SLICE_S = 0.5
+
+    def __init__(self, write_table: Callable, tenant: str,
+                 controller=None, max_records: Optional[int] = None):
+        self.write_table = write_table
+        self.tenant = tenant
+        self.controller = controller
+        self.max_records = max_records
+        self.rows_emitted = 0
+        self.tables_emitted = 0
+        self._next = 0
+        self._held: Dict[int, object] = {}
+        self._held_bytes: Dict[int, int] = {}
+        self._done = False
+        # indexes that will NEVER emit (failed chunks, partial policy);
+        # written cross-thread, hence the lock
+        self._skipped = set()
+        self._skip_lock = threading.Lock()
+
+    def emit(self, index: int, table) -> None:
+        if table is None:
+            with self._skip_lock:
+                self._skipped.add(index)
+            # no flush from this (foreign) thread — the assembly
+            # thread's next emit / gate retry / finish() drains
+            return
+        if self._done:
+            return
+        nbytes = int(table.nbytes)
+        if self.controller is not None:
+            self._acquire_gate(nbytes)
+        self._held[index] = table
+        self._held_bytes[index] = nbytes
+        self._flush_ready()
+
+    def _acquire_gate(self, nbytes: int) -> None:
+        """Byte-gate acquire that keeps draining: between short waits,
+        flush anything a newly-signalled failed chunk unblocked (that
+        releases held bytes). Gives up only after the controller's full
+        `byte_wait_timeout_s` passes with zero progress — drained bytes
+        or an advanced gap both re-arm the clock."""
+        window = self.controller.byte_wait_timeout_s
+        t0 = time.monotonic()
+        last_next = self._next
+        last_held = None
+        while True:
+            self._flush_ready()
+            if self._next != last_next:
+                last_next = self._next
+                t0 = time.monotonic()  # gap progress re-arms the clock
+            budget_left = window - (time.monotonic() - t0)
+            try:
+                self.controller.acquire_bytes(
+                    self.tenant, nbytes,
+                    timeout_s=min(self._GATE_SLICE_S,
+                                  max(0.0, budget_left)))
+                return
+            except TimeoutError as exc:
+                held = self.controller.inflight_bytes(self.tenant)
+                if last_held is not None and held < last_held:
+                    t0 = time.monotonic()  # drain progress, same deal
+                last_held = held
+                if window - (time.monotonic() - t0) \
+                        <= self._GATE_SLICE_S:
+                    raise TimeoutError(
+                        f"tenant '{self.tenant}' held {held} in-flight "
+                        f"bytes for {window:.0f}s with no drain and no "
+                        "failed-chunk gap progress (client too slow or "
+                        "gone)") from exc
+
+    def _flush_ready(self) -> None:
+        while True:
+            with self._skip_lock:
+                if self._next in self._skipped:
+                    self._skipped.discard(self._next)
+                    self._next += 1
+                    continue
+            if self._next not in self._held:
+                return
+            index = self._next
+            table = self._held.pop(index)
+            nbytes = self._held_bytes.pop(index)
+            try:
+                self._write_capped(table)
+            finally:
+                if self.controller is not None:
+                    self.controller.release_bytes(self.tenant, nbytes)
+            self._next += 1
+
+    def _write_capped(self, table) -> None:
+        if self._done:
+            return
+        if self.max_records is not None:
+            remaining = self.max_records - self.rows_emitted
+            if remaining <= 0:
+                self._done = True
+                return
+            if table.num_rows > remaining:
+                table = table.slice(0, remaining)
+        if table.num_rows == 0 and self.tables_emitted:
+            return  # empty non-first chunks add nothing to the stream
+        self.rows_emitted += table.num_rows
+        self.tables_emitted += 1
+        self.write_table(table)
+
+    def finish(self) -> None:
+        """Flush what remains, skipping failed-chunk gaps (buffered
+        indexes past a gap emit in ascending order)."""
+        for index in sorted(self._held):
+            table = self._held.pop(index)
+            nbytes = self._held_bytes.pop(index)
+            try:
+                self._write_capped(table)
+            finally:
+                if self.controller is not None:
+                    self.controller.release_bytes(self.tenant, nbytes)
+
+    def abort(self) -> None:
+        """Drop buffered tables and return their bytes to the gate."""
+        self._done = True
+        self._held.clear()
+        if self.controller is not None:
+            for nbytes in self._held_bytes.values():
+                self.controller.release_bytes(self.tenant, nbytes)
+        self._held_bytes.clear()
+
+
+class ScanSession:
+    """Run one admitted request and deliver ordered Arrow tables to
+    `write_table`; returns the summary trailer dict. Transport-neutral:
+    raising from `write_table` aborts the scan (dead client)."""
+
+    def __init__(self, request: ScanRequest,
+                 server_options: Optional[dict] = None,
+                 controller=None,
+                 on_progress: Optional[Callable] = None):
+        self.request = request
+        self.server_options = server_options
+        self.controller = controller
+        self.on_progress = on_progress
+        # the result's Arrow schema (set by run): lets the transport
+        # send a valid EMPTY IPC stream when a scan produced no batches
+        self.result_schema = None
+
+    def run(self, write_table: Callable) -> dict:
+        from ..api import read_cobol
+
+        req = self.request
+        emitter = OrderedBatchEmitter(
+            write_table, req.tenant, controller=self.controller,
+            max_records=req.max_records)
+        kwargs = req.read_kwargs(self.server_options)
+        progress_cb = None
+        if req.want_progress and self.on_progress is not None:
+            progress_cb = self.on_progress
+        t0 = time.monotonic()
+        try:
+            data = read_cobol(req.files if len(req.files) > 1
+                              else req.files[0],
+                              progress_callback=progress_cb,
+                              batch_callback=emitter.emit, **kwargs)
+            emitter.finish()
+        except BaseException:
+            emitter.abort()
+            raise
+        from ..reader.arrow_out import arrow_schema
+
+        self.result_schema = arrow_schema(data.schema)
+        diagnostics = (data.diagnostics.to_json()
+                       if data.diagnostics is not None else None)
+        summary = {
+            "rows": emitter.rows_emitted,
+            "tables": emitter.tables_emitted,
+            "records_total": len(data),
+            "scan_s": round(time.monotonic() - t0, 6),
+            "diagnostics": diagnostics,
+        }
+        if data.metrics is not None:
+            m = data.metrics
+            summary["metrics"] = {
+                "shards": m.shards,
+                "bytes_read": m.bytes_read,
+                "plan_cache": m.plan_cache,
+                "io": m.io,
+                "pipeline": ({"chunks": m.pipeline.get("chunks"),
+                              "overlap": m.pipeline.get("overlap")}
+                             if m.pipeline else None),
+            }
+        return summary

@@ -1,0 +1,646 @@
+"""Serving tier tests (cobrix_tpu.serve): the multi-tenant streaming
+scan server end to end through real sockets.
+
+The matrix: streamed ≡ one-shot parity (rows/schema/diagnostics
+metadata) for fixed and variable-length inputs; concurrent multi-tenant
+scans with quota rejection and tenant isolation; mid-stream server-side
+faults (ChaosSource) surfacing as structured client errors — never a
+hang; warm-cache re-scans proving the shared block/index planes from
+the client-visible trailer; `/metrics` + `/healthz` scrape format; live
+progress frames over the wire; and the bridge shim's client-side
+timeouts. Everything sits under `hard_timeout` so a protocol bug fails
+loud instead of wedging CI.
+"""
+import json
+import os
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.bridge import BridgeServer, read_remote
+from cobrix_tpu.obs.progress import ScanProgress
+from cobrix_tpu.reader.stream import RetryPolicy
+from cobrix_tpu.serve import (
+    AdmissionController,
+    AdmissionRejected,
+    ScanServer,
+    ServeError,
+    TenantQuota,
+    fetch_table,
+    flight_available,
+    stream_scan,
+)
+from cobrix_tpu.testing.faults import register_chaos_backend
+from cobrix_tpu.testing.generators import (
+    EXP1_COPYBOOK,
+    EXP2_COPYBOOK,
+    generate_exp1,
+    generate_exp2,
+)
+
+from util import hard_timeout
+
+# multi-chunk on purpose: ~3 MB of fixed records against a 1 MB chunk
+# size, so streaming yields many batches and first-batch latency is a
+# real fraction of the scan
+FIXED_RECORDS = 20_000
+FIXED_OPTS = dict(copybook_contents=EXP1_COPYBOOK, chunk_size_mb="1",
+                  pipeline_workers="2")
+
+EXP2_OPTS = dict(copybook_contents=EXP2_COPYBOOK, is_record_sequence="true",
+                 segment_field="SEGMENT-ID",
+                 redefine_segment_id_map="STATIC-DETAILS => C",
+                 **{"redefine_segment_id_map:1": "CONTACTS => P"})
+
+
+@pytest.fixture(scope="module")
+def fixed_file():
+    path = tempfile.mktemp(suffix=".dat")
+    with open(path, "wb") as f:
+        f.write(generate_exp1(FIXED_RECORDS, seed=5).tobytes())
+    yield path
+    os.unlink(path)
+
+
+@pytest.fixture(scope="module")
+def vrl_file():
+    path = tempfile.mktemp(suffix=".dat")
+    with open(path, "wb") as f:
+        f.write(generate_exp2(600, seed=11))
+    yield path
+    os.unlink(path)
+
+
+@pytest.fixture()
+def server():
+    srv = ScanServer().start()
+    yield srv
+    srv.stop()
+
+
+def http_get(srv, path):
+    host, port = srv.http_address
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                    timeout=10) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as err:  # non-2xx still has a body
+        return err.code, dict(err.headers), err.read()
+
+
+# -- streamed ≡ one-shot parity ------------------------------------------
+
+
+def test_streamed_matches_one_shot_fixed(server, fixed_file):
+    with hard_timeout(180, "fixed stream parity"):
+        # iterating surface: incremental batches, client memory O(batch)
+        # (batches are NOT retained by the stream — collect our own)
+        batches = []
+        with stream_scan(server.address, fixed_file,
+                         **FIXED_OPTS) as stream:
+            for batch in stream:
+                batches.append(batch)
+            summary = stream.summary
+            assert stream._batches == []  # iterate-only keeps nothing
+            with pytest.raises(RuntimeError, match="already partially"):
+                stream.table()  # iterate OR collect, never both
+        local = read_cobol(fixed_file, **FIXED_OPTS).to_arrow()
+        assert len(batches) > 1  # incremental, not one blob
+        assert sum(b.num_rows for b in batches) == local.num_rows
+        # collecting surface: table() drives a fresh stream
+        with stream_scan(server.address, fixed_file,
+                         **FIXED_OPTS) as stream:
+            remote = stream.table()
+        assert remote.schema == local.schema  # includes field metadata
+        assert remote.schema.metadata == local.schema.metadata
+        assert remote.equals(local)
+        assert summary["rows"] == local.num_rows
+        assert summary["bytes"] > 0
+
+
+def test_streamed_matches_one_shot_var_len(server, vrl_file):
+    with hard_timeout(180, "VRL stream parity"):
+        opts = dict(EXP2_OPTS, pipeline_workers="2")
+        remote = fetch_table(server.address, vrl_file, **opts)
+        local = read_cobol(vrl_file, **opts).to_arrow()
+        assert remote.schema == local.schema
+        assert remote.schema.metadata == local.schema.metadata
+        assert remote.to_pylist() == local.to_pylist()
+
+
+def test_streamed_diagnostics_metadata_round_trips(server, vrl_file):
+    """A scan that ledgers errors ships the ReadDiagnostics JSON in the
+    trailer, and the assembled table carries it byte-identically."""
+    with hard_timeout(180, "diagnostics parity"):
+        # corrupt a copy mid-file so permissive mode ledgers records
+        raw = bytearray(open(vrl_file, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        path = tempfile.mktemp(suffix=".dat")
+        with open(path, "wb") as f:
+            f.write(raw)
+        try:
+            opts = dict(EXP2_OPTS, record_error_policy="permissive")
+            remote = fetch_table(server.address, path, **opts)
+            local = read_cobol(path, **opts).to_arrow()
+            key = b"cobrix_tpu.read_diagnostics"
+            assert remote.schema.metadata.get(key) \
+                == local.schema.metadata.get(key)
+        finally:
+            os.unlink(path)
+
+
+def test_max_records_caps_stream(server, fixed_file):
+    with hard_timeout(120, "max_records"):
+        t = fetch_table(server.address, fixed_file, max_records=7,
+                        **FIXED_OPTS)
+        assert t.num_rows == 7
+
+
+def test_empty_result_is_a_valid_stream(server, fixed_file):
+    with hard_timeout(120, "empty stream"):
+        t = fetch_table(server.address, fixed_file, max_records=0,
+                        **FIXED_OPTS)
+        assert t.num_rows == 0
+        assert len(t.schema) > 0  # schema still travels
+
+
+# -- multi-tenant admission ----------------------------------------------
+
+
+def test_quota_rejection_keeps_other_tenants_running(fixed_file):
+    """Two tenants with quota 1 each: tenant A's second concurrent scan
+    is REJECTED with a structured error while tenant B's scan still
+    completes; stopping the server leaks no threads."""
+    baseline = threading.active_count()
+    srv = ScanServer(
+        default_quota=TenantQuota(max_concurrent=1, max_queued=0)).start()
+    try:
+        with hard_timeout(180, "quota rejection"):
+            first_batch = threading.Event()
+            outcome = {}
+
+            def tenant_a_scan():
+                with stream_scan(srv.address, fixed_file, tenant="a",
+                                 **FIXED_OPTS) as s:
+                    it = iter(s)
+                    next(it)
+                    first_batch.set()
+                    time.sleep(0.8)  # hold the quota slot
+                    for _ in it:
+                        pass
+                    outcome["a1"] = s.summary["rows"]
+
+            holder = threading.Thread(target=tenant_a_scan)
+            holder.start()
+            assert first_batch.wait(60)
+            with pytest.raises(ServeError) as err:
+                fetch_table(srv.address, fixed_file, tenant="a",
+                            **FIXED_OPTS)
+            assert err.value.code == "rejected"
+            assert "retry" in str(err.value)
+            # tenant B is untouched by A's quota exhaustion
+            t = fetch_table(srv.address, fixed_file, tenant="b",
+                            **FIXED_OPTS)
+            assert t.num_rows == FIXED_RECORDS
+            holder.join()
+            assert outcome["a1"] == FIXED_RECORDS
+    finally:
+        srv.stop()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        leftover = [t.name for t in threading.enumerate()
+                    if t.name.startswith("cobrix-serve")]
+        if not leftover and threading.active_count() <= baseline:
+            break
+        time.sleep(0.05)
+    assert not leftover
+    assert threading.active_count() <= baseline
+
+
+def test_admission_weighted_fair_share_drains_heavier_tenant_faster():
+    """Unit-level: with weight 2 vs 1 and one global slot, the heavy
+    tenant's queue drains about twice as fast — its last grant lands
+    before the light tenant's."""
+    with hard_timeout(60, "fair share"):
+        ctl = AdmissionController(
+            quotas={"heavy": TenantQuota(weight=2.0, max_queued=16),
+                    "light": TenantQuota(weight=1.0, max_queued=16)},
+            max_concurrent_scans=1, queue_timeout_s=30.0)
+        hold = ctl.admit("light")
+        order = []
+        lock = threading.Lock()
+
+        def waiter(tenant):
+            ticket = ctl.admit(tenant)
+            with lock:
+                order.append(tenant)
+            ctl.release(ticket)
+
+        threads = []
+        for i in range(4):
+            for tenant in ("heavy", "light"):
+                t = threading.Thread(target=waiter, args=(tenant,))
+                t.start()
+                threads.append(t)
+        time.sleep(0.3)  # everyone queued behind the held slot
+        ctl.release(hold)
+        for t in threads:
+            t.join(30)
+        assert len(order) == 8
+        last_heavy = max(i for i, t in enumerate(order) if t == "heavy")
+        last_light = max(i for i, t in enumerate(order) if t == "light")
+        assert last_heavy < last_light, order
+
+
+def test_admission_queue_timeout_rejects():
+    with hard_timeout(60, "queue timeout"):
+        ctl = AdmissionController(max_concurrent_scans=1,
+                                  queue_timeout_s=0.2)
+        hold = ctl.admit("t")
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejected) as err:
+            ctl.admit("t")
+        assert err.value.reason == "queue_timeout"
+        assert time.monotonic() - t0 < 5.0
+        ctl.release(hold)
+        snap = ctl.snapshot()
+        assert snap["active_scans"] == 0 and snap["queued_scans"] == 0
+
+
+def test_server_owned_options_are_rejected(server, fixed_file):
+    with hard_timeout(60, "server-owned options"):
+        with pytest.raises(ServeError) as err:
+            fetch_table(server.address, fixed_file,
+                        cache_dir="/tmp/evil", **FIXED_OPTS)
+        assert err.value.code == "protocol"
+        assert "server-owned" in str(err.value)
+
+
+# -- faults: structured errors, never hangs ------------------------------
+
+
+def test_mid_stream_fault_surfaces_as_client_error(server, fixed_file):
+    """A storage fault mid-scan (ChaosSource, retries exhausted) must
+    reach the client as a ServeError while iterating — the pre-serve
+    bridge left the peer blocked in a read here."""
+    with hard_timeout(120, "mid-stream fault"):
+        scheme = f"chaos{uuid.uuid4().hex[:8]}"
+        data = open(fixed_file, "rb").read()
+        register_chaos_backend(scheme, data, fail_every=3)
+        with pytest.raises(ServeError) as err:
+            with stream_scan(server.address, f"{scheme}://input",
+                             io_retry_attempts="1",
+                             **FIXED_OPTS) as stream:
+                for _ in stream:
+                    pass
+        assert err.value.code == "scan_error"
+        assert "injected fault" in str(err.value)
+
+
+def test_scan_error_before_first_batch_is_structured(server, fixed_file):
+    with hard_timeout(60, "pre-stream error"):
+        with pytest.raises(ServeError) as err:
+            fetch_table(server.address, fixed_file,
+                        copybook_contents="       01 R.\n"
+                                          "          05 F PIC Q.\n")
+        assert err.value.code == "scan_error"
+        assert "CopybookSyntaxError" in str(err.value)
+        # and the handler survives for the next request
+        t = fetch_table(server.address, fixed_file, max_records=1,
+                        **FIXED_OPTS)
+        assert t.num_rows == 1
+
+
+def test_stalled_server_read_times_out_client_side(server, fixed_file):
+    """A server that produces nothing for longer than the client's read
+    timeout surfaces as an OSError/timeout, not an indefinite block."""
+    with hard_timeout(120, "client read timeout"):
+        scheme = f"slow{uuid.uuid4().hex[:8]}"
+        register_chaos_backend(scheme, open(fixed_file, "rb").read(),
+                               latency_s=2.0)
+        with pytest.raises((OSError, ServeError)):
+            with stream_scan(server.address, f"{scheme}://input",
+                             read_timeout_s=0.5, **FIXED_OPTS) as stream:
+                for _ in stream:
+                    pass
+
+
+def test_bridge_connect_timeout_fails_fast():
+    """read_remote against nothing listening raises promptly under its
+    RetryPolicy instead of hanging (the satellite fix)."""
+    with hard_timeout(60, "bridge connect timeout"):
+        import socket
+
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead = probe.getsockname()
+        probe.close()  # nothing listens here now
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            read_remote(dead, ["/no/such"],
+                        connect_retry=RetryPolicy(max_attempts=2,
+                                                  base_delay=0.05,
+                                                  max_delay=0.1,
+                                                  deadline=2.0))
+        assert time.monotonic() - t0 < 30.0
+
+
+def test_bridge_mid_scan_fault_is_a_bridge_error(fixed_file):
+    """The compat shim keeps the historical 'bridge error: ...' message
+    for scan failures, including MID-stream ones."""
+    with hard_timeout(120, "bridge mid-scan fault"):
+        srv = BridgeServer().start()
+        try:
+            scheme = f"bchaos{uuid.uuid4().hex[:8]}"
+            register_chaos_backend(scheme, open(fixed_file, "rb").read(),
+                                   fail_every=3)
+            with pytest.raises(RuntimeError, match="bridge error"):
+                read_remote(srv.address, [f"{scheme}://input"],
+                            io_retry_attempts="1", **FIXED_OPTS)
+        finally:
+            srv.stop()
+
+
+# -- shared warm planes --------------------------------------------------
+
+
+def test_warm_second_scan_hits_shared_caches(vrl_file, tmp_path):
+    """Scan the same remote VRL file twice through one server pinned to
+    a `cache_dir`: the trailer's io metrics must show the second scan
+    riding the block cache AND the sparse-index store — asserted purely
+    client-side, no server shell access."""
+    fsspec = pytest.importorskip("fsspec")
+    with hard_timeout(180, "warm cache"):
+        bucket = f"/serve{uuid.uuid4().hex[:12]}"
+        fs = fsspec.filesystem("memory")
+        with fs.open(f"{bucket}/data.dat", "wb") as f:
+            f.write(open(vrl_file, "rb").read())
+        url = f"memory:/{bucket}/data.dat"
+        srv = ScanServer(
+            server_options={"cache_dir": str(tmp_path / "cache")}).start()
+        try:
+            def scan_io():
+                with stream_scan(srv.address, url, **EXP2_OPTS) as s:
+                    rows = sum(b.num_rows for b in s)
+                    return rows, s.summary["metrics"]["io"]
+
+            cold_rows, cold_io = scan_io()
+            warm_rows, warm_io = scan_io()
+            assert cold_rows == warm_rows == 600
+            assert cold_io["bytes_fetched"] > 0
+            assert warm_io["bytes_fetched"] == 0  # network never touched
+            assert warm_io["block_hits"] >= 1
+            assert warm_io["index_hits"] >= 1  # no re-index pass
+        finally:
+            srv.stop()
+
+
+# -- observability endpoints + progress frames ---------------------------
+
+
+def test_metrics_and_healthz_scrape(server, fixed_file):
+    with hard_timeout(120, "scrape"):
+        fetch_table(server.address, fixed_file, tenant="scrape-tenant",
+                    max_records=5, **FIXED_OPTS)
+        status, headers, body = http_get(server, "/metrics")
+        text = body.decode()
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert "# HELP cobrix_serve_scans_admitted_total" in text
+        assert "# TYPE cobrix_serve_scans_admitted_total counter" in text
+        assert 'cobrix_serve_scans_admitted_total{' \
+               'tenant="scrape-tenant"}' in text
+        assert 'outcome="ok"' in text
+        assert "cobrix_serve_first_batch_seconds_bucket" in text
+        assert 'cobrix_serve_streamed_bytes_total{' \
+               'tenant="scrape-tenant"}' in text
+
+        status, headers, body = http_get(server, "/healthz")
+        doc = json.loads(body)
+        assert status == 200
+        assert headers["Content-Type"] == "application/json"
+        assert doc["status"] == "ok"
+        assert doc["active_scans"] == 0
+        assert "max_concurrent_scans" in doc
+
+        status, _, _ = http_get(server, "/nope")
+        assert status == 404
+
+
+def test_rejection_metrics_carry_reason(fixed_file):
+    with hard_timeout(120, "rejection metrics"):
+        srv = ScanServer(default_quota=TenantQuota(max_concurrent=1,
+                                                   max_queued=0)).start()
+        try:
+            gate = threading.Event()
+
+            def holder():
+                with stream_scan(srv.address, fixed_file, tenant="q",
+                                 **FIXED_OPTS) as s:
+                    it = iter(s)
+                    next(it)
+                    gate.set()
+                    time.sleep(0.5)
+                    for _ in it:
+                        pass
+
+            t = threading.Thread(target=holder)
+            t.start()
+            assert gate.wait(60)
+            with pytest.raises(ServeError):
+                fetch_table(srv.address, fixed_file, tenant="q",
+                            **FIXED_OPTS)
+            t.join()
+            _, _, body = http_get(srv, "/metrics")
+            assert 'cobrix_serve_scans_rejected_total{tenant="q",' \
+                   'reason="queue_full"}' in body.decode()
+        finally:
+            srv.stop()
+
+
+def test_progress_frames_stream_live(server, fixed_file):
+    """Opt-in progress frames arrive as ScanProgress snapshots: bytes
+    monotonic, a final done=True, all while batches stream."""
+    with hard_timeout(120, "progress frames"):
+        snaps = []
+        with stream_scan(server.address, fixed_file,
+                         progress_callback=snaps.append,
+                         progress_interval_s="0",
+                         **FIXED_OPTS) as stream:
+            batches = sum(1 for _ in stream)
+        assert batches > 1
+        assert snaps, "no progress frames arrived"
+        assert all(isinstance(s, ScanProgress) for s in snaps)
+        done_bytes = [s.bytes_done for s in snaps]
+        assert done_bytes == sorted(done_bytes)
+        assert snaps[-1].done is True
+        assert snaps[-1].chunks_done == snaps[-1].chunks_total > 1
+
+
+def test_progress_frames_absent_unless_requested(server, fixed_file):
+    with hard_timeout(120, "no progress by default"):
+        with stream_scan(server.address, fixed_file, max_records=5,
+                         **FIXED_OPTS) as stream:
+            list(stream)
+            # the trailer parsed cleanly with no progress callback and
+            # no 'P' frames were requested; nothing to assert beyond a
+            # clean summary
+            assert stream.summary["rows"] == 5
+
+
+# -- optional flight front-end -------------------------------------------
+
+
+@pytest.mark.skipif(not flight_available(),
+                    reason="pyarrow.flight not importable")
+def test_flight_front_end_streams_same_rows(fixed_file):
+    import pyarrow.flight as flight
+
+    from cobrix_tpu.serve.flight import FlightScanServer
+
+    with hard_timeout(180, "flight front-end"):
+        srv = FlightScanServer().start()
+        try:
+            client = flight.connect(f"grpc://127.0.0.1:{srv.port}")
+            ticket = flight.Ticket(json.dumps(
+                {"tenant": "fl", "files": [fixed_file],
+                 "options": dict(FIXED_OPTS)}).encode())
+            table = client.do_get(ticket).read_all()
+            local = read_cobol(fixed_file, **FIXED_OPTS).to_arrow()
+            assert table.num_rows == local.num_rows
+            assert table.schema.names == local.schema.names
+            with pytest.raises(flight.FlightError):
+                client.do_get(flight.Ticket(b"not json"))
+        finally:
+            srv.stop()
+
+
+# -- failed-chunk gaps vs the reorder buffer + byte gate ------------------
+
+
+def test_executor_signals_failed_chunk_under_partial():
+    """A terminally-failed chunk (partial policy) fires the executor's
+    on_chunk_failed tap — the signal OrderedBatchEmitter needs to know
+    a gap is permanent."""
+    from cobrix_tpu.engine.pipeline import PipelineExecutor
+    from cobrix_tpu.reader.parameters import ShardErrorPolicy
+
+    def proc(x):
+        if x == 1:
+            raise ValueError("poison chunk 1")
+        return x
+
+    with hard_timeout(60, "failed-chunk signal"):
+        ex = PipelineExecutor(2, error_policy=ShardErrorPolicy.PARTIAL)
+        failed = []
+        ex.on_chunk_failed = failed.append
+        out = ex.run([((lambda i=i: i), proc) for i in range(3)])
+        assert out == [0, None, 2]
+        assert failed == [1]
+
+
+def test_gap_blocked_emitter_drains_on_failed_chunk_signal():
+    """Post-gap tables buffered against the byte gate must drain as
+    soon as the gap is declared permanent — NOT stall out the
+    byte-wait timeout and fail a healthy chunk."""
+    import pyarrow as pa
+
+    from cobrix_tpu.serve.session import OrderedBatchEmitter
+
+    with hard_timeout(60, "gap drain"):
+        t = pa.table({"v": list(range(1000))})  # ~8 KB
+        budget = int(t.nbytes * 2.5)  # fits 2 buffered tables, not 3
+        ctl = AdmissionController(
+            default_quota=TenantQuota(max_inflight_bytes=budget),
+            byte_wait_timeout_s=20.0)
+        written = []
+        em = OrderedBatchEmitter(written.append, "t", controller=ctl)
+        em.emit(0, t)             # flushes straight through
+        em.emit(2, t)             # gap at 1: buffered + charged
+        em.emit(3, t)             # buffered + charged (budget now full)
+
+        blocked_done = threading.Event()
+
+        def emit_blocked():
+            em.emit(4, t)         # over budget: blocks on the gate
+            blocked_done.set()
+
+        worker = threading.Thread(target=emit_blocked, daemon=True)
+        worker.start()
+        time.sleep(0.6)           # let it actually block
+        assert not blocked_done.is_set()
+        t0 = time.monotonic()
+        em.emit(1, None)          # chunk 1 failed: the gap is permanent
+        assert blocked_done.wait(10), \
+            "gate-blocked emit never drained after the failure signal"
+        assert time.monotonic() - t0 < 10  # not the 20s no-drain window
+        em.finish()
+        assert len(written) == 4  # 0,2,3,4 in order; 1 skipped
+        assert ctl.inflight_bytes("t") == 0
+
+
+def test_batch_callback_delivers_none_for_failed_chunks(fixed_file):
+    """read_cobol parity inside ONE partial-policy scan with injected
+    chunk failures: every chunk index arrives exactly once (table or
+    None), and the delivered tables concatenate to that same read's
+    to_arrow()."""
+    import pyarrow as pa
+
+    from cobrix_tpu.reader.stream import (ByteRangeSource,
+                                          register_stream_backend)
+
+    with hard_timeout(180, "partial batch_callback"):
+        payload = open(fixed_file, "rb").read()
+        # permanently poison one byte window inside chunk 1 (1 MB
+        # chunks): every read touching it fails, across retries too, so
+        # exactly that chunk fails terminally under the partial policy
+        poison = (1_200_000, 1_300_000)
+
+        class _PoisonSource(ByteRangeSource):
+            def __init__(self, name):
+                self._name = name
+
+            def size(self):
+                return len(payload)
+
+            def read(self, offset, n):
+                if offset < poison[1] and offset + n > poison[0]:
+                    raise IOError(f"poisoned range {poison}")
+                return payload[offset:offset + n]
+
+            def fingerprint(self):
+                return "poison-fixture"
+
+            @property
+            def name(self):
+                return self._name
+
+        scheme = f"poison{uuid.uuid4().hex[:8]}"
+        register_stream_backend(scheme, _PoisonSource)
+        got = {}
+
+        def on_batch(i, table):
+            got[i] = table
+
+        data = read_cobol(f"{scheme}://input", batch_callback=on_batch,
+                          shard_error_policy="partial",
+                          io_retry_attempts="1", **FIXED_OPTS)
+        table = data.to_arrow()
+        failures = (data.diagnostics.shard_failures
+                    if data.diagnostics else []) or []
+        nones = {i for i, tb in got.items() if tb is None}
+        assert nones, "the poisoned range produced no chunk failure"
+        assert len(nones) == len(failures)
+        # the poisoned window sits inside the failed chunk's byte range
+        assert any(f.offset_from <= 1_200_000 < (f.offset_to
+                   if f.offset_to != -1 else float("inf"))
+                   for f in failures), failures
+        delivered = [got[i] for i in sorted(got) if got[i] is not None]
+        assert pa.concat_tables(delivered).replace_schema_metadata(None) \
+            .equals(table.replace_schema_metadata(None))

@@ -1,0 +1,186 @@
+"""Serving-tier smoke check: stream a scan, prove first-batch latency.
+
+Drives cobrix_tpu.serve end to end in one process — a ScanServer with a
+per-tenant quota, a streaming client, and the observability endpoints:
+
+  1. stream a multi-chunk fixed-length scan and compare against the
+     in-process `read_cobol(...).to_arrow()`: rows, schema, and bytes
+     must be identical;
+  2. time-to-first-batch over the stream MUST be lower than the total
+     one-shot latency (the whole point of streaming: a client renders
+     after one chunk decodes, not after the whole table exists);
+  3. a second concurrent scan over quota must be REJECTED with a
+     structured error while the first still completes;
+  4. scrape `/metrics` (per-tenant serve counters present) and
+     `/healthz` (status ok, admission snapshot).
+
+    python tools/servecheck.py              # quick: ~8 MB input
+    python tools/servecheck.py --mb 64      # bigger input
+    python tools/servecheck.py --sweep      # chunk x workers grid
+                                            # (slow; tier-1 runs quick)
+
+Exit code 0 = parity + latency + quota + scrape all hold; 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _fixed_file(mb: float) -> str:
+    from cobrix_tpu.testing.generators import generate_exp1
+
+    n = max(256, int(mb * 1024 * 1024) // 1493)
+    path = tempfile.mktemp(suffix=".dat")
+    with open(path, "wb") as f:
+        f.write(generate_exp1(n, seed=13).tobytes())
+    return path
+
+
+def check(path: str, chunk_mb: str, workers: str,
+          quota_check: bool = True, scrape: bool = True) -> bool:
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.serve import (ScanServer, ServeError, TenantQuota,
+                                  stream_scan)
+    from cobrix_tpu.testing.generators import EXP1_COPYBOOK
+
+    opts = dict(copybook_contents=EXP1_COPYBOOK, chunk_size_mb=chunk_mb,
+                pipeline_workers=workers)
+    mb = os.path.getsize(path) / (1024 * 1024)
+    ok = True
+
+    def fail(msg: str) -> None:
+        nonlocal ok
+        ok = False
+        print(f"{'':<10} FAILED: {msg}")
+
+    srv = ScanServer(
+        default_quota=TenantQuota(max_concurrent=1, max_queued=0)).start()
+    try:
+        # one-shot latency: the in-process whole-table read. Warm the
+        # copybook/plan compile caches first so the streamed scan (which
+        # shares them in-process) isn't unfairly favored
+        read_cobol(path, **dict(opts, max_records="64"))
+        t0 = time.perf_counter()
+        local = read_cobol(path, **opts).to_arrow()
+        one_shot_s = time.perf_counter() - t0
+
+        # streamed: first batch + total, client-side clock
+        t0 = time.perf_counter()
+        first_batch_s = None
+        batches = rows = 0
+        with stream_scan(srv.address, path, tenant="smoke",
+                         **opts) as stream:
+            for batch in stream:
+                if first_batch_s is None:
+                    first_batch_s = time.perf_counter() - t0
+                batches += 1
+                rows += batch.num_rows
+            summary = stream.summary
+        total_s = time.perf_counter() - t0
+
+        if rows != local.num_rows:
+            fail(f"streamed {rows} rows, one-shot {local.num_rows}")
+        if batches < 2 and mb > 2 * float(chunk_mb):
+            fail(f"only {batches} batch(es) streamed for a "
+                 f"{mb:.1f} MB / {chunk_mb} MB-chunk scan — "
+                 "not incremental")
+        if summary.get("rows") != local.num_rows:
+            fail(f"trailer rows {summary.get('rows')} != {local.num_rows}")
+        if first_batch_s is None or first_batch_s >= one_shot_s:
+            fail(f"first batch took {first_batch_s:.3f}s, NOT below the "
+                 f"{one_shot_s:.3f}s one-shot latency")
+
+        if quota_check:
+            gate = threading.Event()
+
+            def holder():
+                with stream_scan(srv.address, path, tenant="smoke",
+                                 **opts) as s:
+                    it = iter(s)
+                    next(it)
+                    gate.set()
+                    time.sleep(0.4)  # hold the quota slot
+                    for _ in it:
+                        pass
+
+            t = threading.Thread(target=holder)
+            t.start()
+            gate.wait(60)
+            try:
+                with stream_scan(srv.address, path, tenant="smoke",
+                                 **opts) as s:
+                    list(s)
+                fail("over-quota scan was NOT rejected")
+            except ServeError as exc:
+                if exc.code != "rejected":
+                    fail(f"rejection code {exc.code!r} != 'rejected'")
+            t.join()
+
+        if scrape:
+            host, port = srv.http_address
+            text = urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10) \
+                .read().decode()
+            for needle in ("cobrix_serve_scans_admitted_total",
+                           'tenant="smoke"',
+                           "cobrix_serve_first_batch_seconds_bucket",
+                           "cobrix_serve_streamed_bytes_total"):
+                if needle not in text:
+                    fail(f"/metrics missing {needle!r}")
+            health = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=10).read())
+            if health.get("status") != "ok":
+                fail(f"/healthz status {health.get('status')!r}")
+
+        speedup = one_shot_s / first_batch_s if first_batch_s else 0.0
+        print(f"chunk={chunk_mb:>4} workers={workers:>2} | {mb:6.1f} MB"
+              f" | one-shot {one_shot_s:6.3f}s"
+              f" | first batch {first_batch_s:6.3f}s"
+              f" ({speedup:4.1f}x sooner)"
+              f" | stream total {total_s:6.3f}s"
+              f" ({mb / total_s:6.1f} MB/s, {batches} batches)")
+        return ok
+    finally:
+        srv.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mb", type=float, default=8.0,
+                    help="approx input size (MB); needs several chunks")
+    ap.add_argument("--chunk-mb", default="1",
+                    help="chunk_size_mb for the streamed scan")
+    ap.add_argument("--workers", default="2",
+                    help="pipeline_workers for the streamed scan")
+    ap.add_argument("--sweep", action="store_true",
+                    help="chunk-size x worker grid (slow)")
+    args = ap.parse_args()
+
+    path = _fixed_file(args.mb)
+    try:
+        if args.sweep:
+            ok = True
+            for chunk in ("0.5", "1", "4"):
+                for workers in ("1", "2", "-1"):
+                    ok &= check(path, chunk, workers,
+                                quota_check=False, scrape=False)
+        else:
+            ok = check(path, args.chunk_mb, args.workers)
+        print("OK: streamed parity, first-batch latency, quota, scrape"
+              if ok else "FAILED: serving-tier checks diverged")
+        return 0 if ok else 1
+    finally:
+        os.unlink(path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
