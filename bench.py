@@ -34,6 +34,60 @@ def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _calibrate_roofline():
+    """One-time host memory-bandwidth calibration (obs/roofline.py;
+    cached on disk so later rounds and per-read metrics reuse it).
+    Returns bytes/s or None — a failed calibration must never sink the
+    bench."""
+    try:
+        from cobrix_tpu.obs.roofline import measured_bandwidth
+
+        t0 = time.perf_counter()
+        bw = measured_bandwidth()
+        _log(f"roofline: host memory bandwidth {bw / 1e9:.1f} GB/s "
+             f"({time.perf_counter() - t0:.1f}s; cached)")
+        return bw
+    except Exception as exc:
+        _log(f"roofline calibration failed: {exc}")
+        return None
+
+
+def _roofline_field(mbps) -> dict:
+    """{'calibrated_GBps', 'fraction'} anchoring a measured MB/s against
+    the cached calibration — the decode-throughput-law view (arxiv
+    2606.22423): regressions visible as a fraction of the hardware
+    limit, not just MB/s. None when uncalibrated."""
+    try:
+        from cobrix_tpu.obs.roofline import cached_bandwidth
+
+        bw = cached_bandwidth()
+        if not bw or not mbps:
+            return None
+        return {"calibrated_GBps": round(bw / 1e9, 2),
+                "fraction": round(mbps * 1024 * 1024 / bw, 4)}
+    except Exception:
+        return None
+
+
+def _top_fields_profile(path, kw, n=5):
+    """Top-N per-field costs from ONE attribution-enabled read of the
+    same workload (cobrix_tpu.obs.fieldcost). Run SEPARATELY from the
+    timed runs so the headline numbers never carry attribution
+    overhead; the table makes the BENCH trajectory self-describing
+    about WHICH columns the time goes to."""
+    try:
+        from cobrix_tpu import read_cobol
+        from cobrix_tpu.obs.fieldcost import top_fields
+
+        out = read_cobol(path, field_costs="true", **kw)
+        out.to_arrow()
+        costs = out.metrics.field_costs if out.metrics else None
+        return top_fields(costs, n) if costs else None
+    except Exception as exc:
+        _log(f"field-cost profile failed: {exc}")
+        return None
+
+
 def _axon_relay_down():
     """Fast dead-tunnel detection: under the loopback-relay axon setup,
     jax rides local TCP relay ports — when none accept a connection, the
@@ -442,6 +496,7 @@ def run(backend: str, mb_target: float) -> dict:
         "value": round(mbps, 2),
         "unit": "MB/s",
         "vs_baseline": round(mbps / BASELINE_MBPS, 2),
+        "roofline": _roofline_field(mbps),
     }
 
 
@@ -480,20 +535,24 @@ def run_exp3_to_arrow(mb_target: float) -> dict:
                 path, dict(kw, **_pipeline_kw()))
         except Exception as exc:
             _log(f"exp3 pipelined to_arrow failed: {exc}")
+        top = _top_fields_profile(path, kw)
     finally:
         if path:
             os.unlink(path)
     if table is None:
         raise RuntimeError("both exp3 to_arrow variants failed")
     best = min(t for t in (seq_best, pipe_best) if t)
+    mbps = mb / best
     result = {
         "metric": "exp3_multiseg_wide_to_arrow",
-        "value": round(mb / best, 2),
+        "value": round(mbps, 2),
         "unit": "MB/s",
-        "vs_baseline": round(mb / best / BASELINE_MBPS, 2),
+        "vs_baseline": round(mbps / BASELINE_MBPS, 2),
         "rows_per_s": int(table.num_rows / best),
         "pipelined_MBps": (round(mb / pipe_best, 1) if pipe_best else None),
         "sequential_MBps": (round(mb / seq_best, 1) if seq_best else None),
+        "roofline": _roofline_field(mbps),
+        "top_fields": top,
     }
     _log(f"exp3 end-to-end to_arrow: {result}")
     return result
@@ -570,6 +629,7 @@ def run_exp1_side_metric(mb_target: float) -> dict:
         seq_best, _, _ = _best_to_arrow(path, kw)
         pipe_best, table, pipe_metrics = _best_to_arrow(
             path, dict(kw, **_pipeline_kw()))
+        top = _top_fields_profile(path, dict(kw, **_pipeline_kw()))
     finally:
         if path:
             os.unlink(path)
@@ -583,6 +643,8 @@ def run_exp1_side_metric(mb_target: float) -> dict:
         "pipelined_MBps": round(mb / pipe_best, 1),
         "sequential_MBps": round(mb / seq_best, 1),
         "pipeline_on_vs_off": round(seq_best / pipe_best, 2),
+        "roofline": _roofline_field(mb / best),
+        "top_fields": top,
         # the read's FULL structured metrics (timings, stage busy,
         # pipeline overlap, plan_cache) so the perf trajectory carries
         # attributable stage breakdowns, not just headline MB/s
@@ -654,6 +716,7 @@ def run_exp2_side_metric(mb_target: float) -> dict:
                 path, dict(base_kw, **_pipeline_kw()))
         except Exception as exc:
             _log(f"exp2 pipeline variant failed: {exc}")
+        top = _top_fields_profile(path, base_kw)
     finally:
         if path:
             os.unlink(path)
@@ -662,6 +725,8 @@ def run_exp2_side_metric(mb_target: float) -> dict:
         "value": round(mb / best, 1),
         "unit": "MB/s",
         "vs_baseline": round(mb / best / baseline, 1),
+        "roofline": _roofline_field(mb / best),
+        "top_fields": top,
         "with_seg_ids_MBps": (round(mb / with_ids, 1)
                               if with_ids else None),
         "rows_per_s": int(table.num_rows / best),
@@ -712,6 +777,9 @@ def _device_metrics(mb_target: float, platform: str) -> dict:
 def main():
     mb_target = float(os.environ.get("BENCH_MB", "64"))
     backend = os.environ.get("BENCH_BACKEND", "")
+    # anchor every experiment against the machine's memory bandwidth
+    # (one-time; cached across rounds) BEFORE any timing runs
+    _calibrate_roofline()
     if os.environ.get("BENCH_FORCE_CPU"):
         # validation mode: run the jax paths on host CPU (honestly labeled)
         import jax
@@ -841,6 +909,7 @@ def run_hierarchical_side_metric(mb_target: float) -> dict:
         "unit": "MB/s",
         "vs_exp3_bar": round(mb / min(times) / 160.0, 2),  # 20x exp3 bar
         "roots_per_s": int(table.num_rows / min(times)),
+        "roofline": _roofline_field(mb / min(times)),
         "assembly": stats,  # columnar builds vs row-path bails
     }
     _log(f"side metric hierarchical: {result}")
@@ -920,6 +989,7 @@ def run_serve_side_metric(mb_target: float) -> dict:
         "metric": "exp_serve_streamed_to_arrow",
         "value": round(mb / best_total, 1),
         "unit": "MB/s",
+        "roofline": _roofline_field(mb / best_total),
         "rows": rows,
         "batches": batches,
         "one_shot_s": round(one_shot_s, 4),

@@ -7,6 +7,7 @@ columnar data — with the per-record decode loop replaced by batched TPU
 byte-transcoding kernels over `[batch, record_len]` uint8 arrays.
 """
 from .api import CobolData, read_cobol
+from .explain import ScanReport, explain
 from .copybook.copybook import Copybook, merge_copybooks, parse_copybook
 from .reader.diagnostics import (ReadDiagnostics, RecordErrorPolicy,
                                  ShardErrorPolicy, ShardFailureInfo)
@@ -32,6 +33,8 @@ __version__ = "0.1.0"
 __all__ = [
     "CobolData",
     "read_cobol",
+    "ScanReport",
+    "explain",
     "Copybook",
     "parse_copybook",
     "merge_copybooks",

@@ -12,7 +12,11 @@ from __future__ import annotations
 import glob as _glob
 import json
 import os
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import (TYPE_CHECKING, Dict, Iterable, List, Optional,
+                    Sequence, Tuple, Union)
+
+if TYPE_CHECKING:  # explain.py imports api; annotation only
+    from .explain import ScanReport
 
 import numpy as np
 
@@ -291,6 +295,7 @@ def parse_options(options: Dict[str, object],
         progress_interval_s=float(
             opts.get("progress_interval_s", "") or 0.5),
         stream_batch_rows=opts.get_int("stream_batch_rows", 0),
+        field_costs=opts.get_bool("field_costs"),
     )
     # recognized keys consumed later by read_cobol — mark used before the
     # pedantic unused-key audit runs
@@ -557,6 +562,15 @@ class CobolData:
         outputs without row materialization (the reference must feed Spark
         rows, SparkCobolRowType.scala:24; a columnar framework emits
         columns)."""
+        table = self._to_arrow_impl()
+        if (self.metrics is not None
+                and self.metrics.field_costs_acc is not None):
+            # sequential assembly ran after the trace was written; fold
+            # its accrued per-field costs back into the artifact
+            self.metrics.refresh_trace_field_costs()
+        return table
+
+    def _to_arrow_impl(self):
         import pyarrow as pa
 
         from .reader.arrow_out import arrow_schema, rows_to_table
@@ -677,10 +691,14 @@ def _scan_var_len(reader, files, params, backend: str, prefix: str,
     if progress is not None:
         progress.set_plan(chunks_total=len(shards))
     shard_times = None
-    if tracer is not None:
+    if tracer is not None or (metrics is not None
+                              and metrics.field_costs_acc is not None):
         # tracing on: per-stage spans from inside the readers (read /
         # frame / decode) via a tracer-wired StageTimes, published on
-        # the read metrics like the pipelined path's
+        # the read metrics like the pipelined path's. Field-cost
+        # attribution wants the same stage busy breakdown even
+        # untraced — the explain report compares the per-field decode
+        # sum against the decode-stage busy time
         from .profiling import StageTimes
 
         shard_times = StageTimes(tracer=tracer)
@@ -739,7 +757,8 @@ def read_cobol(path=None,
                backend: str = "numpy",
                progress_callback=None,
                batch_callback=None,
-               **options) -> CobolData:
+               explain: bool = False,
+               **options) -> "Union[CobolData, ScanReport]":
     """Read mainframe file(s) into decoded rows.
 
     `copybook` is a path (or list of paths) to copybook file(s);
@@ -767,6 +786,12 @@ def read_cobol(path=None,
     exception aborts the scan under fail_fast (ledgers the chunk under
     a partial shard policy) — the serving tier relies on that to cancel
     scans whose client went away.
+
+    `explain=True` returns a `ScanReport` instead of the bare
+    CobolData: the parsed field plan (offsets/widths/codecs), the
+    execution plan, cache-plane status, and — because it forces the
+    `field_costs` option on — the measured per-field cost table and
+    roofline anchoring. The decoded data rides on `report.data`.
     """
     if progress_callback is not None and not callable(progress_callback):
         raise ValueError("'progress_callback' must be callable (it "
@@ -809,6 +834,11 @@ def read_cobol(path=None,
         raise ValueError("'path' must be specified for read_cobol.")
 
     params, opts = parse_options(options)
+    if explain and not params.field_costs:
+        # explain wants the measured cost table; flip attribution on
+        from dataclasses import replace as _dc_replace
+
+        params = _dc_replace(params, field_costs=True)
     debug_ignore_file_size = opts.get_bool("debug_ignore_file_size")
     # local concurrency for the indexed shard scan (the analogue of the
     # reference's executor count; not a reference option)
@@ -851,6 +881,10 @@ def read_cobol(path=None,
     metrics = ReadMetrics(files=len(files), backend=backend,
                           hosts=max(hosts, 1))
     metrics.bytes_read = _total_input_bytes(files, metrics.io_stats)
+    if params.field_costs:
+        from .obs.fieldcost import FieldCostAccumulator
+
+        metrics.field_costs_acc = FieldCostAccumulator()
     io_cfg = _io_config(params)
 
     # the read's observability context: per-read cache-counter scope
@@ -892,6 +926,11 @@ def read_cobol(path=None,
         # record order — the callback sees the same batches, just with
         # one-shot latency
         batch_tap.emit_data(data)
+    if explain:
+        from .explain import build_scan_report
+
+        return build_scan_report(params, files=files, data=data,
+                                 backend=backend)
     return data
 
 
@@ -949,7 +988,8 @@ def _build_obs_context(params: ReaderParameters, metrics: ReadMetrics,
     return ObsContext(tracer=tracer, metrics=scan_metrics(),
                       progress=progress,
                       cache_scope=metrics.cache_scope,
-                      io_stats=metrics.io_stats)
+                      io_stats=metrics.io_stats,
+                      field_costs=metrics.field_costs_acc)
 
 
 def _finish_obs(obs_ctx, params: ReaderParameters, data) -> None:
@@ -959,6 +999,10 @@ def _finish_obs(obs_ctx, params: ReaderParameters, data) -> None:
     if obs_ctx.progress is not None:
         obs_ctx.progress.finish(records_total=len(data))
     if obs_ctx.tracer is not None and params.trace_file:
+        if data.metrics is not None:
+            # lazy post-read assembly refreshes the artifact with its
+            # accrued field costs (ReadMetrics.refresh_trace_field_costs)
+            data.metrics._trace_file = params.trace_file
         try:
             obs_ctx.tracer.write_chrome_trace(params.trace_file)
         except OSError:
@@ -1000,6 +1044,17 @@ def _read_cobol_single_host(files, copybook_contents,
     on_batch = batch_tap.emit if batch_tap is not None else None
     results: List[FileResult] = []
     copybook_obj: Optional[Copybook] = None
+    # attribution on: give the SEQUENTIAL paths a StageTimes too, so the
+    # per-field decode costs have a decode-stage busy total to anchor
+    # against (pipelined paths attach the executor's own; _scan_var_len
+    # builds its shard-pool one)
+    seq_stage_times = None
+    if (metrics.field_costs_acc is not None and not use_pipeline
+            and not is_var_len and backend != "host"):
+        from .profiling import StageTimes
+
+        seq_stage_times = StageTimes()
+        metrics.stage_busy = seq_stage_times
 
     with stage(metrics, "parse_copybook"):
         if is_var_len:
@@ -1108,7 +1163,7 @@ def _read_cobol_single_host(files, copybook_contents,
                     results.extend(_read_fixed_len_chunked(
                         reader, file_path, params, backend, file_order,
                         base, debug_ignore_file_size, retry, on_retry,
-                        io))
+                        io, stage_times=seq_stage_times))
 
     data = CobolData.from_results(results, schema, parallelism=parallelism)
     data.diagnostics = _aggregate_diagnostics(params, results,
@@ -1163,7 +1218,8 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
                             file_order: int, base_record_id: int,
                             ignore_file_size: bool,
                             retry: Optional[RetryPolicy] = None,
-                            on_retry=None, io=None) -> List["FileResult"]:
+                            on_retry=None, io=None,
+                            stage_times=None) -> List["FileResult"]:
     from .obs.context import current as obs_current
     from .reader.stream import open_stream, source_size
 
@@ -1189,7 +1245,8 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
             _read_file_bytes(file_path, retry, on_retry, io),
             backend=backend,
             file_id=file_order, first_record_id=base_record_id,
-            input_file_name=file_path, ignore_file_size=ignore_file_size),
+            input_file_name=file_path, ignore_file_size=ignore_file_size,
+            stage_times=stage_times),
             size)]
     chunk_bytes = max(rs, (FIXED_READ_CHUNK_BYTES // rs) * rs)
     results: List[FileResult] = []
@@ -1206,7 +1263,8 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
                 data, backend=backend, file_id=file_order,
                 first_record_id=base_record_id + done // rs,
                 input_file_name=file_path,
-                ignore_file_size=ignore_file_size), len(data)))
+                ignore_file_size=ignore_file_size,
+                stage_times=stage_times), len(data)))
             done += len(data)
     return results
 

@@ -26,11 +26,11 @@ import json
 import logging
 import os
 import shutil
-import tempfile
 import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..reader.stream import ByteRangeSource
+from ..utils.atomic import write_atomic
 from .stats import IoStats
 
 _logger = logging.getLogger(__name__)
@@ -155,18 +155,9 @@ class BlockCache:
 
     @staticmethod
     def _write_atomic(path: str, data: bytes) -> None:
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
-                                   prefix=".tmp-")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(data)
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        # no fsync: a lost-on-crash block simply re-fetches; the atomic
+        # rename still guarantees no reader sees a partial block
+        write_atomic(path, data)
 
     # -- LRU budget ------------------------------------------------------
 

@@ -28,10 +28,10 @@ import hashlib
 import json
 import logging
 import os
-import tempfile
 from typing import List, Optional
 
 from ..reader.index import SparseIndexEntry
+from ..utils.atomic import write_atomic
 
 _logger = logging.getLogger(__name__)
 
@@ -122,14 +122,7 @@ class SparseIndexStore:
         }
         path = self._path(url, config_fp)
         try:
-            fd, tmp = tempfile.mkstemp(dir=self.root, prefix=".tmp-")
-            with os.fdopen(fd, "w", encoding="utf-8") as f:
-                json.dump(payload, f)
-            os.replace(tmp, path)
+            write_atomic(path, json.dumps(payload))
         except OSError as exc:
             _logger.warning("sparse-index save failed for %s: %s",
                             url, exc)
-            try:
-                os.unlink(tmp)
-            except (OSError, UnboundLocalError):
-                pass

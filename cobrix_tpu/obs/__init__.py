@@ -15,6 +15,7 @@ chunked pipeline, forked multihost):
 utilization, straggler table).
 """
 from .context import ObsContext, activate, current
+from .fieldcost import FieldCostAccumulator, top_fields
 from .metrics import (
     Counter,
     Gauge,
@@ -25,12 +26,24 @@ from .metrics import (
     scan_metrics,
 )
 from .progress import ProgressTracker, ScanProgress
+from .roofline import (
+    cached_bandwidth,
+    measured_bandwidth,
+    roofline_fraction,
+    roofline_summary,
+)
 from .trace import Tracer, clock_sample, maybe_parent, maybe_span
 
 __all__ = [
     "ObsContext",
     "activate",
     "current",
+    "FieldCostAccumulator",
+    "top_fields",
+    "cached_bandwidth",
+    "measured_bandwidth",
+    "roofline_fraction",
+    "roofline_summary",
     "Counter",
     "Gauge",
     "Histogram",

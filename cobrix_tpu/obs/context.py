@@ -27,15 +27,20 @@ class ObsContext:
     """The read's observability bundle (any member may be None)."""
 
     __slots__ = ("tracer", "metrics", "progress", "cache_scope",
-                 "io_stats")
+                 "io_stats", "field_costs")
 
     def __init__(self, tracer=None, metrics: Optional[dict] = None,
-                 progress=None, cache_scope=None, io_stats=None):
+                 progress=None, cache_scope=None, io_stats=None,
+                 field_costs=None):
         self.tracer = tracer
         self.metrics = metrics      # obs.metrics.scan_metrics() dict
         self.progress = progress    # obs.progress.ProgressTracker
         self.cache_scope = cache_scope  # plan.cache.CacheStatsScope
         self.io_stats = io_stats    # io.stats.IoStats (remote IO planes)
+        # obs.fieldcost.FieldCostAccumulator — per-field/kernel-group
+        # cost attribution; None = attribution off (the zero-cost
+        # default: every timer site gates on this being None)
+        self.field_costs = field_costs
 
 
 def current() -> Optional[ObsContext]:

@@ -356,6 +356,15 @@ def scan_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "cobrix_io_remote_bytes_total",
             "Bytes fetched from remote storage backends",
             label_names=("source",)),
+        # achieved scan bytes/s of the most recent read as a fraction
+        # of the calibrated host memory bandwidth (obs.roofline) — the
+        # decode-throughput-law view: a regression shows as a smaller
+        # fraction of the hardware limit even across machine changes.
+        # Stays 0 until a roofline calibration exists on the machine.
+        "roofline": r.gauge(
+            "cobrix_roofline_fraction",
+            "Last scan's achieved bytes/s over the calibrated host "
+            "memory bandwidth (0 = uncalibrated)"),
     }
 
 

@@ -238,8 +238,12 @@ class Tracer:
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def write_chrome_trace(self, path: str) -> None:
+        """Write the Chrome-trace JSON crash-safely: a process killed
+        ANYWHERE during the write leaves either the old artifact or
+        none — never a truncated/unparseable JSON — and a failed write
+        never leaks its temp file. fsync because a watcher reads this
+        artifact: durability must precede visibility."""
+        from ..utils.atomic import write_atomic
+
         self.finish_root()
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(self.chrome_trace(), f)
-        os.replace(tmp, path)  # atomic: a watcher never reads half a file
+        write_atomic(path, json.dumps(self.chrome_trace()), fsync=True)

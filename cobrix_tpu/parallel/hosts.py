@@ -222,6 +222,7 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
         from ..io.stats import IoStats
         from ..obs.context import ObsContext
         from ..obs.context import activate as obs_activate
+        from ..obs.fieldcost import FieldCostAccumulator
         from ..obs.metrics import MetricsRegistry, scan_metrics
         from ..plan.cache import CacheStatsScope
         from ..profiling import StageTimes
@@ -236,8 +237,13 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
         wm = scan_metrics(MetricsRegistry())
         ws = CacheStatsScope()
         wio = IoStats()
+        # per-field attribution: workers count into a worker-LOCAL
+        # accumulator (fork children cannot write the parent's) and
+        # ship the table home on the result pipe like spans/io/cache
+        wfc = (FieldCostAccumulator()
+               if ctx["reader"].params.field_costs else None)
         wctx = ObsContext(tracer=wt, metrics=wm, cache_scope=ws,
-                          io_stats=wio)
+                          io_stats=wio, field_costs=wfc)
         with obs_activate(wctx):
             if wt is not None:
                 with wt.span("shard", "shard", parent=trace_root,
@@ -253,6 +259,8 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
             "trace": wt.export_state() if wt is not None else None,
             "cache": ws.stats,
             "io": wio.as_dict(),
+            "field_costs": (wfc.as_dict() if wfc is not None
+                            and not wfc.is_zero else None),
             "record_length": wm["record_length"].state(),
         })
 
@@ -332,6 +340,12 @@ def multihost_scan(reader, shards: Sequence[WorkShard], is_var_len: bool,
                 # worker-LOCAL IoStats whether forked or inline, so the
                 # merge is unconditional
                 obs.io_stats.merge(blob["io"])
+            if (obs is not None and obs.field_costs is not None
+                    and blob.get("field_costs")):
+                # worker-local per-field costs fold into the read's
+                # table; duplicate-key shards never reach this point,
+                # so speculation can't double-charge a field
+                obs.field_costs.merge(blob["field_costs"])
         with pa.ipc.open_stream(pa.py_buffer(payload)) as rd:
             table = rd.read_all()
         if progress is not None:

@@ -130,6 +130,72 @@ class FieldPlan:
         return [c for c in self.columns if c.statement is st]
 
     @property
+    def ambiguous_names(self) -> frozenset:
+        """Leaf names used by more than one statement (name reuse across
+        groups is idiomatic COBOL, qualified by OF/IN). Cost attribution
+        must path-qualify these or same-named fields in different groups
+        silently merge into one wrong row."""
+        amb = getattr(self, "_ambiguous_names", None)
+        if amb is None:
+            owner: Dict[str, object] = {}
+            dupes = set()
+            for c in self.columns:
+                prev = owner.setdefault(c.name, c.statement)
+                if prev is not c.statement:
+                    dupes.add(c.name)
+            amb = frozenset(dupes)
+            self._ambiguous_names = amb
+        return amb
+
+    def cost_name(self, c: "ColumnSpec") -> str:
+        """The column's identity in the per-field cost table: the bare
+        name when unique, the dotted path when the name is reused by
+        another statement. OCCURS slots of one statement share both, so
+        they still merge into one row."""
+        if c.name in self.ambiguous_names:
+            return ".".join(c.path + (c.name,))
+        return c.name
+
+    def describe(self) -> List[dict]:
+        """One dict per FIELD (OCCURS slots of a statement collapse to
+        one row carrying the slot count) — the structured form of the
+        explain report's field-plan table: name, dotted path, first
+        byte offset, per-instance width, kernel family, and the decode
+        parameters that select the kernel variant."""
+        rows: List[dict] = []
+        by_field: Dict[int, dict] = {}
+        for c in self.columns:
+            key = id(c.statement) if c.statement is not None else id(c)
+            row = by_field.get(key)
+            if row is not None:
+                row["occurs"] += 1
+                continue
+            p = c.params
+            row = {
+                "field": c.name,
+                "path": ".".join(c.path + (c.name,)),
+                "offset": c.offset,
+                "width": c.width,
+                "codec": c.codec.value,
+                "occurs": 1,
+                "signed": p.signed,
+                "scale": p.scale,
+                "precision": p.precision,
+                "segment": c.segment,
+            }
+            by_field[key] = row
+            rows.append(row)
+        return rows
+
+    def group_summary(self) -> List[dict]:
+        """Kernel-group shape of the plan: one row per (codec, width)
+        launch group with its column count — the launch count the batch
+        decoder pays per chunk."""
+        return [{"codec": g.codec.value, "width": g.width,
+                 "columns": len(g.columns)}
+                for g in self.groups]
+
+    @property
     def max_extent(self) -> int:
         """Largest byte any column reads — the minimum row width a batch
         matrix needs for this plan. Much smaller than record_size when an

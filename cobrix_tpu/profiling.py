@@ -159,6 +159,19 @@ class ReadMetrics:
         # optional obs.Tracer for the read (set by read_cobol when
         # tracing is on); stage() timers double as scan-level spans
         self.tracer = None
+        # per-field/kernel-group cost attribution
+        # (obs.fieldcost.FieldCostAccumulator) — set by read_cobol when
+        # the `field_costs` option (or explain=True) enables it; None
+        # keeps every attribution timer site a no-op. Snapshots are
+        # taken LIVE (field_costs/as_dict), not frozen at finalize:
+        # sequential reads assemble Arrow after the read returns, and
+        # the snapshot must include that work like the pipelined path's
+        self.field_costs_acc = None
+        # root-span args dict + trace destination, kept so lazy
+        # post-read assembly can fold its costs back into an already
+        # written trace artifact (refresh_trace_field_costs)
+        self._trace_root_args = None
+        self._trace_file = ""
 
     def add_timing(self, name: str, seconds: float) -> None:
         """Accumulate wall time for one named stage. Locked: pipelined
@@ -177,13 +190,68 @@ class ReadMetrics:
             self.io["prefetch_utilization"] = round(
                 self.io_stats.prefetch_utilization, 3)
         if self.tracer is not None:
-            self.tracer.finish_root(args={
+            root_args = {
                 "files": self.files, "shards": self.shards,
                 "records": self.records, "bytes": self.bytes_read,
-                "backend": self.backend, "hosts": self.hosts})
+                "backend": self.backend, "hosts": self.hosts}
+            fc = self.field_costs
+            if fc:
+                # the trace artifact carries the cost table too, so
+                # `tools/traceview.py --fields` works on a trace file
+                # alone, no separate metrics dump needed
+                root_args["field_costs"] = fc
+            self.tracer.finish_root(args=root_args)
+            self._trace_root_args = root_args
             self.spans = list(self.tracer.spans)
         self._publish_registry()
         data.metrics = self
+
+    def refresh_trace_field_costs(self) -> None:
+        """Fold the now-complete cost table back into the trace artifact.
+
+        Sequential reads assemble Arrow (and transcode lazy strings)
+        AFTER finalize wrote the trace, so a string-heavy traced read
+        would otherwise ship a trace whose field_costs is missing or
+        missing its assemble plane. Called from `to_arrow` when both
+        attribution and `trace_file` were on: the root-span args dict is
+        shared by reference with the recorded span, so updating it and
+        rewriting (atomic) brings the artifact up to date. No-op for
+        untraced / unattributed reads and safely repeatable."""
+        if (self.tracer is None or not self._trace_file
+                or self._trace_root_args is None):
+            return
+        fc = self.field_costs
+        if not fc:
+            return
+        self._trace_root_args["field_costs"] = fc
+        self.spans = list(self.tracer.spans)
+        try:
+            self.tracer.write_chrome_trace(self._trace_file)
+        except OSError:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "failed to refresh trace_file %r with field costs",
+                self._trace_file, exc_info=True)
+
+    @property
+    def field_costs(self) -> Optional[dict]:
+        """Live per-field cost table ({field -> kernel/decode_s/
+        assemble_s/bytes/values}); None when attribution is off or
+        nothing was attributed yet."""
+        acc = self.field_costs_acc
+        if acc is None or acc.is_zero:
+            return None
+        return acc.as_dict()
+
+    def roofline(self) -> Optional[dict]:
+        """Achieved scan bytes/s anchored to the calibrated host memory
+        bandwidth (obs.roofline); None until both a calibration and a
+        finished 'scan' timing exist. Never triggers a calibration."""
+        from .obs.roofline import roofline_summary
+
+        scan_s = self.timings_s.get("scan", 0.0)
+        return roofline_summary(self.bytes_read, scan_s)
 
     def _publish_registry(self) -> None:
         """Fold this read into the process-global metrics registry
@@ -218,6 +286,9 @@ class ReadMetrics:
         if io.get("bytes_from_cache"):
             m["remote_bytes"].labels(source="cache").inc(
                 io["bytes_from_cache"])
+        roof = self.roofline()
+        if roof is not None:
+            m["roofline"].set(roof["fraction"])
 
     def as_dict(self) -> dict:
         out = {
@@ -239,6 +310,12 @@ class ReadMetrics:
             out["plan_cache"] = self.plan_cache
         if self.io is not None:
             out["io"] = self.io
+        fc = self.field_costs
+        if fc is not None:
+            out["field_costs"] = fc
+        roof = self.roofline()
+        if roof is not None:
+            out["roofline"] = roof
         if self.spans is not None:
             out["span_count"] = len(self.spans)
         return out

@@ -23,6 +23,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from ..copybook.ast import Group, Primitive, Statement
+from ..obs import fieldcost
 from ..plan.compiler import Codec
 from ..copybook.datatypes import SchemaRetentionPolicy, TrimPolicy
 from .columnar import (
@@ -160,6 +161,24 @@ def _string_from_codepoints(mat: np.ndarray, trimming: TrimPolicy):
     return arr
 
 
+_PA_LAZY_WARMED = False
+
+
+def _warm_pa_lazy_imports() -> None:
+    """Trigger pyarrow's lazy pandas-shim import outside any attribution
+    region. The first masked `pa.array` call in a process imports pandas
+    (~0.8s when installed); without this warm-up that one-time cost
+    lands on whichever field happens to assemble first and tops the
+    explain cost table with a lie. Only called when attribution is on —
+    plain reads keep pyarrow's lazy behavior."""
+    global _PA_LAZY_WARMED
+    if _PA_LAZY_WARMED:
+        return
+    _PA_LAZY_WARMED = True
+    pa = _pa()
+    pa.array(np.zeros(1, dtype=np.int64), mask=np.array([True]))
+
+
 class ArrowBatchBuilder:
     """Builds Arrow arrays for one DecodedBatch — either a single active
     segment (`active`), or a decode-once whole-plan batch where
@@ -174,6 +193,17 @@ class ArrowBatchBuilder:
         self.active = active
         self.redefine_masks = redefine_masks
         self.n = batch.n_records
+        # per-field cost attribution (None = off): the per-column
+        # assembly step is timed at column granularity; nested regions
+        # (string transcode / decimal128 group builds triggered inside
+        # a column's build) charge their own time, not the column's.
+        # Taken from the BATCH (captured at decode time), not the obs
+        # context — sequential `to_arrow` runs after the read's context
+        # deactivated, and CobolData's pooled table builds run on
+        # threads that never activated it
+        self.fc = batch.field_costs
+        if self.fc is not None:
+            _warm_pa_lazy_imports()
 
     # -- leaves ------------------------------------------------------------
 
@@ -277,6 +307,19 @@ class ArrowBatchBuilder:
         if col is None:
             return pa.nulls(self.n, type=pa_type)
         spec = self.decoder.plan.columns[col]
+        fc = self.fc
+        if fc is None:
+            return self._leaf_array_impl(st, col, spec, pa_type)
+        tok = fc.begin()
+        arr = self._leaf_array_impl(st, col, spec, pa_type)
+        # seconds only: the field's bytes/values were already counted by
+        # the decode (or string-transcode) call that produced the planes
+        fc.commit(tok, (self.decoder.plan.cost_name(spec),),
+                  fieldcost.PLANE_ASSEMBLE, 0, 0)
+        return arr
+
+    def _leaf_array_impl(self, st: Primitive, col: int, spec, pa_type):
+        pa = _pa()
         # rows where this column is visible: in a decode-once batch a
         # redefine-gated column only matters where its segment is active
         # (elsewhere the parent struct is null and the decoded bytes are
@@ -392,6 +435,18 @@ class ArrowBatchBuilder:
     def _build_decimal_group(self, g) -> dict:
         """{col index -> pa.Array | None} for every decimal-typed column
         of one kernel group, via one decimal128_batch call."""
+        fc = self.fc
+        if fc is None:
+            return self._build_decimal_group_impl(g)
+        tok = fc.begin()
+        entry = self._build_decimal_group_impl(g)
+        plan = self.decoder.plan
+        names = tuple(plan.cost_name(c) for c in g.columns
+                      if c.index in entry) or g.names
+        fc.commit(tok, names, fieldcost.PLANE_ASSEMBLE, 0, 0, g.label)
+        return entry
+
+    def _build_decimal_group_impl(self, g) -> dict:
         from .. import native
 
         pa = _pa()
@@ -613,6 +668,21 @@ class ArrowBatchBuilder:
 
     def _list_array(self, st: Statement, slot_path):
         """OCCURS -> ListArray: element slots interleaved via one take."""
+        fc = self.fc
+        if fc is None:
+            return self._list_array_impl(st, slot_path)
+        tok = fc.begin()
+        arr = self._list_array_impl(st, slot_path)
+        # list glue (offsets, interleave take) charged to the array
+        # field itself; element builds are nested regions with their own
+        # charges — the OCCURS slots share the statement name, so the
+        # whole array still reads as one cost row
+        cols = self.decoder.plan.columns_for(st)
+        name = self.decoder.plan.cost_name(cols[0]) if cols else st.name
+        fc.commit(tok, (name,), fieldcost.PLANE_ASSEMBLE, 0, 0)
+        return arr
+
+    def _list_array_impl(self, st: Statement, slot_path):
         pa = _pa()
         n, max_size = self.n, st.array_max_size
         counts_probe = self._occurs_counts(st)

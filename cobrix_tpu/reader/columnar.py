@@ -38,6 +38,7 @@ from ..copybook.datatypes import (
     Usage,
 )
 from .. import native
+from ..obs import fieldcost
 from ..ops import batch_np
 from ..profiling import annotate
 from ..plan.cache import cached_code_page_lut, cached_compile_plan
@@ -196,12 +197,18 @@ def _masks_equal(a, b) -> bool:
 
 class _KernelGroup:
     def __init__(self, codec: Codec, width: int, variant: tuple,
-                 columns: List[ColumnSpec]):
+                 columns: List[ColumnSpec], names: Tuple[str, ...]):
         self.codec = codec
         self.width = width
         self.variant = variant
         self.columns = columns
         self.offsets = np.array([c.offset for c in columns], dtype=np.int64)
+        # cost-attribution identity: plan-resolved field names (OCCURS
+        # slots repeat a name and merge into one cost row; names reused
+        # across statements arrive path-qualified — FieldPlan.cost_name)
+        # and the kernel-family label shown in the explain table
+        self.names = names
+        self.label = f"{codec.value}/w{width}"
 
     @property
     def wide(self) -> bool:
@@ -319,6 +326,12 @@ class DecodedBatch:
         # file image — the packed `data` matrix then covers only the narrow
         # prefix, so lazy string columns transcode from here instead
         self.raw_source = raw_source
+        # the read's cost accumulator, captured at DECODE time (the obs
+        # context is active here) so lazy work on this batch — string
+        # transcode, Arrow assembly — attributes to the right read even
+        # when it runs after read_cobol returned (sequential to_arrow)
+        # or on a thread pool that never activated the context
+        self.field_costs = fieldcost.current()
 
     # -- vectorized access -------------------------------------------------
 
@@ -333,6 +346,17 @@ class DecodedBatch:
         """Resolve a lazily-deferred string kernel group into the code-point
         ("bytes") matrices the row/value paths consume. Reads never pay this
         when the Arrow path already emitted the column natively."""
+        fc = self.field_costs
+        tok = fc.begin() if fc is not None else None
+        self._materialize_strings_impl(g)
+        if tok is not None:
+            # lazy strings decode at output materialization, so their
+            # cost lands on the assemble plane (keeping the decode plane
+            # comparable to the decode-stage busy time)
+            fc.commit(tok, g.names, fieldcost.PLANE_ASSEMBLE,
+                      self.n_records * g.width, self.n_records, g.label)
+
+    def _materialize_strings_impl(self, g: "_KernelGroup") -> None:
         dec = self.decoder
         if g.codec is Codec.EBCDIC_STRING:
             if self.raw_source is not None:
@@ -406,6 +430,8 @@ class DecodedBatch:
         if not seen:
             return
         gs = list(seen.values())
+        fc = self.field_costs
+        tok = fc.begin() if fc is not None else None
         col_offs = np.concatenate([g.offsets for g in gs])
         widths = np.concatenate(
             [np.full(len(g.offsets), g.width, dtype=np.int64) for g in gs])
@@ -437,6 +463,15 @@ class DecodedBatch:
             self._arrow_str_cache[id(g)] = (group_masks[id(g)],
                                             res[i:i + len(g.offsets)])
             i += len(g.offsets)
+        if tok is not None:
+            # the one-pass transcode+trim covered every lazy group of
+            # this codec: split by bytes touched, like the merged
+            # numeric pass (assemble plane — see _materialize_strings)
+            fc.commit_weighted(
+                tok,
+                [(g.names, g.width, self.n_records * g.width, g.label)
+                 for g in gs],
+                fieldcost.PLANE_ASSEMBLE, self.n_records)
 
     @staticmethod
     def _group_masks(g: "_KernelGroup", relevant_of):
@@ -861,7 +896,8 @@ class ColumnarDecoder:
             key = (c.codec, c.width) + _variant_key(c)
             groups.setdefault(key, []).append(c)
         self.kernel_groups = [
-            _KernelGroup(key[0], key[1], key[2:], cols)
+            _KernelGroup(key[0], key[1], key[2:], cols,
+                         tuple(self.plan.cost_name(c) for c in cols))
             for key, cols in groups.items()]
         # column index -> its kernel group (group-batched Arrow builds)
         self.group_of_col: Dict[int, _KernelGroup] = {
@@ -956,6 +992,7 @@ class ColumnarDecoder:
                                  for k, v in segment_row_masks.items()}
 
         n = len(offs)
+        fc = fieldcost.current()
         outputs: Dict[int, dict] = {}
         narrow_groups = []
         narrow_extent = 1
@@ -967,17 +1004,23 @@ class ColumnarDecoder:
 
         for g in self.kernel_groups:
             res = None
+            tok = None
+            g_rows = n
             gmask = (None if g.codec in _STRING_CODECS
                      else self._group_segment_mask(g, segment_row_masks))
             if g.codec is Codec.BINARY and not g.wide:
                 signed, big_endian, fits32, _ = g.variant
                 goffs, glens = subset(gmask)
+                g_rows = len(goffs)
+                tok = fc.begin() if fc is not None else None
                 res = native.decode_binary_cols_raw(
                     buf, goffs, glens, g.offsets, g.width,
                     signed, big_endian, fits32=fits32)
             elif g.codec is Codec.BCD and not g.wide:
                 fits32, _ = g.variant
                 goffs, glens = subset(gmask)
+                g_rows = len(goffs)
+                tok = fc.begin() if fc is not None else None
                 res = native.decode_bcd_cols_raw(
                     buf, goffs, glens, g.offsets, g.width,
                     fits32=fits32)
@@ -999,7 +1042,14 @@ class ColumnarDecoder:
                 if gmask is not None:
                     res = tuple(_scatter_rows(a, gmask, n) for a in res)
                 self._store_numeric(g, outputs, *res)
+                if tok is not None:
+                    fc.commit(tok, g.names, fieldcost.PLANE_DECODE,
+                              g_rows * g.width, g_rows, g.label)
                 continue
+            if tok is not None:
+                # no native library: the group re-times on the packed
+                # fallback path below, so this region charges nobody
+                fc.discard(tok)
             if gmask is not None and g.codec is not Codec.HOST_FALLBACK:
                 masked_narrow.setdefault(id(gmask), (gmask, []))[1].append(g)
                 continue
@@ -1078,7 +1128,9 @@ class ColumnarDecoder:
         each record's bytes are touched once for the whole numeric plane
         instead of once per kernel group (exp1's type-variety profile has
         59 such groups)."""
-        groups = self._run_groups_merged(groups, arr, outputs)
+        fc = fieldcost.current()
+        groups = self._run_groups_merged(groups, arr, outputs, fc)
+        n = arr.shape[0]
         for g in groups:
             if g.codec is Codec.HOST_FALLBACK:
                 continue
@@ -1090,13 +1142,20 @@ class ColumnarDecoder:
                 for pos, c in enumerate(g.columns):
                     outputs[c.index] = {"lazy_string": (g, pos)}
                 continue
-            if self._run_group_native(g, arr, outputs):
-                continue
-            slab = arr[:, g.offsets[:, None] + np.arange(g.width)[None, :]]
-            self._run_group_numpy(g, slab, outputs)
+            # attribution: one timed region per kernel-group launch
+            # (native single-pass or gather + numpy), split across the
+            # group's columns — call-granularity, never per record
+            tok = fc.begin() if fc is not None else None
+            if not self._run_group_native(g, arr, outputs):
+                slab = arr[:, g.offsets[:, None]
+                           + np.arange(g.width)[None, :]]
+                self._run_group_numpy(g, slab, outputs)
+            if tok is not None:
+                fc.commit(tok, g.names, fieldcost.PLANE_DECODE,
+                          n * g.width, n, g.label)
 
     def _run_groups_merged(self, groups, arr: np.ndarray,
-                           outputs: Dict[int, dict]) -> list:
+                           outputs: Dict[int, dict], fc=None) -> list:
         """Decode all narrow binary/BCD/DISPLAY groups in one native pass
         (native.decode_numeric_groups); returns the groups still needing
         the per-group path. A single eligible group keeps the per-group
@@ -1140,11 +1199,24 @@ class ColumnarDecoder:
         eligible, rest, plan = cached
         if plan is None:
             return groups
+        tok = fc.begin() if fc is not None else None
         res = native.decode_numeric_groups(arr, None, plan=plan)
         if res is None:  # no native library: per-group numpy path
+            if tok is not None:
+                fc.discard(tok)
             return groups
         for g, out in zip(eligible, res):
             self._store_numeric(g, outputs, *out)
+        if tok is not None:
+            # ONE native pass decoded every narrow numeric group: split
+            # its time across the groups weighted by the bytes each one
+            # made the pass touch (columns * width), then per column
+            n = arr.shape[0]
+            fc.commit_weighted(
+                tok,
+                [(g.names, g.width, n * g.width, g.label)
+                 for g in eligible],
+                fieldcost.PLANE_DECODE, n)
         return rest
 
     def _run_group_native(self, g: _KernelGroup, arr: np.ndarray,
@@ -1398,9 +1470,22 @@ class ColumnarDecoder:
             padded = arr
         # explicit H2D: the implicit transfer inside jit dispatch is far
         # slower than device_put on remote-attached (tunneled) devices
+        fc = fieldcost.current()
+        tok = fc.begin() if fc is not None else None
         with annotate("cobrix_decode"):
             device_outs = self._jax_fn(jax.device_put(padded))
-        return self.collect_outputs(device_outs, n)
+        outputs = self.collect_outputs(device_outs, n)
+        if tok is not None:
+            # one jitted program decodes every group: split its wall
+            # (incl. transfers) across groups by bytes touched — coarser
+            # than the host path's per-launch timing, but the same table
+            fc.commit_weighted(
+                tok,
+                [(g.names, g.width, n * g.width, g.label)
+                 for g in self.kernel_groups
+                 if g.codec is not Codec.HOST_FALLBACK and g.names],
+                fieldcost.PLANE_DECODE, n)
+        return outputs
 
     def collect_outputs(self, device_outs, n: int) -> Dict[int, dict]:
         """Transfer per-group device outputs to host numpy column arrays,
@@ -1477,12 +1562,19 @@ class ColumnarDecoder:
 
     def _decode_host_fallback(self, arr: np.ndarray,
                               outputs: Dict[int, dict]) -> None:
+        fc = fieldcost.current()
+        n = arr.shape[0]
         for g in self.kernel_groups:
             if g.codec is not Codec.HOST_FALLBACK:
                 continue
             for c in g.columns:
+                tok = fc.begin() if fc is not None else None
                 values = []
-                for i in range(arr.shape[0]):
+                for i in range(n):
                     chunk = arr[i, c.offset: c.offset + c.width].tobytes()
                     values.append(self.options.decode(c.dtype, chunk))
                 outputs[c.index] = {"host": values}
+                if tok is not None:
+                    fc.commit(tok, (self.plan.cost_name(c),),
+                              fieldcost.PLANE_DECODE, n * c.width, n,
+                              "host")

@@ -165,6 +165,12 @@ class ReaderParameters:
     # minimum seconds between progress_callback invocations (the final
     # done=True snapshot always fires)
     progress_interval_s: float = 0.5
+    # per-field/kernel-group cost attribution (obs.fieldcost): timers
+    # around each kernel-group launch and Arrow-assembly column step,
+    # surfaced as ReadMetrics.field_costs and the explain cost table.
+    # Off by default — the disabled path takes zero timestamps;
+    # `read_cobol(..., explain=True)` forces it on for that read
+    field_costs: bool = False
     # -- streaming delivery (batch_callback / cobrix_tpu.serve) ----------
     # cap on rows per emitted Arrow record batch when results stream out
     # incrementally (a serving client shouldn't receive one giant batch

@@ -280,5 +280,14 @@ class ScanSession:
                 "pipeline": ({"chunks": m.pipeline.get("chunks"),
                               "overlap": m.pipeline.get("overlap")}
                              if m.pipeline else None),
+                # per-field cost attribution + roofline anchoring: the
+                # streaming happened via batch_callback DURING the scan,
+                # so the table is complete here — serving clients get
+                # "which columns cost what" and "what fraction of the
+                # hardware limit" without any server shell access.
+                # Client opt-in via the `field_costs` read option; None
+                # when attribution was off (the zero-overhead default)
+                "field_costs": m.field_costs,
+                "roofline": m.roofline(),
             }
         return summary

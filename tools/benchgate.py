@@ -1,0 +1,260 @@
+"""Noise-aware perf regression gate over the BENCH trajectory.
+
+Compares a fresh `bench.py` JSON against the repo's `BENCH_r*.json`
+history (each an envelope whose `parsed` field holds the bench line):
+for every metric present in both, the fresh value must not fall more
+than `--tolerance` below the MEDIAN of its history — median-of-history
+because single rounds on shared boxes are noisy, a tolerance because
+even medians wobble, and per-metric because the experiments regress
+independently.
+
+Roofline-aware: when both the fresh run and the history carry a
+roofline `fraction` (achieved bytes/s over the machine's calibrated
+memory bandwidth, obs/roofline.py), the gate compares FRACTIONS instead
+of raw MB/s — a slower machine then doesn't read as a code regression,
+and a faster machine doesn't mask one (the decode-throughput-law view,
+arxiv 2606.22423).
+
+    python tools/benchgate.py fresh.json                # gate a run
+    python tools/benchgate.py fresh.json --tolerance 0.3
+    python tools/benchgate.py --smoke                   # self-check
+
+Exit 0 = no regression (or not enough history to judge); 1 = at least
+one metric regressed past tolerance; 2 = bad input.
+"""
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import statistics
+import sys
+from typing import Dict, List, Optional
+
+DEFAULT_TOLERANCE = 0.25   # the 2-core CI box swings ±15-20% run to run
+DEFAULT_MIN_HISTORY = 2
+
+
+def load_bench_doc(path: str) -> Optional[dict]:
+    """One bench JSON: either the raw line bench.py prints or the
+    BENCH_r*.json envelope ({"parsed": <line>, "rc": ...})."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as exc:
+        print(f"benchgate: cannot read {path}: {exc}", file=sys.stderr)
+        return None
+    if not isinstance(doc, dict):
+        return None
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        if doc.get("rc", 0) not in (0, None):
+            return None  # a failed round's numbers are not a baseline
+        return parsed
+    return doc
+
+
+def extract_metrics(doc: dict) -> Dict[str, dict]:
+    """{metric name -> {'value': float, 'fraction': float|None}} for
+    every throughput metric a bench doc carries (headline, decode_only,
+    and the named side experiments). Metrics are keyed by their OWN
+    `metric` name, so a renamed/retired experiment simply stops
+    matching instead of comparing apples to oranges."""
+    out: Dict[str, dict] = {}
+
+    def add(sub) -> None:
+        if not isinstance(sub, dict):
+            return
+        name = sub.get("metric")
+        value = sub.get("value")
+        if not name or not isinstance(value, (int, float)):
+            return
+        roof = sub.get("roofline")
+        fraction = None
+        if isinstance(roof, dict):
+            fraction = roof.get("fraction")
+        out[str(name)] = {"value": float(value),
+                          "fraction": (float(fraction)
+                                       if fraction else None)}
+
+    add(doc)
+    add(doc.get("decode_only"))
+    for key in ("exp1", "exp2", "hierarchical", "exp_serve"):
+        add(doc.get(key))
+    return out
+
+
+def gate(fresh: Dict[str, dict], history: List[Dict[str, dict]],
+         tolerance: float, min_history: int) -> List[dict]:
+    """Evaluate every fresh metric against its history series; returns
+    one row per comparable metric with verdict 'ok' | 'regression' |
+    'insufficient_history'."""
+    rows: List[dict] = []
+    for name, entry in sorted(fresh.items()):
+        series_frac = [h[name]["fraction"] for h in history
+                       if name in h and h[name]["fraction"]]
+        series_raw = [h[name]["value"] for h in history if name in h]
+        use_fraction = (entry["fraction"] is not None
+                        and len(series_frac) >= min_history)
+        series = series_frac if use_fraction else series_raw
+        value = entry["fraction"] if use_fraction else entry["value"]
+        row = {"metric": name,
+               "basis": "roofline_fraction" if use_fraction else "raw",
+               "value": round(value, 4) if value else value,
+               "history_n": len(series)}
+        if len(series) < min_history:
+            row["verdict"] = "insufficient_history"
+            rows.append(row)
+            continue
+        med = statistics.median(series)
+        floor = med * (1.0 - tolerance)
+        row["median"] = round(med, 4)
+        row["floor"] = round(floor, 4)
+        row["ratio"] = round(value / med, 3) if med else None
+        row["verdict"] = "regression" if value < floor else "ok"
+        rows.append(row)
+    return rows
+
+
+def run_gate(fresh_path: str, history_glob: str, tolerance: float,
+             min_history: int) -> int:
+    fresh_doc = load_bench_doc(fresh_path)
+    if fresh_doc is None:
+        print(f"benchgate: unreadable fresh bench JSON: {fresh_path}",
+              file=sys.stderr)
+        return 2
+    history_docs = []
+    for p in sorted(_glob.glob(history_glob)):
+        if os.path.abspath(p) == os.path.abspath(fresh_path):
+            continue  # the run under test must not be its own baseline
+        doc = load_bench_doc(p)
+        if doc is not None:
+            history_docs.append(extract_metrics(doc))
+    fresh = extract_metrics(fresh_doc)
+    if not fresh:
+        print("benchgate: fresh JSON carries no comparable metrics",
+              file=sys.stderr)
+        return 2
+    rows = gate(fresh, history_docs, tolerance, min_history)
+    bad = [r for r in rows if r["verdict"] == "regression"]
+    for r in rows:
+        mark = {"ok": "OK  ", "regression": "FAIL",
+                "insufficient_history": "--  "}[r["verdict"]]
+        line = (f"{mark} {r['metric']:<36} {r['basis']:<17} "
+                f"value={r['value']}")
+        if "median" in r:
+            line += (f" median={r['median']} floor={r['floor']} "
+                     f"x{r['ratio']}")
+        else:
+            line += f" (history n={r['history_n']} < {min_history})"
+        print(line)
+    if bad:
+        print(f"benchgate: {len(bad)} metric(s) regressed more than "
+              f"{tolerance * 100:.0f}% below the history median")
+        return 1
+    print("benchgate: no regression "
+          f"({len(rows)} metric(s), tolerance {tolerance * 100:.0f}%)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# --smoke: self-check on synthetic history (what tier-1 runs)
+# ---------------------------------------------------------------------------
+
+def _doc(headline: float, exp1: float, fraction: Optional[float] = None):
+    d = {"metric": "exp3_to_arrow", "value": headline, "unit": "MB/s",
+         "exp1": {"metric": "exp1_to_arrow", "value": exp1,
+                  "unit": "MB/s"}}
+    if fraction is not None:
+        d["roofline"] = {"bandwidth_GBps": 10.0, "fraction": fraction}
+    return d
+
+
+def _smoke() -> int:
+    ok = True
+
+    def check(label: str, cond: bool) -> None:
+        nonlocal ok
+        print(f"  {'ok' if cond else 'FAILED'}: {label}")
+        ok &= cond
+
+    hist = [extract_metrics(_doc(100.0, 50.0, 0.10)),
+            extract_metrics(_doc(110.0, 52.0, 0.11)),
+            extract_metrics(_doc(90.0, 48.0, 0.09))]
+
+    rows = gate(extract_metrics(_doc(98.0, 49.0, 0.10)), hist, 0.25, 2)
+    check("steady run passes",
+          all(r["verdict"] == "ok" for r in rows))
+
+    rows = gate(extract_metrics(_doc(40.0, 50.0, 0.04)), hist, 0.25, 2)
+    check("50% headline drop is caught",
+          any(r["metric"] == "exp3_to_arrow"
+              and r["verdict"] == "regression" for r in rows))
+
+    # slower machine: raw MB/s halves but the roofline fraction holds —
+    # the fraction basis must keep this green
+    rows = gate(extract_metrics(_doc(50.0, 25.0, 0.10)), hist, 0.25, 2)
+    headline = next(r for r in rows if r["metric"] == "exp3_to_arrow")
+    check("machine change rides the fraction basis",
+          headline["basis"] == "roofline_fraction"
+          and headline["verdict"] == "ok")
+
+    # exp1 carries no fraction -> raw basis -> the drop IS a regression
+    check("fraction-less metric still gates on raw",
+          any(r["metric"] == "exp1_to_arrow"
+              and r["verdict"] == "regression" for r in rows))
+
+    # one-round history: not enough to judge, never a false failure
+    rows = gate(extract_metrics(_doc(40.0, 20.0)), hist[:1], 0.25, 2)
+    check("thin history abstains",
+          all(r["verdict"] == "insufficient_history" for r in rows))
+
+    # envelope parsing: failed rounds are excluded from the baseline
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as f:
+        json.dump({"parsed": _doc(1.0, 1.0), "rc": 1}, f)
+        p = f.name
+    try:
+        check("rc!=0 envelope yields no baseline",
+              load_bench_doc(p) is None)
+    finally:
+        os.unlink(p)
+
+    print("OK: benchgate smoke passed" if ok
+          else "FAILED: benchgate smoke")
+    return 0 if ok else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fresh", nargs="?",
+                    help="fresh bench.py JSON (line or BENCH envelope)")
+    ap.add_argument("--history", default=None,
+                    help="glob of history files "
+                         "(default: BENCH_r*.json next to this repo)")
+    ap.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
+                    help="allowed drop below the history median "
+                         "(fraction, default 0.25)")
+    ap.add_argument("--min-history", type=int,
+                    default=DEFAULT_MIN_HISTORY,
+                    help="series length required before gating")
+    ap.add_argument("--smoke", action="store_true",
+                    help="self-check the gate on synthetic history")
+    args = ap.parse_args()
+    if args.smoke:
+        return _smoke()
+    if not args.fresh:
+        ap.error("a fresh bench JSON (or --smoke) is required")
+    history = args.history
+    if history is None:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        history = os.path.join(repo, "BENCH_r*.json")
+    return run_gate(args.fresh, history, args.tolerance,
+                    args.min_history)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,16 @@ tool is the terminal view — what took the time, which shard straggled,
 what the supervisor did — without leaving the shell.
 
     python tools/traceview.py scan.trace.json     # summarize a trace
+    python tools/traceview.py --fields scan.trace.json
+                                                  # per-field cost table
+                                                  # (busy_s, bytes, MB/s,
+                                                  # % of decode) from a
+                                                  # trace whose read ran
+                                                  # with field_costs /
+                                                  # explain=True, or from
+                                                  # any metrics/bench
+                                                  # JSON carrying a
+                                                  # field_costs table
     python tools/traceview.py --smoke             # self-check: run a
                                                   # small traced scan and
                                                   # assert the summary
@@ -167,6 +177,60 @@ def print_summary(s: dict) -> None:
         print(f"supervision: {evs}")
 
 
+def find_field_costs(doc) -> Optional[dict]:
+    """Locate a per-field cost table ({field -> {busy_s, bytes, ...}})
+    in any artifact shape: an explain/metrics dict (`field_costs` key
+    at any depth, e.g. bench JSON `read_metrics`), or a Chrome trace
+    whose scan-root span args carry it (ReadMetrics.finalize embeds
+    the table when attribution ran)."""
+    if isinstance(doc, dict):
+        fc = doc.get("field_costs")
+        if isinstance(fc, dict) and fc and all(
+                isinstance(v, dict) and "busy_s" in v
+                for v in fc.values()):
+            return fc
+        events = doc.get("traceEvents")
+        if isinstance(events, list):
+            for e in events:
+                if e.get("cat") == "scan":
+                    fc = (e.get("args") or {}).get("field_costs")
+                    if isinstance(fc, dict) and fc:
+                        return fc
+            return None
+        for v in doc.values():
+            found = find_field_costs(v)
+            if found is not None:
+                return found
+    elif isinstance(doc, list):
+        for v in doc:
+            found = find_field_costs(v)
+            if found is not None:
+                return found
+    return None
+
+
+def print_fields(costs: dict, top_n: int = 20) -> None:
+    """The per-field cost table: busy seconds split decode/assemble,
+    bytes, MB/s, and each field's share of the decode plane — the
+    terminal twin of ScanReport.render()'s cost section."""
+    rows = sorted(costs.items(), key=lambda kv: -kv[1].get("busy_s", 0))
+    decode_total = sum(r.get("decode_s", 0) for _, r in rows)
+    print(f"{len(rows)} field(s), decode plane "
+          f"{decode_total:.4f}s busy; top {min(top_n, len(rows))}:")
+    print(f"{'field':<26} {'kernel':<20} {'busy_s':>8} {'dec_s':>8} "
+          f"{'asm_s':>8} {'MB':>8} {'MB/s':>8} {'%decode':>8}")
+    for name, r in rows[:top_n]:
+        mb = r.get("bytes", 0) / (1024 * 1024)
+        busy = r.get("busy_s", 0)
+        mbps = mb / busy if busy > 0 else 0.0
+        pct = (r.get("decode_s", 0) / decode_total * 100
+               if decode_total > 0 else 0.0)
+        print(f"{name:<26} {r.get('kernel', ''):<20} {busy:>8.4f} "
+              f"{r.get('decode_s', 0):>8.4f} "
+              f"{r.get('assemble_s', 0):>8.4f} {mb:>8.2f} "
+              f"{mbps:>8.1f} {pct:>7.1f}%")
+
+
 def _smoke(sweep: bool) -> int:
     """Generate small traced scans and assert the summary parses — the
     end-to-end self-check CI runs (pipecheck/chaoscheck style)."""
@@ -228,11 +292,32 @@ def main() -> int:
                     help="self-check: run a traced scan and summarize it")
     ap.add_argument("--sweep", action="store_true",
                     help="with --smoke: add the multihost profile (slow)")
+    ap.add_argument("--fields", action="store_true",
+                    help="render the per-field cost table from the "
+                         "artifact (trace or metrics/bench JSON)")
     args = ap.parse_args()
     if args.smoke:
         return _smoke(args.sweep)
     if not args.trace:
         ap.error("a trace file (or --smoke) is required")
+    if args.fields:
+        try:
+            with open(args.trace, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as exc:
+            print(f"FAILED: {exc}", file=sys.stderr)
+            return 1
+        costs = find_field_costs(doc)
+        if costs is None:
+            print("FAILED: no field_costs table in this artifact (run "
+                  "the read with field_costs=true or explain=True)",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(costs))
+        else:
+            print_fields(costs)
+        return 0
     try:
         summary = summarize(load_events(args.trace))
     except (ValueError, OSError, json.JSONDecodeError) as exc:
