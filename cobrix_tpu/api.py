@@ -292,6 +292,8 @@ def parse_options(options: Dict[str, object],
         heartbeat_interval_s=float(
             opts.get("heartbeat_interval_s", "") or 0.5),
         trace_file=opts.get("trace_file", "") or "",
+        trace_id=opts.get("trace_id", "") or "",
+        request_id=opts.get("request_id", "") or "",
         progress_interval_s=float(
             opts.get("progress_interval_s", "") or 0.5),
         stream_batch_rows=opts.get_int("stream_batch_rows", 0),
@@ -758,6 +760,7 @@ def read_cobol(path=None,
                progress_callback=None,
                batch_callback=None,
                explain: bool = False,
+               tracer=None,
                **options) -> "Union[CobolData, ScanReport]":
     """Read mainframe file(s) into decoded rows.
 
@@ -792,7 +795,19 @@ def read_cobol(path=None,
     execution plan, cache-plane status, and — because it forces the
     `field_costs` option on — the measured per-field cost table and
     roofline anchoring. The decoded data rides on `report.data`.
+
+    `tracer`: an `obs.Tracer` to record scan spans into instead of
+    creating one. The request-scoped surface for embedders (the serving
+    tier passes its per-request tracer here so queue-wait and scan
+    spans share one timeline and one trace_id); spans are collected
+    in memory (`data.metrics.spans`) and only written to disk when
+    `trace_file` is also set. The string options `trace_id` /
+    `request_id` are the wire-friendly subset: they tag a read's OWN
+    tracer with inbound identity.
     """
+    if tracer is not None and not hasattr(tracer, "record_span"):
+        raise ValueError("'tracer' must be an obs.Tracer (it receives "
+                         "scan spans).")
     if progress_callback is not None and not callable(progress_callback):
         raise ValueError("'progress_callback' must be callable (it "
                          "receives ScanProgress snapshots).")
@@ -892,7 +907,8 @@ def read_cobol(path=None,
     # thread and re-activated by every pool the scan fans out to.
     from .obs.context import activate as obs_activate
 
-    obs_ctx = _build_obs_context(params, metrics, progress_callback)
+    obs_ctx = _build_obs_context(params, metrics, progress_callback,
+                                 tracer=tracer)
     try:
         with obs_activate(obs_ctx):
             if hosts > 1:
@@ -965,18 +981,21 @@ class _BatchTap:
 
 
 def _build_obs_context(params: ReaderParameters, metrics: ReadMetrics,
-                       progress_callback):
-    """The read's ObsContext: tracer when `trace_file` is set, progress
+                       progress_callback, tracer=None):
+    """The read's ObsContext: tracer when `trace_file` is set (or one
+    was injected by an embedder like the serving tier), progress
     tracker when a callback was passed, the default metrics registry's
     scan metric set, and the metrics object's per-read cache scope."""
     from .obs.context import ObsContext
     from .obs.metrics import scan_metrics
 
-    tracer = None
-    if params.trace_file:
+    if tracer is None and params.trace_file:
         from .obs.trace import Tracer
 
-        tracer = Tracer()
+        tracer = Tracer(trace_id=params.trace_id or None)
+    if tracer is not None:
+        if params.request_id:
+            tracer.meta.setdefault("request_id", params.request_id)
         metrics.tracer = tracer
     progress = None
     if progress_callback is not None:

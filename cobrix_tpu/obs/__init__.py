@@ -1,7 +1,7 @@
 """cobrix_tpu.obs — unified scan telemetry.
 
-Three planes over every execution path (sequential, threaded shard scan,
-chunked pipeline, forked multihost):
+Process-scoped planes over every execution path (sequential, threaded
+shard scan, chunked pipeline, forked multihost):
 
 * **trace** — `Tracer` spans (scan -> shard -> chunk -> stage) with
   Chrome-trace/Perfetto JSON export (`trace_file=` read option) and
@@ -11,9 +11,20 @@ chunked pipeline, forked multihost):
 * **progress** — monotonic `ScanProgress` snapshots pushed to a
   `progress_callback` while the scan runs.
 
+Request-scoped planes for the serving tier (and any embedder):
+
+* **audit** — one `ScanRecord` per completed/failed/rejected scan in a
+  size-rotated JSONL `AuditLog`, plus the `FlightRecorder` ring that
+  dumps full trace + field-cost evidence for scans breaching a latency
+  SLO or erroring;
+* **slo** — declarative objectives (`first_batch_p99=0.5`, ...)
+  evaluated per scan into Prometheus good/bad burn-rate counters.
+
 `tools/traceview.py` summarizes a trace file (critical path, stage
-utilization, straggler table).
+utilization, straggler table); `tools/scanlog.py` tails/filters the
+audit log and groups trace artifacts by trace_id.
 """
+from .audit import AuditLog, FlightRecorder, ScanRecord, read_audit_log
 from .context import ObsContext, activate, current
 from .fieldcost import FieldCostAccumulator, top_fields
 from .metrics import (
@@ -22,8 +33,10 @@ from .metrics import (
     Histogram,
     MetricsRegistry,
     default_registry,
+    process_metrics,
     prometheus_text,
     scan_metrics,
+    update_process_metrics,
 )
 from .progress import ProgressTracker, ScanProgress
 from .roofline import (
@@ -32,9 +45,20 @@ from .roofline import (
     roofline_fraction,
     roofline_summary,
 )
-from .trace import Tracer, clock_sample, maybe_parent, maybe_span
+from .slo import Slo, SloTracker, parse_slo, parse_slos
+from .trace import (
+    Tracer,
+    clock_sample,
+    maybe_parent,
+    maybe_span,
+    new_trace_id,
+)
 
 __all__ = [
+    "AuditLog",
+    "FlightRecorder",
+    "ScanRecord",
+    "read_audit_log",
     "ObsContext",
     "activate",
     "current",
@@ -49,12 +73,19 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "default_registry",
+    "process_metrics",
     "prometheus_text",
     "scan_metrics",
+    "update_process_metrics",
     "ProgressTracker",
     "ScanProgress",
+    "Slo",
+    "SloTracker",
+    "parse_slo",
+    "parse_slos",
     "Tracer",
     "clock_sample",
     "maybe_parent",
     "maybe_span",
+    "new_trace_id",
 ]

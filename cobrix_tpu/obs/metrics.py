@@ -18,7 +18,9 @@ labels kept to the counter type where the scan actually needs them
 from __future__ import annotations
 
 import bisect
+import os
 import threading
+import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 
@@ -366,6 +368,66 @@ def scan_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "Last scan's achieved bytes/s over the calibrated host "
             "memory bandwidth (0 = uncalibrated)"),
     }
+
+
+# -- process-level liveness gauges ------------------------------------------
+
+# module import is close enough to process start for an uptime trend
+_PROCESS_T0 = time.monotonic()
+
+
+def _rss_bytes() -> Optional[int]:
+    """Current resident set size. /proc (exact, Linux) first; the
+    ru_maxrss HIGH-WATER mark as the portable fallback (a peak, not a
+    live value — fine for liveness trends, wrong for leak-recovery
+    curves); None when neither is readable. ru_maxrss units differ by
+    platform: bytes on macOS, kilobytes elsewhere."""
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * (os.sysconf("SC_PAGE_SIZE")
+                                               if hasattr(os, "sysconf")
+                                               else 4096)
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        import sys
+
+        peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return peak if sys.platform == "darwin" else peak * 1024
+    except Exception:
+        return None
+
+
+def process_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
+    """Bare-liveness gauges: a scrape shows the process is up, how long,
+    and how big — without parsing any scan counter. Call
+    `update_process_metrics` before rendering an exposition (the HTTP
+    sidecar does, per scrape; gauges are point-in-time by nature)."""
+    r = registry or _default
+    return {
+        "uptime": r.gauge(
+            "cobrix_process_uptime_seconds",
+            "Seconds since this serving process started"),
+        "rss": r.gauge(
+            "cobrix_process_rss_bytes",
+            "Resident set size of this process (0 = unreadable)"),
+        "open_scans": r.gauge(
+            "cobrix_serve_open_scans",
+            "Scan requests currently open on this process "
+            "(admitted and streaming)"),
+    }
+
+
+def update_process_metrics(open_scans: Optional[int] = None,
+                           registry: Optional[MetricsRegistry] = None
+                           ) -> None:
+    m = process_metrics(registry)
+    m["uptime"].set(time.monotonic() - _PROCESS_T0)
+    rss = _rss_bytes()
+    m["rss"].set(rss if rss is not None else 0)
+    if open_scans is not None:
+        m["open_scans"].set(open_scans)
 
 
 # queue-wait / first-batch latency buckets for the serving tier: finer

@@ -41,6 +41,7 @@ import json
 import os
 import threading
 import time
+import uuid
 from typing import Dict, List, Optional, Tuple
 
 # span record layout (plain tuple — cheapest thing that pickles):
@@ -82,14 +83,34 @@ def clock_sample() -> Tuple[float, float]:
     return (time.time(), time.perf_counter())
 
 
+def new_trace_id() -> str:
+    """A fresh request-scoped trace id: 32 hex chars, globally unique
+    across clients/servers/processes. The serving tier threads ONE of
+    these from the client's 'R' frame through admission, the scan
+    tracer, the audit log, and back out on the trailer — so a slow
+    request resolves to its exact trace and audit record."""
+    return uuid.uuid4().hex
+
+
 class Tracer:
     """Per-scan span collector. Thread-safe (one list append under the
     GIL per span; the lock only guards merge/export), fork-friendly (a
     worker creates its own Tracer and ships `export_state()` back)."""
 
-    def __init__(self, process_name: str = "scan"):
+    def __init__(self, process_name: str = "scan",
+                 trace_id: Optional[str] = None,
+                 meta: Optional[dict] = None):
         self.pid = os.getpid()
         self.process_name = process_name
+        # request-scoped identity: accept an inbound id (the serving
+        # tier's client-minted id, or the `trace_id` read option) or
+        # mint one — every export of this tracer carries it, so traces
+        # from different processes serving ONE request group together
+        self.trace_id = trace_id or new_trace_id()
+        # extra root-span context (request_id, tenant): folded into the
+        # root span's args at finish_root so the artifact is
+        # self-describing
+        self.meta: dict = dict(meta or {})
         self.clock = clock_sample()
         self.spans: List[SpanRecord] = []
         self._tls = threading.local()
@@ -191,13 +212,24 @@ class Tracer:
     # -- export ------------------------------------------------------------
 
     def finish_root(self, args: Optional[dict] = None) -> None:
-        """Close the scan-root span (idempotent)."""
+        """Close the scan-root span (idempotent). The root args carry
+        the trace id and any `meta` (request_id, tenant) in addition to
+        whatever the caller passes."""
         if self._root_closed:
             return
         self._root_closed = True
+        # mutate the caller's dict in place (callers keep a reference so
+        # they can fold late data — field costs accrued after the trace
+        # was written — back into the recorded span; see
+        # ReadMetrics.refresh_trace_field_costs)
+        root_args = args if args is not None else {}
+        root_args.setdefault("trace_id", self.trace_id)
+        for key, value in self.meta.items():
+            root_args.setdefault(key, value)
         self.spans.append((
             self.root_id, 0, self._root_name, "scan", "X", self._t_start,
-            time.perf_counter(), self.pid, threading.get_ident(), args))
+            time.perf_counter(), self.pid, threading.get_ident(),
+            root_args))
 
     def chrome_trace(self) -> dict:
         """The trace as a Chrome trace-event dict (`traceEvents` array).
@@ -206,7 +238,8 @@ class Tracer:
         with self._lock:
             spans = list(self.spans)
         if not spans:
-            return {"traceEvents": [], "displayTimeUnit": "ms"}
+            return {"traceEvents": [], "displayTimeUnit": "ms",
+                    "trace_id": self.trace_id}
         t_base = min(s[5] for s in spans)
         events: List[dict] = []
         seen_procs: Dict[int, str] = {}
@@ -235,7 +268,10 @@ class Tracer:
             else:
                 ev["s"] = "g"  # global-scope instant: visible full-height
             events.append(ev)
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        # trace_id at the top level: tools group per-request artifacts
+        # (tools/scanlog.py traceview) without scanning every span's args
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "trace_id": self.trace_id}
 
     def write_chrome_trace(self, path: str) -> None:
         """Write the Chrome-trace JSON crash-safely: a process killed

@@ -162,6 +162,15 @@ class ReaderParameters:
     # merged onto one timeline — and writes the file at read end. '' =
     # tracing off (the ~zero-overhead default)
     trace_file: str = ""
+    # inbound trace context: a request-scoped trace id propagated from
+    # an upstream caller (the serving tier's 'R' frame, or any in-process
+    # orchestrator). '' = mint a fresh id when tracing is on. The id
+    # lands in every trace export and the scan audit record, so one
+    # request stitches across processes
+    trace_id: str = ""
+    # caller-assigned request id carried on the trace root span and the
+    # audit record ('' = none); purely identifying, never behavioral
+    request_id: str = ""
     # minimum seconds between progress_callback invocations (the final
     # done=True snapshot always fires)
     progress_interval_s: float = 0.5

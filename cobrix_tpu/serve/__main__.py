@@ -1,4 +1,10 @@
-"""`python -m cobrix_tpu.serve` — run a scan server from the CLI."""
+"""`python -m cobrix_tpu.serve` — run a scan server from the CLI.
+
+Exit code 0 = drained clean on SIGTERM/SIGINT; 1 = in-flight scans had
+to be abandoned after `--drain-timeout` seconds.
+"""
+import sys
+
 from .server import main
 
-main()
+sys.exit(main())

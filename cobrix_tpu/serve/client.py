@@ -12,6 +12,17 @@ Timeouts follow RetryPolicy semantics (reader/stream.py): connect
 attempts retry with exponential backoff + jitter under an overall
 deadline; established-stream reads get a per-read socket timeout so a
 dead server surfaces as an error, never a hang.
+
+Request-scoped observability: every request carries a client-minted
+`request_id`/`trace_id` pair on the 'R' frame (accepting inbound ones,
+so an upstream service's trace continues through here); the trailer
+echoes them, and `tools/scanlog.py` resolves either id to the server's
+audit record. With ``trace=True`` the client records its OWN spans
+(connect, request, first-batch wait, stream consumption), the server
+ships its spans back on the trailer, and
+`ScanStream.write_chrome_trace(path)` merges both onto one
+clock-corrected timeline — one Chrome trace per request: client wait ->
+queue wait -> scan stages, across processes.
 """
 from __future__ import annotations
 
@@ -24,6 +35,7 @@ from typing import Callable, Iterator, Optional, Sequence, Tuple
 
 from ..reader.stream import RetryPolicy
 from ..obs.progress import ScanProgress
+from ..obs.trace import Tracer, new_trace_id
 from .protocol import (
     FRAME_DATA,
     FRAME_ERROR,
@@ -149,7 +161,9 @@ class ScanStream:
     (or immediately after iteration starts on an empty result)."""
 
     def __init__(self, sock: socket.socket,
-                 on_progress: Optional[Callable] = None):
+                 on_progress: Optional[Callable] = None,
+                 request_id: str = "", trace_id: str = "",
+                 tracer: Optional[Tracer] = None):
         self._sock = sock
         self._f = sock.makefile("rb")
         self._frames = _FrameStream(self._f, on_progress)
@@ -158,6 +172,14 @@ class ScanStream:
         self._collect = False
         self._streamed_any = False
         self.schema = None
+        # the request's identity triple (tenant lives server-side on the
+        # audit record); resolves this stream to its audit-log entry
+        self.request_id = request_id
+        self.trace_id = trace_id
+        # client-side span collector (None unless stream_scan(trace=True));
+        # after exhaustion it also holds the server's merged spans
+        self.tracer = tracer
+        self._merged_server_trace = False
 
     @property
     def summary(self) -> Optional[dict]:
@@ -166,6 +188,8 @@ class ScanStream:
     def __iter__(self) -> Iterator:
         import pyarrow as pa
 
+        t0 = time.perf_counter()
+        first_t: Optional[float] = None
         if self._reader is None:
             self._reader = pa.ipc.open_stream(self._frames)
             self.schema = self._reader.schema
@@ -174,12 +198,24 @@ class ScanStream:
                 batch = self._reader.read_next_batch()
             except StopIteration:
                 break
+            if first_t is None:
+                first_t = time.perf_counter()
             if self._collect:
                 self._batches.append(batch)
             else:
                 self._streamed_any = True
             yield batch
         self._frames.drain_trailer()
+        if self.tracer is not None:
+            # the client's view of this request: how long it waited for
+            # the first batch vs how long it spent consuming the stream
+            # (a slow CLIENT shows up here, not in any server span)
+            if first_t is not None:
+                self.tracer.record_span("wait_first_batch", "client",
+                                        t0, first_t)
+            self.tracer.record_span("consume_stream", "client", t0,
+                                    time.perf_counter())
+            self._merge_server_trace()
         self.close()
 
     def table(self):
@@ -206,6 +242,45 @@ class ScanStream:
             table = table.replace_schema_metadata(metadata)
         return table
 
+    def _merge_server_trace(self) -> None:
+        """Fold the trailer's server spans onto the client tracer's
+        timeline (Tracer.merge clock-corrects across processes).
+        Idempotent — table() drives __iter__ exactly once, but guard
+        anyway."""
+        if self.tracer is None or self._merged_server_trace:
+            return
+        trace = (self.summary or {}).get("trace")
+        if not trace:
+            return
+        self._merged_server_trace = True
+        spans = [tuple(s) for s in trace.get("spans", ())]
+        clock = tuple(trace.get("clock") or (0.0, 0.0))
+        if spans and len(clock) == 2:
+            self.tracer.merge(spans, clock)
+
+    def chrome_trace(self) -> dict:
+        """The merged client+server Chrome trace dict (stream must be
+        exhausted; requires stream_scan(..., trace=True))."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "no client tracer: open the stream with "
+                "stream_scan(..., trace=True)")
+        self.tracer.finish_root(
+            args={"request_id": self.request_id})
+        return self.tracer.chrome_trace()
+
+    def write_chrome_trace(self, path: str) -> None:
+        """One Chrome-trace artifact for this request: client spans,
+        the server's queue-wait, and every scan stage — one trace_id,
+        one timeline. Open it in chrome://tracing / ui.perfetto.dev."""
+        if self.tracer is None:
+            raise RuntimeError(
+                "no client tracer: open the stream with "
+                "stream_scan(..., trace=True)")
+        self.tracer.finish_root(
+            args={"request_id": self.request_id})
+        self.tracer.write_chrome_trace(path)
+
     def close(self) -> None:
         try:
             self._f.close()
@@ -230,33 +305,64 @@ def stream_scan(address: Tuple[str, int], files,
                 connect_retry: Optional[RetryPolicy] = None,
                 connect_timeout_s: float = 10.0,
                 read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+                request_id: Optional[str] = None,
+                trace_id: Optional[str] = None,
+                trace: bool = False,
                 **options) -> ScanStream:
     """Open one streamed scan against a ScanServer.
 
     `files`: input path(s) as the SERVER sees them; `options` is the
     read_cobol option surface (minus server-owned keys). Pass
     `progress_callback` to receive live `ScanProgress` snapshots (the
-    opt-in progress frames). Returns a ScanStream to iterate."""
+    opt-in progress frames). Returns a ScanStream to iterate.
+
+    `request_id` / `trace_id` default to fresh ids (pass inbound ones
+    to continue an upstream trace); both ride the 'R' frame, tag the
+    server's audit record, and come back on `stream.summary`.
+    `trace=True` additionally records client-side spans and asks the
+    server for its spans on the trailer —
+    `stream.write_chrome_trace(path)` then emits ONE merged Chrome
+    trace for the request."""
     if isinstance(files, (str, bytes)):
         files = [files]
+    request_id = request_id or new_trace_id()[:16]
+    trace_id = trace_id or new_trace_id()
+    tracer = None
+    if trace:
+        tracer = Tracer(process_name="client-request",
+                        trace_id=trace_id,
+                        meta={"request_id": request_id,
+                              "tenant": tenant})
+    t0 = time.perf_counter()
     sock = connect(address, retry=connect_retry,
                    connect_timeout_s=connect_timeout_s)
+    if tracer is not None:
+        tracer.record_span("connect", "client", t0, time.perf_counter())
     try:
         sock.settimeout(read_timeout_s if read_timeout_s
                         and read_timeout_s > 0 else None)
         f = sock.makefile("wb")
+        t0 = time.perf_counter()
         write_json_frame(f, FRAME_REQUEST, {
             "tenant": tenant,
             "files": list(files),
             "options": options,
             "max_records": max_records,
             "progress": progress_callback is not None,
+            "request_id": request_id,
+            "trace_id": trace_id,
+            "trace": trace,
         })
         f.flush()
+        if tracer is not None:
+            tracer.record_span("send_request", "client", t0,
+                               time.perf_counter())
     except BaseException:
         sock.close()
         raise
-    return ScanStream(sock, on_progress=progress_callback)
+    return ScanStream(sock, on_progress=progress_callback,
+                      request_id=request_id, trace_id=trace_id,
+                      tracer=tracer)
 
 
 def fetch_table(address: Tuple[str, int], files,

@@ -1,12 +1,24 @@
-"""`/metrics` + `/healthz` for a serving process.
+"""`/metrics`, `/healthz`, and `/debug/*` for a serving process.
 
 A deliberately tiny HTTP sidecar (stdlib http.server, daemon threads)
-bound next to the scan port: `/metrics` serves the process-global
-Prometheus exposition (`obs.metrics.prometheus_text()` — scan totals,
-cache planes, AND the per-tenant serving counters), `/healthz` serves a
-JSON liveness document with the admission controller's live snapshot.
-Scrapers and load balancers hit these without touching the scan
-protocol, so a wedged scan plane still answers health checks.
+bound next to the scan port. Scrapers and load balancers hit these
+without touching the scan protocol, so a wedged scan plane still
+answers health checks.
+
+* ``/metrics`` — the process-global Prometheus exposition
+  (`obs.metrics.prometheus_text()`: scan totals, cache planes,
+  per-tenant serving counters, SLO good/bad counters) plus the
+  process-liveness gauges refreshed per scrape via `pre_scrape`
+  (uptime, RSS, open scan count — a bare scrape shows liveness trends
+  without parsing scan counters).
+* ``/healthz`` — JSON liveness with the admission snapshot and SLO
+  status. 200 when healthy, **503 while draining** (balancers stop
+  routing before the listener disappears), 500 when the snapshot
+  itself fails.
+* ``/debug/scans|recent|errors|slo|config`` — the request-scoped
+  debug surface (`debug_fn` serves it; see ScanServer._debug):
+  active scans with live ScanProgress, the flight-recorder ring,
+  SLO status, and the effective config.
 """
 from __future__ import annotations
 
@@ -15,24 +27,35 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Tuple
+from urllib.parse import parse_qsl
 
 from ..obs.metrics import prometheus_text
 
 
 class ObsHttpServer:
     """`ObsHttpServer(snapshot_fn).start()` ... `.stop()`; `address` is
-    the bound (host, port)."""
+    the bound (host, port). `debug_fn(subpath, query)` returns a
+    JSON-able document or None (404); `pre_scrape()` runs before each
+    /metrics render (refresh point-in-time gauges)."""
 
     def __init__(self, snapshot_fn: Optional[Callable[[], dict]] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 debug_fn: Optional[Callable] = None,
+                 pre_scrape: Optional[Callable[[], None]] = None):
         self._t0 = time.monotonic()
         snapshot = snapshot_fn or (lambda: {})
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                path, _, qs = self.path.partition("?")
+                query = dict(parse_qsl(qs))
                 if path == "/metrics":
+                    if pre_scrape is not None:
+                        try:
+                            pre_scrape()
+                        except Exception:
+                            pass  # stale gauges beat a dead scrape
                     body = prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                     code = 200
@@ -48,11 +71,33 @@ class ObsHttpServer:
                     body = (json.dumps(doc, sort_keys=True) + "\n") \
                         .encode()
                     ctype = "application/json"
-                    code = 200 if doc["status"] == "ok" else 500
+                    code = (200 if doc["status"] == "ok"
+                            else 503 if doc["status"] == "draining"
+                            else 500)
+                elif path.startswith("/debug/") and debug_fn is not None:
+                    try:
+                        doc = debug_fn(path[len("/debug/"):], query)
+                    except Exception as exc:
+                        doc = {"error": f"{type(exc).__name__}: {exc}"}
+                        body = (json.dumps(doc) + "\n").encode()
+                        self._reply(500, "application/json", body)
+                        return
+                    if doc is None:
+                        body = b"not found\n"
+                        ctype = "text/plain"
+                        code = 404
+                    else:
+                        body = (json.dumps(doc, sort_keys=True,
+                                           default=str) + "\n").encode()
+                        ctype = "application/json"
+                        code = 200
                 else:
                     body = b"not found\n"
                     ctype = "text/plain"
                     code = 404
+                self._reply(code, ctype, body)
+
+            def _reply(self, code, ctype, body):
                 self.send_response(code)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))

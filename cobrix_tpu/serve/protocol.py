@@ -10,7 +10,16 @@ so any language with sockets can speak it. Frame types:
 
     client -> server
       'R'  request            JSON: {tenant, files, options,
-                                     max_records, progress}
+                                     max_records, progress,
+                                     request_id, trace_id, trace}
+                              — request_id/trace_id are the request's
+                              identity triple (with tenant): minted by
+                              the client (or an upstream service),
+                              echoed on the trailer, keyed into the
+                              server's audit log and trace spans.
+                              "trace" asks the server to ship its span
+                              list back on the trailer so the client
+                              can merge ONE cross-process Chrome trace
     server -> client
       'D'  data               raw Arrow IPC *stream* bytes (the
                               concatenation of every D payload is one
@@ -19,10 +28,13 @@ so any language with sockets can speak it. Frame types:
       'P'  progress           JSON ScanProgress.as_dict() (opt-in via
                               the request's "progress" flag; throttled
                               server-side by `progress_interval_s`)
-      'F'  final summary      JSON: {rows, tables, bytes, diagnostics,
-                                     metrics, ...} — the stream's
-                              trailer (serve/session.py builds it);
-                              arrives after the IPC end-of-stream
+      'F'  final summary      JSON: {rows, tables, bytes, request_id,
+                                     trace_id, queue_wait_s,
+                                     first_batch_s, diagnostics,
+                                     metrics, trace?, ...} — the
+                              stream's trailer (serve/session.py
+                              builds it); arrives after the IPC
+                              end-of-stream
       'E'  error              JSON: {error, code} — terminal; the
                               connection closes after it
 

@@ -20,9 +20,17 @@ from __future__ import annotations
 import socketserver
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
-from ..obs.metrics import serve_metrics
+from ..obs.audit import (
+    AuditLog,
+    FlightRecorder,
+    ScanRecord,
+    record_from_summary,
+)
+from ..obs.metrics import serve_metrics, update_process_metrics
+from ..obs.slo import Slo, SloTracker, parse_slos
+from ..obs.trace import Tracer
 from .admission import AdmissionController, AdmissionRejected, TenantQuota
 from .http import ObsHttpServer
 from .protocol import (
@@ -145,6 +153,20 @@ class _Handler(socketserver.StreamRequestHandler):
         except Exception as exc:
             writer.try_json(FRAME_ERROR, error_payload(exc, "protocol"))
             return
+        # request-scoped tracer: built when the client asked for the
+        # merged trace OR the flight recorder needs evidence; carries
+        # the request's identity so every span groups under one trace_id
+        tracer = server.request_tracer(request)
+        t_req = time.monotonic()
+        p_req = time.perf_counter()
+        if server.draining:
+            writer.try_json(FRAME_ERROR, {
+                "error": "AdmissionRejected: server is draining",
+                "code": "rejected", "reason": "draining",
+                "tenant": tenant})
+            server.record_rejection(request, "draining",
+                                    "server is draining")
+            return
         try:
             ticket = server.controller.admit(tenant)
         except AdmissionRejected as exc:
@@ -152,27 +174,46 @@ class _Handler(socketserver.StreamRequestHandler):
                 "error": f"AdmissionRejected: {exc}",
                 "code": "rejected", "reason": exc.reason,
                 "tenant": exc.tenant})
+            server.record_rejection(request, exc.reason, str(exc))
             return
         t_admit = time.monotonic()
+        queue_wait_s = t_admit - t_req
+        if tracer is not None:
+            tracer.record_span(
+                "queue_wait", "serve", p_req, time.perf_counter(),
+                args={"tenant": tenant,
+                      "request_id": request.request_id})
         m = server.metrics
         sink = _ArrowFrameSink(writer, m, tenant)
         table_writer = _StreamingTableWriter(
             sink, m, tenant,
             server.stream_batch_rows(request))
         outcome = "error"
+        error_text = ""
+        summary: dict = {}
+        first_batch_s = None
+        entry = server.register_active(request)
+
+        def on_progress(p):
+            entry["progress"] = p.as_dict()  # the /debug/scans source
+            if request.want_progress:
+                writer.try_json(FRAME_PROGRESS, p.as_dict())
+
+        session = ScanSession(
+            request, server_options=server.server_options,
+            controller=server.controller,
+            on_progress=on_progress, tracer=tracer,
+            force_progress=True,
+            force_field_costs=server.wants_field_costs())
         try:
-            session = ScanSession(
-                request, server_options=server.server_options,
-                controller=server.controller,
-                on_progress=(lambda p: writer.try_json(
-                    FRAME_PROGRESS, p.as_dict())))
             summary = session.run(table_writer.write_table)
             table_writer.close(fallback_schema=session.result_schema)
             summary["bytes"] = writer.bytes_written
+            summary["queue_wait_s"] = round(queue_wait_s, 6)
             if table_writer.first_batch_t is not None:
-                first = table_writer.first_batch_t - t_admit
-                summary["first_batch_s"] = round(first, 6)
-                m["first_batch"].observe(first)
+                first_batch_s = table_writer.first_batch_t - t_admit
+                summary["first_batch_s"] = round(first_batch_s, 6)
+                m["first_batch"].observe(first_batch_s)
             writer.json(FRAME_FINAL, summary)
             outcome = "ok"
         except ClientGone:
@@ -180,8 +221,11 @@ class _Handler(socketserver.StreamRequestHandler):
             # the batch callback and cancelled the scan; nothing left to
             # tell the client. (Only ClientGone means that: a scan can
             # itself die of an OSError — storage faults are IOErrors —
-            # and those MUST still become an 'E' frame below.)
-            pass
+            # and those MUST still become an 'E' frame below.) Audited
+            # as its own outcome: a client hanging up is not a server
+            # failure, must not burn SLOs or spend flight-recorder dumps
+            outcome = "client_gone"
+            error_text = "ClientGone: peer disconnected mid-stream"
         except Exception as exc:
             # scan failure with the peer still connected: a structured
             # error frame, never a silent close (the pre-serve bridge
@@ -190,9 +234,27 @@ class _Handler(socketserver.StreamRequestHandler):
             code = exc.code if isinstance(exc, ServeError) \
                 else "scan_error"
             writer.try_json(FRAME_ERROR, error_payload(exc, code))
+            error_text = f"{type(exc).__name__}: {exc}"
+            if code == "protocol":
+                # a request the server refused to run (reserved /
+                # server-owned options): audited like an admission
+                # rejection — a misbehaving CLIENT must not burn the
+                # error-budget SLO or spend flight-recorder dumps
+                outcome = "rejected"
         finally:
             server.controller.release(ticket)
-            m["completed"].labels(tenant=tenant, outcome=outcome).inc()
+            server.unregister_active(entry)
+            # the Prometheus counter keeps its historical ok/error
+            # vocabulary; the finer client_gone class lives on the
+            # audit record
+            m["completed"].labels(
+                tenant=tenant,
+                outcome="ok" if outcome == "ok" else "error").inc()
+            server.observe_scan(
+                request, summary, outcome=outcome, error=error_text,
+                queue_wait_s=queue_wait_s, first_batch_s=first_batch_s,
+                e2e_s=time.monotonic() - t_req, session=session,
+                tracer=tracer)
 
 
 class ScanServer(socketserver.ThreadingTCPServer):
@@ -206,6 +268,23 @@ class ScanServer(socketserver.ThreadingTCPServer):
     `server_options` are read_cobol options forced onto every scan
     (e.g. ``{"cache_dir": "/var/cache/cobrix"}`` — the shared-plane
     pin); client options ride underneath them.
+
+    Request-scoped observability (all off by default — a bare server
+    adds zero per-record cost):
+
+    * ``audit_log`` — JSONL path; one ScanRecord per completed /
+      failed / rejected scan, size-rotated (``audit_max_mb`` /
+      ``audit_keep``). `tools/scanlog.py` reads it.
+    * ``slos`` — objective specs (strings like ``first_batch_p99=0.5``
+      or `obs.slo.Slo` objects) evaluated per scan into Prometheus
+      good/bad burn-rate counters and the `/healthz` + `/debug/slo`
+      status.
+    * ``flight_dir`` — evidence dumps (trace + field costs + record)
+      for scans breaching a latency SLO or erroring; enabling it turns
+      on span collection and field-cost attribution for every scan
+      (in-memory only — no per-request artifacts on healthy scans).
+    * the last ``flight_ring`` ScanRecords always sit in memory behind
+      `/debug/recent` and `/debug/errors`.
     """
 
     allow_reuse_address = True
@@ -219,7 +298,15 @@ class ScanServer(socketserver.ThreadingTCPServer):
                  send_timeout_s: float = 120.0,
                  server_options: Optional[dict] = None,
                  http_host: Optional[str] = None, http_port: int = 0,
-                 enable_http: bool = True):
+                 enable_http: bool = True,
+                 audit_log: str = "",
+                 audit_max_mb: float = 64.0,
+                 audit_keep: int = 3,
+                 slos: Optional[Sequence[Union[str, Slo]]] = None,
+                 flight_dir: str = "",
+                 flight_ring: int = 64,
+                 flight_max_dumps: int = 200,
+                 drain_timeout_s: float = 30.0):
         super().__init__((host, port), _Handler)
         # max seconds ONE frame write may block on a non-reading peer
         # before the scan is cancelled as ClientGone (0 = unbounded)
@@ -230,10 +317,30 @@ class ScanServer(socketserver.ThreadingTCPServer):
             max_concurrent_scans=max_concurrent_scans,
             queue_timeout_s=queue_timeout_s, metrics=self.metrics)
         self.server_options = dict(server_options or {})
+        self.drain_timeout_s = max(0.0, float(drain_timeout_s))
+        # -- request-scoped observability -------------------------------
+        self.audit = (AuditLog(audit_log, max_mb=audit_max_mb,
+                               keep=audit_keep) if audit_log else None)
+        slo_objs: List[Slo] = []
+        for s in (slos or ()):
+            slo_objs.extend(parse_slos([s]) if isinstance(s, str)
+                            else [s])
+        self.slo = SloTracker(slo_objs) if slo_objs else None
+        self.flight_dir = flight_dir
+        self.flight = FlightRecorder(ring_size=flight_ring,
+                                     dump_dir=flight_dir,
+                                     max_dumps=flight_max_dumps)
+        self._active_scans: Dict[int, dict] = {}
+        self._active_seq = 0
+        self._active_lock = threading.Lock()
+        self.draining = False
+        self._started_at = time.monotonic()
         self._http: Optional[ObsHttpServer] = None
         if enable_http:
             self._http = ObsHttpServer(
-                snapshot_fn=self.controller.snapshot,
+                snapshot_fn=self._health_snapshot,
+                debug_fn=self._debug,
+                pre_scrape=self._pre_scrape,
                 host=http_host if http_host is not None else host,
                 port=http_port)
         self._thread: Optional[threading.Thread] = None
@@ -255,6 +362,153 @@ class ScanServer(socketserver.ThreadingTCPServer):
             n = 0
         return n if n > 0 else None
 
+    # -- request-scoped observability -----------------------------------
+
+    def wants_field_costs(self) -> bool:
+        """Flight-recorder evidence includes the per-field cost table,
+        so a configured dump dir turns attribution on for every scan."""
+        return bool(self.flight_dir)
+
+    def request_tracer(self, request: ScanRequest) -> Optional[Tracer]:
+        """A per-request Tracer when anyone will read its spans: the
+        client asked for the merged trace, or a flight-recorder dump may
+        need evidence. None otherwise — the zero-overhead default."""
+        if not (request.want_trace or self.flight_dir):
+            return None
+        return Tracer(process_name="request",
+                      trace_id=request.trace_id,
+                      meta={"request_id": request.request_id,
+                            "tenant": request.tenant})
+
+    def register_active(self, request: ScanRequest) -> dict:
+        """The `/debug/scans` live entry; the handler's progress
+        callback mutates ``entry['progress']`` in place. Keyed by a
+        server-local token, NOT the client-minted request_id — a client
+        retrying with the same id while its first attempt still streams
+        must not evict the live entry of either attempt."""
+        entry = {
+            "request_id": request.request_id,
+            "trace_id": request.trace_id,
+            "tenant": request.tenant,
+            "files": list(request.files),
+            "started_unix": round(time.time(), 3),
+            "progress": None,
+        }
+        with self._active_lock:
+            self._active_seq += 1
+            key = self._active_seq
+            self._active_scans[key] = entry
+        entry["_key"] = key
+        return entry
+
+    def unregister_active(self, entry: dict) -> None:
+        with self._active_lock:
+            self._active_scans.pop(entry.get("_key"), None)
+
+    def record_rejection(self, request: ScanRequest, reason: str,
+                         detail: str) -> None:
+        """Rejected scans get audit records too — 'why did my request
+        vanish' must be answerable from the log alone."""
+        record = ScanRecord(
+            request_id=request.request_id, trace_id=request.trace_id,
+            tenant=request.tenant, outcome="rejected", ts=time.time(),
+            files=list(request.files), error=f"{reason}: {detail}")
+        self._observe_record(record, tracer=None, field_costs=None)
+
+    def observe_scan(self, request: ScanRequest, summary: dict,
+                     outcome: str, error: str, queue_wait_s: float,
+                     first_batch_s: Optional[float], e2e_s: float,
+                     session: ScanSession,
+                     tracer: Optional[Tracer]) -> None:
+        """One completed/failed scan -> audit record -> SLO counters ->
+        flight recorder. Never raises: observability of a scan must not
+        fail the NEXT request on this connection pool."""
+        try:
+            record = record_from_summary(
+                request.request_id, request.trace_id, request.tenant,
+                request.files, summary, outcome=outcome, error=error,
+                queue_wait_s=round(queue_wait_s, 6),
+                first_batch_s=(round(first_batch_s, 6)
+                               if first_batch_s is not None else None),
+                e2e_s=round(e2e_s, 6))
+            field_costs = (session.metrics.field_costs
+                           if session.metrics is not None else None)
+            self._observe_record(record, tracer=tracer,
+                                 field_costs=field_costs)
+        except Exception:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "failed to record scan observability for request %s",
+                request.request_id, exc_info=True)
+
+    def _observe_record(self, record: ScanRecord, tracer,
+                        field_costs) -> None:
+        if self.slo is not None:
+            # stamps record.slo_breaches; on an 'ok' scan only latency/
+            # throughput objectives can breach (error_rate passes), so
+            # a breach list on a good scan IS the dump trigger set
+            self.slo.observe(record)
+        self.flight.observe(record, tracer=tracer,
+                            field_costs=field_costs)
+        if self.audit is not None:
+            self.audit.append(record)
+
+    # -- health + /debug -------------------------------------------------
+
+    def _health_snapshot(self) -> dict:
+        doc: dict = {}
+        if self.draining:
+            doc["status"] = "draining"
+        doc.update(self.controller.snapshot())
+        if self.slo is not None:
+            doc["slo"] = self.slo.status()
+        return doc
+
+    def _pre_scrape(self) -> None:
+        with self._active_lock:
+            open_scans = len(self._active_scans)
+        update_process_metrics(open_scans=open_scans)
+
+    def _debug(self, path: str, query: dict) -> Optional[object]:
+        """`/debug/<path>` documents (None -> 404)."""
+        if path == "scans":
+            with self._active_lock:
+                return {"scans": [
+                    {k: v for k, v in e.items()
+                     if not k.startswith("_")}
+                    for e in self._active_scans.values()]}
+        if path in ("recent", "errors"):
+            try:
+                n = int(query.get("n", "50"))
+            except ValueError:
+                n = 50
+            records = self.flight.recent(
+                n=n, outcome=("bad" if path == "errors" else None))
+            return {path: [r.as_dict() for r in records]}
+        if path == "slo":
+            return {"slo": self.slo.status() if self.slo else {},
+                    "configured": self.slo is not None}
+        if path == "config":
+            return {
+                "address": list(self.address),
+                "draining": self.draining,
+                "max_concurrent_scans":
+                    self.controller.max_concurrent_scans,
+                "queue_timeout_s": self.controller.queue_timeout_s,
+                "send_timeout_s": self.send_timeout_s,
+                "drain_timeout_s": self.drain_timeout_s,
+                "server_options": dict(self.server_options),
+                "default_quota": vars(self.controller.default_quota),
+                "quotas": {t: vars(q) for t, q in
+                           self.controller.quotas.items()},
+                "audit_log": self.audit.path if self.audit else "",
+                "flight_dir": self.flight_dir,
+                "slos": [vars(s) for s in
+                         (self.slo.slos if self.slo else [])],
+            }
+        return None
+
     # -- lifecycle ------------------------------------------------------
 
     @property
@@ -274,6 +528,38 @@ class ScanServer(socketserver.ThreadingTCPServer):
         self._thread.start()
         return self
 
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful shutdown, balancer-style: stop accepting scans
+        (new requests get a structured ``draining`` rejection, and
+        `/healthz` answers 503 so balancers stop routing), let in-flight
+        scans finish for up to `timeout_s` (default `drain_timeout_s`),
+        then flush the audit log. Returns True when every scan finished
+        inside the window — False means scans were abandoned and the
+        process should exit nonzero. The HTTP sidecar stays up
+        throughout (a draining process must still answer health
+        checks); `stop()` tears it down afterwards."""
+        self.draining = True
+        if self._thread is not None:  # stop the accept loop first
+            self.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.server_close()
+        window = (self.drain_timeout_s if timeout_s is None
+                  else max(0.0, float(timeout_s)))
+        deadline = time.monotonic() + window
+        clean = False
+        while True:
+            snap = self.controller.snapshot()
+            if snap["active_scans"] == 0 and snap["queued_scans"] == 0:
+                clean = True
+                break
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.05)
+        if self.audit is not None:
+            self.audit.flush()
+        return clean
+
     def stop(self) -> None:
         if self._thread is not None:  # shutdown() deadlocks when
             self.shutdown()           # serve_forever never ran
@@ -284,10 +570,17 @@ class ScanServer(socketserver.ThreadingTCPServer):
             self._http.stop()
 
 
-def main(argv=None) -> None:
+def main(argv=None) -> int:
     """``python -m cobrix_tpu.serve [--host H] [--port P] [--http-port P]
-    [--cache-dir DIR] [--max-concurrent N]``"""
+    [--cache-dir DIR] [--max-concurrent N] [--audit-log PATH]
+    [--slo SPEC ...] [--flight-dir DIR] [--drain-timeout S]``
+
+    SIGTERM/SIGINT start a graceful drain: the listener closes,
+    `/healthz` answers 503 ``draining``, in-flight scans get
+    ``--drain-timeout`` seconds to finish, the audit log is flushed,
+    and the process exits 0 (clean) or 1 (scans were aborted)."""
     import argparse
+    import signal
 
     ap = argparse.ArgumentParser(
         description="cobrix_tpu multi-tenant streaming scan server")
@@ -300,6 +593,24 @@ def main(argv=None) -> None:
     ap.add_argument("--max-concurrent", type=int, default=16)
     ap.add_argument("--tenant-concurrent", type=int, default=4,
                     help="default per-tenant concurrent-scan quota")
+    ap.add_argument("--audit-log", default="",
+                    help="JSONL scan audit log path (rotated; "
+                         "tools/scanlog.py reads it)")
+    ap.add_argument("--audit-max-mb", type=float, default=64.0)
+    ap.add_argument("--slo", action="append", default=[],
+                    metavar="SPEC",
+                    help="objective, e.g. first_batch_p99=0.5, "
+                         "e2e_p95=3.0, roofline_min=0.05, "
+                         "error_rate=0.01 (repeatable)")
+    ap.add_argument("--flight-dir", default="",
+                    help="flight-recorder dump dir for scans breaching "
+                         "a latency SLO or erroring")
+    ap.add_argument("--flight-max-dumps", type=int, default=200,
+                    help="lifetime cap on evidence dumps (disk-fill "
+                         "guard; exhaustion is logged once)")
+    ap.add_argument("--drain-timeout", type=float, default=30.0,
+                    help="seconds in-flight scans get to finish on "
+                         "SIGTERM/SIGINT before forced abort")
     args = ap.parse_args(argv)
     server_options = ({"cache_dir": args.cache_dir} if args.cache_dir
                       else None)
@@ -308,16 +619,31 @@ def main(argv=None) -> None:
         default_quota=TenantQuota(max_concurrent=args.tenant_concurrent),
         max_concurrent_scans=args.max_concurrent,
         server_options=server_options,
-        http_port=args.http_port)
+        http_port=args.http_port,
+        audit_log=args.audit_log, audit_max_mb=args.audit_max_mb,
+        slos=args.slo, flight_dir=args.flight_dir,
+        flight_max_dumps=args.flight_max_dumps,
+        drain_timeout_s=args.drain_timeout)
     print(f"cobrix_tpu serving scans on {srv.address}, "
           f"obs on {srv.http_address}", flush=True)
+    stop_signal = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop_signal.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
     srv.start()
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        srv.stop()
+    stop_signal.wait()
+    print("cobrix_tpu serve: draining "
+          f"(up to {args.drain_timeout:.0f}s)...", flush=True)
+    clean = srv.drain()
+    srv.stop()
+    print("cobrix_tpu serve: "
+          + ("drained clean" if clean else
+             "FORCED abort: in-flight scans abandoned"), flush=True)
+    return 0 if clean else 1
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -20,10 +20,14 @@ optional Flight front-end both drive it):
   gate (backpressure, then a structured timeout — never an unbounded
   reorder buffer). Keep the byte budget above the pipeline's in-flight
   window (workers+2 chunks) or the gate can fire on a healthy scan;
-* the trailer — rows/batches/bytes, the ReadDiagnostics ledger JSON
+* the trailer — rows/batches/bytes, the request's
+  `request_id`/`trace_id` echo, the ReadDiagnostics ledger JSON
   (re-attached client-side so streamed tables carry byte-identical
-  schema metadata), and the read's io/plan-cache metrics, so a client
-  can assert warm-cache behavior without server shell access.
+  schema metadata), the read's io/plan-cache metrics, and — when the
+  client sent ``trace: true`` — the server-side trace spans + clock
+  sample the client merges into its own timeline, so a client can
+  assert warm-cache behavior and debug latency without server shell
+  access.
 """
 from __future__ import annotations
 
@@ -38,6 +42,16 @@ from .protocol import ServeError
 SERVER_OWNED_OPTIONS = ("trace_file", "cache_dir", "cache_max_mb",
                         "hosts")
 
+# read_cobol parameters the session itself supplies (path positionally,
+# the callbacks, the request tracer, and explain's return-type switch):
+# a client option with one of these names would raise a confusing
+# TypeError deep in the call — or silently change the session's
+# contract — instead of a structured protocol rejection here.
+# (copybook/copybook_contents/backend stay client-settable: they flow
+# through **kwargs into read_cobol's named parameters untouched.)
+RESERVED_OPTION_KEYS = ("path", "progress_callback", "batch_callback",
+                        "explain", "tracer")
+
 # streaming wants the pipelined engine (that is where first-batch
 # latency comes from); a request may still override explicitly
 DEFAULT_STREAM_OPTIONS = {"pipeline_workers": "-1"}
@@ -47,6 +61,8 @@ class ScanRequest:
     """Validated request payload (the 'R' frame JSON)."""
 
     def __init__(self, payload: dict):
+        from ..obs.trace import new_trace_id
+
         files = payload.get("files")
         if not files or not isinstance(files, (list, tuple)):
             raise ServeError("request must carry a non-empty 'files' "
@@ -62,6 +78,17 @@ class ScanRequest:
         self.max_records: Optional[int] = (None if max_records is None
                                            else int(max_records))
         self.want_progress = bool(payload.get("progress"))
+        # request-scoped identity: the client mints both ids (so ITS
+        # spans and logs already carry them before the server answers);
+        # requests from older/bare clients get server-minted ids so the
+        # audit record and trace are still addressable
+        self.request_id = str(payload.get("request_id") or "") \
+            or new_trace_id()[:16]
+        self.trace_id = str(payload.get("trace_id") or "") \
+            or new_trace_id()
+        # client opt-in: ship the server-side trace spans back on the
+        # trailer so the client can merge one cross-process Chrome trace
+        self.want_trace = bool(payload.get("trace"))
 
     def read_kwargs(self, server_options: Optional[dict]) -> dict:
         """The effective read_cobol option map: defaults, then client
@@ -74,8 +101,17 @@ class ScanRequest:
                 raise ServeError(
                     f"option '{key}' is server-owned and cannot be set "
                     "by a serving client", code="protocol")
+            if key in RESERVED_OPTION_KEYS:
+                raise ServeError(
+                    f"'{key}' is not a string option (it is a "
+                    "read_cobol parameter the session controls)",
+                    code="protocol")
             kw[key] = value
         kw.update(server_options or {})
+        # the request-level ids always win over option-level ones: the
+        # triple on the 'R' frame IS the identity the audit log keys on
+        kw["trace_id"] = self.trace_id
+        kw["request_id"] = self.request_id
         return kw
 
 
@@ -223,16 +259,35 @@ class OrderedBatchEmitter:
 class ScanSession:
     """Run one admitted request and deliver ordered Arrow tables to
     `write_table`; returns the summary trailer dict. Transport-neutral:
-    raising from `write_table` aborts the scan (dead client)."""
+    raising from `write_table` aborts the scan (dead client).
+
+    `tracer`: the request's `obs.Tracer` (trace_id already set from the
+    request) — injected into read_cobol so queue-wait and scan spans
+    share one timeline; the server's flight recorder and the client's
+    merged trace both read it. `force_progress` drives the progress
+    callback even when the client didn't opt into 'P' frames (the
+    `/debug/scans` live view needs ScanProgress regardless).
+    `force_field_costs` turns per-field attribution on server-side so a
+    flight-recorder dump carries the cost table."""
 
     def __init__(self, request: ScanRequest,
                  server_options: Optional[dict] = None,
                  controller=None,
-                 on_progress: Optional[Callable] = None):
+                 on_progress: Optional[Callable] = None,
+                 tracer=None,
+                 force_progress: bool = False,
+                 force_field_costs: bool = False):
         self.request = request
         self.server_options = server_options
         self.controller = controller
         self.on_progress = on_progress
+        self.tracer = tracer
+        self.force_progress = force_progress
+        self.force_field_costs = force_field_costs
+        # the finished scan's ReadMetrics (None until run() succeeds);
+        # the flight recorder reads field costs off it. The tracer is
+        # caller-owned, so trace evidence survives even a raised scan
+        self.metrics = None
         # the result's Arrow schema (set by run): lets the transport
         # send a valid EMPTY IPC stream when a scan produced no batches
         self.result_schema = None
@@ -245,15 +300,22 @@ class ScanSession:
             write_table, req.tenant, controller=self.controller,
             max_records=req.max_records)
         kwargs = req.read_kwargs(self.server_options)
+        if self.force_field_costs:
+            # operator-owned, like the ids in read_kwargs: the flight
+            # recorder's evidence must not be disableable by a client
+            # sending field_costs="false"
+            kwargs["field_costs"] = "true"
         progress_cb = None
-        if req.want_progress and self.on_progress is not None:
+        if self.on_progress is not None and (req.want_progress
+                                             or self.force_progress):
             progress_cb = self.on_progress
         t0 = time.monotonic()
         try:
             data = read_cobol(req.files if len(req.files) > 1
                               else req.files[0],
                               progress_callback=progress_cb,
-                              batch_callback=emitter.emit, **kwargs)
+                              batch_callback=emitter.emit,
+                              tracer=self.tracer, **kwargs)
             emitter.finish()
         except BaseException:
             emitter.abort()
@@ -261,6 +323,7 @@ class ScanSession:
         from ..reader.arrow_out import arrow_schema
 
         self.result_schema = arrow_schema(data.schema)
+        self.metrics = data.metrics
         diagnostics = (data.diagnostics.to_json()
                        if data.diagnostics is not None else None)
         summary = {
@@ -268,6 +331,8 @@ class ScanSession:
             "tables": emitter.tables_emitted,
             "records_total": len(data),
             "scan_s": round(time.monotonic() - t0, 6),
+            "request_id": req.request_id,
+            "trace_id": req.trace_id,
             "diagnostics": diagnostics,
         }
         if data.metrics is not None:
@@ -290,4 +355,13 @@ class ScanSession:
                 "field_costs": m.field_costs,
                 "roofline": m.roofline(),
             }
+        if req.want_trace and self.tracer is not None:
+            # the client asked for the server-side spans: ship them with
+            # the tracer's clock sample so the client can shift them
+            # onto ITS perf_counter axis (Tracer.merge) and export one
+            # cross-process Chrome trace. JSON turns span tuples into
+            # lists; merge() unpacks either
+            spans, clock = self.tracer.export_state()
+            summary["trace"] = {"trace_id": self.tracer.trace_id,
+                                "spans": spans, "clock": clock}
         return summary

@@ -1,0 +1,618 @@
+"""Request-scoped observability (PR 8): trace propagation over the
+serve protocol, the scan audit log + flight recorder, SLO burn
+tracking, /debug endpoints, graceful drain, and the zero-overhead
+contract when none of it is configured.
+
+The acceptance spine: a streamed scan yields ONE merged Chrome trace
+(client spans + server queue-wait + scan stages) under one trace_id;
+`tools/scanlog.py` resolves that trace_id to its audit record; a scan
+breaching a configured SLO leaves a flight-recorder dump carrying
+trace + field costs; and a server with no trace/audit/SLO config mints
+zero spans and zero attribution timestamps (counter-asserted like the
+PR 7 zero-timestamp path).
+"""
+import contextlib
+import io
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.obs import fieldcost
+from cobrix_tpu.obs.audit import (
+    AuditLog,
+    FlightRecorder,
+    ScanRecord,
+    read_audit_log,
+)
+from cobrix_tpu.obs.slo import SloTracker, parse_slo, parse_slos
+from cobrix_tpu.obs.trace import Tracer
+from cobrix_tpu.serve import (
+    ScanServer,
+    ServeError,
+    TenantQuota,
+    stream_scan,
+)
+from cobrix_tpu.testing.generators import EXP1_COPYBOOK, generate_exp1
+
+from util import hard_timeout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# multi-chunk so queue_wait/scan/chunk spans and first-batch latency
+# are all real
+RECORDS = 6000
+OPTS = dict(copybook_contents=EXP1_COPYBOOK, chunk_size_mb="1",
+            pipeline_workers="2")
+
+
+@pytest.fixture(scope="module")
+def fixed_file():
+    path = tempfile.mktemp(suffix=".dat")
+    with open(path, "wb") as f:
+        f.write(generate_exp1(RECORDS, seed=7).tobytes())
+    yield path
+    os.unlink(path)
+
+
+def _settle(predicate, timeout_s=10.0):
+    """The handler audits AFTER the client saw its trailer; poll."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def http_get(srv, path):
+    host, port = srv.http_address
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                    timeout=10) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as err:
+        return err.code, err.read()
+
+
+class _SlotHolder:
+    """A streamed scan paused after its first batch: holds a quota slot
+    / keeps the scan in flight until released."""
+
+    def __init__(self, address, path, tenant="etl"):
+        self.gate = threading.Event()
+        self.release = threading.Event()
+        self.rows = None
+        self.error = None
+
+        def run():
+            try:
+                with stream_scan(address, path, tenant=tenant,
+                                 **OPTS) as s:
+                    it = iter(s)
+                    first = next(it)
+                    self.gate.set()
+                    self.release.wait(60)
+                    self.rows = first.num_rows + sum(
+                        b.num_rows for b in it)
+            except Exception as exc:
+                self.gate.set()
+                self.error = exc
+
+        self.thread = threading.Thread(target=run)
+        self.thread.start()
+
+    def finish(self):
+        self.release.set()
+        self.thread.join()
+
+
+# -- trace propagation ----------------------------------------------------
+
+
+class TestTracePropagation:
+    def test_in_process_inbound_trace_context(self, fixed_file,
+                                              tmp_path):
+        """The `trace_id`/`request_id` read options tag the read's own
+        trace artifact — in-process callers join an upstream trace the
+        same way serving clients do."""
+        trace_path = str(tmp_path / "scan.json")
+        read_cobol(fixed_file, copybook_contents=EXP1_COPYBOOK,
+                   trace_file=trace_path, trace_id="inbound-trace",
+                   request_id="req-42")
+        doc = json.load(open(trace_path))
+        assert doc["trace_id"] == "inbound-trace"
+        roots = [e for e in doc["traceEvents"]
+                 if (e.get("args") or {}).get("trace_id")]
+        assert roots and roots[0]["args"]["request_id"] == "req-42"
+
+    def test_tracer_mints_unique_trace_ids(self):
+        assert Tracer().trace_id != Tracer().trace_id
+
+    def test_streamed_scan_yields_one_merged_trace(self, fixed_file,
+                                                   tmp_path):
+        """THE acceptance path: client-side, queue, and server scan
+        spans in one Chrome trace sharing one trace_id."""
+        with hard_timeout(120, "merged trace"):
+            srv = ScanServer().start()
+            try:
+                with stream_scan(srv.address, fixed_file, tenant="etl",
+                                 trace=True, **OPTS) as stream:
+                    rows = sum(b.num_rows for b in stream)
+                    summary = stream.summary
+                    trace_path = str(tmp_path / "merged.json")
+                    stream.write_chrome_trace(trace_path)
+                    client_trace_id = stream.trace_id
+                    client_request_id = stream.request_id
+            finally:
+                srv.stop()
+        assert rows == RECORDS
+        # the trailer echoes the client-minted identity
+        assert summary["request_id"] == client_request_id
+        assert summary["trace_id"] == client_trace_id
+        assert summary["queue_wait_s"] >= 0
+        doc = json.load(open(trace_path))
+        assert doc["trace_id"] == client_trace_id
+        names = {e["name"] for e in doc["traceEvents"]}
+        # client-side spans
+        assert {"connect", "send_request", "wait_first_batch",
+                "consume_stream"} <= names
+        # server-side: admission queue wait + the scan stage spans
+        assert "queue_wait" in names
+        assert "scan" in names
+        # every root-args trace_id agrees (client and server tracer
+        # roots both carry it)
+        tagged = [e["args"]["trace_id"] for e in doc["traceEvents"]
+                  if (e.get("args") or {}).get("trace_id")]
+        assert tagged and set(tagged) == {client_trace_id}
+
+    def test_reserved_option_is_a_protocol_error(self, fixed_file):
+        """A client option shadowing a read_cobol PYTHON parameter the
+        session supplies (tracer, callbacks, explain) is rejected as a
+        structured protocol error — not a TypeError deep in the call
+        audited as a scan failure."""
+        with hard_timeout(60, "reserved option"):
+            srv = ScanServer().start()
+            try:
+                with pytest.raises(ServeError) as err:
+                    with stream_scan(srv.address, fixed_file,
+                                     tenant="etl",
+                                     **dict(OPTS, tracer="x")) as s:
+                        list(s)
+                assert err.value.code == "protocol"
+                assert "tracer" in str(err.value)
+                # audited like a rejection: a misbehaving client must
+                # not burn error-budget SLOs or spend flight dumps
+                assert _settle(lambda: len(srv.flight.recent(5)) == 1)
+                assert srv.flight.recent(5)[0].outcome == "rejected"
+            finally:
+                srv.stop()
+
+    def test_trace_absent_unless_requested(self, fixed_file):
+        with hard_timeout(120, "trailer opt-out"):
+            srv = ScanServer().start()
+            try:
+                with stream_scan(srv.address, fixed_file, tenant="etl",
+                                 **OPTS) as stream:
+                    for _ in stream:
+                        pass
+                    assert "trace" not in stream.summary
+                    # ids still round-trip for audit correlation
+                    assert stream.summary["request_id"] == \
+                        stream.request_id
+            finally:
+                srv.stop()
+
+
+# -- audit log ------------------------------------------------------------
+
+
+class TestAuditLog:
+    def test_rotation_bounds_size(self, tmp_path):
+        path = str(tmp_path / "audit.log")
+        log = AuditLog(path, max_mb=0.0002, keep=2)  # ~200 bytes
+        for i in range(40):
+            log.append(ScanRecord(request_id=f"r{i:04d}", trace_id="t",
+                                  tenant="a", outcome="ok"))
+        names = sorted(os.listdir(tmp_path))
+        assert names == ["audit.log", "audit.log.1", "audit.log.2"]
+        for name in names:
+            assert os.path.getsize(tmp_path / name) <= 300
+        # newest record is in the live file; rotated generations parse
+        recs = list(read_audit_log(path, include_rotated=True))
+        assert recs[-1].request_id == "r0039"
+        assert all(r.tenant == "a" for r in recs)
+
+    def test_malformed_lines_are_skipped(self, tmp_path):
+        path = str(tmp_path / "audit.log")
+        log = AuditLog(path)
+        log.append(ScanRecord(request_id="good", trace_id="t",
+                              tenant="a", outcome="ok"))
+        with open(path, "a") as f:
+            f.write("NOT JSON\n{\"half\": \n")
+        log.append(ScanRecord(request_id="good2", trace_id="t",
+                              tenant="a", outcome="ok"))
+        assert [r.request_id for r in read_audit_log(path)] == \
+            ["good", "good2"]
+
+    def test_served_scans_reach_the_audit_log(self, fixed_file,
+                                              tmp_path):
+        """ok, error, and rejected outcomes all land with matching
+        request_ids, and scanlog's tail filter resolves the trace_id
+        (the acceptance's 'scanlog resolves that trace_id' clause)."""
+        audit_path = str(tmp_path / "audit.log")
+        with hard_timeout(120, "served audit"):
+            srv = ScanServer(
+                audit_log=audit_path,
+                default_quota=TenantQuota(max_concurrent=1,
+                                          max_queued=0)).start()
+            try:
+                with stream_scan(srv.address, fixed_file, tenant="etl",
+                                 **OPTS) as stream:
+                    for _ in stream:
+                        pass
+                    ok_ids = (stream.request_id, stream.trace_id)
+                with pytest.raises(ServeError):
+                    with stream_scan(srv.address, "/no/such/file",
+                                     tenant="etl", **OPTS) as stream:
+                        list(stream)
+                holder = _SlotHolder(srv.address, fixed_file)
+                assert holder.gate.wait(30)
+                with pytest.raises(ServeError):
+                    with stream_scan(srv.address, fixed_file,
+                                     tenant="etl", **OPTS) as s:
+                        list(s)
+                holder.finish()
+                assert holder.error is None
+                assert _settle(lambda: len(list(
+                    read_audit_log(audit_path))) >= 4)
+            finally:
+                srv.stop()
+        records = list(read_audit_log(audit_path))
+        by_outcome = {}
+        for r in records:
+            by_outcome.setdefault(r.outcome, []).append(r)
+        assert by_outcome["ok"] and by_outcome["error"] \
+            and by_outcome["rejected"]
+        ok_rec = [r for r in by_outcome["ok"]
+                  if r.request_id == ok_ids[0]]
+        assert ok_rec and ok_rec[0].trace_id == ok_ids[1]
+        assert ok_rec[0].rows == RECORDS
+        assert ok_rec[0].first_batch_s is not None
+        assert ok_rec[0].e2e_s >= ok_rec[0].first_batch_s
+        assert by_outcome["error"][0].error.startswith(
+            "FileNotFoundError")
+        assert "queue_full" in by_outcome["rejected"][0].error
+        # scanlog tail: the trace_id resolves to exactly this record
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        import scanlog
+
+        class _Args:
+            path = audit_path
+            n = 20
+            tenant = ""
+            outcome = ""
+            trace_id = ok_ids[1]
+            request_id = ""
+            breached = False
+            json = True
+            all = False
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = scanlog.cmd_tail(_Args)
+        assert rc == 0
+        resolved = [json.loads(line) for line in
+                    buf.getvalue().splitlines()]
+        assert len(resolved) == 1
+        assert resolved[0]["request_id"] == ok_ids[0]
+
+
+# -- SLOs -----------------------------------------------------------------
+
+
+class TestSlo:
+    def test_parse_specs(self):
+        slo = parse_slo("first_batch_p99=0.5")
+        assert (slo.kind, slo.threshold, slo.objective) == \
+            ("first_batch", 0.5, 0.99)
+        assert parse_slo("e2e_p95=3").objective == 0.95
+        assert parse_slo("roofline_min=0.05").kind == "roofline"
+        assert parse_slo("error_rate=0.01").objective == 0.99
+        for bad in ("p99=1", "first_batch=1", "roofline_min=2",
+                    "error_rate=1.5", "e2e_p999=1"):
+            with pytest.raises(ValueError):
+                parse_slo(bad)
+        with pytest.raises(ValueError):
+            parse_slos(["error_rate=0.1", "error_rate=0.2"])
+
+    def test_evaluation_matrix(self):
+        slos = parse_slos(["first_batch_p99=0.1", "e2e_p95=1.0",
+                           "roofline_min=0.5", "error_rate=0.01"])
+        tracker = SloTracker(slos)
+
+        def rec(**kw):
+            base = dict(request_id="r", trace_id="t", tenant="matrix",
+                        outcome="ok")
+            base.update(kw)
+            return ScanRecord(**base)
+
+        # fast + efficient scan: everything good
+        assert tracker.observe(rec(first_batch_s=0.05, e2e_s=0.5,
+                                   roofline_fraction=0.9)) == []
+        # slow first batch only
+        assert tracker.observe(rec(first_batch_s=0.5, e2e_s=0.5,
+                                   roofline_fraction=0.9)) == \
+            ["first_batch_p99"]
+        # error: every objective burns (the user's request failed)
+        breaches = tracker.observe(rec(outcome="error"))
+        assert set(breaches) == {"first_batch_p99", "e2e_p95",
+                                 "roofline_min", "error_rate"}
+        # rejected scans never count against scan SLOs, and neither do
+        # client hangups — the scan plane did its job both times
+        assert tracker.observe(rec(outcome="rejected")) == []
+        assert tracker.observe(rec(outcome="client_gone")) == []
+        # missing measurements are not applicable, not bad
+        assert tracker.observe(rec()) == []
+        status = tracker.status()
+        assert status["first_batch_p99"]["good"] == 1
+        assert status["first_batch_p99"]["bad"] == 2
+        assert status["first_batch_p99"]["burning"] is True
+        assert status["error_rate"]["good"] == 3
+
+    def test_served_slo_counters_and_healthz(self, fixed_file):
+        """An impossible first-batch objective: every scan is 'bad',
+        the burn-rate counters and /healthz say so."""
+        with hard_timeout(120, "slo serve"):
+            srv = ScanServer(slos=["first_batch_p99=0.000001",
+                                   "error_rate=0.01"]).start()
+            try:
+                with stream_scan(srv.address, fixed_file,
+                                 tenant="slocheck", **OPTS) as stream:
+                    for _ in stream:
+                        pass
+                assert _settle(lambda: srv.slo.status()[
+                    "first_batch_p99"]["bad"] >= 1)
+                _code, body = http_get(srv, "/metrics")
+                text = body.decode()
+                assert ('cobrix_slo_bad_total{slo="first_batch_p99",'
+                        'tenant="slocheck"} 1') in text
+                assert ('cobrix_slo_good_total{slo="error_rate",'
+                        'tenant="slocheck"} 1') in text
+                code, body = http_get(srv, "/healthz")
+                doc = json.loads(body)
+                assert code == 200
+                assert doc["slo"]["first_batch_p99"]["burning"] is True
+                assert doc["slo"]["error_rate"]["burning"] is False
+            finally:
+                srv.stop()
+
+
+# -- flight recorder ------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_and_dump_unit(self, tmp_path):
+        fr = FlightRecorder(ring_size=3, dump_dir=str(tmp_path))
+        healthy = ScanRecord(request_id="h", trace_id="t", tenant="a",
+                             outcome="ok")
+        assert fr.observe(healthy) is None  # no breach -> no dump
+        tracer = Tracer(trace_id="dump-trace")
+        with tracer.span("decode"):
+            pass
+        bad = ScanRecord(request_id="slow1", trace_id="dump-trace",
+                         tenant="a", outcome="ok",
+                         slo_breaches=["first_batch_p99"])
+        dump = fr.observe(bad, tracer=tracer,
+                          field_costs={"F1": {"decode_s": 0.5}})
+        assert dump and os.path.isdir(dump)
+        assert bad.dump_path == dump
+        trace = json.load(open(os.path.join(dump, "trace.json")))
+        assert trace["trace_id"] == "dump-trace"
+        costs = json.load(open(os.path.join(dump, "field_costs.json")))
+        assert costs["F1"]["decode_s"] == 0.5
+        # ring keeps the last N, newest first
+        for i in range(5):
+            fr.observe(ScanRecord(request_id=f"r{i}", trace_id="t",
+                                  tenant="a", outcome="ok"))
+        recent = fr.recent(10)
+        assert [r.request_id for r in recent] == ["r4", "r3", "r2"]
+        assert fr.recent(10, outcome="bad") == []
+
+    def test_breach_dumps_trace_and_field_costs(self, fixed_file,
+                                                tmp_path):
+        """Acceptance: a scan breaching a configured SLO produces a
+        flight-recorder dump with trace + field costs — WITHOUT the
+        client asking for anything."""
+        flight_dir = str(tmp_path / "flight")
+        with hard_timeout(120, "flight dump"):
+            srv = ScanServer(slos=["first_batch_p99=0.000001"],
+                             flight_dir=flight_dir).start()
+            try:
+                with stream_scan(srv.address, fixed_file, tenant="etl",
+                                 **OPTS) as stream:
+                    for _ in stream:
+                        pass
+                    request_id = stream.request_id
+                    trace_id = stream.trace_id
+                assert _settle(
+                    lambda: os.path.isdir(flight_dir) and any(
+                        request_id in d and os.path.exists(os.path.join(
+                            flight_dir, d, "field_costs.json"))
+                        for d in os.listdir(flight_dir)))
+            finally:
+                srv.stop()
+        dump = [d for d in os.listdir(flight_dir) if request_id in d][0]
+        dump = os.path.join(flight_dir, dump)
+        record = json.load(open(os.path.join(dump, "record.json")))
+        assert record["slo_breaches"] == ["first_batch_p99"]
+        assert record["trace_id"] == trace_id
+        trace = json.load(open(os.path.join(dump, "trace.json")))
+        assert trace["trace_id"] == trace_id
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "queue_wait" in names and "scan" in names
+        costs = json.load(open(os.path.join(dump, "field_costs.json")))
+        assert costs  # per-field table present (force_field_costs)
+
+    def test_error_scan_dumps_too(self, fixed_file, tmp_path):
+        flight_dir = str(tmp_path / "flight")
+        with hard_timeout(120, "error dump"):
+            srv = ScanServer(flight_dir=flight_dir).start()
+            try:
+                with pytest.raises(ServeError):
+                    with stream_scan(srv.address, "/no/such/file",
+                                     tenant="etl", **OPTS) as stream:
+                        list(stream)
+                assert _settle(lambda: os.path.isdir(flight_dir)
+                               and any(os.path.exists(os.path.join(
+                                   flight_dir, d, "trace.json"))
+                                   for d in os.listdir(flight_dir)))
+            finally:
+                srv.stop()
+        dump = os.path.join(flight_dir, os.listdir(flight_dir)[0])
+        record = json.load(open(os.path.join(dump, "record.json")))
+        assert record["outcome"] == "error"
+        assert record["error"].startswith("FileNotFoundError")
+        # the partial trace still exists (queue wait at minimum)
+        trace = json.load(open(os.path.join(dump, "trace.json")))
+        assert any(e["name"] == "queue_wait"
+                   for e in trace["traceEvents"])
+
+
+# -- /debug endpoints -----------------------------------------------------
+
+
+class TestDebugEndpoints:
+    def test_debug_surface(self, fixed_file):
+        with hard_timeout(120, "debug endpoints"):
+            srv = ScanServer(slos=["error_rate=0.01"]).start()
+            try:
+                holder = _SlotHolder(srv.address, fixed_file)
+                assert holder.gate.wait(30)
+                _code, body = http_get(srv, "/debug/scans")
+                seen_active = json.loads(body)
+                holder.finish()
+                assert holder.error is None
+                # live view: the in-flight scan was listed with identity
+                assert len(seen_active["scans"]) == 1
+                entry = seen_active["scans"][0]
+                assert entry["tenant"] == "etl"
+                assert entry["files"] == [fixed_file]
+                assert entry["request_id"] and entry["trace_id"]
+                assert _settle(lambda: len(json.loads(http_get(
+                    srv, "/debug/recent")[1])["recent"]) >= 1)
+                recent = json.loads(
+                    http_get(srv, "/debug/recent")[1])["recent"]
+                assert recent[0]["outcome"] == "ok"
+                assert recent[0]["rows"] == RECORDS
+                assert json.loads(
+                    http_get(srv, "/debug/errors")[1])["errors"] == []
+                doc = json.loads(http_get(srv, "/debug/slo")[1])
+                assert doc["configured"] is True
+                assert doc["slo"]["error_rate"]["good"] >= 1
+                cfg = json.loads(http_get(srv, "/debug/config")[1])
+                assert cfg["max_concurrent_scans"] == 16
+                assert cfg["slos"][0]["name"] == "error_rate"
+                assert http_get(srv, "/debug/nope")[0] == 404
+                # after completion the live view empties
+                assert _settle(lambda: json.loads(http_get(
+                    srv, "/debug/scans")[1])["scans"] == [])
+            finally:
+                srv.stop()
+
+    def test_process_gauges_on_metrics(self):
+        with hard_timeout(60, "process gauges"):
+            srv = ScanServer().start()
+            try:
+                _code, body = http_get(srv, "/metrics")
+                text = body.decode()
+                assert "cobrix_process_uptime_seconds" in text
+                assert "cobrix_process_rss_bytes" in text
+                assert "cobrix_serve_open_scans 0" in text
+                rss = [line for line in text.splitlines()
+                       if line.startswith("cobrix_process_rss_bytes ")]
+                assert float(rss[0].split()[1]) > 1e6  # a real process
+            finally:
+                srv.stop()
+
+
+# -- graceful drain -------------------------------------------------------
+
+
+class TestDrain:
+    def test_drain_finishes_inflight_then_cleans(self, fixed_file):
+        with hard_timeout(120, "drain"):
+            srv = ScanServer().start()
+            holder = _SlotHolder(srv.address, fixed_file)
+            assert holder.gate.wait(30)
+            drained = {}
+
+            def drainer():
+                drained["clean"] = srv.drain(timeout_s=60)
+
+            dt = threading.Thread(target=drainer)
+            dt.start()
+            # while draining: healthz answers 503 'draining' so
+            # balancers stop routing, but the listener for scrapes
+            # stays alive
+            assert _settle(lambda: srv.draining)
+            code, body = http_get(srv, "/healthz")
+            assert code == 503
+            assert json.loads(body)["status"] == "draining"
+            # the in-flight scan is allowed to finish
+            holder.finish()
+            dt.join()
+            assert drained["clean"] is True
+            assert holder.error is None
+            assert holder.rows == RECORDS
+            srv.stop()
+
+    def test_drain_timeout_reports_forced_abort(self, fixed_file):
+        with hard_timeout(60, "drain timeout"):
+            srv = ScanServer().start()
+            holder = _SlotHolder(srv.address, fixed_file)
+            assert holder.gate.wait(30)
+            # the scan is pinned open past the drain window
+            assert srv.drain(timeout_s=0.3) is False
+            holder.finish()
+            srv.stop()
+
+
+# -- zero overhead when fully off ----------------------------------------
+
+
+class TestZeroOverhead:
+    def test_no_spans_no_timers_without_config(self, fixed_file):
+        """No trace/audit/SLO/flight config -> the scan mints ZERO span
+        ids (the shared process-wide counter does not move) and takes
+        ZERO field-cost timestamps — the PR 7 discipline extended to
+        the serving tier."""
+        with hard_timeout(120, "zero overhead"):
+            srv = ScanServer().start()
+            try:
+                probe = Tracer()  # ids come from the shared counter
+                base = probe.new_id()
+                timers = fieldcost.timer_calls()
+                with stream_scan(srv.address, fixed_file, tenant="etl",
+                                 **OPTS) as stream:
+                    rows = sum(b.num_rows for b in stream)
+                    summary = stream.summary
+                # settle: the handler's finally runs after the trailer
+                assert _settle(
+                    lambda: len(srv.flight.recent(5)) == 1)
+                assert rows == RECORDS
+                assert "trace" not in summary
+                assert probe.new_id() == base + 1  # zero spans between
+                assert fieldcost.timer_calls() == timers
+                # the always-on ring still recorded the scan (one
+                # record per REQUEST, not per record)
+                assert srv.flight.recent(5)[0].rows == RECORDS
+            finally:
+                srv.stop()

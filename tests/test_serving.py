@@ -644,3 +644,117 @@ def test_batch_callback_delivers_none_for_failed_chunks(fixed_file):
         delivered = [got[i] for i in sorted(got) if got[i] is not None]
         assert pa.concat_tables(delivered).replace_schema_metadata(None) \
             .equals(table.replace_schema_metadata(None))
+
+
+# -- concurrent multi-tenant ObsContext isolation (PR 8 satellite) -------
+
+
+def test_concurrent_tenant_obs_isolation(server):
+    """Two SIMULTANEOUS streamed scans from different tenants must not
+    cross-contaminate trace spans, field costs, or IoStats — the PR 4
+    per-read isolation guarantee extended through serve/session.py.
+
+    Each tenant scans a DIFFERENT-SIZED memory:// input with tracing
+    and attribution on; any leakage between the two concurrent
+    ObsContexts would show up as a wrong per-field value count, a
+    wrong remote-byte total, or a foreign span in the merged trace."""
+    fsspec = pytest.importorskip("fsspec")
+    fs = fsspec.filesystem("memory")
+    sizes = {"tenant-a": 2500, "tenant-b": 900}
+    urls = {}
+    raw_bytes = {}
+    for tenant, n in sizes.items():
+        payload = generate_exp1(n, seed=len(tenant)).tobytes()
+        url = f"memory://iso-{uuid.uuid4().hex}/{tenant}.dat"
+        with fs.open(url.replace("memory://", "/"), "wb") as f:
+            f.write(payload)
+        urls[tenant] = url
+        raw_bytes[tenant] = len(payload)
+
+    barrier = threading.Barrier(len(sizes))
+    results = {}
+    errors = {}
+
+    def scan(tenant):
+        try:
+            barrier.wait(30)
+            with stream_scan(server.address, urls[tenant],
+                             tenant=tenant, trace=True,
+                             field_costs="true", io_block_mb="0.125",
+                             **FIXED_OPTS) as s:
+                rows = sum(b.num_rows for b in s)
+                results[tenant] = {
+                    "rows": rows,
+                    "summary": s.summary,
+                    "trace": s.chrome_trace(),
+                    "trace_id": s.trace_id,
+                }
+        except Exception as exc:  # pragma: no cover - assertion below
+            errors[tenant] = exc
+
+    with hard_timeout(180, "tenant obs isolation"):
+        threads = [threading.Thread(target=scan, args=(t,))
+                   for t in sizes]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors, errors
+    assert results["tenant-a"]["trace_id"] != \
+        results["tenant-b"]["trace_id"]
+    for tenant, n in sizes.items():
+        res = results[tenant]
+        assert res["rows"] == n
+        m = res["summary"]["metrics"]
+        # bytes: each scan accounted exactly its own input
+        assert m["bytes_read"] == raw_bytes[tenant]
+        # IoStats: the remote plane charged this read ONLY its own
+        # fetched bytes (block-aligned, so slightly above raw; a leaked
+        # context would at least add the OTHER tenant's whole input)
+        assert m["io"] is not None
+        fetched = m["io"]["bytes_fetched"]
+        assert raw_bytes[tenant] <= fetched < raw_bytes[tenant] * 1.2
+        # field costs: every attributed field saw exactly this scan's
+        # record count — a foreign chunk would inflate it
+        fc = m["field_costs"]
+        assert fc, "attribution was on"
+        assert {v["values"] for v in fc.values()} == {n}
+        # trace spans: the merged artifact's root args carry THIS
+        # request's identity and record count, and every tagged span
+        # agrees on the trace_id
+        events = res["trace"]["traceEvents"]
+        tagged = {e["args"]["trace_id"] for e in events
+                  if (e.get("args") or {}).get("trace_id")}
+        assert tagged == {res["trace_id"]}
+        roots = [e["args"] for e in events
+                 if (e.get("args") or {}).get("records") is not None]
+        assert roots and roots[0]["records"] == n
+        assert roots[0]["tenant"] == tenant
+
+
+# -- servecheck smoke (the chunk x workers grid stays behind `slow`) -----
+
+
+def test_servecheck_quick():
+    """The full tool in quick mode: parity, first-batch latency, quota,
+    scrape, AND the request-scoped obs section (merged trace, audit
+    request_ids, /debug, chaos-slow flight dump)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/servecheck.py", "--mb", "3"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "request-scoped obs" in proc.stdout
+
+
+@pytest.mark.slow
+def test_servecheck_sweep():
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "tools/servecheck.py", "--mb", "6", "--sweep"],
+        capture_output=True, text=True, timeout=1200)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,248 @@
+"""Scan audit-log reader: tail, filter, summarize, and group traces.
+
+The serving tier writes one JSONL ScanRecord per completed / failed /
+rejected scan (obs/audit.py, the ``audit_log`` server knob). This tool
+is the operator's grep with the schema built in:
+
+    python tools/scanlog.py tail AUDIT.log                  # last 20
+    python tools/scanlog.py tail AUDIT.log -n 50 --json
+    python tools/scanlog.py tail AUDIT.log --tenant etl \\
+                                           --outcome error
+    python tools/scanlog.py tail AUDIT.log --trace-id 645c1539...
+    python tools/scanlog.py tail AUDIT.log --request-id 0488...
+    python tools/scanlog.py summary AUDIT.log               # rollup
+    python tools/scanlog.py traceview TRACE.json [...]      # group
+    python tools/scanlog.py traceview FLIGHT_DUMP_DIR/      # by id
+
+* ``tail`` — newest records first, filtered by tenant / outcome /
+  trace_id / request_id / breached SLO; resolves "this slow request's
+  trace_id" to its audit record (and its flight-recorder dump path,
+  when one was written).
+* ``summary`` — per-tenant and per-outcome counts, latency quantiles
+  (queue wait / first batch / e2e), breach counts, byte totals.
+* ``traceview`` — loads Chrome-trace artifacts (client-merged files,
+  flight-recorder ``trace.json`` dumps, or a directory of either) and
+  groups spans by the artifact's ``trace_id``: per request one line of
+  span counts, wall span, and the slowest spans — the "which request
+  was it" view `tools/traceview.py` (per-artifact deep dive)
+  deliberately does not have.
+
+Rotated generations (``AUDIT.log.1`` ...) are included with ``--all``.
+Exit code: 0 on success, 1 when a filter matched nothing (so CI can
+assert "this request reached the log").
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_records(path: str, include_rotated: bool) -> List:
+    from cobrix_tpu.obs.audit import read_audit_log
+
+    return list(read_audit_log(path, include_rotated=include_rotated))
+
+
+def _fmt_latency(v: Optional[float]) -> str:
+    return f"{v * 1000:8.1f}ms" if v is not None else "       - "
+
+
+def _render(rec) -> str:
+    flags = ""
+    if rec.slo_breaches:
+        flags = " BREACH[" + ",".join(rec.slo_breaches) + "]"
+    if rec.dump_path:
+        flags += f" dump={rec.dump_path}"
+    err = f" err={rec.error}" if rec.error else ""
+    return (f"{rec.request_id:<17} {rec.tenant:<10} {rec.outcome:<8} "
+            f"rows={rec.rows:<9} q={_fmt_latency(rec.queue_wait_s)} "
+            f"first={_fmt_latency(rec.first_batch_s)} "
+            f"e2e={_fmt_latency(rec.e2e_s)} "
+            f"trace={rec.trace_id[:12]}{flags}{err}")
+
+
+def cmd_tail(args) -> int:
+    records = _load_records(args.path, args.all)
+    records.reverse()  # newest first
+    out = []
+    for rec in records:
+        if args.tenant and rec.tenant != args.tenant:
+            continue
+        if args.outcome and rec.outcome != args.outcome:
+            continue
+        if args.trace_id and not rec.trace_id.startswith(args.trace_id):
+            continue
+        if args.request_id and \
+                not rec.request_id.startswith(args.request_id):
+            continue
+        if args.breached and not rec.slo_breaches:
+            continue
+        out.append(rec)
+        if len(out) >= args.n:
+            break
+    for rec in out:
+        print(json.dumps(rec.as_dict(), sort_keys=True) if args.json
+              else _render(rec))
+    if not out:
+        print("no matching records", file=sys.stderr)
+        return 1
+    return 0
+
+
+def _quantiles(values: List[float]) -> str:
+    if not values:
+        return "-"
+    values = sorted(values)
+
+    def q(f: float) -> float:
+        return values[min(len(values) - 1, int(f * len(values)))]
+
+    return (f"p50={q(0.50) * 1000:.1f}ms p95={q(0.95) * 1000:.1f}ms "
+            f"p99={q(0.99) * 1000:.1f}ms max={values[-1] * 1000:.1f}ms")
+
+
+def cmd_summary(args) -> int:
+    records = _load_records(args.path, args.all)
+    if not records:
+        print("no records", file=sys.stderr)
+        return 1
+    by_tenant = {}
+    for rec in records:
+        t = by_tenant.setdefault(rec.tenant, {
+            "ok": 0, "error": 0, "rejected": 0, "client_gone": 0,
+            "rows": 0, "bytes": 0,
+            "queue": [], "first": [], "e2e": [], "breaches": 0})
+        t[rec.outcome] = t.get(rec.outcome, 0) + 1
+        t["rows"] += rec.rows
+        t["bytes"] += rec.bytes_streamed
+        t["breaches"] += 1 if rec.slo_breaches else 0
+        for key, v in (("queue", rec.queue_wait_s),
+                       ("first", rec.first_batch_s),
+                       ("e2e", rec.e2e_s)):
+            if v is not None:
+                t[key].append(v)
+    print(f"{len(records)} records, {len(by_tenant)} tenant(s)")
+    for tenant in sorted(by_tenant):
+        t = by_tenant[tenant]
+        print(f"\ntenant {tenant}: ok={t['ok']} error={t['error']} "
+              f"rejected={t['rejected']} "
+              f"client_gone={t['client_gone']} rows={t['rows']} "
+              f"streamed={t['bytes'] / 1e6:.1f}MB "
+              f"slo_breaches={t['breaches']}")
+        print(f"  queue wait   {_quantiles(t['queue'])}")
+        print(f"  first batch  {_quantiles(t['first'])}")
+        print(f"  e2e          {_quantiles(t['e2e'])}")
+    return 0
+
+
+def _trace_files(paths: List[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, files in os.walk(p):
+                out.extend(os.path.join(root, f) for f in files
+                           if f.endswith(".json"))
+        else:
+            out.append(p)
+    return sorted(out)
+
+
+def cmd_traceview(args) -> int:
+    """Group Chrome-trace artifacts by trace_id: one summary line per
+    request plus its slowest spans."""
+    groups = {}
+    for path in _trace_files(args.paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        events = doc.get("traceEvents")
+        if not isinstance(events, list):
+            continue  # not a trace artifact (e.g. a dump's record.json)
+        trace_id = str(doc.get("trace_id") or "untagged")
+        g = groups.setdefault(trace_id, {"files": [], "spans": [],
+                                         "meta": {}})
+        g["files"].append(path)
+        for ev in events:
+            if ev.get("ph") != "X":
+                continue
+            g["spans"].append((ev.get("name", "?"),
+                               float(ev.get("dur", 0.0)) / 1e6,
+                               float(ev.get("ts", 0.0)) / 1e6,
+                               ev.get("pid")))
+            ev_args = ev.get("args") or {}
+            for key in ("request_id", "tenant"):
+                if key in ev_args:
+                    g["meta"][key] = ev_args[key]
+    if not groups:
+        print("no trace artifacts found", file=sys.stderr)
+        return 1
+    for trace_id in sorted(groups):
+        g = groups[trace_id]
+        spans = g["spans"]
+        t0 = min((s[2] for s in spans), default=0.0)
+        t1 = max((s[2] + s[1] for s in spans), default=0.0)
+        pids = {s[3] for s in spans}
+        meta = " ".join(f"{k}={v}" for k, v in sorted(g["meta"].items()))
+        print(f"trace {trace_id}: {len(spans)} spans, "
+              f"{len(pids)} process(es), wall {t1 - t0:.3f}s, "
+              f"{len(g['files'])} artifact(s) {meta}")
+        for name, dur, _ts, _pid in sorted(
+                spans, key=lambda s: -s[1])[:args.top]:
+            print(f"    {name:<28} {dur * 1000:10.2f}ms")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    tail = sub.add_parser("tail", help="newest records, filtered")
+    tail.add_argument("path")
+    tail.add_argument("-n", type=int, default=20)
+    tail.add_argument("--tenant", default="")
+    tail.add_argument("--outcome", default="",
+                      choices=("", "ok", "error", "rejected",
+                               "client_gone"))
+    tail.add_argument("--trace-id", default="",
+                      help="prefix match on trace_id")
+    tail.add_argument("--request-id", default="",
+                      help="prefix match on request_id")
+    tail.add_argument("--breached", action="store_true",
+                      help="only scans that breached an SLO")
+    tail.add_argument("--json", action="store_true",
+                      help="raw JSONL instead of columns")
+    tail.add_argument("--all", action="store_true",
+                      help="include rotated generations")
+    tail.set_defaults(fn=cmd_tail)
+
+    summary = sub.add_parser("summary", help="per-tenant rollup")
+    summary.add_argument("path")
+    summary.add_argument("--all", action="store_true")
+    summary.set_defaults(fn=cmd_summary)
+
+    tv = sub.add_parser(
+        "traceview",
+        help="group Chrome-trace artifacts by trace_id")
+    tv.add_argument("paths", nargs="+",
+                    help="trace JSON file(s) or directories "
+                         "(flight-recorder dumps)")
+    tv.add_argument("--top", type=int, default=5,
+                    help="slowest spans to list per trace")
+    tv.set_defaults(fn=cmd_traceview)
+
+    args = ap.parse_args()
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

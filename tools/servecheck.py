@@ -12,14 +12,22 @@ per-tenant quota, a streaming client, and the observability endpoints:
   3. a second concurrent scan over quota must be REJECTED with a
      structured error while the first still completes;
   4. scrape `/metrics` (per-tenant serve counters present) and
-     `/healthz` (status ok, admission snapshot).
+     `/healthz` (status ok, admission snapshot);
+  5. request-scoped observability end to end: a traced scan yields ONE
+     merged client+server Chrome trace under the request's trace_id,
+     the audit log contains every scan this check ran (matched by
+     request_id), `/debug/recent|scans|slo|config` answer, and a
+     deliberately slow chaos scan (per-read latency injection) breaches
+     the first-batch SLO and leaves a flight-recorder dump with trace,
+     field costs, and record.
 
     python tools/servecheck.py              # quick: ~8 MB input
     python tools/servecheck.py --mb 64      # bigger input
     python tools/servecheck.py --sweep      # chunk x workers grid
                                             # (slow; tier-1 runs quick)
 
-Exit code 0 = parity + latency + quota + scrape all hold; 1 otherwise.
+Exit code 0 = parity + latency + quota + scrape + request-obs all hold;
+1 otherwise.
 """
 from __future__ import annotations
 
@@ -153,6 +161,142 @@ def check(path: str, chunk_mb: str, workers: str,
         srv.stop()
 
 
+def _audit_has(audit_path: str, request_id: str) -> bool:
+    try:
+        with open(audit_path, encoding="utf-8") as f:
+            return any(request_id in line for line in f)
+    except OSError:
+        return False
+
+
+def check_request_obs(path: str) -> bool:
+    """Tentpole end-to-end: trace propagation, audit log, /debug, SLO
+    breach -> flight-recorder dump (via a genuinely slow chaos scan)."""
+    import shutil
+
+    from cobrix_tpu.serve import ScanServer, stream_scan
+    from cobrix_tpu.testing.faults import register_chaos_backend
+    from cobrix_tpu.testing.generators import EXP1_COPYBOOK
+
+    ok = True
+
+    def fail(msg: str) -> None:
+        nonlocal ok
+        ok = False
+        print(f"{'':<10} FAILED: {msg}")
+
+    workdir = tempfile.mkdtemp(prefix="servecheck-obs-")
+    audit_path = os.path.join(workdir, "audit.log")
+    flight_dir = os.path.join(workdir, "flight")
+    # the slow tenant reads through a chaos backend with per-read
+    # latency: its first batch CANNOT beat the 50 ms objective, so the
+    # breach (and the dump) is deterministic, not a timing accident
+    with open(path, "rb") as f:
+        payload = f.read()
+    register_chaos_backend("servecheckslow", payload, latency_s=0.2)
+    srv = ScanServer(
+        audit_log=audit_path,
+        slos=["first_batch_p99=0.05", "error_rate=0.01"],
+        flight_dir=flight_dir).start()
+    opts = dict(copybook_contents=EXP1_COPYBOOK, chunk_size_mb="1",
+                pipeline_workers="2")
+    try:
+        # 1. traced scan -> one merged client+server chrome trace
+        with stream_scan(srv.address, path, tenant="obs", trace=True,
+                         **opts) as stream:
+            rows = sum(b.num_rows for b in stream)
+            summary = stream.summary
+            fast_request_id = stream.request_id
+            trace_path = os.path.join(workdir, "merged.json")
+            stream.write_chrome_trace(trace_path)
+        if summary.get("request_id") != fast_request_id:
+            fail("trailer request_id != client request_id")
+        doc = json.load(open(trace_path))
+        if doc.get("trace_id") != stream.trace_id:
+            fail("merged trace artifact lost the request trace_id")
+        names = {e.get("name") for e in doc["traceEvents"]}
+        for needle in ("connect", "queue_wait", "scan",
+                       "wait_first_batch"):
+            if needle not in names:
+                fail(f"merged trace missing the {needle!r} span")
+
+        # 2. slow chaos scan -> SLO breach -> flight-recorder dump
+        with stream_scan(srv.address, "servecheckslow://chaos",
+                         tenant="slowpoke", **opts) as stream:
+            slow_rows = sum(b.num_rows for b in stream)
+            slow_request_id = stream.request_id
+        if slow_rows != rows:
+            fail(f"chaos scan rows {slow_rows} != {rows}")
+        # the handler audits/dumps AFTER the client saw its trailer —
+        # wait for the dump to be complete (record.json is written
+        # first... last artifact is the audit append; poll for both)
+        deadline = time.monotonic() + 10
+        dumps = []
+        while time.monotonic() < deadline:
+            if os.path.isdir(flight_dir):
+                dumps = [
+                    d for d in os.listdir(flight_dir)
+                    if slow_request_id in d and os.path.exists(
+                        os.path.join(flight_dir, d, "field_costs.json"))
+                    and _audit_has(audit_path, slow_request_id)]
+            if dumps:
+                break
+            time.sleep(0.05)
+        if not dumps:
+            fail("slow chaos scan left no flight-recorder dump")
+        else:
+            dump = os.path.join(flight_dir, dumps[0])
+            for artifact in ("record.json", "trace.json",
+                             "field_costs.json"):
+                if not os.path.exists(os.path.join(dump, artifact)):
+                    fail(f"flight dump missing {artifact}")
+            record = json.load(open(os.path.join(dump, "record.json")))
+            if "first_batch_p99" not in record.get("slo_breaches", []):
+                fail("dumped record does not carry the breach")
+
+        # 3. audit log contains BOTH scans, matched by request_id
+        seen = set()
+        for line in open(audit_path, encoding="utf-8"):
+            seen.add(json.loads(line).get("request_id"))
+        for rid in (fast_request_id, slow_request_id):
+            if rid not in seen:
+                fail(f"audit log missing request_id {rid}")
+
+        # 4. /debug endpoints answer with the data above
+        host, port = srv.http_address
+
+        def debug(p):
+            return json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/debug/{p}", timeout=10).read())
+
+        recent = debug("recent")["recent"]
+        if slow_request_id not in {r.get("request_id") for r in recent}:
+            fail("/debug/recent missing the chaos scan")
+        if debug("scans").get("scans") is None:
+            fail("/debug/scans malformed")
+        slo_doc = debug("slo")["slo"]
+        if slo_doc.get("first_batch_p99", {}).get("bad", 0) < 1:
+            fail("/debug/slo shows no first_batch_p99 breach")
+        if debug("config").get("audit_log") != audit_path:
+            fail("/debug/config lost the audit path")
+        text = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10).read().decode()
+        for needle in ("cobrix_slo_bad_total", "cobrix_slo_good_total",
+                       "cobrix_process_uptime_seconds",
+                       "cobrix_serve_open_scans"):
+            if needle not in text:
+                fail(f"/metrics missing {needle!r}")
+
+        if ok:
+            print(f"{'request-obs':>10} | merged trace + audit + "
+                  f"/debug + flight dump all hold "
+                  f"({len(seen)} audited scans)")
+        return ok
+    finally:
+        srv.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mb", type=float, default=8.0,
@@ -173,9 +317,12 @@ def main() -> int:
                 for workers in ("1", "2", "-1"):
                     ok &= check(path, chunk, workers,
                                 quota_check=False, scrape=False)
+            ok &= check_request_obs(path)
         else:
             ok = check(path, args.chunk_mb, args.workers)
-        print("OK: streamed parity, first-batch latency, quota, scrape"
+            ok &= check_request_obs(path)
+        print("OK: streamed parity, first-batch latency, quota, scrape,"
+              " request-scoped obs"
               if ok else "FAILED: serving-tier checks diverged")
         return 0 if ok else 1
     finally:
