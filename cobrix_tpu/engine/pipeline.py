@@ -319,10 +319,36 @@ class PipelineExecutor:
                 with self.stage_times.timed("read"):
                     return task[0]()
 
+        degrade_events = [0]
+
+        def pressure_wait() -> None:
+            """Memory-pressure degrade (utils.pressure): while the
+            process is past its degrade watermark, the reader holds new
+            chunks until in-flight count drops under HALF the normal
+            window — raw chunk bytes are the pipeline's dominant RSS,
+            so halving the window sheds them fastest without failing
+            anything. Checked per chunk: a cached probe, not a syscall
+            per block. No budget configured = no-op."""
+            from ..utils.pressure import LEVEL_DEGRADED, current_level
+
+            shrunk = max(1, self.max_inflight // 2)
+            waited = False
+            while not stop.is_set():
+                if current_level() < LEVEL_DEGRADED:
+                    break
+                with lock:
+                    if len(inflight) < shrunk:
+                        break
+                if not waited:
+                    waited = True
+                    degrade_events[0] += 1
+                time.sleep(_TICK_S)
+
         def reader_loop() -> None:
             for i, task in enumerate(tasks):
                 if stop.is_set():
                     break
+                pressure_wait()
                 try:
                     payload = run_read(i, task)
                 except BaseException as exc:
@@ -529,6 +555,8 @@ class PipelineExecutor:
         }
         if any(counters.values()):
             self.report.update(counters)
+        if degrade_events[0]:
+            self.report["pressure_degrades"] = degrade_events[0]
         if stuck:
             self.report["stuck_stages"] = stuck
 

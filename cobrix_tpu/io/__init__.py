@@ -25,6 +25,8 @@ production implementations the engine runs against object storage:
 """
 from .config import IoConfig, wrap_source
 from .blockcache import BlockCache, CachingSource
+from .integrity import (checksum, corruption_counter, sweep_cache_root,
+                        verify_json_payload)
 from .fsspec_source import (FsspecSource, fsspec_listing, open_fsspec_source,
                             register_fsspec_backend)
 from .index_store import SparseIndexStore, index_config_fingerprint
@@ -36,6 +38,10 @@ __all__ = [
     "wrap_source",
     "BlockCache",
     "CachingSource",
+    "checksum",
+    "corruption_counter",
+    "sweep_cache_root",
+    "verify_json_payload",
     "FsspecSource",
     "fsspec_listing",
     "open_fsspec_source",

@@ -18,6 +18,17 @@ processes writing the same block converge on identical bytes (ranges are
 deterministic slices of an immutable file version). LRU eviction is by
 file mtime — hits re-touch their block — with a bounded rescan whenever
 the tracked total passes the budget.
+
+Integrity (io/integrity.py): every block is stored as
+``magic + crc32(payload) + payload`` and VERIFIED on read — a
+bit-flipped, truncated, or foreign file is quarantined under
+``<cache_dir>/quarantine/``, counted on
+``cobrix_cache_corruption_total{plane="block"}``, and served as a miss
+(the caller refetches from storage), never decoded into wrong scan
+output. The entry format is part of the generation key, so a format
+bump invalidates old generations structurally; opening a cache root
+also runs the crash-consistency sweep (orphaned temp files, torn
+entries) once per process.
 """
 from __future__ import annotations
 
@@ -31,9 +42,22 @@ from typing import Dict, List, Optional, Tuple
 
 from ..reader.stream import ByteRangeSource
 from ..utils.atomic import write_atomic
+from .integrity import (
+    frame_block,
+    note_corruption,
+    quarantine,
+    sweep_cache_root,
+    unframe_block,
+)
 from .stats import IoStats
 
 _logger = logging.getLogger(__name__)
+
+# entry-format generation token: folded into the generation-directory
+# hash so a changed on-disk block layout invalidates every existing
+# generation structurally (the stale-url sweep removes them) instead of
+# failing verification entry by entry
+_BLOCK_FORMAT = "blkv2"
 
 
 def _h(text: str) -> str:
@@ -61,13 +85,20 @@ class BlockCache:
     when a write/eviction happens (`current_io_stats`), so one instance
     serves concurrent reads without cross-attributing."""
 
-    def __init__(self, cache_dir: str, max_bytes: int = 0):
+    def __init__(self, cache_dir: str, max_bytes: int = 0,
+                 sweep: bool = True):
         self.root = os.path.join(cache_dir, "blocks")
+        self.quarantine_root = os.path.join(cache_dir, "quarantine")
         self.max_bytes = max(0, int(max_bytes))  # 0 = unbounded
         self._lock = threading.Lock()
         self._approx_total = -1  # lazily measured on first budget check
         self._gen_resolved: set = set()  # generation dirs already swept
         os.makedirs(self.root, exist_ok=True)
+        if sweep:
+            # crash-consistency sweep once per instance (and
+            # shared_block_cache keeps one instance per root per
+            # process): orphaned .tmp-* writers, torn creations
+            sweep_cache_root(self.root)
 
     # -- generation management ------------------------------------------
 
@@ -77,7 +108,8 @@ class BlockCache:
         block plane' contract). Resolved once per (url, fingerprint):
         per-chunk stream opens skip the directory sweep."""
         url_h = _h(url)
-        gen = os.path.join(self.root, f"{url_h}-{_h(fingerprint)}")
+        gen = os.path.join(
+            self.root, f"{url_h}-{_h(f'{fingerprint}|{_BLOCK_FORMAT}')}")
         with self._lock:
             # isdir guards the revert case: a swept generation whose
             # fingerprint comes BACK (file restored) must be recreated
@@ -94,10 +126,16 @@ class BlockCache:
             pass
         if not os.path.isdir(gen):
             os.makedirs(gen, exist_ok=True)
-            self._write_atomic(
-                os.path.join(gen, "meta.json"),
-                json.dumps({"url": url, "fingerprint": fingerprint},
-                           sort_keys=True).encode())
+            try:
+                self._write_atomic(
+                    os.path.join(gen, "meta.json"),
+                    json.dumps({"url": url, "fingerprint": fingerprint},
+                               sort_keys=True).encode())
+            except OSError as exc:
+                # meta.json is debuggability only: a full disk skips it
+                # (block puts degrade the same way), never fails the scan
+                _logger.warning("block cache meta write failed for %s: "
+                                "%s", gen, exc)
         with self._lock:
             self._gen_resolved.add(gen)
         return gen
@@ -113,26 +151,32 @@ class BlockCache:
         coalescing scan to size one fetch over a run of missing blocks."""
         return os.path.exists(self._block_path(gen_dir, start, end))
 
-    def get(self, gen_dir: str, start: int, end: int) -> Optional[bytes]:
+    def get(self, gen_dir: str, start: int, end: int,
+            io_stats: Optional[IoStats] = None) -> Optional[bytes]:
         path = self._block_path(gen_dir, start, end)
         try:
             with open(path, "rb") as f:
                 data = f.read()
         except OSError:
             return None  # missing OR evicted mid-race: a miss either way
-        if len(data) != end - start:
-            # torn write from a crashed process predating the atomic
-            # rename, or an eviction race — drop it and refetch
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+        payload = unframe_block(data, end - start)
+        if payload is None:
+            # the disk lied: a torn tail, a flipped bit, a file shorter
+            # than its aligned-range key, or a foreign format —
+            # quarantine the entry and serve a MISS (the caller
+            # refetches the true bytes from storage), never short or
+            # wrong bytes into the record framer
+            quarantine(path, self.quarantine_root)
+            note_corruption(
+                "block", path,
+                f"{len(data)}B on disk for aligned range "
+                f"[{start}, {end})", io_stats=io_stats)
             return None
         try:
             os.utime(path)  # LRU touch
         except OSError:
             pass
-        return data
+        return payload
 
     def put(self, gen_dir: str, start: int, end: int, data: bytes,
             io_stats: Optional[IoStats] = None) -> None:
@@ -145,7 +189,7 @@ class BlockCache:
         if os.path.exists(path):
             return
         try:
-            self._write_atomic(path, data)
+            self._write_atomic(path, frame_block(data))
         except OSError as exc:  # a full cache disk must not fail the scan
             _logger.warning("block cache write failed for %s: %s", path, exc)
             return
@@ -295,7 +339,8 @@ class CachingSource(ByteRangeSource):
         idx = first
         while idx <= last:
             bs, be = self._block_range(idx)
-            cached = self._cache.get(self._gen_dir, bs, be)
+            cached = self._cache.get(self._gen_dir, bs, be,
+                                     io_stats=self._io_stats)
             if cached is not None:
                 if self._io_stats is not None:
                     self._io_stats.bump("block_hits")

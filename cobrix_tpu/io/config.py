@@ -80,18 +80,30 @@ def wrap_source(source: ByteRangeSource, url: str,
     if io.cache_enabled:
         from .blockcache import CachingSource, shared_block_cache
 
-        cache = shared_block_cache(io.cache_dir, io.cache_max_bytes)
         # one fingerprint probe (a backend metadata round trip) per
-        # read, not per chunk-stream open
-        fingerprint = None
-        if io_stats is not None:
-            key = ("fingerprint", url)
-            fingerprint = io_stats.memo.get(key)
-            if fingerprint is None:
-                fingerprint = source.fingerprint()
+        # read, not per chunk-stream open. Deliberately OUTSIDE the
+        # degrade guard below: a failing BACKEND probe is a storage
+        # error the caller must see, not a cache-volume problem
+        key = ("fingerprint", url) if io_stats is not None else None
+        fingerprint = io_stats.memo.get(key) if key else None
+        if fingerprint is None:
+            fingerprint = source.fingerprint()
+            if key:
                 io_stats.memo[key] = fingerprint
-        source = CachingSource(source, url, cache, io.block_bytes,
-                               io_stats=io_stats, fingerprint=fingerprint)
+        try:
+            cache = shared_block_cache(io.cache_dir, io.cache_max_bytes)
+            source = CachingSource(source, url, cache, io.block_bytes,
+                                   io_stats=io_stats,
+                                   fingerprint=fingerprint)
+        except OSError as exc:
+            # an unusable cache VOLUME (read-only mount, full disk at
+            # mkdir time) degrades to direct backend reads — a cache
+            # must never be the reason a scan fails
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "block cache unavailable under %s (%s); reading "
+                "without the persistent cache plane", io.cache_dir, exc)
     if io.prefetch_enabled:
         from .prefetch import ReadAheadSource
 

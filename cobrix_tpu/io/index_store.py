@@ -21,6 +21,16 @@ property of the *read*, not the file, so the loader re-stamps the
 current file_order. Writes are atomic (temp + rename) and loads treat
 any malformed/incompatible payload as a miss, so concurrent processes
 can share one cache directory safely.
+
+Integrity (io/integrity.py): payloads carry a CRC-32 over their
+canonical serialization, verified on load. A bit-flipped entry — which
+would otherwise deserialize into WRONG shard offsets and frame garbage
+records — is quarantined, counted on
+``cobrix_cache_corruption_total{plane="index"}``, and treated as a
+miss, so the sequential index pass simply re-runs and re-persists.
+Undecodable JSON (a torn write from a pre-atomic crash) counts as
+corruption too; a clean format/fingerprint mismatch stays an ordinary
+(uncounted) miss.
 """
 from __future__ import annotations
 
@@ -28,15 +38,29 @@ import hashlib
 import json
 import logging
 import os
+import threading
 from typing import List, Optional
 
 from ..reader.index import SparseIndexEntry
 from ..utils.atomic import write_atomic
+from .integrity import (
+    note_corruption,
+    quarantine,
+    stamp_json_payload,
+    sweep_cache_root,
+    verify_json_payload,
+)
 
 _logger = logging.getLogger(__name__)
 
 # bump when the payload layout changes: old files become misses
-_FORMAT = 1
+# (2 = checksummed payloads, io/integrity.py)
+_FORMAT = 2
+
+# crash-consistency sweep once per root per process (the store itself
+# is constructed per file per read)
+_SWEPT_LOCK = threading.Lock()
+_SWEPT_ROOTS: set = set()
 
 
 def index_config_fingerprint(reader, params) -> str:
@@ -78,25 +102,52 @@ def index_config_fingerprint(reader, params) -> str:
 class SparseIndexStore:
     def __init__(self, cache_dir: str):
         self.root = os.path.join(cache_dir, "index")
+        self.quarantine_root = os.path.join(cache_dir, "quarantine")
         os.makedirs(self.root, exist_ok=True)
+        with _SWEPT_LOCK:
+            swept = self.root in _SWEPT_ROOTS
+            _SWEPT_ROOTS.add(self.root)
+        if not swept:
+            sweep_cache_root(self.root)
 
     def _path(self, url: str, config_fp: str) -> str:
         h = hashlib.sha256(
             f"{url}\x00{config_fp}".encode("utf-8", "replace"))
         return os.path.join(self.root, h.hexdigest()[:40] + ".json")
 
+    def _corrupt(self, path: str, detail: str) -> None:
+        quarantine(path, self.quarantine_root)
+        note_corruption("index", path, detail)
+
     def load(self, url: str, fingerprint: str, config_fp: str,
              file_id: int) -> Optional[List[SparseIndexEntry]]:
         """The persisted entries for this (url, file version, config),
         re-stamped with the caller's file_id — or None (miss: absent,
-        stale fingerprint, or unreadable)."""
+        stale fingerprint, corrupt — corrupt payloads are additionally
+        quarantined and counted)."""
+        path = self._path(url, config_fp)
         try:
-            with open(self._path(url, config_fp), encoding="utf-8") as f:
-                payload = json.load(f)
-        except (OSError, ValueError):
+            with open(path, encoding="utf-8") as f:
+                raw = f.read()
+        except OSError:
             return None
-        if (payload.get("format") != _FORMAT
-                or payload.get("url") != url
+        try:
+            payload = json.loads(raw)
+        except ValueError:
+            # not even JSON: a torn write or foreign bytes, not a stale
+            # entry — wrong data wearing this key's name
+            self._corrupt(path, "undecodable JSON payload")
+            return None
+        if not isinstance(payload, dict) \
+                or payload.get("format") != _FORMAT:
+            return None  # older/newer format: a clean miss
+        if not verify_json_payload(payload):
+            # structurally valid JSON whose checksum disagrees: the
+            # classic bit-flip that WOULD have framed garbage records
+            # from wrong shard offsets
+            self._corrupt(path, "payload checksum mismatch")
+            return None
+        if (payload.get("url") != url
                 or payload.get("fingerprint") != fingerprint
                 or payload.get("config") != config_fp):
             return None
@@ -106,20 +157,21 @@ class SparseIndexStore:
                     for offset_from, offset_to, record_index
                     in payload["entries"]]
         except (KeyError, TypeError, ValueError):
+            self._corrupt(path, "entry rows failed to deserialize")
             return None
 
     def save(self, url: str, fingerprint: str, config_fp: str,
              entries: List[SparseIndexEntry]) -> None:
         """Persist one file version's entries (atomic; best-effort — a
         full disk degrades to re-indexing, never to a failed read)."""
-        payload = {
+        payload = stamp_json_payload({
             "format": _FORMAT,
             "url": url,
             "fingerprint": fingerprint,
             "config": config_fp,
             "entries": [[e.offset_from, e.offset_to, e.record_index]
                         for e in entries],
-        }
+        })
         path = self._path(url, config_fp)
         try:
             write_atomic(path, json.dumps(payload))

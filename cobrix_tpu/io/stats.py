@@ -19,9 +19,11 @@ KEYS = (
     "block_misses",       # block-cache reads that went to storage
     "block_put_bytes",    # bytes written into the block cache
     "block_evictions",    # cache files removed by the LRU budget
+    "block_corrupt",      # block entries failing verification (quarantined)
     "index_hits",         # sparse-index store loads (no sequential pass)
     "index_misses",       # store lookups that fell through to a scan
     "index_saves",        # freshly-computed indexes persisted
+    "index_corrupt",      # index payloads failing verification (quarantined)
     "prefetch_issued",    # read-ahead fetches scheduled
     "prefetch_hits",      # consumer reads served by a finished prefetch
     "prefetch_waits",     # consumer reads that waited on an in-flight one

@@ -67,6 +67,12 @@ class ScanRecord:
     slo_breaches: List[str] = field(default_factory=list)
     # set when the flight recorder dumped this scan's evidence
     dump_path: str = ""
+    # the ORIGINAL request_id when this scan resumed an interrupted
+    # stream (replica failover): `tools/scanlog.py` groups the attempts
+    # into one logical request by it, and SLO evaluation skips resumed
+    # records entirely — the logical request's objectives were already
+    # accounted once, a recovery attempt must never double-burn them
+    resume_of: str = ""
 
     def as_dict(self) -> dict:
         out = asdict(self)
@@ -86,7 +92,8 @@ def record_from_summary(request_id: str, trace_id: str, tenant: str,
                         error: str = "",
                         queue_wait_s: Optional[float] = None,
                         first_batch_s: Optional[float] = None,
-                        e2e_s: Optional[float] = None) -> ScanRecord:
+                        e2e_s: Optional[float] = None,
+                        resume_of: str = "") -> ScanRecord:
     """Build a ScanRecord from a serving-session trailer summary (the
     rejected/failed paths pass a partial or empty summary)."""
     metrics = summary.get("metrics") or {}
@@ -112,7 +119,8 @@ def record_from_summary(request_id: str, trace_id: str, tenant: str,
         queue_wait_s=queue_wait_s, first_batch_s=first_batch_s,
         e2e_s=e2e_s,
         roofline_fraction=roof.get("fraction"),
-        cache=cache, error=error)
+        cache=cache, error=error,
+        resume_of=resume_of or str(summary.get("resume_of") or ""))
 
 
 class AuditLog:

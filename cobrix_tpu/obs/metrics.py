@@ -349,6 +349,13 @@ def scan_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "cobrix_io_cache_events_total",
             "Persistent-cache lookups by plane (block/index) and outcome",
             label_names=("plane", "result")),
+        "cache_corruption": r.counter(
+            "cobrix_cache_corruption_total",
+            "Persistent-state entries that failed checksum/structure "
+            "verification on read, by plane (block/index/roofline); "
+            "every count is a corrupt entry that was quarantined and "
+            "rebuilt instead of being served",
+            label_names=("plane",)),
         "prefetch": r.counter(
             "cobrix_io_prefetch_total",
             "Read-ahead prefetches by outcome "
@@ -471,6 +478,16 @@ def serve_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
         "streamed_batches": r.counter(
             "cobrix_serve_streamed_batches_total",
             "Arrow record batches streamed to clients, by tenant",
+            label_names=("tenant",)),
+        "resumed": r.counter(
+            "cobrix_serve_scans_resumed_total",
+            "Admitted scans that resumed an earlier interrupted stream "
+            "(carried a resume token), by tenant",
+            label_names=("tenant",)),
+        "degraded": r.counter(
+            "cobrix_serve_scans_degraded_total",
+            "Scans started with degraded io/pipeline knobs because the "
+            "process was over its memory degrade watermark, by tenant",
             label_names=("tenant",)),
         "queue_wait": r.histogram(
             "cobrix_serve_queue_wait_seconds",

@@ -82,25 +82,61 @@ def calibrate(size_mb: float = 128.0, repeats: int = 3) -> dict:
 
 
 def _read_cache() -> Optional[dict]:
+    """The on-disk calibration, verified (io/integrity.py): a corrupted
+    record would silently re-anchor every roofline fraction on this
+    machine to a wrong basis — benchgate comparisons, SLO roofline_min
+    objectives, the Prometheus gauge. A record failing its checksum is
+    quarantined (next to the cache file), counted on
+    ``cobrix_cache_corruption_total{plane="roofline"}``, and treated as
+    uncalibrated, so the next `measured_bandwidth()` rebuilds it."""
+    path = cache_path()
     try:
-        with open(cache_path(), encoding="utf-8") as f:
+        with open(path, encoding="utf-8") as f:
             doc = json.load(f)
-        if (isinstance(doc, dict) and doc.get("bandwidth_bytes_per_s")
-                and doc.get("method") == _METHOD):
-            return doc
-    except (OSError, ValueError):
-        pass
+    except OSError:
+        return None
+    except ValueError:
+        _quarantine_cache(path, "undecodable JSON")
+        return None
+    if not isinstance(doc, dict):
+        _quarantine_cache(path, "non-object payload")
+        return None
+    if "crc" in doc:
+        from ..io.integrity import verify_json_payload
+
+        if not verify_json_payload(doc):
+            _quarantine_cache(path, "checksum mismatch")
+            return None
+    elif doc.get("method") == _METHOD:
+        # same method but no checksum: a pre-integrity record whose
+        # bytes can no longer be trusted end to end — recalibrate once
+        # rather than anchor a fleet of fractions on unverifiable data
+        return None
+    if doc.get("bandwidth_bytes_per_s") and doc.get("method") == _METHOD:
+        return doc
     return None
 
 
+def _quarantine_cache(path: str, detail: str) -> None:
+    from ..io.integrity import note_corruption, quarantine
+
+    quarantine(path, os.path.join(os.path.dirname(path) or ".",
+                                  "quarantine"))
+    note_corruption("roofline", path, detail)
+
+
 def _write_cache(record: dict) -> None:
+    from ..io.integrity import stamp_json_payload
     from ..utils.atomic import write_atomic
 
     path = cache_path()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     # fsync: the calibration anchors every roofline fraction on this
-    # machine; a zero-length file after a crash must not be possible
-    write_atomic(path, json.dumps(record), fsync=True)
+    # machine; a zero-length file after a crash must not be possible.
+    # The crc stamp lets readers detect the subtler failure: a file
+    # that IS valid JSON but no longer the bytes that were written
+    write_atomic(path, json.dumps(stamp_json_payload(record)),
+                 fsync=True)
 
 
 def cached_bandwidth() -> Optional[float]:

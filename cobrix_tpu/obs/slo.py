@@ -62,6 +62,12 @@ class Slo:
         also skip scans without the measurement."""
         if record.outcome not in ("ok", "error"):
             return None
+        if getattr(record, "resume_of", ""):
+            # a recovery attempt of an already-accounted logical
+            # request (replica failover): evaluating it again would
+            # double-burn latency objectives — a resumed scan's "first
+            # batch" sits behind a skip of everything already delivered
+            return None
         if self.kind == "error_rate":
             return record.outcome == "ok"
         if record.outcome != "ok":

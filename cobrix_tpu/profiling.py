@@ -275,6 +275,12 @@ class ReadMetrics:
                 if count:
                     m["io_cache"].labels(
                         plane=plane, result=label).inc(count)
+            corrupt = io.get(f"{plane}_corrupt", 0)
+            if corrupt:
+                # detections ride IoStats during a read (multihost
+                # workers merge theirs home) and reach Prometheus here,
+                # exactly once per detection
+                m["cache_corruption"].labels(plane=plane).inc(corrupt)
         for result, label in (("issued", "issued"), ("hits", "hit"),
                               ("waits", "wait"), ("unused", "unused")):
             count = io.get(f"prefetch_{result}", 0)

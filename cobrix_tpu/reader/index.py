@@ -78,9 +78,14 @@ def file_index_entries(reader, file_path: str, file_order: int, params,
                                       index_config_fingerprint)
         from ..io.stats import current_io_stats
 
-        store = SparseIndexStore(io.cache_dir)
-        config_fp = index_config_fingerprint(reader, params)
-        io_stats = current_io_stats()
+        try:
+            store = SparseIndexStore(io.cache_dir)
+            config_fp = index_config_fingerprint(reader, params)
+            io_stats = current_io_stats()
+        except OSError:
+            # unusable cache volume (read-only / full): index without
+            # persistence — the cache must never fail the scan
+            store = None
 
     def from_store(fingerprint: str):
         cached = store.load(file_path, fingerprint, config_fp, file_order)

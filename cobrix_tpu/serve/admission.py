@@ -17,6 +17,15 @@ BLOCK on the byte gate — backpressure, not rejection — and time out
 into a scan error only after `byte_wait_timeout_s` of zero drain (a
 stuck client must not pin server memory forever).
 
+The third dimension is the process itself: when a memory budget is
+configured (utils.pressure — the serve CLI's ``--memory-budget-mb``)
+and RSS crosses the SHED watermark, admission stops absorbing work
+instead of letting the OOM-killer end every tenant at once. New
+requests are refused with a structured ``overloaded`` reason, and
+queued waiters are shed lowest-weight-first (the fair-share weight is
+also the keep-under-pressure priority) until the queue halves. Scans
+already admitted keep running — shedding protects them.
+
 Everything is condition-variable based and deadline-bounded: no wait in
 this module is infinite.
 """
@@ -57,12 +66,13 @@ class AdmissionRejected(Exception):
 
 
 class _Waiter:
-    __slots__ = ("tenant", "granted", "abandoned")
+    __slots__ = ("tenant", "granted", "abandoned", "shed")
 
     def __init__(self, tenant: str):
         self.tenant = tenant
         self.granted = False
         self.abandoned = False
+        self.shed = False  # evicted by overload shedding
 
 
 class AdmissionController:
@@ -79,12 +89,18 @@ class AdmissionController:
                  max_concurrent_scans: int = 16,
                  queue_timeout_s: float = 30.0,
                  byte_wait_timeout_s: float = 60.0,
-                 metrics: Optional[dict] = None):
+                 metrics: Optional[dict] = None,
+                 pressure=None):
         self.default_quota = default_quota or TenantQuota()
         self.quotas = dict(quotas or {})
         self.max_concurrent_scans = max(1, int(max_concurrent_scans))
         self.queue_timeout_s = max(0.0, float(queue_timeout_s))
         self.byte_wait_timeout_s = max(0.0, float(byte_wait_timeout_s))
+        # memory watermark source: an explicit utils.pressure
+        # MemoryPressure, else the process-wide monitor (None installed
+        # = never sheds)
+        self._pressure = pressure
+        self.scans_shed = 0
         self._m = metrics if metrics is not None else serve_metrics()
         self._cond = threading.Condition()
         self._active: Dict[str, int] = {}
@@ -100,14 +116,68 @@ class AdmissionController:
     def quota(self, tenant: str) -> TenantQuota:
         return self.quotas.get(tenant, self.default_quota)
 
+    # -- overload shedding -----------------------------------------------
+
+    def pressure_level(self) -> int:
+        from ..utils.pressure import current_level
+
+        if self._pressure is not None:
+            return self._pressure.level()
+        return current_level()
+
+    def _shed_queued_locked(self) -> int:
+        """Evict queued waiters lowest-weight-first until the queue is
+        at most half its current depth (admitted scans are untouched —
+        shedding exists to let them finish). Evicted waiters' admit()
+        calls raise a structured ``overloaded`` rejection, newest
+        request first within a tenant (the oldest waiter kept its place
+        longest). Returns the count shed."""
+        total = sum(len(q) for q in self._queues.values())
+        if total == 0:
+            return 0
+        target = total // 2
+        shed = 0
+        tenants = sorted(self._queues,
+                         key=lambda t: (self.quota(t).weight, t))
+        for tenant in tenants:
+            q = self._queues.get(tenant)
+            while q and total - shed > target:
+                waiter = q.pop()  # newest first
+                waiter.shed = True
+                shed += 1
+            if q is not None and not q:
+                self._queues.pop(tenant, None)
+            if total - shed <= target:
+                break
+        if shed:
+            self.scans_shed += shed
+            self._cond.notify_all()
+        return shed
+
     # -- scan admission --------------------------------------------------
 
     def admit(self, tenant: str) -> _Waiter:
         """Block until this scan may run; returns the ticket for
-        `release`. Raises AdmissionRejected (queue_full /
-        queue_timeout) — never hangs past `queue_timeout_s`."""
+        `release`. Raises AdmissionRejected (queue_full / queue_timeout
+        / overloaded) — never hangs past `queue_timeout_s`."""
+        from ..utils.pressure import LEVEL_SHED
+
         quota = self.quota(tenant)
         t0 = time.monotonic()
+        if self.pressure_level() >= LEVEL_SHED:
+            # over the memory shed watermark: refuse new work AND shed
+            # queued waiters (lowest weight first) so admitted scans
+            # keep their memory and finish — the alternative is the
+            # OOM-killer ending every tenant at once
+            with self._cond:
+                shed = self._shed_queued_locked()
+            self._m["rejected"].labels(
+                tenant=tenant, reason="overloaded").inc()
+            raise AdmissionRejected(
+                tenant, "overloaded",
+                f"server is over its memory budget (shedding load"
+                f"{f', evicted {shed} queued scan(s)' if shed else ''});"
+                " retry later or on another replica")
         with self._cond:
             if self._can_run_locked(tenant, quota) \
                     and not self._queues.get(tenant):
@@ -129,6 +199,15 @@ class AdmissionController:
             try:
                 deadline = t0 + self.queue_timeout_s
                 while not waiter.granted:
+                    if waiter.shed:
+                        self._prune_vtime_locked(tenant)
+                        self._m["rejected"].labels(
+                            tenant=tenant, reason="overloaded").inc()
+                        raise AdmissionRejected(
+                            tenant, "overloaded",
+                            f"queued scan for tenant '{tenant}' shed "
+                            "under memory pressure; retry later or on "
+                            "another replica")
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         waiter.abandoned = True
@@ -289,11 +368,12 @@ class AdmissionController:
         with self._cond:
             tenants = sorted(set(self._active) | set(self._queues)
                              | set(self._inflight_bytes))
-            return {
+            out = {
                 "active_scans": sum(self._active.values()),
                 "queued_scans": sum(len(q) for q in
                                     self._queues.values()),
                 "max_concurrent_scans": self.max_concurrent_scans,
+                "scans_shed": self.scans_shed,
                 "tenants": {
                     t: {"active": self._active.get(t, 0),
                         "queued": len(self._queues.get(t, ())),
@@ -301,3 +381,11 @@ class AdmissionController:
                             self._inflight_bytes.get(t, 0)}
                     for t in tenants},
             }
+        monitor = self._pressure
+        if monitor is None:
+            from ..utils.pressure import process_pressure
+
+            monitor = process_pressure()
+        if monitor is not None:
+            out["pressure"] = monitor.snapshot()
+        return out

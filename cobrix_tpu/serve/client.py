@@ -1,4 +1,4 @@
-"""Streaming client for the scan server.
+"""Streaming client for the scan server: resumable, replica-failover.
 
 `stream_scan(...)` is the incremental surface: a `ScanStream` you
 iterate for record batches as the server produces them (first batch
@@ -8,30 +8,43 @@ concatenate, and re-attach the ReadDiagnostics schema metadata from the
 trailer so the result is byte-identical to an in-process
 `read_cobol(...).to_arrow()`.
 
+Recovery is client-transparent, the serving tier's analogue of Spark's
+task re-execution (PAPER.md §2/§5 — a mid-scan executor death is
+invisible to the caller): `address` may be a LIST of replica addresses
+(the horizontal-scale recipe: N servers sharing one `cache_dir`). The
+server streams resume tokens ('T' frames: chunk-plan fingerprint +
+records-delivered watermark) between record batches; when a connection
+dies mid-stream — server SIGKILL, network drop, timeout, or a
+structured mid-scan error — the stream reconnects to the next replica
+under the RetryPolicy and resumes from the watermark. Already-yielded
+batches are never re-delivered; the server validates the plan
+fingerprint (a changed file version refuses the resume with
+``resume_mismatch`` rather than splicing mixed-version rows) and skips
+already-delivered records before anything touches the wire. The
+resumed attempt carries ``resume: {of: <original request_id>}`` so the
+audit log ties the attempts into one logical request.
+
 Timeouts follow RetryPolicy semantics (reader/stream.py): connect
 attempts retry with exponential backoff + jitter under an overall
 deadline; established-stream reads get a per-read socket timeout so a
-dead server surfaces as an error, never a hang.
+dead server surfaces as a failover (or an error), never a hang.
 
 Request-scoped observability: every request carries a client-minted
 `request_id`/`trace_id` pair on the 'R' frame (accepting inbound ones,
 so an upstream service's trace continues through here); the trailer
 echoes them, and `tools/scanlog.py` resolves either id to the server's
 audit record. With ``trace=True`` the client records its OWN spans
-(connect, request, first-batch wait, stream consumption), the server
-ships its spans back on the trailer, and
+(connect, request, first-batch wait, per-failover reconnects, stream
+consumption), the server ships its spans back on the trailer, and
 `ScanStream.write_chrome_trace(path)` merges both onto one
-clock-corrected timeline — one Chrome trace per request: client wait ->
-queue wait -> scan stages, across processes.
+clock-corrected timeline.
 """
 from __future__ import annotations
 
 import io
-import json
 import socket
-import struct
 import time
-from typing import Callable, Iterator, Optional, Sequence, Tuple
+from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..reader.stream import RetryPolicy
 from ..obs.progress import ScanProgress
@@ -42,6 +55,7 @@ from .protocol import (
     FRAME_FINAL,
     FRAME_PROGRESS,
     FRAME_REQUEST,
+    FRAME_TOKEN,
     ProtocolError,
     ServeError,
     parse_json,
@@ -51,6 +65,17 @@ from .protocol import (
 )
 
 DEFAULT_READ_TIMEOUT_S = 300.0
+# mid-stream failovers allowed per logical request before the failure
+# surfaces to the caller (connect retries within ONE failover are the
+# RetryPolicy's business)
+DEFAULT_MAX_FAILOVERS = 3
+
+# ServeError codes a different replica may legitimately answer better:
+# a scan_error can be replica-local (its disk, its memory), a rejection
+# (quota/queue/overload/draining) is explicitly retry-later. 'protocol'
+# (the request itself is malformed) and 'resume_mismatch' (the FILE
+# changed — no replica can resume this stream) are terminal.
+_FAILOVER_SERVE_CODES = ("scan_error", "rejected")
 
 
 def connect(address: Tuple[str, int],
@@ -80,14 +105,17 @@ def connect(address: Tuple[str, int],
 
 
 class _FrameStream(io.RawIOBase):
-    """File-like view over the connection's 'D' payloads, dispatching
+    """File-like view over one connection's 'D' payloads, dispatching
     interleaved control frames: pyarrow's IPC reader pulls record-batch
-    bytes out of this, while progress frames reach the callback and an
-    error frame raises ServeError from whatever read triggered it."""
+    bytes out of this, while progress frames reach the callback, resume
+    tokens reach `on_token`, and an error frame raises ServeError from
+    whatever read triggered it."""
 
-    def __init__(self, sock_file, on_progress: Optional[Callable]):
+    def __init__(self, sock_file, on_progress: Optional[Callable],
+                 on_token: Optional[Callable] = None):
         self._f = sock_file
         self._on_progress = on_progress
+        self._on_token = on_token
         self._current = memoryview(b"")
         self._eos = False
         self.summary: Optional[dict] = None
@@ -113,12 +141,23 @@ class _FrameStream(io.RawIOBase):
                     except Exception:
                         self._on_progress = None  # broken bar, once
                 continue
+            if ftype == FRAME_TOKEN:
+                if self._on_token is not None:
+                    self._on_token(parse_json(payload))
+                continue
             if ftype == FRAME_FINAL:
                 self.summary = parse_json(payload)
+                token = self.summary.get("resume_token")
+                if token and self._on_token is not None:
+                    self._on_token(token)
                 self._eos = True
                 return False
             if ftype == FRAME_ERROR:
-                raise_error_frame(parse_json(payload))
+                doc = parse_json(payload)
+                token = doc.get("resume_token")
+                if token and self._on_token is not None:
+                    self._on_token(token)
+                raise_error_frame(doc)
             raise ProtocolError(f"unexpected frame {ftype!r} in stream")
 
     def read(self, n: int = -1) -> bytes:
@@ -149,28 +188,54 @@ class _FrameStream(io.RawIOBase):
 
 
 class ScanStream:
-    """One streamed scan: iterate for `pyarrow.RecordBatch`es.
+    """One logical streamed scan: iterate for `pyarrow.RecordBatch`es.
 
     After exhaustion, `summary` holds the server trailer (rows, bytes,
-    diagnostics JSON, per-scan io/plan-cache metrics). `table()`
-    collects the whole stream — with the diagnostics re-attached — into
-    the one-shot-identical pyarrow Table; call it INSTEAD of iterating
+    diagnostics JSON, per-scan io/plan-cache metrics — from the final
+    attempt when failovers happened). `table()` collects the whole
+    stream — with the diagnostics re-attached — into the
+    one-shot-identical pyarrow Table; call it INSTEAD of iterating
     (batches are only retained when `table()` drives the stream — plain
     iteration stays O(one batch) in client memory, which is the point
-    of streaming). `schema` is available once the first batch arrives
-    (or immediately after iteration starts on an empty result)."""
+    of streaming). `schema` is available once the first batch arrives.
 
-    def __init__(self, sock: socket.socket,
+    Failover state after exhaustion: `failovers` counts mid-stream
+    reconnects (0 = one clean attempt), `attempt_request_ids` lists the
+    wire-level request id of every attempt (the first IS `request_id`;
+    resumed attempts mint fresh ids and carry
+    ``resume.of = request_id`` so the audit log groups them)."""
+
+    def __init__(self, replicas: List[Tuple[str, int]],
+                 request_fields: dict,
                  on_progress: Optional[Callable] = None,
                  request_id: str = "", trace_id: str = "",
-                 tracer: Optional[Tracer] = None):
-        self._sock = sock
-        self._f = sock.makefile("rb")
-        self._frames = _FrameStream(self._f, on_progress)
+                 tracer: Optional[Tracer] = None,
+                 connect_retry: Optional[RetryPolicy] = None,
+                 connect_timeout_s: float = 10.0,
+                 read_timeout_s: float = DEFAULT_READ_TIMEOUT_S,
+                 max_failovers: int = DEFAULT_MAX_FAILOVERS):
+        self._replicas = list(replicas)
+        self._replica_idx = 0
+        self._fields = dict(request_fields)
+        self._on_progress = on_progress
+        self._connect_retry = connect_retry
+        self._connect_timeout_s = connect_timeout_s
+        self._read_timeout_s = read_timeout_s
+        self.max_failovers = max(0, int(max_failovers))
+        # current attempt's transport (None between attempts)
+        self._sock: Optional[socket.socket] = None
+        self._f = None
+        self._frames: Optional[_FrameStream] = None
         self._reader = None
+        # recovery state
+        self._plan_fp = ""
+        self._rows_yielded = 0
+        self.failovers = 0
+        self.attempt_request_ids: List[str] = [request_id]
         self._batches: list = []
         self._collect = False
         self._streamed_any = False
+        self._exhausted = False
         self.schema = None
         # the request's identity triple (tenant lives server-side on the
         # audit record); resolves this stream to its audit-log entry
@@ -183,29 +248,164 @@ class ScanStream:
 
     @property
     def summary(self) -> Optional[dict]:
-        return self._frames.summary
+        return self._frames.summary if self._frames is not None else None
+
+    # -- attempt lifecycle ----------------------------------------------
+
+    def _note_token(self, token: dict) -> None:
+        plan = token.get("plan")
+        if plan:
+            self._plan_fp = str(plan)
+
+    def _open_attempt(self) -> None:
+        """Connect to the current replica and send the request frame —
+        with resume state when a previous attempt already delivered
+        rows (or at least the plan token)."""
+        address = self._replicas[self._replica_idx]
+        t0 = time.perf_counter()
+        sock = connect(address, retry=self._connect_retry,
+                       connect_timeout_s=self._connect_timeout_s)
+        if self.tracer is not None:
+            name = "connect" if self.failovers == 0 \
+                else f"failover_connect#{self.failovers}"
+            self.tracer.record_span(name, "client", t0,
+                                    time.perf_counter(),
+                                    args={"address": list(address)})
+        fields = dict(self._fields)
+        if self.failovers and not self._plan_fp:
+            # the previous attempt died before even the initial plan
+            # token: nothing was delivered (_try_failover guarantees
+            # it), so this is a plain fresh retry of the same request
+            pass
+        elif self.failovers:
+            # resumed attempts are NEW wire requests (fresh request_id;
+            # the original id rides in resume.of so the audit log ties
+            # the attempts together) continuing the same trace
+            wire_id = new_trace_id()[:16]
+            fields["request_id"] = wire_id
+            self.attempt_request_ids.append(wire_id)
+            fields["resume"] = {
+                "plan": self._plan_fp,
+                "records": self._rows_yielded,
+                "of": self.request_id,
+            }
+        try:
+            sock.settimeout(self._read_timeout_s
+                            if self._read_timeout_s
+                            and self._read_timeout_s > 0 else None)
+            wf = sock.makefile("wb")
+            t0 = time.perf_counter()
+            write_json_frame(wf, FRAME_REQUEST, fields)
+            wf.flush()
+            wf.close()
+            if self.tracer is not None and self.failovers == 0:
+                self.tracer.record_span("send_request", "client", t0,
+                                        time.perf_counter())
+        except BaseException:
+            sock.close()
+            raise
+        self._sock = sock
+        self._f = sock.makefile("rb")
+        self._frames = _FrameStream(self._f, self._on_progress,
+                                    on_token=self._note_token)
+        self._reader = None
+
+    def _close_attempt(self) -> None:
+        for closer in (self._f, self._sock):
+            if closer is not None:
+                try:
+                    closer.close()
+                except OSError:
+                    pass
+        self._f = self._sock = None
+        self._frames = None
+        self._reader = None
+
+    def _try_failover(self, exc: BaseException) -> bool:
+        """Whether `exc` may be answered by reconnecting (to the next
+        replica) and resuming. Terminal: failover budget exhausted, a
+        non-transport non-retryable error, or rows were yielded but no
+        plan token ever arrived (resuming without plan validation could
+        splice mixed-version rows — refuse)."""
+        if isinstance(exc, ServeError):
+            # the server ANSWERED authoritatively: only a different
+            # replica could answer better — with a single address the
+            # structured error stands (the pre-resume semantics)
+            if (exc.code not in _FAILOVER_SERVE_CODES
+                    or len(self._replicas) < 2):
+                return False
+        elif not isinstance(exc, (OSError, ProtocolError)):
+            return False
+        if self.failovers >= self.max_failovers:
+            return False
+        if self._rows_yielded > 0 and not self._plan_fp:
+            return False
+        self.failovers += 1
+        self._close_attempt()
+        self._replica_idx = (self._replica_idx + 1) % len(self._replicas)
+        return True
+
+    # -- iteration -------------------------------------------------------
 
     def __iter__(self) -> Iterator:
         import pyarrow as pa
 
+        if self._exhausted:
+            return
         t0 = time.perf_counter()
         first_t: Optional[float] = None
-        if self._reader is None:
-            self._reader = pa.ipc.open_stream(self._frames)
-            self.schema = self._reader.schema
         while True:
+            # (re)establish an attempt and its IPC reader
             try:
-                batch = self._reader.read_next_batch()
-            except StopIteration:
-                break
-            if first_t is None:
-                first_t = time.perf_counter()
-            if self._collect:
-                self._batches.append(batch)
-            else:
-                self._streamed_any = True
-            yield batch
-        self._frames.drain_trailer()
+                if self._frames is None:
+                    self._open_attempt()
+                if self._reader is None:
+                    self._reader = pa.ipc.open_stream(self._frames)
+                    if self.schema is None:
+                        self.schema = self._reader.schema
+                    elif not self._reader.schema.equals(self.schema):
+                        raise ProtocolError(
+                            "resumed stream changed schema mid-request")
+            except BaseException as exc:
+                if isinstance(exc, ProtocolError) and \
+                        "changed schema" in str(exc):
+                    raise
+                if not self._try_failover(exc):
+                    raise
+                continue
+            # drain this attempt's batches
+            failed_over = False
+            while True:
+                try:
+                    batch = self._reader.read_next_batch()
+                except StopIteration:
+                    break
+                except BaseException as exc:
+                    if not self._try_failover(exc):
+                        raise
+                    failed_over = True
+                    break
+                if first_t is None:
+                    first_t = time.perf_counter()
+                if self._collect:
+                    self._batches.append(batch)
+                else:
+                    self._streamed_any = True
+                self._rows_yielded += batch.num_rows
+                yield batch
+            if failed_over:
+                continue
+            try:
+                self._frames.drain_trailer()
+            except BaseException as exc:
+                # the data all arrived but the trailer didn't: the
+                # resumed attempt skips every record and hands over the
+                # summary the caller is still owed
+                if not self._try_failover(exc):
+                    raise
+                continue
+            break
+        self._exhausted = True
         if self.tracer is not None:
             # the client's view of this request: how long it waited for
             # the first batch vs how long it spent consuming the stream
@@ -244,9 +444,7 @@ class ScanStream:
 
     def _merge_server_trace(self) -> None:
         """Fold the trailer's server spans onto the client tracer's
-        timeline (Tracer.merge clock-corrects across processes).
-        Idempotent — table() drives __iter__ exactly once, but guard
-        anyway."""
+        timeline (Tracer.merge clock-corrects across processes)."""
         if self.tracer is None or self._merged_server_trace:
             return
         trace = (self.summary or {}).get("trace")
@@ -282,14 +480,13 @@ class ScanStream:
         self.tracer.write_chrome_trace(path)
 
     def close(self) -> None:
-        try:
-            self._f.close()
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        for closer in (self._f, self._sock):
+            if closer is not None:
+                try:
+                    closer.close()
+                except OSError:
+                    pass
+        self._f = self._sock = None
 
     def __enter__(self) -> "ScanStream":
         return self
@@ -298,7 +495,19 @@ class ScanStream:
         self.close()
 
 
-def stream_scan(address: Tuple[str, int], files,
+def _normalize_replicas(address) -> List[Tuple[str, int]]:
+    """One (host, port) or a sequence of them -> a replica list."""
+    if (isinstance(address, (tuple, list)) and len(address) == 2
+            and isinstance(address[0], str)
+            and isinstance(address[1], int)):
+        return [tuple(address)]
+    replicas = [tuple(a) for a in address]
+    if not replicas:
+        raise ValueError("need at least one scan-server address")
+    return replicas
+
+
+def stream_scan(address, files,
                 tenant: str = "default",
                 max_records: Optional[int] = None,
                 progress_callback: Optional[Callable] = None,
@@ -308,9 +517,14 @@ def stream_scan(address: Tuple[str, int], files,
                 request_id: Optional[str] = None,
                 trace_id: Optional[str] = None,
                 trace: bool = False,
+                max_failovers: int = DEFAULT_MAX_FAILOVERS,
                 **options) -> ScanStream:
-    """Open one streamed scan against a ScanServer.
+    """Open one streamed scan against a ScanServer (or replica set).
 
+    `address`: one ``(host, port)`` or a LIST of them — with several
+    replicas (sharing one `cache_dir`), a connection lost mid-stream
+    fails over to the next replica and transparently RESUMES from the
+    records-delivered watermark; the caller just keeps iterating.
     `files`: input path(s) as the SERVER sees them; `options` is the
     read_cobol option surface (minus server-owned keys). Pass
     `progress_callback` to receive live `ScanProgress` snapshots (the
@@ -322,9 +536,12 @@ def stream_scan(address: Tuple[str, int], files,
     `trace=True` additionally records client-side spans and asks the
     server for its spans on the trailer —
     `stream.write_chrome_trace(path)` then emits ONE merged Chrome
-    trace for the request."""
+    trace for the request. `max_failovers` bounds mid-stream recovery
+    attempts per logical request (0 = fail on the first interruption,
+    the pre-resume behavior)."""
     if isinstance(files, (str, bytes)):
         files = [files]
+    replicas = _normalize_replicas(address)
     request_id = request_id or new_trace_id()[:16]
     trace_id = trace_id or new_trace_id()
     tracer = None
@@ -333,17 +550,9 @@ def stream_scan(address: Tuple[str, int], files,
                         trace_id=trace_id,
                         meta={"request_id": request_id,
                               "tenant": tenant})
-    t0 = time.perf_counter()
-    sock = connect(address, retry=connect_retry,
-                   connect_timeout_s=connect_timeout_s)
-    if tracer is not None:
-        tracer.record_span("connect", "client", t0, time.perf_counter())
-    try:
-        sock.settimeout(read_timeout_s if read_timeout_s
-                        and read_timeout_s > 0 else None)
-        f = sock.makefile("wb")
-        t0 = time.perf_counter()
-        write_json_frame(f, FRAME_REQUEST, {
+    stream = ScanStream(
+        replicas,
+        request_fields={
             "tenant": tenant,
             "files": list(files),
             "options": options,
@@ -352,25 +561,35 @@ def stream_scan(address: Tuple[str, int], files,
             "request_id": request_id,
             "trace_id": trace_id,
             "trace": trace,
-        })
-        f.flush()
-        if tracer is not None:
-            tracer.record_span("send_request", "client", t0,
-                               time.perf_counter())
-    except BaseException:
-        sock.close()
-        raise
-    return ScanStream(sock, on_progress=progress_callback,
-                      request_id=request_id, trace_id=trace_id,
-                      tracer=tracer)
+        },
+        on_progress=progress_callback,
+        request_id=request_id, trace_id=trace_id, tracer=tracer,
+        connect_retry=connect_retry,
+        connect_timeout_s=connect_timeout_s,
+        read_timeout_s=read_timeout_s,
+        max_failovers=max_failovers)
+    # connect + send the request eagerly (connect errors raise HERE,
+    # like they always did), leaving frame consumption to iteration —
+    # but a replica dead BEFORE the stream starts fails over too: the
+    # replica set must survive a pre-stream death as well as a
+    # mid-stream one
+    while True:
+        try:
+            stream._open_attempt()
+            break
+        except BaseException as exc:
+            if not stream._try_failover(exc):
+                raise
+    return stream
 
 
-def fetch_table(address: Tuple[str, int], files,
+def fetch_table(address, files,
                 tenant: str = "default",
                 max_records: Optional[int] = None,
                 **kwargs):
     """One-shot convenience: stream the scan and return the assembled
-    pyarrow Table (byte-identical to in-process `to_arrow()`)."""
+    pyarrow Table (byte-identical to in-process `to_arrow()`; with a
+    replica list, interruptions fail over and resume transparently)."""
     with stream_scan(address, files, tenant=tenant,
                      max_records=max_records, **kwargs) as stream:
         return stream.table()

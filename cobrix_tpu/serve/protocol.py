@@ -11,7 +11,8 @@ so any language with sockets can speak it. Frame types:
     client -> server
       'R'  request            JSON: {tenant, files, options,
                                      max_records, progress,
-                                     request_id, trace_id, trace}
+                                     request_id, trace_id, trace,
+                                     resume?}
                               — request_id/trace_id are the request's
                               identity triple (with tenant): minted by
                               the client (or an upstream service),
@@ -19,7 +20,14 @@ so any language with sockets can speak it. Frame types:
                               server's audit log and trace spans.
                               "trace" asks the server to ship its span
                               list back on the trailer so the client
-                              can merge ONE cross-process Chrome trace
+                              can merge ONE cross-process Chrome trace.
+                              "resume" = {plan, records, of} resumes an
+                              interrupted stream: `plan` is the chunk-
+                              plan fingerprint from a prior attempt's
+                              resume token, `records` the count already
+                              delivered to the consumer, `of` the
+                              original request_id the audit log ties
+                              the attempts together under
     server -> client
       'D'  data               raw Arrow IPC *stream* bytes (the
                               concatenation of every D payload is one
@@ -28,6 +36,15 @@ so any language with sockets can speak it. Frame types:
       'P'  progress           JSON ScanProgress.as_dict() (opt-in via
                               the request's "progress" flag; throttled
                               server-side by `progress_interval_s`)
+      'T'  resume token       JSON: {plan, records} — the recovery
+                              watermark, sent periodically between data
+                              frames and echoed on the trailer: `plan`
+                              fingerprints the chunk plan (files, file
+                              versions, row-shaping options) so a
+                              resume against a CHANGED file is refused
+                              (`resume_mismatch`) instead of splicing
+                              mixed-version rows; `records` is the
+                              running count of records put on the wire
       'F'  final summary      JSON: {rows, tables, bytes, request_id,
                                      trace_id, queue_wait_s,
                                      first_batch_s, diagnostics,
@@ -58,11 +75,12 @@ MAX_DATA_FRAME = 8 * 1024 * 1024
 FRAME_REQUEST = b"R"
 FRAME_DATA = b"D"
 FRAME_PROGRESS = b"P"
+FRAME_TOKEN = b"T"
 FRAME_FINAL = b"F"
 FRAME_ERROR = b"E"
 
-_CONTROL_FRAMES = (FRAME_REQUEST, FRAME_PROGRESS, FRAME_FINAL,
-                   FRAME_ERROR)
+_CONTROL_FRAMES = (FRAME_REQUEST, FRAME_PROGRESS, FRAME_TOKEN,
+                   FRAME_FINAL, FRAME_ERROR)
 
 
 class ProtocolError(ConnectionError):

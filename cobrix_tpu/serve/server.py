@@ -38,6 +38,7 @@ from .protocol import (
     FRAME_FINAL,
     FRAME_PROGRESS,
     FRAME_REQUEST,
+    FRAME_TOKEN,
     ClientGone,
     FrameWriter,
     ProtocolError,
@@ -204,9 +205,31 @@ class _Handler(socketserver.StreamRequestHandler):
             controller=server.controller,
             on_progress=on_progress, tracer=tracer,
             force_progress=True,
-            force_field_costs=server.wants_field_costs())
+            force_field_costs=server.wants_field_costs(),
+            # the stream's FIRST frame is a resume token carrying the
+            # chunk-plan fingerprint: a client that dies at any later
+            # point holds the plan identity it must resume against
+            on_plan=lambda fp: writer.try_json(
+                FRAME_TOKEN,
+                {"plan": fp, "records": request.resume_records}))
+        if request.is_resume:
+            m["resumed"].labels(tenant=tenant).inc()
+        # resume tokens ride between data frames: after a table is on
+        # the wire, the delivery watermark advanced — tell the client
+        # (throttled), so a connection lost mid-stream resumes from the
+        # last token instead of record 0. FrameWriter's lock keeps the
+        # token frame-aligned between IPC fragments.
+        token_last = [0.0]
+
+        def write_table(table) -> None:
+            table_writer.write_table(table)
+            now = time.monotonic()
+            if now - token_last[0] >= server.token_interval_s:
+                token_last[0] = now
+                writer.try_json(FRAME_TOKEN, session.resume_token())
+
         try:
-            summary = session.run(table_writer.write_table)
+            summary = session.run(write_table)
             table_writer.close(fallback_schema=session.result_schema)
             summary["bytes"] = writer.bytes_written
             summary["queue_wait_s"] = round(queue_wait_s, 6)
@@ -233,7 +256,13 @@ class _Handler(socketserver.StreamRequestHandler):
             # its own code (request hygiene failures are 'protocol')
             code = exc.code if isinstance(exc, ServeError) \
                 else "scan_error"
-            writer.try_json(FRAME_ERROR, error_payload(exc, code))
+            payload = error_payload(exc, code)
+            if code == "scan_error" and session.plan_fp:
+                # even a failed scan tells the client how far it got:
+                # the failover attempt on another replica resumes from
+                # here instead of re-streaming everything
+                payload["resume_token"] = session.resume_token()
+            writer.try_json(FRAME_ERROR, payload)
             error_text = f"{type(exc).__name__}: {exc}"
             if code == "protocol":
                 # a request the server refused to run (reserved /
@@ -250,6 +279,8 @@ class _Handler(socketserver.StreamRequestHandler):
             m["completed"].labels(
                 tenant=tenant,
                 outcome="ok" if outcome == "ok" else "error").inc()
+            if session.degraded:
+                m["degraded"].labels(tenant=tenant).inc()
             server.observe_scan(
                 request, summary, outcome=outcome, error=error_text,
                 queue_wait_s=queue_wait_s, first_batch_s=first_batch_s,
@@ -306,11 +337,34 @@ class ScanServer(socketserver.ThreadingTCPServer):
                  flight_dir: str = "",
                  flight_ring: int = 64,
                  flight_max_dumps: int = 200,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0,
+                 token_interval_s: float = 1.0,
+                 memory_budget_mb: float = 0.0,
+                 degrade_fraction: float = 0.75,
+                 shed_fraction: float = 0.9):
         super().__init__((host, port), _Handler)
         # max seconds ONE frame write may block on a non-reading peer
         # before the scan is cancelled as ClientGone (0 = unbounded)
         self.send_timeout_s = max(0.0, float(send_timeout_s))
+        # min seconds between mid-stream resume-token ('T') frames
+        self.token_interval_s = max(0.0, float(token_interval_s))
+        # overload shedding: a positive budget installs the
+        # process-wide memory watermark (utils.pressure) — past the
+        # degrade fraction new scans run with shrunk io/pipeline knobs,
+        # past the shed fraction admission refuses work with structured
+        # `overloaded` rejections instead of riding into the OOM-killer.
+        # The server owns what it installed: stop() uninstalls it so a
+        # stopped server's budget cannot throttle unrelated in-process
+        # work (embedders / tests constructing several servers)
+        self._installed_budget = bool(memory_budget_mb
+                                      and memory_budget_mb > 0)
+        if self._installed_budget:
+            from ..utils.pressure import set_process_budget
+
+            set_process_budget(
+                int(memory_budget_mb * 1024 * 1024),
+                degrade_fraction=degrade_fraction,
+                shed_fraction=shed_fraction)
         self.metrics = serve_metrics()
         self.controller = AdmissionController(
             default_quota=default_quota, quotas=quotas,
@@ -412,7 +466,9 @@ class ScanServer(socketserver.ThreadingTCPServer):
         record = ScanRecord(
             request_id=request.request_id, trace_id=request.trace_id,
             tenant=request.tenant, outcome="rejected", ts=time.time(),
-            files=list(request.files), error=f"{reason}: {detail}")
+            files=list(request.files), error=f"{reason}: {detail}",
+            resume_of=((request.resume_of or "?")
+                       if request.is_resume else ""))
         self._observe_record(record, tracer=None, field_costs=None)
 
     def observe_scan(self, request: ScanRequest, summary: dict,
@@ -430,7 +486,12 @@ class ScanServer(socketserver.ThreadingTCPServer):
                 queue_wait_s=round(queue_wait_s, 6),
                 first_batch_s=(round(first_batch_s, 6)
                                if first_batch_s is not None else None),
-                e2e_s=round(e2e_s, 6))
+                e2e_s=round(e2e_s, 6),
+                # only an HONORED resume (it actually skipped records)
+                # ties and SLO-exempts — a zero-record resume shape is
+                # an ordinary scan and must account like one
+                resume_of=((request.resume_of or "?")
+                           if request.is_resume else ""))
             field_costs = (session.metrics.field_costs
                            if session.metrics is not None else None)
             self._observe_record(record, tracer=tracer,
@@ -568,6 +629,11 @@ class ScanServer(socketserver.ThreadingTCPServer):
         self.server_close()
         if self._http is not None:
             self._http.stop()
+        if getattr(self, "_installed_budget", False):
+            from ..utils.pressure import set_process_budget
+
+            set_process_budget(0)
+            self._installed_budget = False
 
 
 def main(argv=None) -> int:
@@ -611,6 +677,12 @@ def main(argv=None) -> int:
     ap.add_argument("--drain-timeout", type=float, default=30.0,
                     help="seconds in-flight scans get to finish on "
                          "SIGTERM/SIGINT before forced abort")
+    ap.add_argument("--memory-budget-mb", type=float, default=0.0,
+                    help="process RSS budget: past 75%% new scans run "
+                         "degraded (halved read-ahead, shrunk chunk "
+                         "window), past 90%% admission sheds with "
+                         "structured 'overloaded' rejections "
+                         "(0 = no watermark)")
     args = ap.parse_args(argv)
     server_options = ({"cache_dir": args.cache_dir} if args.cache_dir
                       else None)
@@ -623,7 +695,8 @@ def main(argv=None) -> int:
         audit_log=args.audit_log, audit_max_mb=args.audit_max_mb,
         slos=args.slo, flight_dir=args.flight_dir,
         flight_max_dumps=args.flight_max_dumps,
-        drain_timeout_s=args.drain_timeout)
+        drain_timeout_s=args.drain_timeout,
+        memory_budget_mb=args.memory_budget_mb)
     print(f"cobrix_tpu serving scans on {srv.address}, "
           f"obs on {srv.http_address}", flush=True)
     stop_signal = threading.Event()

@@ -31,6 +31,9 @@ optional Flight front-end both drive it):
 """
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -55,6 +58,73 @@ RESERVED_OPTION_KEYS = ("path", "progress_callback", "batch_callback",
 # streaming wants the pipelined engine (that is where first-batch
 # latency comes from); a request may still override explicitly
 DEFAULT_STREAM_OPTIONS = {"pipeline_workers": "-1"}
+
+
+# read options that do NOT shape which records stream in which order:
+# identity/telemetry, io/cache/prefetch plumbing, retry budgets, and
+# engine parallelism knobs (sequential==pipelined==multihost row parity
+# is pinned by tests). Excluded from the chunk-plan fingerprint so two
+# replicas with different OPERATOR config (cache_dir mount points,
+# prefetch depths, worker counts) still accept each other's resume
+# tokens — only row-shaping divergence may refuse a resume.
+NON_PLAN_OPTIONS = frozenset((
+    "trace_id", "request_id", "trace_file", "field_costs",
+    "progress_interval_s",
+    "cache_dir", "cache_max_mb", "prefetch_blocks", "io_block_mb",
+    "io_retry_attempts", "io_retry_base_delay", "io_retry_max_delay",
+    "io_retry_deadline",
+    "pipeline_workers", "pipeline_chunk_mb", "pipeline_max_inflight",
+    "chunk_size_mb", "stream_batch_rows",
+    "shard_timeout_s", "shard_max_retries", "speculative_quantile",
+    "scan_deadline_s", "heartbeat_interval_s", "hosts",
+))
+
+
+def plan_fingerprint(files: List[str], read_kwargs: dict) -> str:
+    """The chunk-plan fingerprint a resume token carries: a digest of
+    each input's *content version* (local size+mtime_ns; a backend's
+    own fingerprint — etag/ukey — for registry schemes) plus every
+    read option that shapes which records stream in which order. Two
+    replicas sharing storage compute the SAME fingerprint for the same
+    file version, so a client can resume on either; a changed file
+    changes the fingerprint and the resume is refused
+    (``resume_mismatch``) — a resumed stream must never splice rows of
+    two file versions.
+
+    Cost: one stat / backend metadata round trip per file per request,
+    before any byte decodes (the read's own memoized probe runs inside
+    read_cobol and is not reachable from here). That is the price of
+    every stream being resumable; it is the same cost class as the
+    scan's own per-read version probe."""
+    from ..reader.stream import (normalize_local, path_scheme,
+                                 resolve_stream_backend)
+
+    versions = []
+    for f in files:
+        scheme = path_scheme(f)
+        token = "unknown"
+        if scheme in (None, "file"):
+            try:
+                st = os.stat(normalize_local(f))
+                token = f"local:{st.st_size}:{st.st_mtime_ns}"
+            except OSError:
+                token = "absent"
+        else:
+            try:
+                factory = resolve_stream_backend(scheme)
+                if factory is not None:
+                    source = factory(f)
+                    try:
+                        token = source.fingerprint()
+                    finally:
+                        source.close()
+            except Exception:
+                token = "unprobeable"
+        versions.append(f"{f}|{token}")
+    opts = {k: v for k, v in read_kwargs.items()
+            if k not in NON_PLAN_OPTIONS}
+    payload = json.dumps([versions, opts], sort_keys=True, default=str)
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()[:24]
 
 
 class ScanRequest:
@@ -89,6 +159,30 @@ class ScanRequest:
         # client opt-in: ship the server-side trace spans back on the
         # trailer so the client can merge one cross-process Chrome trace
         self.want_trace = bool(payload.get("trace"))
+        # resume of an interrupted stream: {plan, records, of}. `plan`
+        # must match this server's computed chunk-plan fingerprint
+        # (validated in ScanSession.run), `records` are skipped before
+        # anything hits the wire, `of` is the ORIGINAL request_id the
+        # audit log ties the attempts together under (resume_of)
+        resume = payload.get("resume") or {}
+        if resume and not isinstance(resume, dict):
+            raise ServeError("'resume' must be an object",
+                             code="protocol")
+        self.resume_plan = str(resume.get("plan") or "")
+        try:
+            self.resume_records = max(0, int(resume.get("records") or 0))
+        except (TypeError, ValueError):
+            raise ServeError("'resume.records' must be an integer",
+                             code="protocol")
+        self.resume_of = str(resume.get("of") or "")
+        # only a resume that actually SKIPS records is honored as one:
+        # with records=0 nothing was delivered, so the request is an
+        # ordinary fresh scan — no plan validation needed (nothing can
+        # splice) and, crucially, no resume_of stamp: resumed records
+        # are exempt from SLO evaluation, and a zero-cost 'resume'
+        # shape must not let a client opt its scans out of SLO
+        # accounting (a real resume forfeits at least one record)
+        self.is_resume = bool(resume) and self.resume_records > 0
 
     def read_kwargs(self, server_options: Optional[dict]) -> dict:
         """The effective read_cobol option map: defaults, then client
@@ -132,11 +226,20 @@ class OrderedBatchEmitter:
     _GATE_SLICE_S = 0.5
 
     def __init__(self, write_table: Callable, tenant: str,
-                 controller=None, max_records: Optional[int] = None):
+                 controller=None, max_records: Optional[int] = None,
+                 skip_records: int = 0):
         self.write_table = write_table
         self.tenant = tenant
         self.controller = controller
         self.max_records = max_records
+        # resume support: records already delivered to this client by a
+        # previous attempt — dropped here before they reach the wire.
+        # Whole tables inside the skip window are discarded without
+        # slicing (the cheap path: a resumed scan's already-delivered
+        # chunks cost decode but neither Arrow materialization nor
+        # serialization nor network), the boundary table is sliced once
+        self.skip_records = max(0, int(skip_records))
+        self.rows_skipped = 0
         self.rows_emitted = 0
         self.tables_emitted = 0
         self._next = 0
@@ -221,6 +324,13 @@ class OrderedBatchEmitter:
     def _write_capped(self, table) -> None:
         if self._done:
             return
+        remaining_skip = self.skip_records - self.rows_skipped
+        if remaining_skip > 0:
+            if table.num_rows <= remaining_skip:
+                self.rows_skipped += table.num_rows
+                return  # wholly inside the skip window: drop, unsliced
+            self.rows_skipped = self.skip_records
+            table = table.slice(remaining_skip)
         if self.max_records is not None:
             remaining = self.max_records - self.rows_emitted
             if remaining <= 0:
@@ -276,8 +386,14 @@ class ScanSession:
                  on_progress: Optional[Callable] = None,
                  tracer=None,
                  force_progress: bool = False,
-                 force_field_costs: bool = False):
+                 force_field_costs: bool = False,
+                 on_plan: Optional[Callable] = None):
         self.request = request
+        # called with the chunk-plan fingerprint BEFORE any decode: the
+        # transport ships it as the stream's first resume token, so a
+        # client losing the connection at ANY later point knows the
+        # plan identity it must resume against
+        self.on_plan = on_plan
         self.server_options = server_options
         self.controller = controller
         self.on_progress = on_progress
@@ -291,20 +407,60 @@ class ScanSession:
         # the result's Arrow schema (set by run): lets the transport
         # send a valid EMPTY IPC stream when a scan produced no batches
         self.result_schema = None
+        # resume-token state the transport reads mid-stream: the chunk-
+        # plan fingerprint (set before the first batch) and the emitter
+        # (its rows_emitted is the live delivery watermark)
+        self.plan_fp = ""
+        self.emitter: Optional[OrderedBatchEmitter] = None
+        # True when memory pressure degraded this scan's io knobs
+        self.degraded = False
+
+    def delivered_records(self) -> int:
+        """Records delivered to this client so far across ALL attempts:
+        the resume token's watermark (prior attempts' skip + this
+        attempt's emitted rows)."""
+        emitted = self.emitter.rows_emitted if self.emitter else 0
+        return self.request.resume_records + emitted
+
+    def resume_token(self) -> dict:
+        return {"plan": self.plan_fp,
+                "records": self.delivered_records()}
 
     def run(self, write_table: Callable) -> dict:
         from ..api import read_cobol
 
         req = self.request
-        emitter = OrderedBatchEmitter(
-            write_table, req.tenant, controller=self.controller,
-            max_records=req.max_records)
         kwargs = req.read_kwargs(self.server_options)
         if self.force_field_costs:
             # operator-owned, like the ids in read_kwargs: the flight
             # recorder's evidence must not be disableable by a client
             # sending field_costs="false"
             kwargs["field_costs"] = "true"
+        # chunk-plan fingerprint: computed up front on EVERY streamed
+        # scan (one stat/metadata probe per file) so every resume token
+        # carries it, and validated against an inbound resume BEFORE
+        # any byte is decoded — a stale file version must fail fast
+        # with a structured error, never splice mixed-version rows
+        self.plan_fp = plan_fingerprint(req.files, kwargs)
+        if req.is_resume and req.resume_plan != self.plan_fp:
+            raise ServeError(
+                "resume token does not match this server's chunk plan "
+                "(the input file(s) or options changed since the "
+                "original attempt); restart the scan from record 0",
+                code="resume_mismatch")
+        # a resumed request's max_records is the ORIGINAL total: this
+        # attempt emits only what remains after the already-delivered
+        # records are skipped
+        max_records = req.max_records
+        if max_records is not None:
+            max_records = max(0, max_records - req.resume_records)
+        emitter = OrderedBatchEmitter(
+            write_table, req.tenant, controller=self.controller,
+            max_records=max_records, skip_records=req.resume_records)
+        self.emitter = emitter
+        self._maybe_degrade(kwargs)
+        if self.on_plan is not None:
+            self.on_plan(self.plan_fp)
         progress_cb = None
         if self.on_progress is not None and (req.want_progress
                                              or self.force_progress):
@@ -334,7 +490,17 @@ class ScanSession:
             "request_id": req.request_id,
             "trace_id": req.trace_id,
             "diagnostics": diagnostics,
+            # the final recovery watermark: a client that loses the
+            # connection AFTER the last data frame but before/while
+            # reading this trailer can still resume (and skip
+            # everything)
+            "resume_token": self.resume_token(),
         }
+        if req.is_resume:
+            summary["resume_of"] = req.resume_of or req.request_id
+            summary["rows_skipped"] = emitter.rows_skipped
+        if self.degraded:
+            summary["degraded"] = True
         if data.metrics is not None:
             m = data.metrics
             summary["metrics"] = {
@@ -365,3 +531,21 @@ class ScanSession:
             summary["trace"] = {"trace_id": self.tracer.trace_id,
                                 "spans": spans, "clock": clock}
         return summary
+
+    def _maybe_degrade(self, kwargs: dict) -> None:
+        """Memory-pressure degrade step (utils.pressure): past the
+        degrade watermark every newly-started scan runs with HALVED
+        read-ahead (prefetched blocks are pure RSS) — the pipeline
+        executor additionally shrinks its own in-flight chunk window.
+        Slower, not failing; the shed watermark above this one is where
+        admission starts refusing work."""
+        from ..utils.pressure import LEVEL_DEGRADED, current_level
+
+        if current_level() < LEVEL_DEGRADED:
+            return
+        self.degraded = True
+        try:
+            prefetch = int(str(kwargs.get("prefetch_blocks", 2)))
+        except ValueError:
+            prefetch = 2
+        kwargs["prefetch_blocks"] = str(prefetch // 2)

@@ -249,6 +249,120 @@ def register_chaos_backend(scheme: str, data: bytes,
     return source
 
 
+# -- durable-state fault injection ---------------------------------------
+#
+# The injectors below break DISK, not bytes-in-flight or workers: the
+# persistent cache planes (io/blockcache, io/index_store, the roofline
+# calibration) trust files across process lifetimes, and
+# tests/test_integrity.py + tools/fsckcache.py drive the self-verifying
+# read path through exactly the corruptions real storage produces —
+# flipped bits, torn tails — plus the writer-side failures (ENOSPC,
+# read-only volume) that must degrade to "cache off", never to a failed
+# scan.
+
+
+def cache_entry_paths(cache_dir: str, plane: str = "block"):
+    """Every durable entry file of one cache plane under `cache_dir`,
+    sorted for determinism. Planes: 'block' (aligned .blk entries),
+    'index' (sparse-index .json payloads)."""
+    sub = {"block": "blocks", "index": "index"}[plane]
+    suffix = {"block": ".blk", "index": ".json"}[plane]
+    root = os.path.join(cache_dir, sub)
+    out = []
+    for dirpath, _dirs, files in os.walk(root):
+        for name in files:
+            if name.endswith(suffix):
+                out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def corrupt_cache_entry(cache_dir: str, plane: str = "block",
+                        mode: str = "bitflip", which: int = 0,
+                        offset: int = -9) -> str:
+    """Corrupt one persistent-cache entry in place and return its path.
+
+    * ``mode='bitflip'`` — flip one bit at `offset` (negative = from
+      the tail, default lands inside the payload, past any header);
+    * ``mode='truncate'`` — tear the file to half its size (a crashed
+      copy, a filesystem that lost the tail);
+    * ``mode='garbage'`` — replace the whole file with non-format bytes.
+
+    `which` picks the entry (sorted order). The integrity layer must
+    turn every one of these into a counted, quarantined MISS."""
+    paths = cache_entry_paths(cache_dir, plane)
+    if not paths:
+        raise FileNotFoundError(
+            f"no '{plane}' cache entries under {cache_dir}")
+    path = paths[which % len(paths)]
+    data = open(path, "rb").read()
+    if mode == "bitflip":
+        pos = offset if offset >= 0 else len(data) + offset
+        pos = max(0, min(len(data) - 1, pos))
+        data = flip_bit(data, pos)
+    elif mode == "truncate":
+        data = data[:max(1, len(data) // 2)]
+    elif mode == "garbage":
+        data = b"\x00\xff" * max(8, len(data) // 4)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+class cache_write_faults:
+    """Context manager making every cache-plane WRITE fail the way a
+    full or read-only volume does (``mode='enospc'`` => OSError ENOSPC,
+    ``mode='readonly'`` => OSError EROFS) while reads keep working.
+    Patches the `write_atomic` symbol each persistence module bound at
+    import, so the fault hits exactly the durable-write call sites::
+
+        with cache_write_faults("enospc"):
+            read_cobol(...)   # scans fine; cache stays cold
+
+    The contract under test: a failing cache write DEGRADES (warn +
+    refetch next time), it never fails the scan."""
+
+    def __init__(self, mode: str = "enospc"):
+        import errno
+
+        self.errno = {"enospc": errno.ENOSPC,
+                      "readonly": errno.EROFS}[mode]
+        self.mode = mode
+        self.write_attempts = 0
+        self._patched = []
+
+    def _raiser(self):
+        fault = self
+
+        def failing_write_atomic(path, data, fsync=False):
+            fault.write_attempts += 1
+            raise OSError(fault.errno,
+                          f"injected {fault.mode} on cache write", path)
+        return failing_write_atomic
+
+    def __enter__(self):
+        # patching utils.atomic also covers late `from ..utils.atomic
+        # import write_atomic` call sites (roofline's lazy import)
+        from ..io import blockcache, index_store
+        from ..utils import atomic
+
+        fail = self._raiser()
+        # patch each consumer's bound symbol AND the source module (for
+        # late importers)
+        for mod in (blockcache, index_store, atomic):
+            self._patched.append((mod, "write_atomic",
+                                  mod.write_atomic))
+            mod.write_atomic = fail
+        return self
+
+    def __exit__(self, *exc):
+        for mod, name, original in self._patched:
+            setattr(mod, name, original)
+        self._patched.clear()
+        return False
+
+
 # -- distributed-supervision fault injection -----------------------------
 #
 # The injectors below break WORKERS, not bytes: a multihost worker

@@ -331,10 +331,28 @@ class TestRoofline:
         process (serving tier) sees a calibration another process
         writes afterwards, without a restart."""
         assert roofline.cached_bandwidth() is None
-        with open(roofline_cache, "w", encoding="utf-8") as f:
-            json.dump({"bandwidth_bytes_per_s": 5e9,
-                       "method": roofline._METHOD}, f)
+        # written the way a real sibling process would (checksummed;
+        # an unstamped record is treated as unverifiable and ignored)
+        roofline._write_cache({"bandwidth_bytes_per_s": 5e9,
+                               "method": roofline._METHOD})
         assert roofline.cached_bandwidth() == pytest.approx(5e9)
+
+    def test_corrupt_calibration_quarantined(self, roofline_cache):
+        """A bit-flipped calibration must read as UNCALIBRATED (and be
+        quarantined + counted), never silently re-anchor fractions."""
+        from cobrix_tpu.io.integrity import corruption_counter
+
+        roofline._write_cache({"bandwidth_bytes_per_s": 5e9,
+                               "method": roofline._METHOD})
+        raw = open(roofline_cache, "rb").read()
+        flip = raw.replace(b"5000000000", b"9000000000")
+        assert flip != raw  # the value the crc protects
+        with open(roofline_cache, "wb") as f:
+            f.write(flip)
+        before = corruption_counter().value(plane="roofline")
+        assert roofline.cached_bandwidth() is None
+        assert corruption_counter().value(plane="roofline") == before + 1
+        assert not os.path.exists(roofline_cache)  # quarantined away
 
     def test_atomic_write_respects_umask(self, tmp_path):
         """mkstemp creates 0600; the shared atomic writer must restore

@@ -1,0 +1,411 @@
+"""Self-verifying durable state (io/integrity.py): the corruption-
+recovery matrix.
+
+Every persistent plane — block cache, sparse-index store, roofline
+calibration — is driven through bit-flips and torn tails, across the
+sequential and pipelined execution paths (multihost under the `slow`
+marker): scans must return BYTE-IDENTICAL output vs a clean read,
+`cobrix_cache_corruption_total{plane}` must count every detection, the
+corrupt entry must land in quarantine, and the NEXT scan must run warm
+again off the rebuilt entry. Writer-side faults (ENOSPC / read-only
+volume) must degrade to cache-off scans, never failed ones. The
+offline verifier (tools/fsckcache.py) smoke-tests in-process here so
+tier-1 covers it without a subprocess.
+"""
+import json
+import os
+import uuid
+
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.io.blockcache import BlockCache
+from cobrix_tpu.io.integrity import (
+    corruption_counter,
+    frame_block,
+    sweep_cache_root,
+    unframe_block,
+)
+from cobrix_tpu.io.stats import IoStats
+from cobrix_tpu.testing.faults import (
+    cache_entry_paths,
+    cache_write_faults,
+    corrupt_cache_entry,
+    register_chaos_backend,
+)
+from cobrix_tpu.testing.generators import (
+    EXP1_COPYBOOK,
+    EXP2_COPYBOOK,
+    generate_exp1,
+    generate_exp2,
+)
+
+from util import hard_timeout
+
+# execution modes of the matrix (multihost is the slow tier)
+MODES = [("sequential", {"pipeline_workers": "0"}),
+         ("pipelined", {"pipeline_workers": "2"})]
+
+
+def _counter(plane: str) -> float:
+    return corruption_counter().value(plane=plane)
+
+
+def _fixed_scheme(data: bytes) -> str:
+    scheme = f"integ{uuid.uuid4().hex[:10]}"
+    register_chaos_backend(scheme, data)
+    return f"{scheme}://input"
+
+
+@pytest.fixture(scope="module")
+def fixed_data():
+    return generate_exp1(4096, seed=7).tobytes()
+
+
+@pytest.fixture(scope="module")
+def vrl_file(tmp_path_factory):
+    # ~2.6 MB against a 1 MB split: several sparse-index entries, so a
+    # flipped entry OFFSET is a real misframing hazard
+    path = tmp_path_factory.mktemp("integ") / "vrl.dat"
+    path.write_bytes(generate_exp2(40000, seed=9))
+    return str(path)
+
+
+VRL_OPTS = dict(copybook_contents=EXP2_COPYBOOK,
+                is_record_sequence="true",
+                segment_field="SEGMENT-ID",
+                redefine_segment_id_map="STATIC-DETAILS => C",
+                **{"redefine_segment_id_map:1": "CONTACTS => P"})
+
+
+# -- block-cache corruption ----------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["bitflip", "truncate", "garbage"])
+@pytest.mark.parametrize("exec_name,exec_opts", MODES)
+def test_block_corruption_self_heals(tmp_path, fixed_data, mode,
+                                     exec_name, exec_opts):
+    with hard_timeout(180, f"block {mode} {exec_name}"):
+        cache_dir = str(tmp_path / "cache")
+        url = _fixed_scheme(fixed_data)
+        opts = dict(copybook_contents=EXP1_COPYBOOK, cache_dir=cache_dir,
+                    io_block_mb="0.25", prefetch_blocks="0", **exec_opts)
+        clean = read_cobol(url, **opts).to_arrow()
+        assert cache_entry_paths(cache_dir, "block")
+
+        corrupted = corrupt_cache_entry(cache_dir, "block", mode)
+        healed = read_cobol(url, **opts)
+        # 1. wrong data never surfaces
+        assert healed.to_arrow().equals(clean)
+        # 2. the detection is counted on the read AND the registry
+        io = healed.metrics.as_dict()["io"]
+        assert io["block_corrupt"] >= 1
+        # 3. the corrupt bytes are held in quarantine, and the entry at
+        # the same path was REBUILT from storage (it verifies again)
+        assert os.listdir(os.path.join(cache_dir, "quarantine"))
+        start, end = (int(x) for x in
+                      os.path.basename(corrupted)[:-4].split("-"))
+        rebuilt = open(corrupted, "rb").read()
+        assert unframe_block(rebuilt, end - start) is not None
+        # 4. rebuilt transparently: the next scan runs warm and clean
+        warm = read_cobol(url, **opts)
+        assert warm.to_arrow().equals(clean)
+        warm_io = warm.metrics.as_dict()["io"]
+        assert warm_io["block_corrupt"] == 0
+        assert warm_io["block_hits"] > 0
+
+
+def test_block_corruption_counts_in_prometheus(tmp_path, fixed_data):
+    with hard_timeout(120, "block prometheus count"):
+        from cobrix_tpu.obs.metrics import prometheus_text
+
+        cache_dir = str(tmp_path / "cache")
+        url = _fixed_scheme(fixed_data)
+        opts = dict(copybook_contents=EXP1_COPYBOOK, cache_dir=cache_dir,
+                    io_block_mb="0.25", prefetch_blocks="0")
+        read_cobol(url, **opts)
+        before = _counter("block")
+        corrupt_cache_entry(cache_dir, "block", "bitflip")
+        read_cobol(url, **opts)
+        assert _counter("block") == before + 1
+        assert "cobrix_cache_corruption_total" in prometheus_text()
+
+
+def test_short_block_file_is_miss_never_served(tmp_path):
+    """The quick guard: a block-cache file SHORTER than its aligned-
+    range key must read as a counted miss — a short block spliced into
+    the record framer would shift every later record's bytes."""
+    cache = BlockCache(str(tmp_path))
+    gen = cache.generation_dir("mem://x", "fp")
+    stats = IoStats()
+    payload = os.urandom(4096)
+    cache.put(gen, 0, 4096, payload, io_stats=stats)
+    path = cache_entry_paths(str(tmp_path), "block")[0]
+    # tear the file mid-payload (shorter than the range key)
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 3])
+    assert cache.get(gen, 0, 4096, io_stats=stats) is None
+    assert stats.as_dict()["block_corrupt"] == 1
+    assert not os.path.exists(path)
+    # a re-put + get round-trips the true bytes again
+    cache.put(gen, 0, 4096, payload, io_stats=stats)
+    assert cache.get(gen, 0, 4096, io_stats=stats) == payload
+
+
+def test_block_frame_roundtrip_and_rejects():
+    payload = b"some block payload" * 100
+    framed = frame_block(payload)
+    assert unframe_block(framed, len(payload)) == payload
+    # flipped payload bit
+    bad = bytearray(framed)
+    bad[-1] ^= 1
+    assert unframe_block(bytes(bad), len(payload)) is None
+    # flipped header bit
+    bad = bytearray(framed)
+    bad[5] ^= 1
+    assert unframe_block(bytes(bad), len(payload)) is None
+    # wrong expected length
+    assert unframe_block(framed, len(payload) - 1) is None
+    # legacy raw (headerless) bytes
+    assert unframe_block(payload, len(payload)) is None
+
+
+# -- sparse-index corruption ---------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+@pytest.mark.parametrize("exec_name,exec_opts", MODES)
+def test_index_corruption_self_heals(tmp_path, vrl_file, mode,
+                                     exec_name, exec_opts):
+    with hard_timeout(180, f"index {mode} {exec_name}"):
+        cache_dir = str(tmp_path / "cache")
+        opts = dict(VRL_OPTS, cache_dir=cache_dir,
+                    input_split_size_mb="1", **exec_opts)
+        clean = read_cobol(vrl_file, **opts)
+        assert clean.metrics.as_dict()["io"]["index_saves"] >= 1
+        assert cache_entry_paths(cache_dir, "index")
+        clean_table = clean.to_arrow()
+
+        corrupted = corrupt_cache_entry(cache_dir, "index", mode,
+                                        offset=-30)
+        healed = read_cobol(vrl_file, **opts)
+        assert healed.to_arrow().equals(clean_table)
+        io = healed.metrics.as_dict()["io"]
+        assert io["index_corrupt"] >= 1
+        assert io["index_saves"] >= 1  # re-persisted
+        assert os.listdir(os.path.join(cache_dir, "quarantine"))
+        # rebuilt at the same path, verified again
+        from cobrix_tpu.io.integrity import verify_json_payload
+
+        assert verify_json_payload(
+            json.loads(open(corrupted, encoding="utf-8").read()))
+        # next scan loads the rebuilt index cleanly
+        warm = read_cobol(vrl_file, **opts)
+        assert warm.to_arrow().equals(clean_table)
+        warm_io = warm.metrics.as_dict()["io"]
+        assert warm_io["index_corrupt"] == 0
+        assert warm_io["index_hits"] >= 1
+
+
+def test_index_bitflip_inside_offsets_never_misframes(tmp_path,
+                                                      vrl_file):
+    """The dangerous corruption: a flipped digit INSIDE an entry's
+    offsets still deserializes structurally — only the checksum knows.
+    The scan must not frame records from the wrong offsets."""
+    with hard_timeout(120, "index offset flip"):
+        cache_dir = str(tmp_path / "cache")
+        opts = dict(VRL_OPTS, cache_dir=cache_dir,
+                    input_split_size_mb="1", pipeline_workers="0")
+        clean = read_cobol(vrl_file, **opts).to_arrow()
+        path = cache_entry_paths(cache_dir, "index")[0]
+        doc = open(path, encoding="utf-8").read()
+        payload = json.loads(doc)
+        # corrupt the SECOND entry's start offset by one digit, keeping
+        # the JSON perfectly valid
+        assert len(payload["entries"]) >= 2
+        off = payload["entries"][1][0]
+        mutated = doc.replace(f"[{off},", f"[{off + 64},", 1)
+        assert mutated != doc
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(mutated)
+        healed = read_cobol(vrl_file, **opts)
+        assert healed.to_arrow().equals(clean)
+        assert healed.metrics.as_dict()["io"]["index_corrupt"] >= 1
+
+
+# -- roofline-cache corruption -------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+def test_roofline_corruption_reads_uncalibrated(tmp_path, monkeypatch,
+                                                mode):
+    from cobrix_tpu.obs import roofline
+
+    cache = tmp_path / "roofline.json"
+    monkeypatch.setenv("COBRIX_ROOFLINE_CACHE", str(cache))
+    roofline._memo = None
+    try:
+        roofline._write_cache({"bandwidth_bytes_per_s": 4e9,
+                               "method": roofline._METHOD})
+        assert roofline.cached_bandwidth() == pytest.approx(4e9)
+        roofline._memo = None
+        raw = cache.read_bytes()
+        if mode == "bitflip":
+            cache.write_bytes(raw.replace(b"4000000000", b"4000000001"))
+        else:
+            cache.write_bytes(raw[: len(raw) // 2])
+        before = _counter("roofline")
+        assert roofline.cached_bandwidth() is None
+        assert _counter("roofline") == before + 1
+        assert not cache.exists()  # quarantined
+        # recalibration rebuilds a verified record
+        bw = roofline.measured_bandwidth(size_mb=4.0)
+        roofline._memo = None
+        assert roofline.cached_bandwidth() == pytest.approx(bw)
+    finally:
+        roofline._memo = None
+
+
+# -- writer-side faults: ENOSPC / read-only volumes ----------------------
+
+
+@pytest.mark.parametrize("fault", ["enospc", "readonly"])
+def test_cache_write_faults_degrade_not_fail(tmp_path, fixed_data,
+                                             fault):
+    with hard_timeout(120, f"cache {fault}"):
+        cache_dir = str(tmp_path / "cache")
+        url = _fixed_scheme(fixed_data)
+        opts = dict(copybook_contents=EXP1_COPYBOOK, cache_dir=cache_dir,
+                    io_block_mb="0.25", prefetch_blocks="0")
+        baseline = read_cobol(url, **dict(opts, cache_dir="")).to_arrow()
+        with cache_write_faults(fault) as faults:
+            t = read_cobol(url, **opts).to_arrow()
+        assert t.equals(baseline)
+        assert faults.write_attempts >= 1
+        # no temp-file litter from the failed writes
+        blocks_root = os.path.join(cache_dir, "blocks")
+        if os.path.isdir(blocks_root):
+            for dirpath, _d, files in os.walk(blocks_root):
+                assert not [n for n in files if n.startswith(".tmp-")]
+        # and the cache works again once the volume recovers
+        warm = read_cobol(url, **opts)
+        assert warm.to_arrow().equals(baseline)
+
+
+def test_unwritable_cache_volume_degrades(tmp_path, fixed_data):
+    """A cache_dir that cannot even be CREATED (read-only mount) must
+    degrade to direct reads, not fail the scan."""
+    with hard_timeout(120, "readonly volume"):
+        ro_root = tmp_path / "ro"
+        ro_root.mkdir()
+        os.chmod(ro_root, 0o555)
+        if os.access(str(ro_root / "x"), os.W_OK) or os.geteuid() == 0:
+            pytest.skip("cannot drop write permission (running as root)")
+        url = _fixed_scheme(fixed_data)
+        t = read_cobol(url, copybook_contents=EXP1_COPYBOOK,
+                       cache_dir=str(ro_root / "cache"),
+                       io_block_mb="0.25").to_arrow()
+        assert t.num_rows > 0
+
+
+# -- crash-consistency sweep ---------------------------------------------
+
+
+def test_sweep_removes_orphans_and_torn_entries(tmp_path):
+    root = tmp_path / "blocks"
+    gen = root / "aaaa-bbbb"
+    gen.mkdir(parents=True)
+    stale_tmp = gen / ".tmp-dead"
+    stale_tmp.write_bytes(b"partial")
+    os.utime(stale_tmp, (1, 1))  # ancient: an orphan, not a live write
+    fresh_tmp = gen / ".tmp-live"
+    fresh_tmp.write_bytes(b"inflight")  # now(): a live writer, kept
+    torn = gen / "0-4096.blk"
+    torn.write_bytes(b"abc")  # shorter than any header
+    good = gen / "4096-8192.blk"
+    good.write_bytes(frame_block(b"x" * 4096))
+    removed = sweep_cache_root(str(root))
+    assert removed == {"tmp_orphans": 1, "truncated": 1}
+    assert not stale_tmp.exists()
+    assert fresh_tmp.exists()
+    assert not torn.exists()
+    assert good.exists()
+
+
+def test_blockcache_open_runs_sweep(tmp_path):
+    root = tmp_path / "blocks"
+    root.mkdir()
+    orphan = root / ".tmp-orphan"
+    orphan.write_bytes(b"x")
+    os.utime(orphan, (1, 1))
+    BlockCache(str(tmp_path))
+    assert not orphan.exists()
+
+
+# -- offline verifier ----------------------------------------------------
+
+
+def test_fsckcache_detects_and_repairs(tmp_path, fixed_data):
+    with hard_timeout(120, "fsckcache"):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "fsckcache", os.path.join(os.path.dirname(__file__),
+                                      os.pardir, "tools", "fsckcache.py"))
+        fsckcache = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fsckcache)
+
+        cache_dir = str(tmp_path / "cache")
+        url = _fixed_scheme(fixed_data)
+        read_cobol(url, copybook_contents=EXP1_COPYBOOK,
+                   cache_dir=cache_dir, io_block_mb="0.25",
+                   prefetch_blocks="0")
+        devnull = open(os.devnull, "w")
+        assert fsckcache.fsck(cache_dir, out=devnull)
+        corrupt_cache_entry(cache_dir, "block", "bitflip")
+        assert not fsckcache.fsck(cache_dir, out=devnull)
+        assert fsckcache.fsck(cache_dir, repair=True, out=devnull)
+        assert fsckcache.fsck(cache_dir, out=devnull)
+
+
+def test_fsckcache_smoke_cli():
+    """The tool's own self-test, exactly as CI/operators invoke it
+    (fast, no network)."""
+    import subprocess
+    import sys
+
+    with hard_timeout(280, "fsckcache --smoke"):
+        proc = subprocess.run(
+            [sys.executable, "tools/fsckcache.py", "--smoke"],
+            capture_output=True, text=True, timeout=240,
+            cwd=os.path.join(os.path.dirname(__file__), os.pardir),
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "all hold" in proc.stdout
+
+
+# -- multihost (forked workers) -------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode", ["bitflip", "truncate"])
+def test_block_corruption_multihost(tmp_path, mode):
+    """Corruption detected INSIDE forked workers still self-heals and
+    the counts merge home onto the parent's ReadMetrics."""
+    with hard_timeout(300, f"multihost block {mode}"):
+        path = str(tmp_path / "fixed.dat")
+        with open(path, "wb") as f:
+            f.write(generate_exp1(20000, seed=13).tobytes())
+        # multihost needs a registry-backed scheme for the cache plane:
+        # serve the local file bytes through a chaos memory backend
+        data = open(path, "rb").read()
+        url = _fixed_scheme(data)
+        cache_dir = str(tmp_path / "cache")
+        opts = dict(copybook_contents=EXP1_COPYBOOK, cache_dir=cache_dir,
+                    io_block_mb="0.25", prefetch_blocks="0", hosts=2)
+        clean = read_cobol(url, **opts).to_arrow()
+        corrupt_cache_entry(cache_dir, "block", mode)
+        healed = read_cobol(url, **opts)
+        assert healed.to_arrow().equals(clean)
+        assert healed.metrics.as_dict()["io"]["block_corrupt"] >= 1

@@ -1,0 +1,454 @@
+"""Resumable streaming scans + replica failover (cobrix_tpu.serve).
+
+The serving tier's answer to Spark's task re-execution: a connection
+that dies mid-stream (server kill, network cut, timeout) fails over to
+the next replica and RESUMES from the records-delivered watermark —
+the caller keeps iterating and the assembled table is identical to an
+uninterrupted read. The matrix here drives the client through real
+mid-stream cuts (a byte-counting TCP proxy that drops the connection
+partway through), a real SIGKILLed subprocess server, resume-token
+semantics, plan-fingerprint validation (changed file => structured
+``resume_mismatch``, never mixed-version rows), audit-log tying via
+``resume_of``, and the no-double-SLO-burn rule.
+"""
+import json
+import os
+import socket
+import socketserver
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.obs.audit import ScanRecord, read_audit_log
+from cobrix_tpu.obs.slo import parse_slo
+from cobrix_tpu.serve import (
+    ScanServer,
+    ServeError,
+    fetch_table,
+    stream_scan,
+)
+from cobrix_tpu.serve.session import plan_fingerprint
+from cobrix_tpu.testing.generators import EXP1_COPYBOOK, generate_exp1
+
+from util import hard_timeout
+
+FIXED_RECORDS = 20_000
+OPTS = dict(copybook_contents=EXP1_COPYBOOK, chunk_size_mb="1",
+            pipeline_workers="2")
+
+
+@pytest.fixture(scope="module")
+def fixed_file():
+    path = tempfile.mktemp(suffix=".dat")
+    with open(path, "wb") as f:
+        f.write(generate_exp1(FIXED_RECORDS, seed=5).tobytes())
+    yield path
+    os.unlink(path)
+
+
+@pytest.fixture()
+def server():
+    srv = ScanServer().start()
+    yield srv
+    srv.stop()
+
+
+class _CuttingProxy:
+    """TCP proxy that forwards to a real server but hard-drops the
+    client connection after `cut_after` server->client bytes — the
+    network-level shape of a server dying mid-stream, deterministic
+    enough to cut inside the record-batch data."""
+
+    def __init__(self, target, cut_after: int):
+        self.target = tuple(target)
+        self.cut_after = cut_after
+        proxy = self
+
+        class _H(socketserver.BaseRequestHandler):
+            def handle(self):
+                upstream = socket.create_connection(proxy.target,
+                                                    timeout=10)
+                stop = threading.Event()
+
+                def c2s():
+                    try:
+                        while not stop.is_set():
+                            data = self.request.recv(65536)
+                            if not data:
+                                break
+                            upstream.sendall(data)
+                    except OSError:
+                        pass
+
+                t = threading.Thread(target=c2s, daemon=True)
+                t.start()
+                sent = 0
+                try:
+                    while sent < proxy.cut_after:
+                        data = upstream.recv(
+                            min(65536, proxy.cut_after - sent))
+                        if not data:
+                            break
+                        self.request.sendall(data)
+                        sent += len(data)
+                finally:
+                    stop.set()
+                    # shutdown() acts on the KERNEL socket (close()
+                    # alone would not send FIN while the c2s thread's
+                    # blocked recv pins the socket alive) — the client
+                    # sees the mid-frame EOF a dead server produces
+                    for s in (self.request, upstream):
+                        try:
+                            s.shutdown(socket.SHUT_RDWR)
+                        except OSError:
+                            pass
+                        try:
+                            s.close()
+                        except OSError:
+                            pass
+
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Srv(("127.0.0.1", 0), _H)
+        self.address = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+# -- mid-stream cut -> transparent resume on the next replica ------------
+
+
+def test_mid_stream_cut_fails_over_and_resumes(server, fixed_file):
+    """Replica 1 (through the cutting proxy) dies mid-stream; the
+    client resumes on replica 2 and the assembled table is IDENTICAL
+    to an uninterrupted read — rows, schema, diagnostics metadata."""
+    with hard_timeout(180, "cut+resume"):
+        local = read_cobol(fixed_file, **OPTS).to_arrow()
+        # cut deep inside the stream: past the schema + a few batches
+        proxy = _CuttingProxy(server.address, cut_after=256 * 1024)
+        try:
+            t = fetch_table([proxy.address, server.address],
+                            fixed_file, **OPTS)
+        finally:
+            proxy.stop()
+        assert t.equals(local)
+        assert t.schema.metadata == local.schema.metadata
+
+
+def test_iteration_surface_survives_cut(server, fixed_file):
+    """Plain iteration (no table()) across a failover delivers every
+    row exactly once, in order."""
+    with hard_timeout(180, "cut+iterate"):
+        local = read_cobol(fixed_file, **OPTS).to_arrow()
+        # cut deep enough that full batches (~1.5 MB of IPC each) were
+        # YIELDED before the drop (a pre-first-batch cut is the
+        # fresh-retry case, covered separately)
+        proxy = _CuttingProxy(server.address, cut_after=4 * 1024 * 1024)
+        try:
+            rows = 0
+            keys = []
+            with stream_scan([proxy.address, server.address],
+                             fixed_file, **OPTS) as stream:
+                for batch in stream:
+                    rows += batch.num_rows
+                    keys.append(batch.column(0)[0])
+                summary = stream.summary
+            assert stream.failovers >= 1
+            assert len(stream.attempt_request_ids) == stream.failovers + 1
+        finally:
+            proxy.stop()
+        assert rows == local.num_rows
+        # the resumed attempt reported only the remainder, but the
+        # token watermark covers the whole logical request
+        assert summary["resume_token"]["records"] == local.num_rows
+        assert summary["resume_of"] == stream.request_id
+
+
+def test_cut_before_any_data_retries_fresh(server, fixed_file):
+    """A connection dying before the first data byte restarts the
+    request from record 0 (no resume token needed)."""
+    with hard_timeout(120, "early cut"):
+        local = read_cobol(fixed_file, **OPTS).to_arrow()
+        proxy = _CuttingProxy(server.address, cut_after=1)
+        try:
+            t = fetch_table([proxy.address, server.address],
+                            fixed_file, **OPTS)
+        finally:
+            proxy.stop()
+        assert t.equals(local)
+
+
+def test_dead_first_replica_fails_over_at_connect(server, fixed_file):
+    """A replica dead BEFORE the stream starts must fail over too —
+    not just a mid-stream death (review-caught: the eager connect sat
+    outside the failover loop)."""
+    with hard_timeout(120, "dead first replica"):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = s.getsockname()
+        s.close()
+        from cobrix_tpu.reader.stream import RetryPolicy
+
+        local = read_cobol(fixed_file, **OPTS).to_arrow()
+        t = fetch_table([dead, server.address], fixed_file,
+                        connect_retry=RetryPolicy(max_attempts=1,
+                                                  deadline=1.0),
+                        **OPTS)
+        assert t.equals(local)
+
+
+def test_plan_fingerprint_ignores_operator_knobs(fixed_file):
+    """Replicas with different operator config (cache mount points,
+    prefetch depths, worker counts) must accept each other's resume
+    tokens: only row-shaping options enter the plan fingerprint."""
+    base = {"copybook_contents": EXP1_COPYBOOK}
+    fp = plan_fingerprint([fixed_file], base)
+    assert fp == plan_fingerprint(
+        [fixed_file], dict(base, cache_dir="/mnt/other/cache",
+                           prefetch_blocks="8", pipeline_workers="4",
+                           chunk_size_mb="4", io_retry_attempts="5"))
+    # row-shaping options still matter
+    assert fp != plan_fingerprint(
+        [fixed_file], dict(base, is_record_sequence="true"))
+
+
+def test_zero_record_resume_is_a_fresh_scan(server, fixed_file,
+                                            tmp_path):
+    """resume with records=0 is honored as an ORDINARY scan: full SLO
+    accounting, no resume_of stamp — a client cannot opt out of SLO
+    burn by wearing a zero-cost resume shape (review-caught)."""
+    audit = str(tmp_path / "audit.log")
+    srv = ScanServer(audit_log=audit,
+                     slos=["error_rate=0.5",
+                           "first_batch_p99=0.000001"]).start()
+    try:
+        with hard_timeout(120, "freeloader resume"):
+            with stream_scan(srv.address, fixed_file, **OPTS) as s1:
+                s1.table()
+                plan = s1.summary["resume_token"]["plan"]
+            # hand-craft the freeloader shape: a valid plan, records=0
+            with stream_scan(srv.address, fixed_file, **OPTS) as s2:
+                s2._plan_fp = plan
+                s2._rows_yielded = 0
+                s2.failovers = 1
+                s2._close_attempt()
+                t = s2.table()
+            assert t.num_rows == FIXED_RECORDS
+            deadline = time.monotonic() + 10
+            recs = []
+            while time.monotonic() < deadline:
+                recs = [r for r in read_audit_log(audit)
+                        if r.outcome == "ok"]
+                if len(recs) >= 2:
+                    break
+                time.sleep(0.05)
+            assert len(recs) >= 2
+            # NO record escaped SLO accounting: the impossibly tight
+            # latency objective breached on every ok scan
+            for r in recs:
+                assert not r.resume_of
+                assert "first_batch_p99" in r.slo_breaches
+    finally:
+        srv.stop()
+
+
+def test_failover_budget_exhausts_structured(fixed_file):
+    """Every replica dead => the transport error surfaces after
+    max_failovers attempts, never an infinite loop."""
+    with hard_timeout(120, "dead replicas"):
+        # nothing listens on these
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead = s.getsockname()
+        s.close()
+        from cobrix_tpu.reader.stream import RetryPolicy
+
+        with pytest.raises((ConnectionError, OSError)):
+            fetch_table([dead, dead], fixed_file,
+                        connect_retry=RetryPolicy(max_attempts=1,
+                                                  deadline=1.0),
+                        max_failovers=2, **OPTS)
+
+
+def test_max_records_preserved_across_resume(server, fixed_file):
+    """max_records is a property of the LOGICAL request: the resumed
+    attempt delivers only the remainder."""
+    with hard_timeout(120, "max_records resume"):
+        cap = 7_000
+        # max_records is a SERVE-level cap (OrderedBatchEmitter): the
+        # in-process expectation is the full table sliced
+        local = read_cobol(fixed_file, **OPTS).to_arrow().slice(0, cap)
+        proxy = _CuttingProxy(server.address, cut_after=4 * 1024 * 1024)
+        try:
+            t = fetch_table([proxy.address, server.address],
+                            fixed_file, max_records=cap, **OPTS)
+        finally:
+            proxy.stop()
+        assert t.num_rows == cap
+        assert t.equals(local)
+
+
+# -- resume-token semantics ----------------------------------------------
+
+
+def test_trailer_carries_resume_token(server, fixed_file):
+    with hard_timeout(120, "trailer token"):
+        with stream_scan(server.address, fixed_file, **OPTS) as s:
+            rows = sum(b.num_rows for b in s)
+            token = s.summary["resume_token"]
+        assert token["records"] == rows
+        assert token["plan"]
+        # the client tracked the plan from the mid-stream tokens too
+        assert s._plan_fp == token["plan"]
+
+
+def test_resume_mismatch_on_changed_file(server, fixed_file):
+    """A stale plan fingerprint (file changed between attempts) is
+    refused with a structured resume_mismatch — mixed-version rows can
+    never splice."""
+    with hard_timeout(120, "resume mismatch"):
+        with stream_scan(server.address, fixed_file, **OPTS) as s:
+            s._plan_fp = "0" * 24  # a plan no server will compute
+            s._rows_yielded = 10
+            s.failovers = 1  # forces the resume shape on reconnect
+            s._close_attempt()
+            with pytest.raises(ServeError) as err:
+                for _ in s:
+                    pass
+        assert err.value.code == "resume_mismatch"
+
+
+def test_plan_fingerprint_tracks_file_version(fixed_file, tmp_path):
+    kwargs = {"copybook_contents": EXP1_COPYBOOK}
+    fp1 = plan_fingerprint([fixed_file], kwargs)
+    assert fp1 == plan_fingerprint([fixed_file], kwargs)  # stable
+    # different options => different plan
+    assert fp1 != plan_fingerprint([fixed_file],
+                                   dict(kwargs, max_records=5))
+    # changed file content/version => different plan
+    clone = tmp_path / "clone.dat"
+    clone.write_bytes(open(fixed_file, "rb").read())
+    fp_clone = plan_fingerprint([str(clone)], kwargs)
+    clone.write_bytes(b"x" + open(fixed_file, "rb").read())
+    assert plan_fingerprint([str(clone)], kwargs) != fp_clone
+
+
+# -- audit + SLO ---------------------------------------------------------
+
+
+def test_resumed_attempts_share_one_audit_identity(fixed_file, tmp_path):
+    audit = str(tmp_path / "audit.log")
+    srv = ScanServer(audit_log=audit,
+                     slos=["first_batch_p99=0.000001",
+                           "error_rate=0.5"]).start()
+    try:
+        with hard_timeout(180, "audit resume_of"):
+            proxy = _CuttingProxy(srv.address, cut_after=4 * 1024 * 1024)
+            try:
+                with stream_scan([proxy.address, srv.address],
+                                 fixed_file, **OPTS) as s:
+                    for _ in s:
+                        pass
+            finally:
+                proxy.stop()
+            assert s.failovers >= 1
+            original = s.request_id
+            deadline = time.monotonic() + 10
+            records = []
+            while time.monotonic() < deadline:
+                records = list(read_audit_log(audit))
+                resumed = [r for r in records if r.resume_of == original]
+                if resumed and any(r.outcome == "ok" for r in resumed):
+                    break
+                time.sleep(0.05)
+            assert resumed, [r.as_dict() for r in records]
+            done = [r for r in resumed if r.outcome == "ok"]
+            assert done
+            # the resumed attempt's wire id is a DIFFERENT request_id,
+            # tied to the original via resume_of
+            assert all(r.request_id != original for r in done)
+            # resumes never double-burn SLOs: the impossibly-tight
+            # first_batch objective classified the ORIGINAL attempts
+            # (if any completed server-side) but no RESUMED record
+            assert all(not r.slo_breaches for r in done)
+    finally:
+        srv.stop()
+
+
+def test_slo_skips_resumed_records():
+    slo = parse_slo("first_batch_p99=0.5")
+    fresh = ScanRecord(request_id="a", trace_id="t", tenant="x",
+                       outcome="ok", first_batch_s=9.0)
+    assert slo.evaluate(fresh) is False
+    resumed = ScanRecord(request_id="b", trace_id="t", tenant="x",
+                         outcome="ok", first_batch_s=9.0,
+                         resume_of="a")
+    assert slo.evaluate(resumed) is None
+    err = parse_slo("error_rate=0.01")
+    resumed_err = ScanRecord(request_id="c", trace_id="t", tenant="x",
+                             outcome="error", resume_of="a")
+    assert err.evaluate(resumed_err) is None
+
+
+# -- real process kill (SIGKILL) -----------------------------------------
+
+
+@pytest.mark.slow
+def test_sigkilled_replica_resumes_on_survivor(fixed_file, tmp_path):
+    """The full chaos shape: two SEPARATE server processes sharing one
+    cache_dir; SIGKILL the one serving the stream mid-flight; the
+    client finishes on the survivor, byte-identical."""
+    with hard_timeout(300, "sigkill failover"):
+        cache_dir = str(tmp_path / "cache")
+        script = (
+            "import sys, json\n"
+            "from cobrix_tpu.serve import ScanServer\n"
+            "srv = ScanServer(server_options={'cache_dir': sys.argv[1]},"
+            " enable_http=False).start()\n"
+            "print(json.dumps(list(srv.address)), flush=True)\n"
+            "import time\n"
+            "time.sleep(600)\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs = []
+        addrs = []
+        try:
+            for _ in range(2):
+                p = subprocess.Popen(
+                    [sys.executable, "-c", script, cache_dir],
+                    stdout=subprocess.PIPE, env=env,
+                    cwd=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))))
+                procs.append(p)
+                addrs.append(tuple(json.loads(p.stdout.readline())))
+            local = read_cobol(fixed_file, **OPTS).to_arrow()
+
+            killed = threading.Event()
+
+            def killer():
+                time.sleep(0.3)  # let the stream get going
+                procs[0].kill()
+                killed.set()
+
+            threading.Thread(target=killer, daemon=True).start()
+            t = fetch_table([addrs[0], addrs[1]], fixed_file,
+                            read_timeout_s=30.0, **OPTS)
+            assert killed.is_set()
+            assert t.equals(local)
+            assert t.schema.metadata == local.schema.metadata
+        finally:
+            for p in procs:
+                p.kill()
+                p.wait()

@@ -732,6 +732,186 @@ def test_concurrent_tenant_obs_isolation(server):
         assert roots[0]["tenant"] == tenant
 
 
+# -- overload shedding (memory watermark -> degrade -> shed) -------------
+
+
+@pytest.fixture()
+def fake_pressure():
+    """Install a process-wide memory monitor driven by a FAKE rss so
+    the watermark crossings are deterministic (no gigabyte balloons in
+    CI); always uninstalled after."""
+    from cobrix_tpu.utils import pressure
+
+    rss = {"value": 0}
+    monitor = pressure.set_process_budget(
+        1000, degrade_fraction=0.5, shed_fraction=0.9, interval_s=0.0,
+        rss_fn=lambda: rss["value"])
+    try:
+        yield rss, monitor
+    finally:
+        pressure.set_process_budget(0)
+
+
+def test_shed_rejects_new_scans_structured(server, fixed_file,
+                                           fake_pressure):
+    """Past the shed watermark: a structured `overloaded` rejection (no
+    SLO burn — it audits as 'rejected'), and scans admitted BEFORE the
+    spike still complete."""
+    rss, _ = fake_pressure
+    with hard_timeout(120, "shed rejection"):
+        # a healthy tenant's scan admitted before the pressure spike
+        gate = threading.Event()
+        done = {}
+
+        def healthy():
+            with stream_scan(server.address, fixed_file,
+                             tenant="healthy", **FIXED_OPTS) as s:
+                it = iter(s)
+                first = next(it)
+                gate.set()
+                done["rows"] = first.num_rows + sum(b.num_rows
+                                                    for b in it)
+
+        t = threading.Thread(target=healthy)
+        t.start()
+        assert gate.wait(60)
+        rss["value"] = 950  # past the 90% shed watermark
+        with pytest.raises(ServeError) as err:
+            fetch_table(server.address, fixed_file, tenant="latecomer",
+                        **FIXED_OPTS)
+        assert err.value.code == "rejected"
+        assert "memory budget" in str(err.value)
+        t.join(60)
+        # the already-admitted scan finished whole despite the spike
+        assert done["rows"] == FIXED_RECORDS
+        # the rejection is counted with its own reason
+        from cobrix_tpu.obs.metrics import serve_metrics
+
+        assert serve_metrics()["rejected"].value(
+            tenant="latecomer", reason="overloaded") >= 1
+        # ... and recedes with the pressure
+        rss["value"] = 100
+        t2 = fetch_table(server.address, fixed_file, tenant="latecomer",
+                         max_records=5, **FIXED_OPTS)
+        assert t2.num_rows == 5
+
+
+def test_degrade_halves_io_knobs_and_reports(server, fixed_file,
+                                             fake_pressure):
+    """Between the degrade and shed watermarks scans still run (and
+    parity holds) — with halved read-ahead, flagged on the trailer and
+    counted per tenant."""
+    rss, _ = fake_pressure
+    with hard_timeout(120, "degraded scan"):
+        local = read_cobol(fixed_file, **FIXED_OPTS).to_arrow()
+        rss["value"] = 700  # between 50% degrade and 90% shed
+        with stream_scan(server.address, fixed_file, tenant="squeezed",
+                         **FIXED_OPTS) as s:
+            t = s.table()
+            summary = s.summary
+        assert t.equals(local)
+        assert summary.get("degraded") is True
+        from cobrix_tpu.obs.metrics import serve_metrics
+
+        assert serve_metrics()["degraded"].value(tenant="squeezed") >= 1
+
+
+def test_degraded_pipeline_shrinks_inflight_window(tmp_path,
+                                                   fake_pressure):
+    """The engine-side degrade: under pressure the pipeline holds new
+    chunks until the in-flight window drops under half, and reports
+    it."""
+    rss, _ = fake_pressure
+    with hard_timeout(120, "pipeline degrade"):
+        path = str(tmp_path / "fixed.dat")
+        with open(path, "wb") as f:
+            f.write(generate_exp1(8000, seed=3).tobytes())
+        rss["value"] = 700
+        out = read_cobol(path, copybook_contents=EXP1_COPYBOOK,
+                         chunk_size_mb="0.5", pipeline_workers="2")
+        clean = read_cobol(path, copybook_contents=EXP1_COPYBOOK)
+        assert out.to_arrow().equals(clean.to_arrow())
+        assert out.metrics.pipeline.get("pressure_degrades", 0) >= 1
+
+
+def test_queued_scans_shed_lowest_weight_first(fixed_file,
+                                               fake_pressure):
+    """Under shed pressure the QUEUE drains by eviction: lowest-weight
+    tenants' waiters get the structured rejection, higher-weight ones
+    keep their place."""
+    rss, _ = fake_pressure
+    srv = ScanServer(
+        max_concurrent_scans=1,
+        quotas={"gold": TenantQuota(max_concurrent=1, weight=4.0),
+                "bronze": TenantQuota(max_concurrent=1, weight=1.0)},
+        queue_timeout_s=30.0).start()
+    try:
+        with hard_timeout(120, "weighted shed"):
+            gate = threading.Event()
+            results = {}
+
+            def holder():
+                with stream_scan(srv.address, fixed_file, tenant="gold",
+                                 **FIXED_OPTS) as s:
+                    it = iter(s)
+                    next(it)
+                    gate.set()
+                    time.sleep(1.0)  # hold the only global slot
+                    for _ in it:
+                        pass
+                results["holder"] = "done"
+
+            def waiter(name, tenant):
+                try:
+                    fetch_table(srv.address, fixed_file, tenant=tenant,
+                                max_records=5, **FIXED_OPTS)
+                    results[name] = "ok"
+                except ServeError as exc:
+                    results[name] = str(exc)
+
+            threads = [threading.Thread(target=holder)]
+            threads[0].start()
+            assert gate.wait(60)
+            for name, tenant in (("bronze_w", "bronze"),
+                                 ("gold_w", "gold")):
+                th = threading.Thread(target=waiter,
+                                      args=(name, tenant))
+                threads.append(th)
+                th.start()
+            time.sleep(0.5)  # both queued behind the held slot
+            rss["value"] = 950  # spike: shedding evicts bronze first
+            # a new arrival triggers the shed sweep and is itself
+            # rejected
+            with pytest.raises(ServeError):
+                fetch_table(srv.address, fixed_file, tenant="probe",
+                            max_records=1, **FIXED_OPTS)
+            rss["value"] = 100  # recede before the holder releases
+            for th in threads:
+                th.join(90)
+            assert results.get("holder") == "done"
+            assert "shed under memory pressure" in results["bronze_w"]
+            assert results.get("gold_w") == "ok", results
+    finally:
+        srv.stop()
+
+
+def test_server_budget_uninstalled_on_stop(fixed_file):
+    """A stopped server's memory budget must not keep throttling the
+    process (review-caught: the global watermark outlived the
+    server)."""
+    from cobrix_tpu.utils.pressure import process_pressure
+
+    srv = ScanServer(memory_budget_mb=1.0).start()
+    try:
+        assert process_pressure() is not None
+        with pytest.raises(ServeError):  # 1 MB budget: sheds instantly
+            fetch_table(srv.address, fixed_file, max_records=1,
+                        **FIXED_OPTS)
+    finally:
+        srv.stop()
+    assert process_pressure() is None
+
+
 # -- servecheck smoke (the chunk x workers grid stays behind `slow`) -----
 
 

@@ -55,8 +55,10 @@ def _fmt_latency(v: Optional[float]) -> str:
 
 def _render(rec) -> str:
     flags = ""
+    if getattr(rec, "resume_of", ""):
+        flags = f" resume_of={rec.resume_of}"
     if rec.slo_breaches:
-        flags = " BREACH[" + ",".join(rec.slo_breaches) + "]"
+        flags += " BREACH[" + ",".join(rec.slo_breaches) + "]"
     if rec.dump_path:
         flags += f" dump={rec.dump_path}"
     err = f" err={rec.error}" if rec.error else ""
@@ -79,7 +81,12 @@ def cmd_tail(args) -> int:
         if args.trace_id and not rec.trace_id.startswith(args.trace_id):
             continue
         if args.request_id and \
-                not rec.request_id.startswith(args.request_id):
+                not rec.request_id.startswith(args.request_id) and \
+                not getattr(rec, "resume_of",
+                            "").startswith(args.request_id):
+            # resume_of ties a failover attempt back to the ORIGINAL
+            # request_id: one --request-id query shows every attempt
+            # of the logical request
             continue
         if args.breached and not rec.slo_breaches:
             continue
@@ -216,7 +223,8 @@ def main() -> int:
     tail.add_argument("--trace-id", default="",
                       help="prefix match on trace_id")
     tail.add_argument("--request-id", default="",
-                      help="prefix match on request_id")
+                      help="prefix match on request_id (also matches "
+                           "resumed attempts via their resume_of tie)")
     tail.add_argument("--breached", action="store_true",
                       help="only scans that breached an SLO")
     tail.add_argument("--json", action="store_true",
