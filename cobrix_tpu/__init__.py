@@ -18,6 +18,7 @@ from .profiling import ReadMetrics, profile_trace
 from .reader.stream import (ByteRangeSource, open_stream,
                             register_stream_backend, source_size)
 from .io import IoConfig, register_fsspec_backend
+from .streaming import ContinuousIngestor, SourceTruncated, tail_cobol
 from .copybook.datatypes import (
     CommentPolicy,
     DebugFieldsPolicy,
@@ -55,6 +56,9 @@ __all__ = [
     "source_size",
     "IoConfig",
     "register_fsspec_backend",
+    "ContinuousIngestor",
+    "tail_cobol",
+    "SourceTruncated",
     "ReadMetrics",
     "profile_trace",
     "ScanProgress",

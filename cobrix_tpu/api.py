@@ -449,6 +449,31 @@ def _validate_options(opts: Options, params: ReaderParameters,
                          + ", ".join(sorted(unused)) + ".")
 
 
+def load_copybook_contents(copybook, copybook_contents):
+    """Resolve the copybook SOURCE the way `read_cobol` does: exactly
+    one of `copybook` (path or list of paths) / `copybook_contents`
+    (text), with the reference's error messages. Shared with the
+    continuous-ingest surface (streaming.ingest) so the loading rules
+    can never drift between entry points."""
+    if copybook is not None and copybook_contents is not None:
+        raise ValueError("Both 'copybook' and 'copybook_contents' options "
+                         "cannot be specified at the same time")
+    if copybook_contents is not None:
+        return copybook_contents
+    if copybook is None:
+        raise ValueError(
+            "COPYBOOK is not provided. Please, provide either 'copybook' "
+            "path or 'copybook_contents'.")
+    books = [copybook] if isinstance(copybook, str) else list(copybook)
+    contents = []
+    for b in books:
+        if os.path.exists(b) and not os.path.isfile(b):
+            raise ValueError(f"The copybook path '{b}' is not a file.")
+        with open(b, encoding="utf-8") as f:
+            contents.append(f.read())
+    return contents if len(contents) > 1 else contents[0]
+
+
 def list_input_files(path) -> List[str]:
     """Recursive globbed listing skipping hidden files, stable order
     (reference FileUtils.scala:54-228, getListFilesWithOrder)."""
@@ -832,19 +857,8 @@ def read_cobol(path=None,
     if has_multi:
         copybook = options.pop("copybooks").split(",")
 
-    if copybook_contents is None:
-        if copybook is None:
-            raise ValueError(
-                "COPYBOOK is not provided. Please, provide either 'copybook' "
-                "path or 'copybook_contents'.")
-        books = [copybook] if isinstance(copybook, str) else list(copybook)
-        contents = []
-        for b in books:
-            if os.path.exists(b) and not os.path.isfile(b):
-                raise ValueError(f"The copybook path '{b}' is not a file.")
-            with open(b, encoding="utf-8") as f:
-                contents.append(f.read())
-        copybook_contents = contents if len(contents) > 1 else contents[0]
+    copybook_contents = load_copybook_contents(copybook,
+                                               copybook_contents)
     if path is None:
         raise ValueError("'path' must be specified for read_cobol.")
 

@@ -160,6 +160,23 @@ class SparseIndexStore:
             self._corrupt(path, "entry rows failed to deserialize")
             return None
 
+    def save_for_local_path(self, path: str, config_fp: str,
+                            entries: List[SparseIndexEntry]) -> bool:
+        """Persist `entries` for the CURRENT on-disk version of a local
+        file, computing the same ``local:<size>:<mtime_ns>`` fingerprint
+        `reader.index.file_index_entries` probes at read time — the
+        continuous-ingest tailer calls this when a tailed generation
+        finalizes, so the first batch scan of a rotated-out file loads
+        the incrementally-built index instead of re-indexing. False when
+        the file cannot be stat'd (vanished between finalize and save)."""
+        try:
+            st = os.stat(path)
+        except OSError:
+            return False
+        self.save(path, f"local:{st.st_size}:{st.st_mtime_ns}",
+                  config_fp, entries)
+        return True
+
     def save(self, url: str, fingerprint: str, config_fp: str,
              entries: List[SparseIndexEntry]) -> None:
         """Persist one file version's entries (atomic; best-effort — a

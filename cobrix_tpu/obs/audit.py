@@ -73,6 +73,9 @@ class ScanRecord:
     # records entirely — the logical request's objectives were already
     # accounted once, a recovery attempt must never double-burn them
     resume_of: str = ""
+    # True for continuous-ingest follow sessions (serve follow=true):
+    # long-lived by design, so e2e latency objectives skip them
+    follow: bool = False
 
     def as_dict(self) -> dict:
         out = asdict(self)
@@ -120,7 +123,8 @@ def record_from_summary(request_id: str, trace_id: str, tenant: str,
         e2e_s=e2e_s,
         roofline_fraction=roof.get("fraction"),
         cache=cache, error=error,
-        resume_of=resume_of or str(summary.get("resume_of") or ""))
+        resume_of=resume_of or str(summary.get("resume_of") or ""),
+        follow=bool(summary.get("follow")))
 
 
 class AuditLog:

@@ -437,6 +437,45 @@ def update_process_metrics(open_scans: Optional[int] = None,
         m["open_scans"].set(open_scans)
 
 
+def stream_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The continuous-ingestion metric set (cobrix_tpu.streaming): how
+    far behind the live sources the consumer is, how stale the
+    committed watermark is, and the rotation/truncation event counters
+    an operator alerts on. Same idempotent-registration contract as
+    `scan_metrics` — every ingestor (and every serve follow session) in
+    the process reports into one set."""
+    r = registry or _default
+    return {
+        "lag_bytes": r.gauge(
+            "cobrix_stream_lag_bytes",
+            "Stable source bytes not yet delivered to the consumer, "
+            "summed over every tailed source of this process"),
+        "watermark_age": r.gauge(
+            "cobrix_stream_watermark_age_seconds",
+            "Seconds since the delivery watermark last advanced while "
+            "undelivered bytes existed (0 = fully caught up)"),
+        "batches": r.counter(
+            "cobrix_stream_batches_total",
+            "Micro-batches delivered by continuous ingestion"),
+        "records": r.counter(
+            "cobrix_stream_records_total",
+            "Records delivered by continuous ingestion"),
+        "rotations": r.counter(
+            "cobrix_stream_rotations_total",
+            "Source rotations detected (same path, new content "
+            "generation); every old generation was drained exactly "
+            "once before the switch"),
+        "truncations": r.counter(
+            "cobrix_stream_truncations_total",
+            "Sources that shrank below their committed watermark "
+            "(structured source_truncated outcome or policy-driven "
+            "generation restart; never silently wrong rows)"),
+        "checkpoints": r.counter(
+            "cobrix_stream_checkpoints_total",
+            "Durable checkpoint commits (acks) by the ingest layer"),
+    }
+
+
 # queue-wait / first-batch latency buckets for the serving tier: finer
 # at the low end than DEFAULT_BUCKETS (an admitted-without-queueing scan
 # waits microseconds) but with the same multi-second tail
@@ -478,6 +517,11 @@ def serve_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
         "streamed_batches": r.counter(
             "cobrix_serve_streamed_batches_total",
             "Arrow record batches streamed to clients, by tenant",
+            label_names=("tenant",)),
+        "follow": r.counter(
+            "cobrix_serve_follow_sessions_total",
+            "Follow-mode subscriptions admitted (continuous-ingest "
+            "streaming over the serve protocol), by tenant",
             label_names=("tenant",)),
         "resumed": r.counter(
             "cobrix_serve_scans_resumed_total",

@@ -33,6 +33,9 @@ class ScanProgress:
     # per-stage busy seconds so far (read/frame/decode/assemble)
     stage_busy_s: Dict[str, float] = field(default_factory=dict)
     done: bool = False
+    # continuous-ingest follow streams only: stable source bytes not
+    # yet delivered (None on ordinary bounded scans)
+    lag_bytes: Optional[int] = None
 
     @property
     def fraction(self) -> Optional[float]:
@@ -58,6 +61,7 @@ class ScanProgress:
             "eta_s": self.eta_s,
             "stage_busy_s": dict(self.stage_busy_s),
             "done": self.done,
+            "lag_bytes": self.lag_bytes,
         }
 
     @classmethod

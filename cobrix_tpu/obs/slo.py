@@ -68,6 +68,13 @@ class Slo:
             # double-burn latency objectives — a resumed scan's "first
             # batch" sits behind a skip of everything already delivered
             return None
+        if getattr(record, "follow", False) \
+                and self.kind in ("e2e", "roofline"):
+            # a follow session streams a LIVE feed for as long as the
+            # subscriber stays — wall-clock duration and aggregate
+            # throughput measure the feed, not the server; first-batch
+            # and error-rate objectives still apply
+            return None
         if self.kind == "error_rate":
             return record.outcome == "ok"
         if record.outcome != "ok":

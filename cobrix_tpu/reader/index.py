@@ -156,6 +156,112 @@ def file_index_entries(reader, file_path: str, file_order: int, params,
     return entries
 
 
+class IncrementalIndexer:
+    """Sparse-index construction one record at a time — the streaming
+    twin of `sparse_index_generator` for the continuous-ingest tailer.
+
+    A growing file cannot be indexed by a one-shot sequential pass (the
+    pass would never end), but the tailer already frames every record as
+    it stabilizes; feeding those framings here keeps the sparse index
+    CURRENT at every watermark, so when the generation finalizes
+    (rotation, stream shutdown) the complete entries persist to the
+    index store and the very first batch `read_cobol` of the rotated
+    file goes straight to shard planning — no re-index pass.
+
+    Split arithmetic mirrors `sparse_index_generator` exactly for the
+    non-hierarchical case (records-per-entry, or size-per-entry with
+    drift carry); `state_dict()`/`from_state` round-trip through the
+    ingest checkpoint so a crashed tailer resumes indexing from its
+    watermark instead of record zero. Hierarchical root-boundary
+    alignment needs segment inspection the live tailer refuses anyway
+    (see streaming.ingest), so it is unsupported here."""
+
+    def __init__(self, records_per_entry: Optional[int] = None,
+                 size_per_entry_mb: Optional[int] = None):
+        self.records_per_entry = records_per_entry
+        self.size_per_entry_mb = size_per_entry_mb
+        self._bytes_per_entry = (size_per_entry_mb
+                                 or DEFAULT_INDEX_ENTRY_SIZE_MB) * MEGABYTE
+        self.byte_index = 0
+        self.record_index = 0
+        self.records_in_chunk = 0
+        self.bytes_in_chunk = 0
+        # (offset_from, record_index) split points; entry 0 is implicit
+        self._splits: List[List[int]] = [[0, 0]]
+        # one-record lookahead: the one-shot generator detects EOF
+        # BEFORE its split branch, so the stream's LAST record can
+        # never open a new entry — mirrored here by applying each
+        # record only once a successor proves it was not last
+        self._held: Optional[List] = None
+
+    def _need_split(self) -> bool:
+        if self.records_per_entry is not None:
+            return self.records_in_chunk >= self.records_per_entry
+        return self.bytes_in_chunk >= self._bytes_per_entry
+
+    def add_record(self, record_size: int, is_valid: bool = True) -> None:
+        """One framed record, in stream order (`record_size` includes
+        its header bytes — the full stream distance it consumed)."""
+        if self._held is not None:
+            self._apply(*self._held)
+        self._held = [int(record_size), bool(is_valid)]
+
+    def _apply(self, record_size: int, is_valid: bool) -> None:
+        if is_valid and self._need_split():
+            self._splits.append([self.byte_index, self.record_index])
+            self.records_in_chunk = 0
+            if self.records_per_entry is None:
+                # carry the size-split drift (sparse_index_generator's
+                # block-alignment rule)
+                self.bytes_in_chunk -= self._bytes_per_entry
+            else:
+                self.bytes_in_chunk = 0
+        self.record_index += 1
+        self.records_in_chunk += 1
+        self.byte_index += record_size
+        self.bytes_in_chunk += record_size
+
+    def entries(self, file_id: int) -> List[SparseIndexEntry]:
+        """The sparse index as of the records fed so far (the last entry
+        is open-ended, matching the one-shot generator's output; the
+        held lookahead record never contributes a split, exactly like
+        the generator's last record)."""
+        out: List[SparseIndexEntry] = []
+        for i, (offset_from, record_index) in enumerate(self._splits):
+            offset_to = (self._splits[i + 1][0]
+                         if i + 1 < len(self._splits) else -1)
+            out.append(SparseIndexEntry(offset_from, offset_to, file_id,
+                                        record_index))
+        return out
+
+    def state_dict(self) -> dict:
+        return {
+            "records_per_entry": self.records_per_entry,
+            "size_per_entry_mb": self.size_per_entry_mb,
+            "byte_index": self.byte_index,
+            "record_index": self.record_index,
+            "records_in_chunk": self.records_in_chunk,
+            "bytes_in_chunk": self.bytes_in_chunk,
+            "splits": [list(s) for s in self._splits],
+            "held": list(self._held) if self._held else None,
+        }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "IncrementalIndexer":
+        indexer = cls(records_per_entry=state.get("records_per_entry"),
+                      size_per_entry_mb=state.get("size_per_entry_mb"))
+        indexer.byte_index = int(state.get("byte_index", 0))
+        indexer.record_index = int(state.get("record_index", 0))
+        indexer.records_in_chunk = int(state.get("records_in_chunk", 0))
+        indexer.bytes_in_chunk = int(state.get("bytes_in_chunk", 0))
+        splits = state.get("splits") or [[0, 0]]
+        indexer._splits = [[int(a), int(b)] for a, b in splits]
+        held = state.get("held")
+        indexer._held = ([int(held[0]), bool(held[1])] if held
+                         else None)
+        return indexer
+
+
 def sparse_index_generator(file_id: int,
                            data_stream: SimpleStream,
                            record_header_parser: Optional[RecordHeaderParser] = None,

@@ -152,6 +152,12 @@ class FileResult:
     # schema rebuilds from the row path instead of serving a stale table
     rows_factory: Optional[object] = None
     arrow_factory: Optional[object] = None
+    # records the framer CONSUMED (and numbered) producing this result —
+    # >= n_rows when segment filters / level gating drop rows after
+    # numbering. The continuous-ingest tailer advances its record-id
+    # watermark by this, so batch-wise Record_Ids stay identical to a
+    # one-shot read's. None on paths that never set it
+    records_framed: Optional[int] = None
     _arrow_cache: Optional[object] = dc_field(default=None, repr=False)
     _arrow_cache_schema: Optional[object] = dc_field(default=None, repr=False)
     _corrupt_col_added: bool = dc_field(default=False, repr=False)

@@ -1046,6 +1046,7 @@ class VarLenReader:
                                 stage_times=stage_times)
         if fast is not None:
             data, base, offsets, lengths, segment_ids, reasons = fast
+            result.records_framed = len(offsets)
             with timed_stage(stage_times, "decode"):
                 self._read_result_fast(
                     result, data, base, offsets, lengths, segment_ids,
@@ -1080,6 +1081,8 @@ class VarLenReader:
                     continue
                 active = self.segment_redefine_map.get(segment_id, "")
                 framed.append((record_index, active, data, level_ids))
+        result.records_framed = (record_reader.record_index + 1
+                                 - start_record_id)
         if record_reader.corrupt_reasons:
             # absolute record indices -> output positions of kept rows
             pos_of = {idx: pos for pos, (idx, _, _, _) in enumerate(framed)}

@@ -54,6 +54,12 @@ class TenantQuota:
     # bytes of assembled Arrow data this tenant's scans may hold
     # in flight toward clients before producers block (0 = unbounded)
     max_inflight_bytes: int = 256 * 1024 * 1024
+    # concurrent follow subscriptions (serve follow=true). Followers
+    # are long-lived BY DESIGN — they hold a scan slot for hours — so
+    # they get their own, tighter ceiling inside max_concurrent: a
+    # tenant cannot park followers on every slot and starve its own
+    # bounded scans
+    max_followers: int = 2
 
 
 class AdmissionRejected(Exception):
@@ -66,13 +72,14 @@ class AdmissionRejected(Exception):
 
 
 class _Waiter:
-    __slots__ = ("tenant", "granted", "abandoned", "shed")
+    __slots__ = ("tenant", "granted", "abandoned", "shed", "follower")
 
-    def __init__(self, tenant: str):
+    def __init__(self, tenant: str, follower: bool = False):
         self.tenant = tenant
         self.granted = False
         self.abandoned = False
         self.shed = False  # evicted by overload shedding
+        self.follower = follower  # long-lived follow subscription
 
 
 class AdmissionController:
@@ -112,6 +119,9 @@ class AdmissionController:
         # doesn't bank unbounded credit
         self._vtime: Dict[str, float] = {}
         self._inflight_bytes: Dict[str, int] = {}
+        # long-lived follow subscriptions currently admitted, per
+        # tenant (a subset of _active; bounded by quota.max_followers)
+        self._followers: Dict[str, int] = {}
 
     def quota(self, tenant: str) -> TenantQuota:
         return self.quotas.get(tenant, self.default_quota)
@@ -156,14 +166,30 @@ class AdmissionController:
 
     # -- scan admission --------------------------------------------------
 
-    def admit(self, tenant: str) -> _Waiter:
+    def admit(self, tenant: str, follower: bool = False) -> _Waiter:
         """Block until this scan may run; returns the ticket for
         `release`. Raises AdmissionRejected (queue_full / queue_timeout
-        / overloaded) — never hangs past `queue_timeout_s`."""
+        / follower_quota / overloaded) — never hangs past
+        `queue_timeout_s`. `follower` marks a long-lived follow
+        subscription: it holds an ordinary weighted scan slot, but is
+        additionally bounded by the tenant's `max_followers` so parked
+        subscriptions cannot starve the tenant's own bounded scans."""
         from ..utils.pressure import LEVEL_SHED
 
         quota = self.quota(tenant)
         t0 = time.monotonic()
+        if follower:
+            with self._cond:
+                if self._followers.get(tenant, 0) >= quota.max_followers:
+                    self._m["rejected"].labels(
+                        tenant=tenant, reason="follower_quota").inc()
+                    raise AdmissionRejected(
+                        tenant, "follower_quota",
+                        f"tenant '{tenant}' already holds "
+                        f"{self._followers[tenant]} follow "
+                        f"subscription(s) "
+                        f"(max_followers={quota.max_followers}); close "
+                        "one or raise the quota")
         if self.pressure_level() >= LEVEL_SHED:
             # over the memory shed watermark: refuse new work AND shed
             # queued waiters (lowest weight first) so admitted scans
@@ -179,11 +205,11 @@ class AdmissionController:
                 f"{f', evicted {shed} queued scan(s)' if shed else ''});"
                 " retry later or on another replica")
         with self._cond:
-            if self._can_run_locked(tenant, quota) \
+            if self._can_run_locked(tenant, quota, follower=follower) \
                     and not self._queues.get(tenant):
-                self._grant_locked(tenant)
+                self._grant_locked(tenant, follower=follower)
                 self._observe_admit(tenant, t0)
-                return _Waiter(tenant)
+                return _Waiter(tenant, follower=follower)
             q = self._queues.setdefault(tenant, deque())
             if len(q) >= quota.max_queued:
                 self._m["rejected"].labels(
@@ -193,7 +219,7 @@ class AdmissionController:
                     f"tenant '{tenant}' already has {quota.max_concurrent}"
                     f" active scan(s) and {len(q)} queued "
                     f"(max_queued={quota.max_queued}); retry later")
-            waiter = _Waiter(tenant)
+            waiter = _Waiter(tenant, follower=follower)
             q.append(waiter)
             self._m["queued"].inc()
             try:
@@ -229,6 +255,12 @@ class AdmissionController:
     def release(self, ticket: _Waiter) -> None:
         with self._cond:
             tenant = ticket.tenant
+            if ticket.follower:
+                left = max(0, self._followers.get(tenant, 0) - 1)
+                if left:
+                    self._followers[tenant] = left
+                else:
+                    self._followers.pop(tenant, None)
             self._active[tenant] = max(0, self._active.get(tenant, 0) - 1)
             if not self._active[tenant]:
                 self._active.pop(tenant)
@@ -250,13 +282,19 @@ class AdmissionController:
         self._m["admitted"].labels(tenant=tenant).inc()
         self._m["queue_wait"].observe(time.monotonic() - t0)
 
-    def _can_run_locked(self, tenant: str, quota: TenantQuota) -> bool:
+    def _can_run_locked(self, tenant: str, quota: TenantQuota,
+                        follower: bool = False) -> bool:
         total = sum(self._active.values())
+        if follower and self._followers.get(tenant, 0) \
+                >= quota.max_followers:
+            return False
         return (total < self.max_concurrent_scans
                 and self._active.get(tenant, 0) < quota.max_concurrent)
 
-    def _grant_locked(self, tenant: str) -> None:
+    def _grant_locked(self, tenant: str, follower: bool = False) -> None:
         self._active[tenant] = self._active.get(tenant, 0) + 1
+        if follower:
+            self._followers[tenant] = self._followers.get(tenant, 0) + 1
         self._m["active"].inc()
         # fair-share bookkeeping: one admitted scan = 1/weight of
         # virtual work, floored at the current minimum so returning
@@ -285,7 +323,8 @@ class AdmissionController:
             for tenant, q in self._queues.items():
                 if not q:
                     continue
-                if not self._can_run_locked(tenant, self.quota(tenant)):
+                if not self._can_run_locked(tenant, self.quota(tenant),
+                                            follower=q[0].follower):
                     continue
                 floor = min(self._vtime.values()) if self._vtime else 0.0
                 vt = self._vtime.get(tenant, floor)
@@ -300,7 +339,7 @@ class AdmissionController:
             if waiter.abandoned:
                 continue
             waiter.granted = True
-            self._grant_locked(tenant)
+            self._grant_locked(tenant, follower=waiter.follower)
         self._cond.notify_all()
 
     # -- the in-flight byte gate ----------------------------------------
@@ -377,6 +416,7 @@ class AdmissionController:
                 "tenants": {
                     t: {"active": self._active.get(t, 0),
                         "queued": len(self._queues.get(t, ())),
+                        "followers": self._followers.get(t, 0),
                         "inflight_bytes":
                             self._inflight_bytes.get(t, 0)}
                     for t in tenants},

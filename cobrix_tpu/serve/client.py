@@ -229,6 +229,9 @@ class ScanStream:
         self._reader = None
         # recovery state
         self._plan_fp = ""
+        # follow mode: the source watermark off the last resume token —
+        # a replacement replica seeds its ingestor from it
+        self._watermark: dict = {}
         self._rows_yielded = 0
         self.failovers = 0
         self.attempt_request_ids: List[str] = [request_id]
@@ -256,6 +259,9 @@ class ScanStream:
         plan = token.get("plan")
         if plan:
             self._plan_fp = str(plan)
+        watermark = token.get("watermark")
+        if isinstance(watermark, dict):
+            self._watermark = watermark
 
     def _open_attempt(self) -> None:
         """Connect to the current replica and send the request frame —
@@ -289,6 +295,10 @@ class ScanStream:
                 "records": self._rows_yielded,
                 "of": self.request_id,
             }
+            if self._watermark:
+                # follow subscriptions: the per-source state the new
+                # replica's ingestor resumes from
+                fields["resume"]["watermark"] = self._watermark
         try:
             sock.settimeout(self._read_timeout_s
                             if self._read_timeout_s
@@ -518,6 +528,7 @@ def stream_scan(address, files,
                 trace_id: Optional[str] = None,
                 trace: bool = False,
                 max_failovers: int = DEFAULT_MAX_FAILOVERS,
+                follow=False,
                 **options) -> ScanStream:
     """Open one streamed scan against a ScanServer (or replica set).
 
@@ -538,7 +549,16 @@ def stream_scan(address, files,
     `stream.write_chrome_trace(path)` then emits ONE merged Chrome
     trace for the request. `max_failovers` bounds mid-stream recovery
     attempts per logical request (0 = fail on the first interruption,
-    the pre-resume behavior)."""
+    the pre-resume behavior).
+
+    `follow`: True (or an options dict — poll_interval_s,
+    idle_timeout_s, max_batches, batch_max_mb, tail_grace_s,
+    truncation_policy) turns the scan into a LIVE subscription: the
+    server tails the source (growth, rotation, truncation handled
+    structurally) and streams batches until the subscriber closes, the
+    row cap hits, or the follow idle timeout passes. Resume tokens then
+    carry the source watermark, so a replica lost mid-follow fails
+    over with the exactly-once guarantee intact."""
     if isinstance(files, (str, bytes)):
         files = [files]
     replicas = _normalize_replicas(address)
@@ -561,6 +581,7 @@ def stream_scan(address, files,
             "request_id": request_id,
             "trace_id": trace_id,
             "trace": trace,
+            **({"follow": follow} if follow else {}),
         },
         on_progress=progress_callback,
         request_id=request_id, trace_id=trace_id, tracer=tracer,

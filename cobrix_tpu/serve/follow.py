@@ -1,0 +1,250 @@
+"""Serve follow mode: subscribe a client to a LIVE source.
+
+A ``follow=true`` request turns a scan into a subscription: the server
+runs a `streaming.ContinuousIngestor` over the requested path and
+streams every micro-batch to the client as source bytes stabilize —
+growth, rotation, and truncation handled by the ingest layer, Arrow
+batches on the same 'D'-frame wire as ordinary scans.
+
+Recovery is the PR-9 resume protocol extended with the source
+watermark: every resume token carries ``{plan, records, watermark}``
+where `watermark` is the ingestor's per-source state
+(`ContinuousIngestor.watermark()`). A client losing its replica
+mid-follow reconnects elsewhere with the token; the new replica seeds
+its ingestor from the watermark, skips the few records delivered after
+the last token, and the subscriber's record stream continues exactly
+once — no duplicates, no gaps, monotone Record_Ids.
+
+The durable state lives with the CLIENT (its last token), not the
+server: follow sessions are stateless on the serving side, which is
+what makes replica failover trivial. Consumers that need crash-durable
+server-side checkpoints run `ContinuousIngestor` with a
+``checkpoint_dir`` in their own process instead.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Callable, Optional
+
+from .protocol import ServeError
+from .session import NON_PLAN_OPTIONS, ScanRequest
+
+# follow knobs a client may set inside the request's "follow" object;
+# everything else in there is refused loudly
+FOLLOW_OPTIONS = ("poll_interval_s", "idle_timeout_s", "max_batches",
+                  "batch_max_mb", "tail_grace_s", "truncation_policy")
+
+# how often an idle follow session proves the subscriber is still there
+# (a keepalive token write; its failure is the disconnect signal)
+KEEPALIVE_INTERVAL_S = 1.0
+
+
+def follow_plan_fingerprint(files, read_kwargs: dict) -> str:
+    """The follow-mode plan identity a resume token carries. Unlike a
+    bounded scan's fingerprint, it does NOT pin file content versions —
+    a follow target grows by design; the source WATERMARK (offsets +
+    head CRCs) carries version identity instead. What must match across
+    replicas is the request shape: the files spec and every row-shaping
+    option."""
+    opts = {k: v for k, v in read_kwargs.items()
+            if k not in NON_PLAN_OPTIONS}
+    payload = json.dumps(["follow", list(files), opts], sort_keys=True,
+                         default=str)
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()[:24]
+
+
+class FollowSession:
+    """One admitted follow subscription: ingest -> ordered batches ->
+    `write_table`, until the subscriber leaves (ClientGone), the row
+    cap is reached, or the request's `idle_timeout_s` passes with no
+    source progress. Interface-compatible with `ScanSession` where the
+    handler needs it (plan_fp, resume_token, degraded, metrics,
+    result_schema)."""
+
+    def __init__(self, request: ScanRequest,
+                 server_options: Optional[dict] = None,
+                 controller=None,
+                 on_progress: Optional[Callable] = None,
+                 tracer=None,
+                 force_progress: bool = False,
+                 force_field_costs: bool = False,
+                 on_plan: Optional[Callable] = None,
+                 keepalive: Optional[Callable] = None):
+        self.request = request
+        self.server_options = server_options
+        self.controller = controller
+        self.on_progress = on_progress
+        self.tracer = tracer
+        self.force_progress = force_progress
+        self.on_plan = on_plan
+        # called during idle gaps with the current resume token; must
+        # RAISE ClientGone when the subscriber is unreachable — it is
+        # the only disconnect signal while no data flows
+        self.keepalive = keepalive
+        self.metrics = None
+        self.result_schema = None
+        self.plan_fp = ""
+        self.degraded = False
+        self.emitter = None
+        self._ingestor = None
+        self._rows_emitted = 0
+        self._last_watermark: dict = {}
+
+    # -- resume surface (handler-compatible with ScanSession) -----------
+
+    def delivered_records(self) -> int:
+        return self.request.resume_records + self._rows_emitted
+
+    def resume_token(self) -> dict:
+        token = {"plan": self.plan_fp,
+                 "records": self.delivered_records()}
+        if self._ingestor is not None:
+            self._last_watermark = self._ingestor.watermark()
+        if self._last_watermark:
+            token["watermark"] = self._last_watermark
+        return token
+
+    # -- the subscription loop ------------------------------------------
+
+    def _follow_kwargs(self) -> dict:
+        raw = self.request.follow
+        if raw is True:
+            raw = {}
+        bad = [k for k in raw if k not in FOLLOW_OPTIONS]
+        if bad:
+            raise ServeError(
+                f"unknown follow option(s): {', '.join(sorted(bad))} "
+                f"(accepted: {', '.join(FOLLOW_OPTIONS)})",
+                code="protocol")
+        out = {}
+        for key in ("poll_interval_s", "idle_timeout_s", "tail_grace_s",
+                    "batch_max_mb"):
+            if raw.get(key) is not None:
+                out[key] = float(raw[key])
+        if raw.get("max_batches") is not None:
+            out["max_batches"] = int(raw["max_batches"])
+        if raw.get("truncation_policy") is not None:
+            out["truncation_policy"] = str(raw["truncation_policy"])
+        return out
+
+    def run(self, write_table: Callable) -> dict:
+        from ..streaming.ingest import ContinuousIngestor
+
+        req = self.request
+        kwargs = req.read_kwargs(self.server_options)
+        # kwargs carries the session default pipeline_workers=-1 on
+        # purpose: the ingest layer frames incrementally either way and
+        # only engages the pipelined executor for multi-window catch-up
+        # backlogs — exactly when a follow subscription wants it
+        follow_kwargs = self._follow_kwargs()
+        idle_timeout = follow_kwargs.pop("idle_timeout_s", None)
+        max_batches = follow_kwargs.pop("max_batches", None)
+        self.plan_fp = follow_plan_fingerprint(req.files, kwargs)
+        if req.is_resume and req.resume_plan != self.plan_fp:
+            raise ServeError(
+                "follow resume token does not match this server's plan "
+                "(files or row-shaping options changed); re-subscribe "
+                "from a fresh request", code="resume_mismatch")
+        ingestor = ContinuousIngestor(
+            req.files if len(req.files) > 1 else req.files[0],
+            checkpoint_dir=None, **follow_kwargs, **kwargs)
+        self._ingestor = ingestor
+        if req.resume_watermark:
+            ingestor.seed_watermark(req.resume_watermark)
+        # records the client received AFTER its last watermark token:
+        # re-derived by the seeded ingestor, dropped here before the
+        # wire — the subscriber sees each record exactly once
+        skip = max(0, req.resume_records
+                   - ingestor.delivered_records)
+        if self.on_plan is not None:
+            self.on_plan(self.plan_fp)
+        max_records = req.max_records
+        remaining = (None if max_records is None
+                     else max(0, max_records - req.resume_records))
+        t0 = time.monotonic()
+        last_progress = t0
+        tables_emitted = 0
+        batches_seen = 0
+        # short inner idle window: batches() returns after it so the
+        # session can heartbeat the subscriber and enforce the
+        # REQUEST-level idle timeout; the ingestor keeps its state
+        # across calls
+        ingestor.idle_timeout_s = KEEPALIVE_INTERVAL_S
+        last_delivery = time.monotonic()
+        try:
+            while True:
+                for batch in ingestor.batches():
+                    batches_seen += 1
+                    table = batch.to_arrow()
+                    if skip > 0:
+                        if table.num_rows <= skip:
+                            skip -= table.num_rows
+                            table = None
+                        else:
+                            table = table.slice(skip)
+                            skip = 0
+                    if table is not None and remaining is not None:
+                        if table.num_rows > remaining:
+                            table = table.slice(0, remaining)
+                    if table is not None and table.num_rows:
+                        write_table(table)
+                        self._rows_emitted += table.num_rows
+                        tables_emitted += 1
+                        last_delivery = time.monotonic()
+                        if remaining is not None:
+                            remaining -= table.num_rows
+                    self._emit_progress(ingestor, t0)
+                    if remaining is not None and remaining <= 0:
+                        raise _FollowDone()
+                    if max_batches is not None \
+                            and batches_seen >= max_batches:
+                        raise _FollowDone()
+                # idle gap: prove the subscriber is still there (the
+                # keepalive raises ClientGone when it is not) and
+                # enforce the request-level idle timeout
+                if self.keepalive is not None:
+                    self.keepalive()
+                self._emit_progress(ingestor, t0)
+                if idle_timeout is not None and \
+                        time.monotonic() - last_delivery >= idle_timeout:
+                    raise _FollowDone()
+        except _FollowDone:
+            pass
+        finally:
+            ingestor.close()
+        from ..reader.arrow_out import arrow_schema
+
+        self.result_schema = arrow_schema(ingestor.schema.schema)
+        summary = {
+            "rows": self._rows_emitted,
+            "tables": tables_emitted,
+            "records_total": self.delivered_records(),
+            "scan_s": round(time.monotonic() - t0, 6),
+            "request_id": req.request_id,
+            "trace_id": req.trace_id,
+            "diagnostics": None,
+            "follow": True,
+            "lag_bytes": ingestor.lag_bytes(),
+            "resume_token": self.resume_token(),
+        }
+        if req.is_resume:
+            summary["resume_of"] = req.resume_of or req.request_id
+            summary["rows_skipped"] = req.resume_records
+        return summary
+
+    def _emit_progress(self, ingestor, t0: float) -> None:
+        if self.on_progress is None:
+            return
+        from ..obs.progress import ScanProgress
+
+        self.on_progress(ScanProgress(
+            records_done=self._rows_emitted,
+            chunks_done=ingestor._delivered_batches,
+            elapsed_s=time.monotonic() - t0,
+            lag_bytes=ingestor.lag_bytes()))
+
+
+class _FollowDone(Exception):
+    """Internal: the subscription reached its requested bound."""

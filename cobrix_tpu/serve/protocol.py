@@ -12,7 +12,7 @@ so any language with sockets can speak it. Frame types:
       'R'  request            JSON: {tenant, files, options,
                                      max_records, progress,
                                      request_id, trace_id, trace,
-                                     resume?}
+                                     follow?, resume?}
                               — request_id/trace_id are the request's
                               identity triple (with tenant): minted by
                               the client (or an upstream service),
@@ -21,13 +21,21 @@ so any language with sockets can speak it. Frame types:
                               "trace" asks the server to ship its span
                               list back on the trailer so the client
                               can merge ONE cross-process Chrome trace.
-                              "resume" = {plan, records, of} resumes an
-                              interrupted stream: `plan` is the chunk-
-                              plan fingerprint from a prior attempt's
+                              "follow" (true or an options object)
+                              turns the scan into a continuous-ingest
+                              subscription: the server tails the
+                              source and streams batches as they
+                              stabilize (serve/follow.py).
+                              "resume" = {plan, records, of,
+                              watermark?} resumes an interrupted
+                              stream: `plan` is the chunk-plan
+                              fingerprint from a prior attempt's
                               resume token, `records` the count already
                               delivered to the consumer, `of` the
                               original request_id the audit log ties
-                              the attempts together under
+                              the attempts together under; `watermark`
+                              (follow mode) is the per-source ingest
+                              state the new replica seeds from
     server -> client
       'D'  data               raw Arrow IPC *stream* bytes (the
                               concatenation of every D payload is one
@@ -36,7 +44,8 @@ so any language with sockets can speak it. Frame types:
       'P'  progress           JSON ScanProgress.as_dict() (opt-in via
                               the request's "progress" flag; throttled
                               server-side by `progress_interval_s`)
-      'T'  resume token       JSON: {plan, records} — the recovery
+      'T'  resume token       JSON: {plan, records, watermark?} — the
+                              recovery
                               watermark, sent periodically between data
                               frames and echoed on the trailer: `plan`
                               fingerprints the chunk plan (files, file
@@ -99,9 +108,16 @@ class ServeError(RuntimeError):
     side. `code` classifies it:
 
     * ``rejected``    — admission control refused the scan (quota /
-                        queue full / queue timeout); retryable later
+                        queue full / queue timeout / follower_quota /
+                        overloaded / draining); retryable later
     * ``scan_error``  — the scan itself failed (bad options, corrupt
                         input, storage fault)
+    * ``resume_mismatch`` — a resume token no longer matches the
+                        server's plan (file or options changed);
+                        restart from record 0
+    * ``source_truncated`` — a followed source shrank below its
+                        watermark (streaming.SourceTruncated);
+                        terminal for the subscription
     * ``protocol``    — malformed request
     """
 

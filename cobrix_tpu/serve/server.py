@@ -169,7 +169,8 @@ class _Handler(socketserver.StreamRequestHandler):
                                     "server is draining")
             return
         try:
-            ticket = server.controller.admit(tenant)
+            ticket = server.controller.admit(
+                tenant, follower=request.is_follow)
         except AdmissionRejected as exc:
             writer.try_json(FRAME_ERROR, {
                 "error": f"AdmissionRejected: {exc}",
@@ -200,18 +201,34 @@ class _Handler(socketserver.StreamRequestHandler):
             if request.want_progress:
                 writer.try_json(FRAME_PROGRESS, p.as_dict())
 
-        session = ScanSession(
-            request, server_options=server.server_options,
-            controller=server.controller,
-            on_progress=on_progress, tracer=tracer,
-            force_progress=True,
-            force_field_costs=server.wants_field_costs(),
+        on_plan = lambda fp: writer.try_json(  # noqa: E731
             # the stream's FIRST frame is a resume token carrying the
             # chunk-plan fingerprint: a client that dies at any later
             # point holds the plan identity it must resume against
-            on_plan=lambda fp: writer.try_json(
-                FRAME_TOKEN,
-                {"plan": fp, "records": request.resume_records}))
+            FRAME_TOKEN,
+            {"plan": fp, "records": request.resume_records})
+        if request.is_follow:
+            from .follow import FollowSession
+
+            session = FollowSession(
+                request, server_options=server.server_options,
+                controller=server.controller,
+                on_progress=on_progress, tracer=tracer,
+                force_progress=True, on_plan=on_plan,
+                # the idle-gap liveness probe: a keepalive token whose
+                # write failure IS the disconnect signal for a
+                # subscriber waiting on a quiet source
+                keepalive=lambda: writer.json(
+                    FRAME_TOKEN, session.resume_token()))
+            m["follow"].labels(tenant=tenant).inc()
+        else:
+            session = ScanSession(
+                request, server_options=server.server_options,
+                controller=server.controller,
+                on_progress=on_progress, tracer=tracer,
+                force_progress=True,
+                force_field_costs=server.wants_field_costs(),
+                on_plan=on_plan)
         if request.is_resume:
             m["resumed"].labels(tenant=tenant).inc()
         # resume tokens ride between data frames: after a table is on
@@ -254,8 +271,17 @@ class _Handler(socketserver.StreamRequestHandler):
             # error frame, never a silent close (the pre-serve bridge
             # left clients blocked in a read here). A ServeError keeps
             # its own code (request hygiene failures are 'protocol')
-            code = exc.code if isinstance(exc, ServeError) \
-                else "scan_error"
+            from ..streaming.sources import SourceTruncated
+
+            if isinstance(exc, ServeError):
+                code = exc.code
+            elif isinstance(exc, SourceTruncated):
+                # a followed source shrank below its watermark: the
+                # structured outcome the chaos matrix pins — audited,
+                # counted, never silently wrong rows
+                code = "source_truncated"
+            else:
+                code = "scan_error"
             payload = error_payload(exc, code)
             if code == "scan_error" and session.plan_fp:
                 # even a failed scan tells the client how far it got:

@@ -159,7 +159,19 @@ class ScanRequest:
         # client opt-in: ship the server-side trace spans back on the
         # trailer so the client can merge one cross-process Chrome trace
         self.want_trace = bool(payload.get("trace"))
-        # resume of an interrupted stream: {plan, records, of}. `plan`
+        # follow mode (continuous ingestion): true or an options object
+        # ({poll_interval_s, idle_timeout_s, max_batches, batch_max_mb,
+        # tail_grace_s, truncation_policy}) — the session becomes a
+        # live subscription driven by serve/follow.FollowSession
+        follow = payload.get("follow") or False
+        if follow not in (False, True) and not isinstance(follow, dict):
+            raise ServeError("'follow' must be true or an object",
+                             code="protocol")
+        self.follow = follow
+        self.is_follow = bool(follow)
+        # resume of an interrupted stream: {plan, records, of} (+
+        # `watermark` for follow subscriptions — the per-source state
+        # a replacement replica seeds its ingestor from). `plan`
         # must match this server's computed chunk-plan fingerprint
         # (validated in ScanSession.run), `records` are skipped before
         # anything hits the wire, `of` is the ORIGINAL request_id the
@@ -169,6 +181,11 @@ class ScanRequest:
             raise ServeError("'resume' must be an object",
                              code="protocol")
         self.resume_plan = str(resume.get("plan") or "")
+        watermark = resume.get("watermark") or {}
+        if watermark and not isinstance(watermark, dict):
+            raise ServeError("'resume.watermark' must be an object",
+                             code="protocol")
+        self.resume_watermark = watermark
         try:
             self.resume_records = max(0, int(resume.get("records") or 0))
         except (TypeError, ValueError):

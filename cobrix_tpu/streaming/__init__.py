@@ -1,0 +1,33 @@
+"""Streaming ingestion for cobrix_tpu.
+
+Two tiers:
+
+* **micro-batch** (`CobolStreamer`, `stream_cobol` — the historical
+  `cobrix_tpu.streaming` surface, kept import-compatible): decode byte
+  chunks or whole new files appearing in a directory; in-memory state
+  only.
+* **continuous ingestion** (`ContinuousIngestor`, `tail_cobol`): the
+  production feed — tail growing/rotating local files and object-store
+  prefixes with durable CRC-framed checkpoints (`CheckpointStore`),
+  an exactly-once ack window, structured rotation/truncation handling
+  (`SourceTruncated`), incremental sparse indexing, and the
+  `cobrix_stream_*` Prometheus metrics. The serving tier's
+  ``follow=true`` mode (cobrix_tpu.serve) streams the same batches to
+  remote clients with replica failover.
+"""
+from .checkpoint import CheckpointStore, StreamCheckpoint
+from .ingest import ContinuousIngestor, IngestBatch, tail_cobol
+from .microbatch import CobolStreamer, stream_cobol
+from .sources import SourceState, SourceTruncated
+
+__all__ = [
+    "CheckpointStore",
+    "StreamCheckpoint",
+    "ContinuousIngestor",
+    "IngestBatch",
+    "tail_cobol",
+    "CobolStreamer",
+    "stream_cobol",
+    "SourceState",
+    "SourceTruncated",
+]

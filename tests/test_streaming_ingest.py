@@ -1,0 +1,713 @@
+"""Exactly-once continuous ingestion (cobrix_tpu.streaming).
+
+The chaos matrix of ISSUE 10: kill/restart cycles at randomized points
+must leave the concatenation of delivered batches byte-identical to a
+one-shot read of the final inputs (fixed and VRL, local and memory://,
+pipelined catch-up on and off); checkpoint corruption self-heals off
+the second slot; rotation drains the old generation exactly once;
+truncation is a structured outcome; the incremental sparse index equals
+a from-scratch index; and the serve follow mode delivers the same
+exactly-once stream through replica failover.
+
+The exactly-once consumer protocol under test is the documented one:
+each delivered batch is appended to a durable-ish output, the ack
+carries the output length as ``app_state``, and a restart truncates the
+output back to the recovered ``app_state`` before consuming — so a
+crash between delivery and ack re-drives batches into the exact hole
+the truncation opened.
+"""
+import os
+import random
+import socket
+import socketserver
+import threading
+import time
+
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from cobrix_tpu import SourceTruncated, read_cobol, tail_cobol
+from cobrix_tpu.obs.metrics import stream_metrics
+from cobrix_tpu.reader.index import (
+    IncrementalIndexer,
+    sparse_index_generator,
+)
+from cobrix_tpu.reader.stream import MemoryStream
+from cobrix_tpu.streaming import CheckpointStore, CobolStreamer
+from cobrix_tpu.testing.faults import (
+    LiveAppender,
+    corrupt_cache_entry,
+    rotate_source,
+    truncate_source,
+)
+
+from util import hard_timeout
+
+FIXED_COPYBOOK = """
+        01  R.
+            05  KEY    PIC 9(7) COMP.
+            05  NAME   PIC X(9).
+"""
+FIXED_RS = 13
+
+RDW_COPYBOOK = """
+        01  R.
+            05  K  PIC X(6).
+"""
+
+
+def fixed_records(n, start=0):
+    return b"".join(
+        (start + i).to_bytes(4, "big")
+        + f"ROW{(start + i) % 1000000:06d}".encode("ascii")
+        for i in range(n))
+
+
+def rdw(payload):
+    return bytes([0, 0, len(payload) % 256, len(payload) // 256]) \
+        + payload
+
+
+def rdw_records(n, start=0):
+    return b"".join(rdw(f"K{i:05d}".encode("cp037"))
+                    for i in range(start, start + n))
+
+
+def bare(table):
+    return table.replace_schema_metadata(None)
+
+
+def one_shot(path, **opts):
+    return bare(read_cobol(path, **opts).to_arrow())
+
+
+class ExactlyOnceConsumer:
+    """The documented ack protocol, in-process: output truncation to
+    the committed app_state on every 'restart'."""
+
+    def __init__(self):
+        self.tables = []
+
+    def run(self, make_ingestor, crash_after=None):
+        """One consumer lifetime; crash_after = batches before the
+        simulated crash (ingestor abandoned, NOTHING acked after the
+        last explicit ack — the same recovery surface a SIGKILL
+        leaves). Returns True when the feed idled out (finished)."""
+        ing = make_ingestor()
+        committed = int(ing.app_state or 0)
+        del self.tables[committed:]
+        n = 0
+        finished = True
+        for batch in ing.batches():
+            self.tables.append(bare(batch.to_arrow()))
+            batch.ack(app_state=len(self.tables))
+            n += 1
+            if crash_after is not None and n >= crash_after:
+                finished = False
+                break  # abandon: no close(), no further acks
+        if finished:
+            ing.close(finalize=True)
+        return finished
+
+    def table(self):
+        return pa.concat_tables(self.tables)
+
+
+FIXED_OPTS = {"copybook_contents": FIXED_COPYBOOK}
+VRL_OPTS = {"copybook_contents": RDW_COPYBOOK,
+            "is_record_sequence": "true",
+            "generate_record_id": "true"}
+
+
+@pytest.mark.parametrize("flavor,pipeline", [
+    ("fixed", "0"), ("fixed", "2"), ("vrl", "0"), ("vrl", "2"),
+])
+def test_kill_restart_matrix_byte_identical(tmp_path, flavor, pipeline):
+    """SIGKILL-shaped kill/restart at randomized points x fixed/VRL x
+    pipelined catch-up on/off => byte-identical concatenation."""
+    with hard_timeout(300, "kill/restart matrix"):
+        rng = random.Random(hash((flavor, pipeline)) & 0xFFFF)
+        payload = (fixed_records(4000) if flavor == "fixed"
+                   else rdw_records(4000))
+        opts = dict(FIXED_OPTS if flavor == "fixed" else VRL_OPTS)
+        src = tmp_path / "feed.dat"
+        ckpt = tmp_path / "ckpt"
+        src.write_bytes(payload[:len(payload) // 3])
+        appender = LiveAppender(str(src), payload[len(payload) // 3:],
+                                slice_sizes=(7, 3, 29, 2, 111),
+                                pause_s=0.001).start()
+        consumer = ExactlyOnceConsumer()
+
+        def make():
+            return tail_cobol(
+                str(src), checkpoint_dir=str(ckpt), auto_ack=False,
+                poll_interval_s=0.02, idle_timeout_s=0.8,
+                finalize_on_idle=True, batch_max_mb=0.004,
+                pipeline_workers=pipeline, **opts)
+
+        kills = 0
+        while True:
+            crash = rng.randint(1, 6) if kills < 3 else None
+            if consumer.run(make, crash_after=crash) and appender.done:
+                break
+            kills += 1
+            assert kills < 200, "kill/restart loop did not converge"
+        assert kills >= 3
+        appender.join(5)
+        got = consumer.table()
+        want = one_shot(str(src), **opts)
+        assert got.equals(want), (
+            f"{got.num_rows} rows delivered vs {want.num_rows} one-shot "
+            f"after {kills} kill/restart cycles")
+
+
+def test_memory_backend_prefix_tail(tmp_path):
+    """Object-store tailing: new immutable objects under a memory://
+    prefix are consumed exactly once, equal to the one-shot read."""
+    fsspec = pytest.importorskip("fsspec")
+    with hard_timeout(120, "memory tail"):
+        fs = fsspec.filesystem("memory")
+        prefix = f"/ingest-{os.getpid()}-{int(time.time() * 1000)}"
+        fs.pipe_file(f"{prefix}/a.dat", fixed_records(300))
+        consumer = ExactlyOnceConsumer()
+        ing = tail_cobol(f"memory://{prefix}/",
+                         checkpoint_dir=str(tmp_path / "ck"),
+                         auto_ack=False, poll_interval_s=0.05,
+                         idle_timeout_s=2.0, **FIXED_OPTS)
+        it = ing.batches()
+        batch = next(it)
+        consumer.tables.append(bare(batch.to_arrow()))
+        batch.ack(app_state=len(consumer.tables))
+        fs.pipe_file(f"{prefix}/b.dat", fixed_records(200, 300))
+        for batch in it:
+            consumer.tables.append(bare(batch.to_arrow()))
+            batch.ack(app_state=len(consumer.tables))
+        ing.close()
+        got = consumer.table()
+        want = one_shot(f"memory://{prefix}/", **FIXED_OPTS)
+        assert got.equals(want)
+
+
+@pytest.mark.parametrize("mode", ["bitflip", "garbage"])
+def test_checkpoint_corruption_self_heals(tmp_path, mode):
+    """A corrupted checkpoint slot is quarantined + counted and
+    recovery falls back to the other slot — the stream stays exactly
+    once through the re-drive (ack protocol absorbs it)."""
+    from cobrix_tpu.obs.metrics import default_registry
+
+    with hard_timeout(180, "checkpoint corruption"):
+        src = tmp_path / "feed.dat"
+        ckpt = tmp_path / "ckpt"
+        src.write_bytes(fixed_records(900))
+        consumer = ExactlyOnceConsumer()
+
+        def make():
+            return tail_cobol(str(src), checkpoint_dir=str(ckpt),
+                              auto_ack=False, poll_interval_s=0.02,
+                              idle_timeout_s=0.4, finalize_on_idle=True,
+                              batch_max_mb=0.002, **FIXED_OPTS)
+
+        consumer.run(make, crash_after=4)  # several acked commits
+        counter = default_registry().counter(
+            "cobrix_cache_corruption_total", label_names=("plane",))
+        before = counter.value(plane="checkpoint")
+        corrupt_cache_entry(str(ckpt), "checkpoint", mode)
+        while not consumer.run(make):
+            pass
+        assert counter.value(plane="checkpoint") == before + 1
+        quarantined = os.listdir(ckpt / "quarantine")
+        assert len(quarantined) >= 1
+        assert consumer.table().equals(one_shot(str(src), **FIXED_OPTS))
+
+
+def test_both_slots_corrupt_restarts_from_zero(tmp_path):
+    with hard_timeout(120, "double corruption"):
+        src = tmp_path / "feed.dat"
+        ckpt = tmp_path / "ckpt"
+        src.write_bytes(fixed_records(400))
+        consumer = ExactlyOnceConsumer()
+
+        def make():
+            return tail_cobol(str(src), checkpoint_dir=str(ckpt),
+                              auto_ack=False, poll_interval_s=0.02,
+                              idle_timeout_s=0.4, finalize_on_idle=True,
+                              batch_max_mb=0.002, **FIXED_OPTS)
+
+        consumer.run(make, crash_after=3)
+        for which in (0, 1):
+            try:
+                corrupt_cache_entry(str(ckpt), "checkpoint", "garbage",
+                                    which=which)
+            except FileNotFoundError:
+                break
+        while not consumer.run(make):
+            pass
+        assert consumer.table().equals(one_shot(str(src), **FIXED_OPTS))
+
+
+def test_rotation_drains_old_generation_exactly_once(tmp_path):
+    """Rename rotation mid-tail: every old-generation record exactly
+    once (including bytes appended to the renamed file while the
+    handle drains), then the new generation."""
+    with hard_timeout(120, "rotation"):
+        src = tmp_path / "app.log"
+        src.write_bytes(fixed_records(50))
+        m = stream_metrics()
+        rotations_before = m["rotations"].value()
+        ing = tail_cobol(str(src), checkpoint_dir=str(tmp_path / "ck"),
+                         poll_interval_s=0.02, **FIXED_OPTS)
+        it = ing.batches()
+        first = next(it)
+        rotated = rotate_source(str(src), fixed_records(30, 1000))
+        # a late append to the ROTATED-AWAY file still belongs to the
+        # old generation (the held descriptor reads it)
+        with open(rotated, "ab") as f:
+            f.write(fixed_records(10, 50))
+        tables = [bare(first.to_arrow())]
+        rows = first.records
+        while rows < 90:
+            batch = next(it)
+            tables.append(bare(batch.to_arrow()))
+            rows += batch.records
+        ing.close()
+        got = pa.concat_tables(tables)
+        keys = got.column("R").to_pylist()
+        old = sorted(k["KEY"] for k in keys if k["KEY"] < 1000)
+        new = sorted(k["KEY"] for k in keys if k["KEY"] >= 1000)
+        assert old == list(range(60))       # 50 + 10 late, exactly once
+        assert new == list(range(1000, 1030))
+        assert m["rotations"].value() == rotations_before + 1
+
+
+def test_truncation_error_policy_is_structured(tmp_path):
+    with hard_timeout(60, "truncation error"):
+        src = tmp_path / "t.dat"
+        src.write_bytes(fixed_records(80))
+        ing = tail_cobol(str(src), checkpoint_dir=str(tmp_path / "ck"),
+                         poll_interval_s=0.02, **FIXED_OPTS)
+        it = ing.batches()
+        next(it)
+        truncate_source(str(src), 2 * FIXED_RS)
+        with pytest.raises(SourceTruncated) as info:
+            next(it)
+        assert info.value.path == str(src)
+        assert info.value.size < info.value.watermark
+        ing.close()
+
+
+def test_truncation_restart_policy_reingests(tmp_path):
+    with hard_timeout(60, "truncation restart"):
+        src = tmp_path / "t.dat"
+        src.write_bytes(fixed_records(60))
+        m = stream_metrics()
+        before = m["truncations"].value()
+        ing = tail_cobol(str(src), checkpoint_dir=str(tmp_path / "ck"),
+                         truncation_policy="restart",
+                         poll_interval_s=0.02, idle_timeout_s=0.5,
+                         finalize_on_idle=True, **FIXED_OPTS)
+        it = ing.batches()
+        first = next(it)
+        assert first.generation == 0
+        with open(src, "wb") as f:  # in-place replacement, larger
+            f.write(fixed_records(70, 5000))
+        rest = list(it)
+        ing.close()
+        assert m["truncations"].value() == before + 1
+        regen = pa.concat_tables([bare(b.to_arrow()) for b in rest])
+        keys = [k["KEY"] for k in regen.column("R").to_pylist()]
+        assert sorted(keys) == list(range(5000, 5070))
+        assert all(b.generation == 1 for b in rest)
+
+
+def test_mid_record_tail_waits_never_garbage(tmp_path):
+    """Torn, non-record-aligned appends: no partial record is ever
+    decoded; the stream converges to the one-shot read."""
+    with hard_timeout(120, "torn appends"):
+        src = tmp_path / "torn.dat"
+        src.write_bytes(b"")
+        payload = rdw_records(600)
+        appender = LiveAppender(str(src), payload,
+                                slice_sizes=(1, 5, 2, 9, 3),
+                                pause_s=0.0005).start()
+        consumer = ExactlyOnceConsumer()
+
+        def make():
+            return tail_cobol(str(src),
+                              checkpoint_dir=str(tmp_path / "ck"),
+                              auto_ack=False, poll_interval_s=0.01,
+                              idle_timeout_s=0.8, finalize_on_idle=True,
+                              **VRL_OPTS)
+
+        while not (consumer.run(make) and appender.done):
+            pass
+        appender.join(5)
+        assert consumer.table().equals(one_shot(str(src), **VRL_OPTS))
+
+
+def test_permissive_corruption_matches_one_shot(tmp_path):
+    """A corrupt RDW run inside a tailed file: the finalized stream's
+    ledgered resync behavior equals the one-shot permissive read."""
+    with hard_timeout(120, "permissive corruption"):
+        good = rdw_records(200)
+        corrupted = good[:1100] + b"\x00" * 4 + good[1100:]
+        src = tmp_path / "c.dat"
+        src.write_bytes(corrupted)
+        opts = dict(VRL_OPTS, record_error_policy="drop_malformed")
+        consumer = ExactlyOnceConsumer()
+
+        def make():
+            return tail_cobol(str(src),
+                              checkpoint_dir=str(tmp_path / "ck"),
+                              auto_ack=False, poll_interval_s=0.02,
+                              idle_timeout_s=0.4, finalize_on_idle=True,
+                              **opts)
+
+        while not consumer.run(make):
+            pass
+        assert consumer.table().equals(one_shot(str(src), **opts))
+
+
+def test_fail_fast_corruption_raises_structured(tmp_path):
+    with hard_timeout(60, "fail-fast corruption"):
+        good = rdw_records(50)
+        src = tmp_path / "c.dat"
+        src.write_bytes(good[:110] + b"\x00" * 4 + good[110:])
+        ing = tail_cobol(str(src), checkpoint_dir=str(tmp_path / "ck"),
+                         poll_interval_s=0.02, tail_grace_s=0.2,
+                         idle_timeout_s=3.0, **VRL_OPTS)
+        with pytest.raises(Exception, match="RDW"):
+            for _ in ing.batches():
+                pass
+        ing.close()
+
+
+def test_unsupported_tail_configs_refused():
+    for bad in (dict(is_text="true"), dict(variable_size_occurs="true"),
+                dict(record_length_field="K"),
+                dict(file_start_offset="4")):
+        with pytest.raises(ValueError, match="continuous ingestion"):
+            tail_cobol("/nonexistent", copybook_contents=RDW_COPYBOOK,
+                       **bad)
+
+
+def test_incremental_index_equals_from_scratch(tmp_path):
+    """IncrementalIndexer == sparse_index_generator over the same
+    records, survives a state round-trip, and the finalized index lands
+    in the store where a one-shot read finds it."""
+    from cobrix_tpu.api import parse_options
+    from cobrix_tpu.reader.var_len_reader import VarLenReader
+
+    with hard_timeout(120, "incremental index"):
+        data = rdw_records(1000)
+        params, _ = parse_options(dict(
+            copybook_contents=RDW_COPYBOOK, is_record_sequence="true",
+            input_split_records="37"))
+        reader = VarLenReader(RDW_COPYBOOK, params)
+        want = sparse_index_generator(
+            0, MemoryStream(data),
+            record_header_parser=reader.record_header_parser(),
+            records_per_index_entry=37)
+        inc = IncrementalIndexer(records_per_entry=37)
+        pos = 0
+        mid_state = None
+        while pos < len(data):
+            length = data[pos + 2] + 256 * data[pos + 3]
+            inc.add_record(4 + length, True)
+            pos += 4 + length
+            if mid_state is None and pos > len(data) // 2:
+                mid_state = inc.state_dict()
+                inc = IncrementalIndexer.from_state(mid_state)
+        assert inc.entries(0) == want
+        # end to end: tail with cache_dir, finalize, one-shot read hits
+        cache = tmp_path / "cache"
+        src = tmp_path / "v.dat"
+        src.write_bytes(data)
+        opts = dict(copybook_contents=RDW_COPYBOOK,
+                    is_record_sequence="true", input_split_records="37",
+                    cache_dir=str(cache))
+        ing = tail_cobol(str(src), checkpoint_dir=str(tmp_path / "ck"),
+                         poll_interval_s=0.02, idle_timeout_s=0.3,
+                         finalize_on_idle=True, batch_max_mb=0.003,
+                         **opts)
+        tables = [bare(b.to_arrow()) for b in ing]
+        warm = read_cobol(str(src), **opts)
+        assert warm.metrics.as_dict()["io"].get("index_hits", 0) >= 1
+        assert pa.concat_tables(tables).equals(bare(warm.to_arrow()))
+
+
+# -- micro-batch satellite fixes ------------------------------------------
+
+
+def test_stream_directory_nondivisible_not_starved(tmp_path):
+    """A size-stable non-record-multiple file surfaces through the
+    record-error policy instead of pending forever."""
+    with hard_timeout(60, "starvation fix"):
+        (tmp_path / "bad.dat").write_bytes(fixed_records(5) + b"\x01\x02")
+        streamer = CobolStreamer(FIXED_COPYBOOK,
+                                 record_error_policy="drop_malformed")
+        batches = list(streamer.stream_directory(
+            str(tmp_path), poll_interval=0.05, idle_timeout=2.0))
+        assert len(batches) == 1
+        assert len(batches[0]) == 5
+        diags = batches[0].diagnostics
+        assert diags is not None and diags.corrupt_records >= 1
+
+
+def test_stream_directory_nondivisible_fail_fast_raises(tmp_path):
+    with hard_timeout(60, "starvation fail-fast"):
+        (tmp_path / "bad.dat").write_bytes(fixed_records(3) + b"\x01")
+        streamer = CobolStreamer(FIXED_COPYBOOK)
+        with pytest.raises(ValueError, match="does not divide"):
+            list(streamer.stream_directory(
+                str(tmp_path), poll_interval=0.05, idle_timeout=2.0))
+
+
+def test_stream_chunks_carryover_parity():
+    """Regression pin for the O(n^2) buffer fix: many tiny unaligned
+    chunks still assemble the identical record stream."""
+    with hard_timeout(60, "chunk carryover"):
+        payload = fixed_records(200)
+        chunks = [payload[i:i + 5] for i in range(0, len(payload), 5)]
+        streamer = CobolStreamer(FIXED_COPYBOOK)
+        rows = []
+        for batch in streamer.stream_chunks(iter(chunks)):
+            rows.extend(batch.to_rows())
+        whole = CobolStreamer(FIXED_COPYBOOK)._batch(payload).to_rows()
+        assert rows == whole
+        with pytest.raises(ValueError, match="mid-record"):
+            list(CobolStreamer(FIXED_COPYBOOK).stream_chunks(
+                [payload[:FIXED_RS + 3]]))
+
+
+# -- serve follow mode ----------------------------------------------------
+
+
+class _CuttingProxy:
+    """Forward to a server, hard-drop after N server->client bytes."""
+
+    def __init__(self, target, cut_after):
+        self.target = tuple(target)
+        self.cut_after = cut_after
+        proxy = self
+
+        class _H(socketserver.BaseRequestHandler):
+            def handle(self):
+                upstream = socket.create_connection(proxy.target,
+                                                    timeout=10)
+                stop = threading.Event()
+
+                def c2s():
+                    try:
+                        while not stop.is_set():
+                            data = self.request.recv(65536)
+                            if not data:
+                                break
+                            upstream.sendall(data)
+                    except OSError:
+                        pass
+
+                t = threading.Thread(target=c2s, daemon=True)
+                t.start()
+                sent = 0
+                try:
+                    while sent < proxy.cut_after:
+                        data = upstream.recv(
+                            min(65536, proxy.cut_after - sent))
+                        if not data:
+                            break
+                        self.request.sendall(data)
+                        sent += len(data)
+                finally:
+                    stop.set()
+                    try:
+                        self.request.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+                    self.request.close()
+                    upstream.close()
+
+        self._srv = socketserver.ThreadingTCPServer(("127.0.0.1", 0),
+                                                    _H)
+        self._srv.daemon_threads = True
+        self.address = self._srv.server_address
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def test_follow_mode_parity_and_metrics(tmp_path):
+    """A follow subscription over a growing file == one-shot read of
+    the final file; lag/watermark metrics move during the run; the
+    trailer token carries the watermark; audit records follow=True."""
+    from cobrix_tpu import prometheus_text
+    from cobrix_tpu.obs.audit import read_audit_log
+    from cobrix_tpu.serve import ScanServer
+    from cobrix_tpu.serve.client import stream_scan
+
+    with hard_timeout(180, "follow parity"):
+        src = tmp_path / "feed.dat"
+        total = 3000
+        src.write_bytes(fixed_records(800))
+        audit = tmp_path / "audit.log"
+        srv = ScanServer(audit_log=str(audit)).start()
+        try:
+            appender = LiveAppender(str(src),
+                                    fixed_records(total - 800, 800),
+                                    slice_sizes=(401, 13, 77),
+                                    pause_s=0.002).start()
+            stream = stream_scan(
+                srv.address, str(src), copybook_contents=FIXED_COPYBOOK,
+                follow={"poll_interval_s": 0.02, "idle_timeout_s": 5.0},
+                max_records=total)
+            batches = list(stream)
+            appender.join(10)
+            got = bare(pa.Table.from_batches(batches))
+            assert got.equals(one_shot(str(src),
+                                       copybook_contents=FIXED_COPYBOOK))
+            token = (stream.summary or {}).get("resume_token") or {}
+            assert token.get("watermark"), "trailer token lacks watermark"
+            assert stream.summary.get("follow") is True
+            text = prometheus_text()
+            assert "cobrix_serve_follow_sessions_total" in text
+            assert "cobrix_stream_batches_total" in text
+            records = [r for r in read_audit_log(str(audit))
+                       if r.request_id == stream.request_id]
+            assert records and records[0].follow is True
+            assert records[0].outcome == "ok"
+        finally:
+            srv.stop()
+
+
+def test_follow_failover_resumes_exactly_once(tmp_path):
+    """A follow subscriber surviving a replica cut mid-stream receives
+    the same exactly-once stream via the watermark token (PR 9 failover
+    extended to live sources)."""
+    from cobrix_tpu.serve import ScanServer
+    from cobrix_tpu.serve.client import stream_scan
+
+    with hard_timeout(300, "follow failover"):
+        src = tmp_path / "feed.dat"
+        total = 3000
+        src.write_bytes(fixed_records(total))
+        srv1 = ScanServer().start()
+        srv2 = ScanServer().start()
+        proxy = _CuttingProxy(srv1.address, cut_after=20000)
+        try:
+            stream = stream_scan(
+                [proxy.address, srv2.address], str(src),
+                copybook_contents=FIXED_COPYBOOK,
+                follow={"poll_interval_s": 0.02, "idle_timeout_s": 5.0,
+                        "batch_max_mb": 0.005},
+                max_records=total)
+            got = bare(pa.Table.from_batches(list(stream)))
+            assert stream.failovers >= 1
+            assert got.equals(one_shot(str(src),
+                                       copybook_contents=FIXED_COPYBOOK))
+        finally:
+            proxy.stop()
+            srv1.stop()
+            srv2.stop()
+
+
+def test_follow_admission_quota(tmp_path):
+    """The (max_followers + 1)-th subscription is refused with a
+    structured follower_quota rejection while the held ones stream."""
+    from cobrix_tpu.serve import ScanServer, ServeError, TenantQuota
+    from cobrix_tpu.serve.client import stream_scan
+
+    with hard_timeout(120, "follower quota"):
+        src = tmp_path / "feed.dat"
+        src.write_bytes(fixed_records(50))
+        srv = ScanServer(default_quota=TenantQuota(max_concurrent=8,
+                                                   max_followers=1)
+                         ).start()
+        try:
+            held = stream_scan(
+                srv.address, str(src), copybook_contents=FIXED_COPYBOOK,
+                follow={"poll_interval_s": 0.05, "idle_timeout_s": 30})
+            it = iter(held)
+            next(it)  # the subscription is live and holding its slot
+            with pytest.raises(ServeError) as info:
+                extra = stream_scan(
+                    srv.address, str(src),
+                    copybook_contents=FIXED_COPYBOOK, follow=True,
+                    max_failovers=0)
+                list(extra)
+            assert "follower" in str(info.value)
+            snap = srv.controller.snapshot()
+            assert snap["tenants"]["default"]["followers"] == 1
+            held.close()
+        finally:
+            srv.stop()
+
+
+def test_checkpoint_store_two_slot_alternation(tmp_path):
+    from cobrix_tpu.streaming import StreamCheckpoint
+
+    store = CheckpointStore(str(tmp_path / "ck"))
+    for i in range(5):
+        store.commit(StreamCheckpoint(delivered_records=i))
+    loaded = CheckpointStore(str(tmp_path / "ck")).load()
+    assert loaded.delivered_records == 4
+    slots = [p for p in store.slot_paths() if os.path.exists(p)]
+    assert len(slots) == 2  # both slots populated, alternating
+
+
+def test_streamcheck_sigkill_subprocess():
+    """The real-SIGKILL harness (tools/streamcheck.py): consumer
+    subprocesses killed by os._exit AND a parent SIGKILL mid-ingest,
+    restarted from the checkpoint, byte-identical output (the tier-1
+    smoke; --sweep widens it under the slow tier)."""
+    import importlib.util
+
+    with hard_timeout(300, "streamcheck"):
+        spec = importlib.util.spec_from_file_location(
+            "streamcheck", os.path.join(os.path.dirname(__file__),
+                                        os.pardir, "tools",
+                                        "streamcheck.py"))
+        streamcheck = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(streamcheck)
+        assert streamcheck.check_exactly_once(
+            "fixed", streamcheck.make_records(1500),
+            {"copybook_contents": streamcheck.COPYBOOK}, kill_cycles=2)
+
+
+@pytest.mark.slow
+def test_kill_restart_fuzz_sweep(tmp_path):
+    """Wider randomized kill-point sweep (the slow tier of the chaos
+    matrix)."""
+    with hard_timeout(600, "fuzz sweep"):
+        for seed in range(4):
+            rng = random.Random(seed)
+            payload = rdw_records(3000)
+            src = tmp_path / f"feed{seed}.dat"
+            ckpt = tmp_path / f"ck{seed}"
+            src.write_bytes(payload[:rng.randint(50, 2000)])
+            appender = LiveAppender(
+                str(src), payload[len(src.read_bytes()):],
+                slice_sizes=(rng.randint(1, 9), rng.randint(1, 50)),
+                pause_s=0.0005).start()
+            consumer = ExactlyOnceConsumer()
+
+            def make(src=src, ckpt=ckpt):
+                return tail_cobol(
+                    str(src), checkpoint_dir=str(ckpt), auto_ack=False,
+                    poll_interval_s=0.01, idle_timeout_s=0.6,
+                    finalize_on_idle=True, batch_max_mb=0.003,
+                    **VRL_OPTS)
+
+            kills = 0
+            while True:
+                crash = rng.randint(1, 8) if kills < 5 else None
+                if consumer.run(make, crash_after=crash) \
+                        and appender.done:
+                    break
+                kills += 1
+            appender.join(5)
+            assert consumer.table().equals(one_shot(str(src),
+                                                    **VRL_OPTS))

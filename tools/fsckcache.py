@@ -124,6 +124,33 @@ def check_orphans(cache_dir: str, repair: bool) -> dict:
     return stats
 
 
+def check_checkpoints(checkpoint_dir: str, repair: bool) -> dict:
+    """Verify the continuous-ingest checkpoint plane: every ``*.ckpt``
+    slot must be a CRC-clean payload (streaming.checkpoint). A stream
+    whose BOTH slots are corrupt restarts from record zero — exactly
+    once still, but a full re-drive — so flagging one bad slot early is
+    the whole point."""
+    from cobrix_tpu.io.integrity import quarantine
+    from cobrix_tpu.streaming.checkpoint import (checkpoint_files,
+                                                 verify_checkpoint_file)
+
+    stats = {"ok": 0, "corrupt": 0}
+    bad = []
+    for path in checkpoint_files(checkpoint_dir):
+        defect = verify_checkpoint_file(path)
+        if defect is None:
+            stats["ok"] += 1
+        else:
+            stats["corrupt"] += 1
+            bad.append((path, defect))
+    if repair:
+        for path, _why in bad:
+            quarantine(path, os.path.join(checkpoint_dir, "quarantine"))
+        stats["repaired"] = len(bad)
+    stats["bad_entries"] = [p for p, _ in bad]
+    return stats
+
+
 def check_quarantine(cache_dir: str) -> dict:
     root = os.path.join(cache_dir, "quarantine")
     try:
@@ -134,8 +161,10 @@ def check_quarantine(cache_dir: str) -> dict:
 
 
 def fsck(cache_dir: str, repair: bool = False,
-         out=sys.stdout) -> bool:
-    """Verify one cache root; True when clean (or repaired)."""
+         out=sys.stdout, checkpoint_dir: str = "") -> bool:
+    """Verify one cache root (and optionally a checkpoint dir — it
+    also runs automatically when ``<cache_dir>/checkpoints`` exists);
+    True when clean (or repaired)."""
     if not os.path.isdir(cache_dir):
         print(f"fsckcache: {cache_dir} is not a directory", file=out)
         return False
@@ -143,18 +172,25 @@ def fsck(cache_dir: str, repair: bool = False,
     index = check_index(cache_dir, repair)
     orphans = check_orphans(cache_dir, repair)
     quarantined = check_quarantine(cache_dir)
+    ckpt_root = checkpoint_dir or os.path.join(cache_dir, "checkpoints")
+    ckpts = (check_checkpoints(ckpt_root, repair)
+             if os.path.isdir(ckpt_root)
+             else {"ok": 0, "corrupt": 0, "bad_entries": []})
     print(f"blocks : {blocks['ok']} ok, {blocks['corrupt']} corrupt, "
           f"{blocks['unparseable_name']} unparseable", file=out)
     print(f"index  : {index['ok']} ok, {index['corrupt']} corrupt, "
           f"{index['stale_format']} stale-format", file=out)
+    print(f"ckpts  : {ckpts['ok']} ok, {ckpts['corrupt']} corrupt",
+          file=out)
     print(f"orphans: {orphans['tmp_orphans']} temp file(s)"
           + (f", swept {orphans['swept']}" if repair else ""), file=out)
     print(f"quarantine: {quarantined['held']} held entr(ies)", file=out)
-    for path in blocks["bad_entries"] + index["bad_entries"]:
+    for path in (blocks["bad_entries"] + index["bad_entries"]
+                 + ckpts["bad_entries"]):
         print(f"  CORRUPT {path}"
               + ("  [quarantined]" if repair else ""), file=out)
     corrupt = (blocks["corrupt"] + blocks["unparseable_name"]
-               + index["corrupt"])
+               + index["corrupt"] + ckpts["corrupt"])
     return corrupt == 0 or repair
 
 
@@ -208,6 +244,21 @@ def smoke() -> bool:
         fail("--repair did not leave the cache clean")
     if not fsck(cache_dir, out=open(os.devnull, "w")):
         fail("cache not clean after repair")
+    # checkpoint plane: a committed ingest checkpoint verifies, a
+    # corrupted slot is flagged and --repair quarantines it
+    ckpt_dir = os.path.join(cache_dir, "checkpoints")
+    from cobrix_tpu.streaming import CheckpointStore, StreamCheckpoint
+
+    store = CheckpointStore(ckpt_dir)
+    store.commit(StreamCheckpoint(delivered_records=7))
+    ckpts = check_checkpoints(ckpt_dir, repair=False)
+    if ckpts["ok"] != 1 or ckpts["corrupt"]:
+        fail(f"fresh checkpoint did not verify: {ckpts}")
+    corrupt_cache_entry(ckpt_dir, "checkpoint", "bitflip")
+    if fsck(cache_dir, out=open(os.devnull, "w")):
+        fail("corrupt checkpoint slot reported clean")
+    if not fsck(cache_dir, repair=True, out=open(os.devnull, "w")):
+        fail("--repair did not clear the checkpoint plane")
     # ENOSPC on cache writes degrades, never fails the scan
     import shutil
 
@@ -236,6 +287,10 @@ def main() -> int:
                     help="cache root to verify")
     ap.add_argument("--repair", action="store_true",
                     help="quarantine corrupt entries and sweep orphans")
+    ap.add_argument("--checkpoint-dir", default="",
+                    help="continuous-ingest checkpoint dir to verify "
+                         "(default: <cache_dir>/checkpoints when it "
+                         "exists)")
     ap.add_argument("--smoke", action="store_true",
                     help="self-test on a throwaway cache (no network)")
     args = ap.parse_args()
@@ -243,7 +298,8 @@ def main() -> int:
         return 0 if smoke() else 1
     if not args.cache_dir:
         ap.error("give a cache_dir or --smoke")
-    return 0 if fsck(args.cache_dir, repair=args.repair) else 1
+    return 0 if fsck(args.cache_dir, repair=args.repair,
+                     checkpoint_dir=args.checkpoint_dir) else 1
 
 
 if __name__ == "__main__":

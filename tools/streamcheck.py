@@ -1,0 +1,281 @@
+"""Continuous-ingestion smoke check: exactly-once under SIGKILL.
+
+Drives cobrix_tpu.streaming end to end the way the chaos matrix
+(ISSUE 10) demands:
+
+  1. a LiveAppender grows a fixed-length file in torn, non-record-
+     aligned increments while a consumer SUBPROCESS tails it with a
+     durable checkpoint dir, appending every delivered batch to an
+     output log and acking each batch with the output length
+     (`app_state`) — the exactly-once recipe;
+  2. the consumer is killed repeatedly — both by its own os._exit
+     mid-stream and by a parent SIGKILL at a random instant — and
+     restarted from the checkpoint until the feed drains;
+  3. the concatenation of the surviving output batches MUST be
+     byte-identical to a one-shot `read_cobol(...).to_arrow()` of the
+     final file: zero duplicates, zero gaps, monotone Record_Ids,
+     across every kill;
+  4. follow-mode parity: a serve-tier ``follow=true`` subscription over
+     the same growing source must deliver the identical table, and
+     `/metrics` must report the `cobrix_stream_*` series.
+
+    python tools/streamcheck.py             # quick (~2 kill cycles)
+    python tools/streamcheck.py --sweep     # fixed + VRL x more kills
+                                            # (slow; tier-1 runs quick)
+
+Exit code 0 = every assertion held; 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+COPYBOOK = """
+        01  R.
+            05  KEY    PIC 9(7) COMP.
+            05  NAME   PIC X(9).
+"""
+RECORD_BYTES = 13
+
+
+def make_records(n: int, start: int = 0) -> bytes:
+    return b"".join(
+        (start + i).to_bytes(4, "big")
+        + f"ROW{(start + i) % 1000000:06d}".encode("ascii")
+        for i in range(n))
+
+
+def make_rdw_records(n: int, start: int = 0) -> bytes:
+    out = []
+    for i in range(start, start + n):
+        payload = f"K{i:05d}".encode("cp037")
+        out.append(bytes([0, 0, len(payload) % 256,
+                          len(payload) // 256]) + payload)
+    return b"".join(out)
+
+
+RDW_COPYBOOK = """
+        01  R.
+            05  K  PIC X(6).
+"""
+
+
+# -- durable output log (the consumer side of exactly-once) ---------------
+
+def append_batch(out_path: str, table) -> int:
+    """Serialize one Arrow table as a length-framed IPC segment,
+    append + fsync, return the new durable length (the app_state the
+    matching ack commits)."""
+    import pyarrow as pa
+
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    payload = sink.getvalue().to_pybytes()
+    with open(out_path, "ab") as f:
+        f.write(struct.pack(">I", len(payload)) + payload)
+        f.flush()
+        os.fsync(f.fileno())
+        return f.tell()
+
+
+def read_output(out_path: str):
+    """Every complete framed segment -> list of tables (a torn final
+    frame — the crash window — is ignored, exactly what truncate-to-
+    app_state would have removed)."""
+    import pyarrow as pa
+
+    tables = []
+    try:
+        data = open(out_path, "rb").read()
+    except OSError:
+        return tables
+    pos = 0
+    while pos + 4 <= len(data):
+        (n,) = struct.unpack(">I", data[pos:pos + 4])
+        if pos + 4 + n > len(data):
+            break
+        with pa.ipc.open_stream(data[pos + 4:pos + 4 + n]) as r:
+            tables.append(r.read_all())
+        pos += 4 + n
+    return tables
+
+
+def consume(source: str, checkpoint_dir: str, out_path: str,
+            crash_after: int, options: dict) -> int:
+    """The consumer subprocess body: resume from the checkpoint,
+    truncate the output to the committed app_state, ingest + ack until
+    idle, optionally dying after `crash_after` batches. Exit 0 = feed
+    idle (caller decides whether it is truly done)."""
+    from cobrix_tpu.streaming import tail_cobol
+
+    ing = tail_cobol(source, checkpoint_dir=checkpoint_dir,
+                     auto_ack=False, poll_interval_s=0.05,
+                     idle_timeout_s=1.0, finalize_on_idle=True,
+                     **options)
+    committed = int(ing.app_state or 0)
+    with open(out_path, "ab") as f:
+        f.truncate(committed)
+    batches = 0
+    for batch in ing:
+        new_len = append_batch(out_path, batch.to_arrow())
+        batch.ack(app_state=new_len)
+        batches += 1
+        if crash_after and batches >= crash_after:
+            os._exit(137)  # SIGKILL-shaped: no cleanup, no flush
+    return 0
+
+
+def _spawn_consumer(source, checkpoint_dir, out_path, crash_after,
+                    options) -> subprocess.Popen:
+    import json as _json
+
+    code = (
+        "import sys, json; sys.path.insert(0, {root!r});\n"
+        "import importlib.util as iu;\n"
+        "spec = iu.spec_from_file_location('streamcheck', {me!r});\n"
+        "m = iu.module_from_spec(spec); spec.loader.exec_module(m);\n"
+        "sys.exit(m.consume({src!r}, {ckpt!r}, {out!r}, {crash!r}, "
+        "json.loads({opts!r})))"
+    ).format(root=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        me=os.path.abspath(__file__), src=source, ckpt=checkpoint_dir,
+        out=out_path, crash=crash_after,
+        opts=_json.dumps(options))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen([sys.executable, "-c", code], env=env)
+
+
+def check_exactly_once(tag: str, payload: bytes, options: dict,
+                       kill_cycles: int = 3,
+                       parent_kill: bool = True) -> bool:
+    """Grow a file tornly, kill/restart the consumer `kill_cycles`
+    times, assert the output equals the one-shot read."""
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing.faults import LiveAppender
+    import pyarrow as pa
+
+    work = tempfile.mkdtemp(prefix=f"streamcheck-{tag}-")
+    src = os.path.join(work, "feed.dat")
+    ckpt = os.path.join(work, "ckpt")
+    out = os.path.join(work, "out.bin")
+    open(src, "wb").write(payload[:len(payload) // 4])
+    appender = LiveAppender(src, payload[len(payload) // 4:],
+                            slice_sizes=(7, 3, 11, 2, 29),
+                            pause_s=0.005).start()
+    cycles = 0
+    deadline = time.monotonic() + 180
+    while True:
+        crash_after = 2 if cycles < kill_cycles else 0
+        proc = _spawn_consumer(src, ckpt, out, crash_after, options)
+        if parent_kill and cycles == 1:
+            # one cycle dies by PARENT SIGKILL at a random instant
+            # instead of a self-crash
+            time.sleep(0.2 + 0.3 * (cycles % 2))
+            proc.send_signal(signal.SIGKILL)
+        rc = proc.wait()
+        cycles += 1
+        if rc == 0 and appender.done:
+            break  # drained an idle feed after the appender finished
+        if time.monotonic() > deadline:
+            print(f"FAIL [{tag}]: kill/restart loop did not drain "
+                  f"within 180s (rc={rc})")
+            return False
+    tables = read_output(out)
+    if not tables:
+        print(f"FAIL [{tag}]: no output batches survived")
+        return False
+    got = pa.concat_tables(tables).replace_schema_metadata(None)
+    want = read_cobol(src, **options).to_arrow() \
+        .replace_schema_metadata(None)
+    if not got.equals(want):
+        print(f"FAIL [{tag}]: output != one-shot read "
+              f"({got.num_rows} vs {want.num_rows} rows over "
+              f"{cycles} kill cycles)")
+        return False
+    print(f"ok [{tag}]: {got.num_rows} rows byte-identical across "
+          f"{cycles} kill/restart cycles ({len(tables)} batches)")
+    return True
+
+
+def check_follow_parity() -> bool:
+    """Serve-tier follow mode over a growing file == one-shot read, and
+    the stream metrics are live during the run."""
+    from cobrix_tpu import prometheus_text, read_cobol
+    from cobrix_tpu.serve import ScanServer
+    from cobrix_tpu.serve.client import stream_scan
+    from cobrix_tpu.testing.faults import LiveAppender
+    import pyarrow as pa
+
+    work = tempfile.mkdtemp(prefix="streamcheck-follow-")
+    src = os.path.join(work, "feed.dat")
+    total = 4000
+    open(src, "wb").write(make_records(1000))
+    appender = LiveAppender(src, make_records(total - 1000, 1000),
+                            slice_sizes=(501, 13, 77),
+                            pause_s=0.002)
+    srv = ScanServer().start()
+    try:
+        appender.start()
+        stream = stream_scan(
+            srv.address, src, copybook_contents=COPYBOOK,
+            follow={"poll_interval_s": 0.05, "idle_timeout_s": 5.0},
+            max_records=total)
+        batches = list(stream)
+        got = pa.Table.from_batches(batches) \
+            .replace_schema_metadata(None)
+        appender.join(10)
+        want = read_cobol(src, copybook_contents=COPYBOOK) \
+            .to_arrow().replace_schema_metadata(None)
+        if not got.equals(want):
+            print(f"FAIL [follow]: subscription table != one-shot "
+                  f"({got.num_rows} vs {want.num_rows} rows)")
+            return False
+        token = (stream.summary or {}).get("resume_token") or {}
+        if not token.get("watermark"):
+            print("FAIL [follow]: trailer token carries no watermark")
+            return False
+        text = prometheus_text()
+        if "cobrix_stream_batches_total" not in text:
+            print("FAIL [follow]: cobrix_stream_* metrics missing")
+            return False
+        print(f"ok [follow]: {got.num_rows} rows streamed live, "
+              "watermark token + stream metrics present")
+        return True
+    finally:
+        srv.stop()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sweep", action="store_true",
+                    help="fixed + VRL, more kill cycles (slow)")
+    ap.add_argument("--records", type=int, default=6000)
+    args = ap.parse_args()
+    fixed_opts = {"copybook_contents": COPYBOOK}
+    ok = check_exactly_once(
+        "fixed", make_records(args.records), fixed_opts,
+        kill_cycles=3 if not args.sweep else 5)
+    if args.sweep:
+        vrl_opts = {"copybook_contents": RDW_COPYBOOK,
+                    "is_record_sequence": "true",
+                    "generate_record_id": "true"}
+        ok = check_exactly_once(
+            "vrl", make_rdw_records(args.records), vrl_opts,
+            kill_cycles=5) and ok
+    ok = check_follow_parity() and ok
+    print("STREAMCHECK", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
