@@ -112,38 +112,136 @@ def _axon_relay_down():
     return True
 
 
-def _probe_jax(timeouts=(60, 90, 150)):
-    """Check device init in a subprocess first — a wedged TPU tunnel would
-    hang this process forever. Retries with growing timeouts (round 2's
-    single 60s attempt conflated a transient tunnel stall with absence)
-    and returns (platform | None, probe | None): `probe` is ONE
-    structured dict ({"error", "attempts": [{"timeout_s", "error"}...]})
-    recorded in the BENCH JSON, replacing the old repeated warning lines,
-    so WHY the device path did not run survives as data (VERDICT r2
-    weak #1)."""
+# the device-probe contract (ROADMAP item 3 first step): ONE bounded
+# subprocess attempt under a HARD deadline — the 60/90/150s escalation
+# burned 5 minutes per round once the tunnel wedged permanently
+# (BENCH_r04/r05 "timed out after 30s" was actually this ladder) — plus
+# a small on-disk cache so platform detection survives ACROSS bench
+# runs: a cached success answers instantly, a cached failure skips the
+# wait entirely (with the original reason preserved) until its TTL
+# lapses. Every no-device outcome carries a structured `skip_reason`
+# in the BENCH JSON so CI shows WHY the device is unmeasured.
+PROBE_DEADLINE_S = float(os.environ.get("BENCH_JAX_PROBE_DEADLINE_S",
+                                        "45"))
+PROBE_FAIL_TTL_S = float(os.environ.get("BENCH_JAX_PROBE_FAIL_TTL_S",
+                                        "1800"))
+PROBE_OK_TTL_S = float(os.environ.get("BENCH_JAX_PROBE_OK_TTL_S",
+                                      "86400"))
+
+
+def _probe_cache_path() -> str:
+    return os.environ.get("COBRIX_JAX_PROBE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "cobrix_tpu",
+        "jax_probe.json")
+
+
+def _probe_env_fingerprint() -> str:
+    """Cache key: anything that changes which device jax would find.
+    A different interpreter, platform pin, or relay pool must never
+    reuse another configuration's answer."""
+    import hashlib
+
+    parts = [sys.executable,
+             os.environ.get("JAX_PLATFORMS", ""),
+             os.environ.get("PALLAS_AXON_POOL_IPS", ""),
+             os.environ.get("COBRIX_TPU_TESTS", "")]
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()[:16]
+
+
+def _probe_cache_load() -> dict:
+    try:
+        with open(_probe_cache_path(), encoding="utf-8") as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) else {}
+    except (OSError, ValueError):
+        return {}
+
+
+def _probe_cache_store(entry: dict) -> None:
+    try:
+        from cobrix_tpu.utils.atomic import write_atomic
+
+        doc = _probe_cache_load()
+        doc[_probe_env_fingerprint()] = entry
+        path = _probe_cache_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        write_atomic(path, json.dumps(doc, sort_keys=True))
+    except OSError:
+        pass  # an unwritable cache just means re-probing next run
+
+
+def _probe_jax(deadline_s=None, use_cache=True):
+    """Bounded device detection: returns ``(platform | None, probe |
+    None)``. `probe` is None when a device answered; otherwise ONE
+    structured dict — ``{"skip_reason", "error", "deadline_s",
+    "cached", "attempts"}`` — embedded in the BENCH JSON as
+    ``jax_probe`` so WHY the device path did not run survives as data.
+
+    skip_reason vocabulary: ``relay_down`` (loopback relay ports
+    closed — no probe can succeed), ``init_timeout`` (jax init blew the
+    hard deadline and was killed), ``init_error`` (init failed fast),
+    ``cached_failure`` (a previous run's failure is still inside its
+    TTL — the original reason rides along in ``error``)."""
     if os.environ.get("BENCH_FORCE_CPU"):
         return "cpu", None
+    deadline = (PROBE_DEADLINE_S if deadline_s is None
+                else max(1.0, float(deadline_s)))
+    if use_cache:
+        entry = _probe_cache_load().get(_probe_env_fingerprint())
+        if isinstance(entry, dict) and "ts" in entry:
+            age = time.time() - float(entry.get("ts") or 0)
+            if entry.get("platform") and age < PROBE_OK_TTL_S:
+                _log(f"jax platform '{entry['platform']}' from probe "
+                     f"cache ({age:.0f}s old)")
+                return entry["platform"], None
+            if not entry.get("platform") and age < PROBE_FAIL_TTL_S:
+                probe = {
+                    "skip_reason": "cached_failure",
+                    "error": (f"cached {entry.get('skip_reason')} "
+                              f"{age:.0f}s ago: "
+                              f"{entry.get('error') or ''}").strip(),
+                    "deadline_s": deadline, "cached": True,
+                    "attempts": []}
+                _log(f"jax probe skipped: {probe['error']} "
+                     f"(retry after {PROBE_FAIL_TTL_S - age:.0f}s or "
+                     "clear the probe cache)")
+                return None, probe
     if _axon_relay_down():
-        # one short confirmation probe in case the relay model changed
-        timeouts = (30,)
-        _log("axon relay ports closed; single short probe only")
-    attempts = []
-    for t in timeouts:
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax; print(jax.devices()[0].platform)"],
-                timeout=t, capture_output=True, text=True)
-            if proc.returncode == 0 and proc.stdout.strip():
-                return proc.stdout.strip().splitlines()[-1], None
-            err = (proc.stderr or "jax init failed").strip()[-400:]
-        except subprocess.TimeoutExpired:
-            err = f"jax device init timed out after {t}s"
-        attempts.append({"timeout_s": t, "error": err})
-    probe = {"error": attempts[-1]["error"] if attempts else None,
-             "attempts": attempts}
-    _log(f"jax probe failed after {len(attempts)} attempt(s): "
-         f"{probe['error']}")
+        # no relay listener can possibly answer; probing would only
+        # burn the deadline — record the reason and move on
+        probe = {"skip_reason": "relay_down",
+                 "error": "axon loopback relay ports closed "
+                          "(no TPU tunnel listener)",
+                 "deadline_s": deadline, "cached": False,
+                 "attempts": []}
+        _probe_cache_store({"skip_reason": "relay_down",
+                            "error": probe["error"],
+                            "ts": time.time()})
+        _log(f"jax probe skipped: {probe['error']}")
+        return None, probe
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            timeout=deadline, capture_output=True, text=True)
+        if proc.returncode == 0 and proc.stdout.strip():
+            platform = proc.stdout.strip().splitlines()[-1]
+            _probe_cache_store({"platform": platform,
+                                "ts": time.time()})
+            return platform, None
+        skip_reason = "init_error"
+        err = (proc.stderr or "jax init failed").strip()[-400:]
+    except subprocess.TimeoutExpired:
+        # subprocess.run killed the child at the deadline — the HARD
+        # bound: the bench never waits longer than this, ever
+        skip_reason = "init_timeout"
+        err = f"jax device init exceeded the {deadline:.0f}s deadline"
+    probe = {"skip_reason": skip_reason, "error": err,
+             "deadline_s": deadline, "cached": False,
+             "attempts": [{"timeout_s": deadline, "error": err}]}
+    _probe_cache_store({"skip_reason": skip_reason, "error": err,
+                        "ts": time.time()})
+    _log(f"jax probe failed ({skip_reason}): {err}")
     return None, probe
 
 
@@ -786,10 +884,10 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
 
-    # with an explicit backend the operator wants the number NOW — probe
-    # once with a short timeout instead of the 3-retry escalation
+    # with an explicit backend the operator wants the number NOW — use
+    # a shorter hard deadline (the cache usually answers instantly)
     platform, probe = _probe_jax(
-        timeouts=((45,) if backend else (60, 90, 150)))
+        deadline_s=(20 if backend else None))
     device_status = platform if platform else "unavailable"
     if not platform:
         _log(f"WARNING: jax unavailable: {probe['error']}")
@@ -841,7 +939,9 @@ def main():
         # work has burned several minutes: a transient outage at probe
         # time must not forfeit the round's only chance at TPU evidence
         _log("re-probing the device at end of run")
-        platform, retry_probe = _probe_jax(timeouts=(60, 120))
+        # fresh probe, cache bypassed: a transient outage at bench
+        # start must not forfeit the round's only chance at evidence
+        platform, retry_probe = _probe_jax(use_cache=False)
         if platform:
             device_status = platform
             probe = None

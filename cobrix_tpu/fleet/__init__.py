@@ -1,0 +1,30 @@
+"""cobrix_tpu.fleet — the cluster-level observability plane.
+
+N serving replicas sharing one ``cache_dir`` stop being observability
+islands: each replica heartbeats a CRC-stamped record into the shared
+cache root (`registry.py`), any replica (or an operator tool) federates
+every live replica's Prometheus exposition / health / SLO status into
+one cluster view (`federate.py`), and the merged view is distilled into
+an autoscaling recommendation record (`signals.py`). Served per replica
+as ``/fleet/{replicas,metrics,slo,signals}`` (serve/http.py) and
+rendered by ``tools/fleetview.py``; ``tools/fleetcheck.py`` is the
+3-replica end-to-end proof.
+
+Everything here is OFF unless `ScanServer(fleet=True)` /
+``python -m cobrix_tpu.serve --fleet`` opts in: a non-fleet server
+never imports this package, writes no heartbeat, takes no timestamp —
+the zero-overhead contract the tests counter-assert.
+"""
+from .federate import FleetFederator, FleetMergeError, FleetView
+from .registry import Heartbeater, ReplicaRecord, ReplicaRegistry
+from .signals import derive_signals
+
+__all__ = [
+    "FleetFederator",
+    "FleetMergeError",
+    "FleetView",
+    "Heartbeater",
+    "ReplicaRecord",
+    "ReplicaRegistry",
+    "derive_signals",
+]

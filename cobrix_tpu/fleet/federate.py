@@ -1,0 +1,404 @@
+"""Federate replica telemetry into one cluster view.
+
+`FleetFederator` reads the replica registry, scrapes every live/stale
+replica's ``/metrics`` (Prometheus text), ``/healthz``, and
+``/debug/slo`` over HTTP with a hard per-request timeout, and merges:
+
+* **counters** — summed. The cluster sample is the exact arithmetic
+  sum of the per-replica samples (integers stay integers), which is
+  what lets fleetcheck assert byte-exact totals over a quiesced fleet.
+* **gauges** — by the policy DECLARED next to the metric definition
+  (`obs.metrics.FLEET_GAUGE_MERGE`): ``sum`` for capacity-like gauges,
+  ``max`` for worst-of-fleet ages/uptime.
+* **histograms** — bucket-wise sums. Bucket boundaries must be
+  identical across replicas (the registry pins them per metric name —
+  `MetricsRegistry.histogram` asserts the invariant at registration);
+  a mismatch here raises the structured `FleetMergeError` instead of
+  merging garbage quantiles.
+
+The merged exposition carries every per-replica series re-labeled with
+``replica="<id>"`` plus the cluster-total series without the replica
+label — one scrape answers both "which replica" and "how much overall",
+and the output is itself a valid exposition (`obs.promparse.validate`
+clean, so a higher aggregation layer can scrape a federating replica).
+
+A replica that dies mid-scrape (connection refused, timeout, half-open
+socket) yields a PARTIAL fleet view: its registry entry is annotated
+with the scrape error and its series are absent from the merge — never
+a crash, never a hang past ``timeout_s``.
+
+The federator also keeps a bounded in-memory history of scrape
+snapshots, which is what turns cumulative counters into windowed rates
+(`fleet.signals` consumes it for queue-wait/rejection deltas, and the
+SLO rollup for fleet-wide fast/slow burn).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..obs import promparse
+from ..obs.metrics import FLEET_GAUGE_MERGE
+from .registry import ReplicaRegistry, ReplicaStatus
+
+# scrape snapshots kept for windowed-rate math (signals, burn): at the
+# default ~1 scrape/s cache this covers the slow burn window
+HISTORY_KEEP_S = 900.0
+
+
+class FleetMergeError(RuntimeError):
+    """Structured federation refusal (e.g. histogram bucket-boundary
+    mismatch between replicas — merging those buckets would produce
+    silently wrong quantiles)."""
+
+    def __init__(self, metric: str, detail: str,
+                 replicas: Optional[dict] = None):
+        super().__init__(f"cannot federate metric '{metric}': {detail}")
+        self.metric = metric
+        self.detail = detail
+        self.replicas = replicas or {}
+
+
+@dataclass
+class ReplicaScrape:
+    """One replica's scrape result (families is None on failure)."""
+
+    status: ReplicaStatus
+    families: Optional[Dict[str, promparse.Family]] = None
+    healthz: Optional[dict] = None
+    slo: Optional[dict] = None
+    error: str = ""
+
+    @property
+    def replica_id(self) -> str:
+        return self.status.record.replica_id
+
+
+@dataclass
+class FleetView:
+    """Everything one federation pass learned."""
+
+    scraped_at: float
+    replicas: List[ReplicaScrape] = field(default_factory=list)
+
+    def reachable(self) -> List[ReplicaScrape]:
+        return [r for r in self.replicas if r.families is not None]
+
+    def live(self) -> List[ReplicaScrape]:
+        return [r for r in self.replicas if r.status.state == "live"]
+
+    def replicas_doc(self) -> dict:
+        """The `/fleet/replicas` JSON document."""
+        out = []
+        for r in self.replicas:
+            doc = r.status.as_dict()
+            doc["reachable"] = r.families is not None
+            if r.error:
+                doc["scrape_error"] = r.error
+            out.append(doc)
+        return {"replicas": out,
+                "live": sum(1 for r in self.replicas
+                            if r.status.state == "live"),
+                "scraped_at": self.scraped_at}
+
+
+def _http_get(url: str, timeout_s: float) -> bytes:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return resp.read()
+
+
+def default_fetcher(timeout_s: float) -> Callable:
+    """fetch(status) -> (metrics_text, healthz_dict, slo_dict); raises
+    on any transport failure. Separated so tests inject synthetic
+    expositions and dead replicas without sockets."""
+
+    def fetch(status: ReplicaStatus):
+        addr = status.record.http_address
+        if not addr:
+            raise ConnectionError("replica record carries no "
+                                  "http_address")
+        base = f"http://{addr[0]}:{int(addr[1])}"
+        text = _http_get(f"{base}/metrics", timeout_s).decode("utf-8")
+        health = json.loads(_http_get(f"{base}/healthz", timeout_s))
+        try:
+            slo = json.loads(_http_get(f"{base}/debug/slo", timeout_s))
+        except Exception:
+            slo = {}
+        return text, health, slo
+
+    return fetch
+
+
+# -- the merge --------------------------------------------------------------
+
+def _strip_replica(labels) -> tuple:
+    return tuple(p for p in labels if p[0] != "replica")
+
+
+def _with_replica(labels, replica_id: str) -> tuple:
+    return tuple(sorted(_strip_replica(labels)
+                        + (("replica", replica_id),)))
+
+
+def _bucket_boundaries(fam: promparse.Family) -> tuple:
+    """Raw ``le`` strings (for mismatch error messages), ordered by
+    their numeric bound."""
+    bounds = set()
+    for s in fam.samples:
+        if s.name == fam.name + "_bucket":
+            le = dict(s.labels).get("le")
+            if le is not None:
+                bounds.add(le)
+
+    def key(b):
+        try:
+            return promparse.le_bound(b)
+        except ValueError:
+            return float("inf")
+
+    return tuple(sorted(bounds, key=key))
+
+
+def merge_expositions(per_replica: "Dict[str, Dict[str, promparse.Family]]",
+                      gauge_merge: Optional[dict] = None
+                      ) -> "Dict[str, promparse.Family]":
+    """Merge each replica's parsed exposition into one cluster family
+    dict: per-replica series get a ``replica`` label, cluster totals
+    ride label-free alongside. Raises FleetMergeError on histogram
+    bucket-boundary disagreement."""
+    gauge_merge = (FLEET_GAUGE_MERGE if gauge_merge is None
+                   else gauge_merge)
+    names: List[str] = []
+    for fams in per_replica.values():
+        for name in fams:
+            if name not in names:
+                names.append(name)
+    out: Dict[str, promparse.Family] = {}
+    for name in sorted(names):
+        sources = {rid: fams[name] for rid, fams in per_replica.items()
+                   if name in fams}
+        first = next(iter(sources.values()))
+        merged = promparse.Family(name=name, kind=first.kind,
+                                  help=first.help)
+        if first.kind == "histogram":
+            layouts = {rid: _bucket_boundaries(f)
+                       for rid, f in sources.items()}
+            distinct = set(layouts.values())
+            if len(distinct) > 1:
+                raise FleetMergeError(
+                    name, "histogram bucket boundaries differ across "
+                    "replicas (mixed code versions?); refusing a "
+                    "bucket-wise merge that would fabricate quantiles",
+                    replicas={rid: list(b) for rid, b in
+                              layouts.items()})
+        # per-replica series, replica-labeled
+        cluster: Dict[Tuple[str, tuple], float] = {}
+        policy = gauge_merge.get(name, "sum")
+        for rid in sorted(sources):
+            for s in sources[rid].samples:
+                merged.samples.append(promparse.Sample(
+                    s.name, _with_replica(s.labels, rid), s.value))
+                key = (s.name, _strip_replica(s.labels))
+                if first.kind == "gauge" and policy == "max":
+                    cur = cluster.get(key)
+                    cluster[key] = (s.value if cur is None
+                                    else max(cur, s.value))
+                else:
+                    cluster[key] = cluster.get(key, 0.0) + s.value
+        for (sname, labels), value in sorted(cluster.items()):
+            merged.samples.append(
+                promparse.Sample(sname, labels, value))
+        out[name] = merged
+    return out
+
+
+def _prune_for_history(view: FleetView) -> FleetView:
+    """A history snapshot keeps ONLY the families windowed-rate math
+    reads (fleet.signals.HISTORY_FAMILIES) — retaining whole parsed
+    expositions (every per-tenant series, healthz, slo docs) for the
+    full HISTORY_KEEP_S would pin real memory on every federating
+    replica for no consumer."""
+    from .signals import HISTORY_FAMILIES
+
+    pruned = FleetView(scraped_at=view.scraped_at)
+    for scrape in view.replicas:
+        families = None
+        if scrape.families is not None:
+            families = {name: scrape.families[name]
+                        for name in HISTORY_FAMILIES
+                        if name in scrape.families}
+        pruned.replicas.append(ReplicaScrape(
+            status=scrape.status, families=families,
+            healthz=None, slo=None, error=scrape.error))
+    return pruned
+
+
+class FleetFederator:
+    """Registry + scraper + merge + history, with a short result cache
+    so four `/fleet/*` endpoints hitting one replica don't quadruple
+    the scrape fan-out."""
+
+    def __init__(self, registry: ReplicaRegistry,
+                 timeout_s: float = 2.0,
+                 cache_ttl_s: float = 1.0,
+                 fetcher: Optional[Callable] = None):
+        self.registry = registry
+        self.timeout_s = max(0.1, float(timeout_s))
+        self.cache_ttl_s = max(0.0, float(cache_ttl_s))
+        self._fetch = fetcher or default_fetcher(self.timeout_s)
+        self._lock = threading.Lock()
+        self._cached: Optional[FleetView] = None
+        self._cached_at = 0.0
+        # scrape history for windowed rates: [(monotonic_ts, FleetView)]
+        self._history: List[Tuple[float, FleetView]] = []
+
+    # -- scraping --------------------------------------------------------
+
+    def _scrape_one(self, status: ReplicaStatus) -> ReplicaScrape:
+        try:
+            text, health, slo = self._fetch(status)
+            return ReplicaScrape(status=status,
+                                 families=promparse.parse_text(text),
+                                 healthz=health, slo=slo)
+        except Exception as exc:
+            return ReplicaScrape(
+                status=status,
+                error=f"{type(exc).__name__}: {exc}")
+
+    def view(self, force: bool = False) -> FleetView:
+        """One federation pass (cached for `cache_ttl_s`). Scrapes run
+        on parallel daemon threads, each bounded by the fetch timeout;
+        a replica dying mid-scrape yields a partial view."""
+        now = time.monotonic()
+        with self._lock:
+            if (not force and self._cached is not None
+                    and now - self._cached_at < self.cache_ttl_s):
+                return self._cached
+        statuses = self.registry.read()
+        scrapes: List[Optional[ReplicaScrape]] = [None] * len(statuses)
+
+        def run(i: int, st: ReplicaStatus) -> None:
+            scrapes[i] = self._scrape_one(st)
+
+        threads = [threading.Thread(target=run, args=(i, st),
+                                    daemon=True)
+                   for i, st in enumerate(statuses)]
+        for t in threads:
+            t.start()
+        # default_fetcher makes up to THREE sequential bounded gets
+        # (/metrics, /healthz, /debug/slo) — the join deadline must
+        # cover all of them, or a healthy-but-slow replica would be
+        # misreported as a failed scrape
+        deadline = time.monotonic() + self.timeout_s * 3 + 1.0
+        for t in threads:
+            t.join(timeout=max(0.1, deadline - time.monotonic()))
+        view = FleetView(scraped_at=time.time())
+        for st, scrape in zip(statuses, scrapes):
+            view.replicas.append(
+                scrape if scrape is not None
+                else ReplicaScrape(status=st,
+                                   error="TimeoutError: scrape thread "
+                                         "did not finish"))
+        with self._lock:
+            self._cached = view
+            self._cached_at = time.monotonic()
+            self._history.append((time.monotonic(),
+                                  _prune_for_history(view)))
+            horizon = time.monotonic() - HISTORY_KEEP_S
+            while self._history and self._history[0][0] < horizon:
+                self._history.pop(0)
+        return view
+
+    def history(self) -> List[Tuple[float, FleetView]]:
+        with self._lock:
+            return list(self._history)
+
+    # -- the cluster exposition ------------------------------------------
+
+    def cluster_exposition(self,
+                           view: Optional[FleetView] = None) -> str:
+        view = view or self.view()
+        per_replica = {r.replica_id: r.families
+                       for r in view.reachable()}
+        merged = merge_expositions(per_replica)
+        return promparse.render(merged)
+
+    # -- the SLO rollup ---------------------------------------------------
+
+    def slo_rollup(self, view: Optional[FleetView] = None) -> dict:
+        """Cluster SLO document: per objective, the fleet-wide lifetime
+        totals and fast/slow burn (merged from each replica's
+        /debug/slo windowed counts), per-tenant counter totals (from
+        the scraped ``cobrix_slo_{good,bad}_total`` series), and the
+        per-replica breakdown — so ``/fleet/slo`` totals are exactly
+        the sums of the per-replica ``/debug/slo`` documents."""
+        view = view or self.view()
+        slos: Dict[str, dict] = {}
+        for scrape in view.reachable():
+            doc = (scrape.slo or {}).get("slo") or {}
+            for name, st in doc.items():
+                agg = slos.setdefault(name, {
+                    "kind": st.get("kind"),
+                    "threshold": st.get("threshold"),
+                    "objective": st.get("objective"),
+                    "good": 0, "bad": 0,
+                    "burn_fast": {"good": 0, "bad": 0},
+                    "burn_slow": {"good": 0, "bad": 0},
+                    "replicas": {}, "tenants": {}})
+                agg["good"] += int(st.get("good") or 0)
+                agg["bad"] += int(st.get("bad") or 0)
+                for win in ("burn_fast", "burn_slow"):
+                    w = st.get(win) or {}
+                    agg[win]["good"] += int(w.get("good") or 0)
+                    agg[win]["bad"] += int(w.get("bad") or 0)
+                    if w.get("window_s") is not None:
+                        agg[win]["window_s"] = w["window_s"]
+                agg["replicas"][scrape.replica_id] = {
+                    "good": int(st.get("good") or 0),
+                    "bad": int(st.get("bad") or 0),
+                    "burning": bool(st.get("burning"))}
+            # per-tenant totals off the Prometheus series
+            for kind, fam_name in (("good", "cobrix_slo_good_total"),
+                                   ("bad", "cobrix_slo_bad_total")):
+                fam = scrape.families.get(fam_name)
+                if fam is None:
+                    continue
+                for s in fam.samples:
+                    labels = dict(s.labels)
+                    name = labels.get("slo")
+                    tenant = labels.get("tenant")
+                    if name is None or tenant is None \
+                            or name not in slos:
+                        continue
+                    t = slos[name]["tenants"].setdefault(
+                        tenant, {"good": 0, "bad": 0})
+                    t[kind] += int(s.value)
+        for name, agg in slos.items():
+            seen = agg["good"] + agg["bad"]
+            agg["ratio"] = (round(agg["good"] / seen, 6) if seen
+                            else None)
+            objective = agg.get("objective")
+            agg["burning"] = bool(
+                seen and objective is not None
+                and agg["good"] / seen < objective)
+            budget = (1.0 - objective) if objective is not None else None
+            for win in ("burn_fast", "burn_slow"):
+                w = agg[win]
+                n = w["good"] + w["bad"]
+                w["ratio"] = round(w["bad"] / n, 6) if n else None
+                w["burn"] = (
+                    None if (w["ratio"] is None or budget is None)
+                    else (round(w["ratio"] / budget, 4) if budget > 0
+                          else (0.0 if w["ratio"] == 0
+                                else float("inf"))))
+            for tenant, t in agg["tenants"].items():
+                n = t["good"] + t["bad"]
+                t["ratio"] = round(t["good"] / n, 6) if n else None
+        return {"slo": slos,
+                "replicas_reporting": len(view.reachable()),
+                "scraped_at": view.scraped_at}

@@ -1,0 +1,325 @@
+"""Replica registry: heartbeat records in the shared ``cache_dir``.
+
+Every fleet-mode ScanServer periodically writes ONE small CRC-stamped
+JSON record under ``<cache_dir>/fleet/replicas/<replica_id>.json`` via
+the existing crash-safe planes (`utils.atomic.write_atomic` for
+atomicity, `io.integrity` stamp/verify for self-verification — a torn
+or bit-flipped heartbeat reads as absent, never as a phantom replica).
+The shared directory IS the membership protocol: no coordinator, no
+extra port — exactly how the block/index caches already share state.
+
+Liveness is judged from the record file's **mtime against the reader's
+clock**, not from the writer's self-reported wall time: the shared
+filesystem's clock is the one reference both sides can see (the same
+move as PR 4's trace clock-offset correction, which trusts a common
+axis and corrects per-process offsets). A replica whose wall clock is
+skewed still heartbeats fresh mtimes; the skew itself is surfaced as
+``clock_skew_s`` (heartbeat_at − mtime) so replica-reported wall
+timestamps (started_at) can be corrected by readers instead of
+silently lying.
+
+States:
+
+* ``live``  — age ≤ ``LIVE_FACTOR`` × interval (the replica is
+  heartbeating on schedule; federation scrapes it)
+* ``stale`` — missed beats but within ``EXPIRE_FACTOR`` × interval
+  (scraped best-effort; shown dimmed in fleetview)
+* expired   — past the expiry horizon: dropped from the view entirely,
+  and garbage-collected from disk after ``GC_FACTOR`` × interval.
+
+A SIGKILLed replica therefore degrades the fleet view to the live
+members within about one heartbeat interval — the property
+tools/fleetcheck.py pins.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+# liveness thresholds, in heartbeat intervals. LIVE_FACTOR must exceed
+# 1.0 (a healthy writer's record is up to one interval + write latency
+# old at read time) but stay tight enough that a killed replica leaves
+# the live set within about one further interval.
+LIVE_FACTOR = 1.6
+EXPIRE_FACTOR = 10.0
+GC_FACTOR = 30.0
+
+# counts every heartbeat write in this process — the zero-overhead
+# counter-assert reads it (fleet off => this module is never imported,
+# and even when another test imported it, the count must not move)
+HEARTBEAT_WRITES = 0
+
+
+@dataclass
+class ReplicaRecord:
+    """One replica's heartbeat payload (the JSON on disk, as data)."""
+
+    replica_id: str
+    pid: int = 0
+    host: str = ""
+    scan_address: Optional[List] = None   # [host, port]
+    http_address: Optional[List] = None
+    started_at: float = 0.0               # writer wall clock
+    heartbeat_at: float = 0.0             # writer wall clock at write
+    interval_s: float = 2.0
+    seq: int = 0                          # monotonic per process
+    draining: bool = False
+    pressure: str = "ok"
+    active_scans: int = 0
+    queued_scans: int = 0
+    followers: int = 0
+    max_concurrent_scans: int = 0
+    # continuous-ingest staleness: how far behind live sources this
+    # replica's follow sessions are (bytes) and how old its committed
+    # watermark is (seconds); 0/0 when nothing streams
+    lag_bytes: int = 0
+    watermark_age_s: float = 0.0
+    # cache-plane hit/miss totals since process start, per plane
+    cache: Dict[str, int] = field(default_factory=dict)
+    # hottest plan/file fingerprints on this replica: the consistent-
+    # hash routing front (ROADMAP item 5) reads these as affinity hints
+    heat: List[dict] = field(default_factory=list)
+
+    def as_dict(self) -> dict:
+        from dataclasses import asdict
+
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ReplicaRecord":
+        known = set(cls.__dataclass_fields__)
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class ReplicaStatus:
+    """A registry read result: the record plus reader-side liveness."""
+
+    record: ReplicaRecord
+    state: str            # "live" | "stale"
+    age_s: float          # now - record file mtime (reader clock)
+    clock_skew_s: float   # heartbeat_at - mtime: writer-clock offset
+
+    def as_dict(self) -> dict:
+        out = self.record.as_dict()
+        out["state"] = self.state
+        out["age_s"] = round(self.age_s, 3)
+        out["clock_skew_s"] = round(self.clock_skew_s, 3)
+        # writer wall timestamps corrected onto the common (filesystem)
+        # clock axis — PR 4's offset-correction idea applied to
+        # heartbeats, so a skewed replica's uptime still reads true
+        if self.record.started_at:
+            out["uptime_s"] = round(
+                time.time() - (self.record.started_at
+                               - self.clock_skew_s), 1)
+        return out
+
+
+def _safe_replica_id(replica_id: str) -> str:
+    """Replica ids become file names; keep them path-safe."""
+    out = "".join(c if (c.isalnum() or c in "-_.") else "-"
+                  for c in replica_id.strip())
+    return out or "replica"
+
+
+def default_replica_id() -> str:
+    return f"{socket.gethostname()}-{os.getpid()}"
+
+
+class ReplicaRegistry:
+    """Read/write the heartbeat directory under one fleet root."""
+
+    def __init__(self, root: str, interval_s: float = 2.0):
+        self.root = root
+        self.replica_dir = os.path.join(root, "replicas")
+        self.interval_s = max(0.05, float(interval_s))
+
+    # -- write side ------------------------------------------------------
+
+    def path_for(self, replica_id: str) -> str:
+        return os.path.join(self.replica_dir,
+                            _safe_replica_id(replica_id) + ".json")
+
+    def write(self, record: ReplicaRecord) -> None:
+        """One heartbeat: CRC-stamped JSON, atomic replace. No fsync —
+        a lost heartbeat is rewritten one interval later (same
+        cheap-rebuild reasoning as the block cache)."""
+        global HEARTBEAT_WRITES
+
+        from ..io.integrity import stamp_json_payload
+        from ..utils.atomic import write_atomic
+
+        os.makedirs(self.replica_dir, exist_ok=True)
+        payload = stamp_json_payload(record.as_dict())
+        write_atomic(self.path_for(record.replica_id),
+                     json.dumps(payload, sort_keys=True))
+        HEARTBEAT_WRITES += 1
+
+    def unregister(self, replica_id: str) -> None:
+        """Clean shutdown: remove the record so the fleet view drops
+        this replica immediately instead of after expiry."""
+        try:
+            os.unlink(self.path_for(replica_id))
+        except OSError:
+            pass
+
+    # -- read side -------------------------------------------------------
+
+    def read(self, now: Optional[float] = None,
+             gc: bool = False) -> List[ReplicaStatus]:
+        """Every unexpired replica, sorted by id. Corrupt records are
+        quarantined+counted (io.integrity) and skipped — a bit-flipped
+        heartbeat must read as an absent replica, never as a phantom
+        member with garbage endpoints. ``gc=True`` also unlinks records
+        past the GC horizon (the heartbeater does this occasionally;
+        plain readers never mutate)."""
+        from ..io.integrity import note_corruption, quarantine, \
+            verify_json_payload
+
+        now = time.time() if now is None else now
+        out: List[ReplicaStatus] = []
+        try:
+            names = sorted(os.listdir(self.replica_dir))
+        except OSError:
+            return out
+        for name in names:
+            if not name.endswith(".json") or name.startswith(".tmp-"):
+                continue
+            path = os.path.join(self.replica_dir, name)
+            try:
+                st = os.stat(path)
+                with open(path, encoding="utf-8") as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                payload = None
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+            if not isinstance(payload, dict) \
+                    or not verify_json_payload(payload):
+                note_corruption("fleet", path,
+                                "heartbeat failed crc/structure check")
+                try:
+                    quarantine(path, os.path.join(self.root,
+                                                  "quarantine"))
+                except OSError:
+                    pass
+                continue
+            try:
+                record = ReplicaRecord.from_dict(payload)
+            except TypeError:
+                note_corruption("fleet", path,
+                                "heartbeat schema mismatch")
+                continue
+            interval = max(0.05, float(record.interval_s
+                                       or self.interval_s))
+            age = now - st.st_mtime
+            if age > interval * EXPIRE_FACTOR:
+                if gc and age > interval * GC_FACTOR:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                continue
+            state = "live" if age <= interval * LIVE_FACTOR else "stale"
+            out.append(ReplicaStatus(
+                record=record, state=state, age_s=max(0.0, age),
+                clock_skew_s=(record.heartbeat_at - st.st_mtime
+                              if record.heartbeat_at else 0.0)))
+        return out
+
+
+class FingerprintHeat:
+    """Bounded heat counter over plan/file fingerprints.
+
+    One bump per scan (never per record). When the key set overflows
+    ``max_keys`` the coldest half is dropped — approximate by design:
+    the consumer is an affinity HINT, not an accounting ledger."""
+
+    def __init__(self, max_keys: int = 256):
+        self.max_keys = max(8, int(max_keys))
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def bump(self, keys) -> None:
+        with self._lock:
+            for key in keys:
+                if not key:
+                    continue
+                self._counts[key] = self._counts.get(key, 0) + 1
+            if len(self._counts) > self.max_keys:
+                keep = sorted(self._counts.items(),
+                              key=lambda kv: -kv[1])[:self.max_keys // 2]
+                self._counts = dict(keep)
+
+    def top(self, k: int = 8) -> List[dict]:
+        with self._lock:
+            items = sorted(self._counts.items(),
+                           key=lambda kv: (-kv[1], kv[0]))[:max(0, k)]
+        return [{"key": key, "count": count} for key, count in items]
+
+
+class Heartbeater:
+    """Daemon thread writing `record_fn()` every interval.
+
+    `record_fn` builds a fresh ReplicaRecord per beat (the server's
+    live admission/pressure/heat snapshot). Write failures degrade to a
+    warning-once: a full disk must not take the scan plane down — the
+    replica just goes stale in the fleet view, which is the truthful
+    signal anyway."""
+
+    def __init__(self, registry: ReplicaRegistry,
+                 record_fn: Callable[[], ReplicaRecord],
+                 interval_s: float = 2.0):
+        self.registry = registry
+        self.record_fn = record_fn
+        self.interval_s = max(0.05, float(interval_s))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._warned = False
+        self._beats = 0
+        self._replica_id = ""
+
+    def _beat(self) -> None:
+        try:
+            record = self.record_fn()
+            self._replica_id = record.replica_id
+            self.registry.write(record)
+            self._beats += 1
+            if self._beats % 60 == 0:
+                # occasional GC of long-expired peers (one reader per
+                # fleet doing this is enough; idempotent across many)
+                self.registry.read(gc=True)
+        except Exception:
+            if not self._warned:
+                self._warned = True
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "fleet heartbeat write failed (replica will show "
+                    "stale); further failures suppressed", exc_info=True)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._beat()
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "Heartbeater":
+        self._thread = threading.Thread(
+            target=self._run, name="cobrix-fleet-heartbeat", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, unregister: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if unregister and self._replica_id:
+            self.registry.unregister(self._replica_id)

@@ -1,0 +1,294 @@
+"""Autoscaling signals derived from the federated fleet view.
+
+`derive_signals` turns one `FleetView` (plus the federator's scrape
+history and SLO rollup) into a **recommendation record** — the input a
+horizontal autoscaler or a human reads. It recommends; it does not
+actuate: nothing here starts or stops replicas, drains traffic, or
+rebalances caches. ``desired_replicas`` means "with the load observed
+over the fast window, this many replicas would keep queue waits under
+target and stop the error budget from burning" — no more.
+
+Scale-UP evidence (any one suffices; all are listed in ``reasons``):
+
+* **queue pressure** — the fleet-wide admission queue-wait quantile
+  over the fast window exceeds ``queue_wait_target_s`` (new work is
+  waiting for slots that more replicas would provide);
+* **rejections** — admission refused work in the window
+  (``queue_full`` / ``queue_timeout`` / ``overloaded``): demand
+  already exceeded what queueing could absorb;
+* **SLO burn** — an objective burns over BOTH windows (the classic
+  multi-window alert shape: fast alone is a blip, slow alone is old
+  news, both together is a real regression in progress);
+* **memory pressure** — replicas at their degrade/shed watermark
+  (more replicas spread the RSS).
+
+Scale-DOWN needs ALL of: low slot utilization, idle queue, no burning
+objective, no rejections — and steps down one replica at a time.
+
+Cache-affinity hints ride along: the hottest plan/file fingerprints
+per replica (from the heartbeat heat top-K), shaped for the
+consistent-hash routing front of ROADMAP item 5 — "requests matching
+this fingerprint are warm HERE".
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, List, Optional, Tuple
+
+# the admission-queue histogram the queue-pressure signal reads, and
+# the rejection counter — public: the federator prunes its scrape
+# HISTORY down to exactly these families (signals is the only consumer
+# of historical snapshots, and whole parsed expositions held for 15
+# minutes would pin real memory on every federating replica)
+QUEUE_WAIT_METRIC = "cobrix_serve_queue_wait_seconds"
+REJECT_METRIC = "cobrix_serve_scans_rejected_total"
+HISTORY_FAMILIES = (QUEUE_WAIT_METRIC, REJECT_METRIC)
+# rejection reasons that are demand signals (a client-side protocol
+# refusal is not evidence the fleet is too small)
+_PRESSURE_REASONS = ("queue_full", "queue_timeout", "overloaded")
+
+
+def _cluster_histogram(view, name: str) -> Optional[dict]:
+    """Sum one histogram family across reachable replicas (all label
+    sets folded): {buckets: [(bound, cum)], count, sum}. The le-bound
+    folding itself lives in obs.promparse (one owner)."""
+    from ..obs.promparse import fold_histogram
+
+    acc = None
+    for scrape in view.reachable():
+        fam = scrape.families.get(name)
+        if fam is not None:
+            acc = fold_histogram(fam, acc)
+    if acc is None:
+        return None
+    return {"buckets": sorted(acc["buckets"].items()),
+            "count": acc["count"], "sum": acc["sum"]}
+
+
+def _histogram_delta(cur: Optional[dict],
+                     base: Optional[dict]) -> Optional[dict]:
+    """Windowed histogram = current cumulative minus the window-base
+    snapshot. No baseline -> None: lifetime totals must not masquerade
+    as recent activity (a freshly-started federator looking at a
+    week-old fleet would otherwise read history as a present emergency
+    and recommend scale-up off stale evidence)."""
+    if cur is None or base is None:
+        return None
+    base_buckets = dict(base["buckets"])
+    buckets = [(b, max(0.0, c - base_buckets.get(b, 0.0)))
+               for b, c in cur["buckets"]]
+    return {"buckets": buckets,
+            "count": max(0.0, cur["count"] - base["count"]),
+            "sum": max(0.0, cur["sum"] - base["sum"])}
+
+
+def _bucket_quantile(hist: Optional[dict],
+                     q: float) -> Optional[float]:
+    """Approximate quantile (upper bucket bound), like
+    obs.metrics.Histogram.quantile; None on an empty window."""
+    if hist is None or hist["count"] <= 0:
+        return None
+    target = q * hist["count"]
+    finite = [b for b, _ in hist["buckets"]
+              if b != float("inf")]
+    prev_cum = 0.0
+    for bound, cum in hist["buckets"]:
+        if cum >= target and cum > prev_cum:
+            if bound == float("inf"):
+                return finite[-1] if finite else None
+            return bound
+        prev_cum = cum
+    return finite[-1] if finite else None
+
+
+def _counter_total(view, name: str,
+                   label_filter: Optional[dict] = None,
+                   label_in: Optional[Tuple[str, tuple]] = None
+                   ) -> float:
+    total = 0.0
+    for scrape in view.reachable():
+        fam = scrape.families.get(name)
+        if fam is None:
+            continue
+        for s in fam.samples:
+            labels = dict(s.labels)
+            if label_filter and any(labels.get(k) != v
+                                    for k, v in label_filter.items()):
+                continue
+            if label_in and labels.get(label_in[0]) not in label_in[1]:
+                continue
+            total += s.value
+    return total
+
+
+def _window_base(history, window_s: float):
+    """The delta baseline as ``(view, age_s)``: the oldest snapshot
+    inside the window, else the NEWEST one older than it — a consumer
+    polling at a cadence >= window_s (a standard 60s+ autoscaler loop)
+    must still get a baseline, or the rate signals would be permanently
+    inert exactly for the callers they exist for. The observed span is
+    reported so readers see when the window is wider than asked.
+    None only when no prior snapshot exists at all."""
+    if len(history) < 2:
+        return None
+    now = history[-1][0]
+    horizon = now - window_s
+    inside = [(ts, v) for ts, v in history[:-1] if ts >= horizon]
+    if inside:
+        ts, view = inside[0]
+    else:
+        ts, view = history[-2]  # newest prior snapshot, outside
+    return view, max(0.0, now - ts)
+
+
+def derive_signals(view, history=None, slo_rollup: Optional[dict] = None,
+                   queue_wait_target_s: float = 0.5,
+                   fast_window_s: float = 60.0,
+                   min_replicas: int = 1,
+                   max_replicas: int = 64,
+                   scale_down_utilization: float = 0.25,
+                   heat_top_k: int = 8) -> dict:
+    """The recommendation record (see module docstring for semantics)."""
+    live = [r for r in view.replicas if r.status.state == "live"]
+    n_live = len(live)
+    records = [r.status.record for r in view.replicas]
+    capacity = sum(r.max_concurrent_scans for r in records)
+    active = sum(r.active_scans for r in records)
+    queued = sum(r.queued_scans for r in records)
+    utilization = (active / capacity) if capacity else None
+    pressured = [r.replica_id for r in view.replicas
+                 if r.status.record.pressure in ("degraded", "shed")]
+    draining = [r.replica_id for r in view.replicas
+                if r.status.record.draining]
+
+    based = _window_base(history or [], fast_window_s)
+    base, window_observed_s = based if based else (None, None)
+    queue_cur = _cluster_histogram(view, QUEUE_WAIT_METRIC)
+    queue_base = (_cluster_histogram(base, QUEUE_WAIT_METRIC)
+                  if base is not None else None)
+    queue_window = _histogram_delta(queue_cur, queue_base)
+    queue_p90 = _bucket_quantile(queue_window, 0.90)
+    queue_p50 = _bucket_quantile(queue_window, 0.50)
+
+    if base is not None:
+        rejections_window = max(0.0, _counter_total(
+            view, REJECT_METRIC,
+            label_in=("reason", _PRESSURE_REASONS)) - _counter_total(
+            base, REJECT_METRIC,
+            label_in=("reason", _PRESSURE_REASONS)))
+    else:
+        # same no-baseline honesty as the histogram delta: cumulative
+        # lifetime rejections are not evidence of pressure NOW
+        rejections_window = 0.0
+
+    burning_both = []
+    if slo_rollup:
+        for name, agg in (slo_rollup.get("slo") or {}).items():
+            fast = (agg.get("burn_fast") or {}).get("burn")
+            slow = (agg.get("burn_slow") or {}).get("burn")
+            if fast is not None and slow is not None \
+                    and fast > 1.0 and slow > 1.0:
+                burning_both.append(name)
+
+    reasons: List[str] = []
+    desired = max(1, n_live)
+    scale_up = False
+    if queue_p90 is not None and queue_p90 > queue_wait_target_s:
+        scale_up = True
+        # waits scale roughly with queue length per slot: grow by half
+        # the fleet, at least one replica
+        desired = max(desired, n_live + max(1, math.ceil(n_live / 2)))
+        reasons.append(
+            f"queue_wait p90 {queue_p90:.3g}s over the "
+            f"{queue_wait_target_s:.3g}s target in the last "
+            f"{fast_window_s:.0f}s")
+    if rejections_window > 0:
+        scale_up = True
+        desired = max(desired, n_live + 1)
+        reasons.append(
+            f"{rejections_window:.0f} admission rejection(s) "
+            f"({'/'.join(_PRESSURE_REASONS)}) in the window")
+    if burning_both:
+        scale_up = True
+        desired = max(desired, n_live + max(1, math.ceil(n_live / 2)))
+        reasons.append("SLO burn over both windows: "
+                       + ", ".join(sorted(burning_both)))
+    if pressured:
+        scale_up = True
+        desired = max(desired, n_live + 1)
+        reasons.append("memory pressure (degraded/shed) on: "
+                       + ", ".join(sorted(pressured)))
+    if not scale_up:
+        idle_queue = (queue_p90 is None
+                      or queue_p90 <= queue_wait_target_s / 10.0)
+        # scale-down needs the same evidentiary bar as scale-up: a real
+        # observation window. The first scrape after a federator
+        # restart must recommend the status quo, in either direction
+        if (base is not None
+                and utilization is not None
+                and utilization < scale_down_utilization
+                and queued == 0 and idle_queue
+                and not burning_both and rejections_window == 0
+                and n_live > min_replicas):
+            desired = n_live - 1
+            reasons.append(
+                f"slot utilization {utilization:.0%} under "
+                f"{scale_down_utilization:.0%} with an idle queue")
+        else:
+            reasons.append("steady: no scale signal in the window")
+    desired = max(min_replicas, min(max_replicas, desired))
+
+    # cache-affinity hints: hottest fingerprint -> the replica where it
+    # is hottest (route-for-warmth, the item-5 routing front's input)
+    heat_by_key: Dict[str, Tuple[str, int, int]] = {}
+    for r in view.replicas:
+        for entry in r.status.record.heat:
+            key = entry.get("key")
+            count = int(entry.get("count") or 0)
+            if not key:
+                continue
+            best = heat_by_key.get(key)
+            total = (best[2] if best else 0) + count
+            if best is None or count > best[1]:
+                heat_by_key[key] = (r.replica_id, count, total)
+            else:
+                heat_by_key[key] = (best[0], best[1], total)
+    affinity = [
+        {"key": key, "replica": rid, "count": count, "fleet_count": tot}
+        for key, (rid, count, tot) in sorted(
+            heat_by_key.items(), key=lambda kv: -kv[1][2])
+    ][:max(0, heat_top_k)]
+
+    return {
+        "generated_at": time.time(),
+        "desired_replicas": desired,
+        "live_replicas": n_live,
+        "known_replicas": len(view.replicas),
+        "reasons": reasons,
+        "inputs": {
+            "window_s": fast_window_s,
+            "window_has_baseline": base is not None,
+            # actual span covered by the baseline delta — wider than
+            # window_s when the caller polls slower than the window
+            "window_observed_s": (round(window_observed_s, 1)
+                                  if window_observed_s is not None
+                                  else None),
+            "queue_wait_p50_s": queue_p50,
+            "queue_wait_p90_s": queue_p90,
+            "queue_wait_target_s": queue_wait_target_s,
+            "rejections_in_window": rejections_window,
+            "slots_active": active,
+            "slots_capacity": capacity,
+            "utilization": (round(utilization, 4)
+                            if utilization is not None else None),
+            "queued_scans": queued,
+            "slos_burning_both_windows": sorted(burning_both),
+            "pressured_replicas": sorted(pressured),
+            "draining_replicas": sorted(draining),
+        },
+        "cache_affinity": affinity,
+        # honesty clause, machine-readable: consumers must treat this
+        # as advice — the record never actuates anything by itself
+        "actuates": False,
+    }

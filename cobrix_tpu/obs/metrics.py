@@ -85,6 +85,13 @@ class Counter:
         with self._registry._lock:
             return self._values.get(key, 0.0)
 
+    def items(self) -> Dict[Tuple[Tuple[str, str], ...], float]:
+        """Snapshot of every label combination -> value (the fleet
+        heartbeat reads cache/admission counters through this instead
+        of re-parsing its own exposition)."""
+        with self._registry._lock:
+            return dict(self._values)
+
     def _samples(self) -> Iterable[str]:
         for key in sorted(self._values):
             yield (f"{self.name}{_label_str(key)} "
@@ -258,6 +265,19 @@ class MetricsRegistry:
                     raise ValueError(
                         f"metric {name} already registered as "
                         f"{type(m).__name__}")
+                if cls is Histogram and "buckets" in kw:
+                    # the fleet-federation invariant: one metric name =
+                    # ONE bucket layout, asserted at registration so a
+                    # drifted call site fails at import/first-use, not
+                    # as a cross-replica bucket-merge error at scrape
+                    want = tuple(sorted(float(b) for b in kw["buckets"]))
+                    if want != m.buckets:
+                        raise ValueError(
+                            f"histogram {name} already registered with "
+                            f"buckets {m.buckets}; re-registration with "
+                            f"{want} would break cross-replica "
+                            "federation (bucket-wise merge needs one "
+                            "pinned layout per metric name)")
                 return m
             m = cls(name, help, self, **kw)
             self._metrics[name] = m
@@ -474,6 +494,30 @@ def stream_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "cobrix_stream_checkpoints_total",
             "Durable checkpoint commits (acks) by the ingest layer"),
     }
+
+
+# -- fleet federation merge policy -----------------------------------------
+
+# How each GAUGE aggregates across replicas when fleet/federate.py rolls
+# a cluster exposition up (counters always sum; histograms always merge
+# bucket-wise). Declared HERE, next to the metric definitions, so adding
+# a gauge forces the author to decide its fleet semantics: "sum" for
+# capacity-like gauges (work in flight, backlog bytes), "max" for
+# worst-of-fleet gauges (staleness ages, uptime) where a sum would be a
+# meaningless total of unrelated clocks. Undeclared gauges fall back to
+# "sum"; the fleet tests assert every gauge this module registers IS
+# declared, so the fallback only ever covers third-party metrics.
+FLEET_GAUGE_MERGE = {
+    "cobrix_inflight_chunks": "sum",
+    "cobrix_roofline_fraction": "max",
+    "cobrix_process_uptime_seconds": "max",
+    "cobrix_process_rss_bytes": "sum",
+    "cobrix_serve_open_scans": "sum",
+    "cobrix_serve_active_scans": "sum",
+    "cobrix_serve_queued_scans": "sum",
+    "cobrix_stream_lag_bytes": "sum",
+    "cobrix_stream_watermark_age_seconds": "max",
+}
 
 
 # queue-wait / first-batch latency buckets for the serving tier: finer

@@ -33,8 +33,10 @@ from __future__ import annotations
 
 import re
 import threading
+import time
+from collections import deque
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .metrics import default_registry
 
@@ -133,11 +135,33 @@ def parse_slos(specs: Sequence[str]) -> List[Slo]:
     return slos
 
 
-class SloTracker:
-    """Per-scan evaluation + good/bad counters + status document."""
+# multi-window burn rates: the standard fast/slow alert pair — the fast
+# window catches a cliff (page), the slow window catches a leak
+# (ticket). Events are bucketed so the memory is bounded: at most
+# slow_window/bucket entries per (slo, tenant) ever exist.
+FAST_WINDOW_S = 60.0
+SLOW_WINDOW_S = 600.0
+_BURN_BUCKET_S = 5.0
 
-    def __init__(self, slos: Sequence[Slo], registry=None):
+
+class SloTracker:
+    """Per-scan evaluation + good/bad counters + status document.
+
+    Besides lifetime totals, the tracker keeps time-bucketed good/bad
+    counts per (slo, tenant) so `burn()` can answer "what fraction of
+    the error budget is being spent RIGHT NOW" over a fast and a slow
+    window — the multi-window burn-rate shape the fleet rollup
+    (fleet/federate.py) aggregates across replicas. burn > 1.0 means
+    the budget is being spent faster than the objective allows."""
+
+    def __init__(self, slos: Sequence[Slo], registry=None,
+                 fast_window_s: float = FAST_WINDOW_S,
+                 slow_window_s: float = SLOW_WINDOW_S,
+                 clock=None):
         self.slos = list(slos)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self._clock = clock or time.monotonic
         r = registry or default_registry()
         self._good = r.counter(
             "cobrix_slo_good_total",
@@ -153,6 +177,21 @@ class SloTracker:
         # per tenant; the health view wants the cross-tenant aggregate
         self._totals: Dict[str, List[int]] = {
             s.name: [0, 0] for s in self.slos}
+        # (slo, tenant) -> deque of [bucket_start_s, good, bad]
+        self._windows: Dict[Tuple[str, str], deque] = {}
+
+    def _note_window_locked(self, slo_name: str, tenant: str,
+                            good: bool) -> None:
+        now = self._clock()
+        bucket = now - (now % _BURN_BUCKET_S)
+        dq = self._windows.setdefault((slo_name, tenant), deque())
+        if dq and dq[-1][0] == bucket:
+            dq[-1][1 if good else 2] += 1
+        else:
+            dq.append([bucket, 1 if good else 0, 0 if good else 1])
+        horizon = now - self.slow_window_s - _BURN_BUCKET_S
+        while dq and dq[0][0] < horizon:
+            dq.popleft()
 
     def observe(self, record) -> List[str]:
         """Classify one ScanRecord against every objective; returns the
@@ -167,13 +206,52 @@ class SloTracker:
                 slo=slo.name, tenant=record.tenant).inc()
             with self._lock:
                 self._totals[slo.name][0 if good else 1] += 1
+                self._note_window_locked(slo.name, record.tenant, good)
             if not good:
                 breaches.append(slo.name)
         record.slo_breaches = breaches
         return breaches
 
+    def _window_counts_locked(self, slo_name: str, window_s: float,
+                              tenant: Optional[str] = None
+                              ) -> Tuple[int, int]:
+        now = self._clock()
+        horizon = now - window_s - _BURN_BUCKET_S
+        good = bad = 0
+        for (name, t), dq in self._windows.items():
+            if name != slo_name:
+                continue
+            if tenant is not None and t != tenant:
+                continue
+            for bucket, g, b in dq:
+                if bucket >= horizon:
+                    good += g
+                    bad += b
+        return good, bad
+
+    def burn(self, slo: Slo, window_s: float,
+             tenant: Optional[str] = None) -> dict:
+        """Error-budget burn over a trailing window: ``ratio`` is the
+        bad fraction of scans in the window, ``burn`` that ratio over
+        the budget fraction ``1 - objective`` (the conventional burn
+        rate: 1.0 = spending exactly at the objective's allowance).
+        None fields when the window saw no evaluated scans."""
+        with self._lock:
+            good, bad = self._window_counts_locked(
+                slo.name, window_s, tenant)
+        seen = good + bad
+        ratio = (bad / seen) if seen else None
+        budget = 1.0 - slo.objective
+        rate = (None if ratio is None
+                else (ratio / budget if budget > 0
+                      else (0.0 if ratio == 0 else float("inf"))))
+        return {"window_s": window_s, "good": good, "bad": bad,
+                "ratio": round(ratio, 6) if ratio is not None else None,
+                "burn": round(rate, 4) if rate is not None else None}
+
     def status(self) -> dict:
-        """Per-objective summary for /healthz + /debug/slo."""
+        """Per-objective summary for /healthz + /debug/slo: lifetime
+        totals plus the fast/slow window burn rates."""
         out = {}
         with self._lock:
             totals = {k: tuple(v) for k, v in self._totals.items()}
@@ -191,5 +269,7 @@ class SloTracker:
                 # burning: the observed ratio is under the objective —
                 # the budget is being spent faster than allowed
                 "burning": bool(seen and ratio < slo.objective),
+                "burn_fast": self.burn(slo, self.fast_window_s),
+                "burn_slow": self.burn(slo, self.slow_window_s),
             }
         return out

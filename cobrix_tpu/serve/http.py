@@ -19,6 +19,13 @@ answers health checks.
   debug surface (`debug_fn` serves it; see ScanServer._debug):
   active scans with live ScanProgress, the flight-recorder ring,
   SLO status, and the effective config.
+* ``/fleet/replicas|metrics|slo|signals`` — the cluster-level view
+  (`fleet_fn` serves it; present only on fleet-mode servers — see
+  ScanServer._fleet_endpoint): replica registry with liveness,
+  federated Prometheus exposition, cluster SLO rollup, autoscaling
+  signals. 404 when fleet mode is off; a structured 500 (JSON error
+  body) when federation itself refuses (e.g. a cross-replica
+  histogram bucket mismatch).
 """
 from __future__ import annotations
 
@@ -41,7 +48,8 @@ class ObsHttpServer:
     def __init__(self, snapshot_fn: Optional[Callable[[], dict]] = None,
                  host: str = "127.0.0.1", port: int = 0,
                  debug_fn: Optional[Callable] = None,
-                 pre_scrape: Optional[Callable[[], None]] = None):
+                 pre_scrape: Optional[Callable[[], None]] = None,
+                 fleet_fn: Optional[Callable] = None):
         self._t0 = time.monotonic()
         snapshot = snapshot_fn or (lambda: {})
         outer = self
@@ -74,6 +82,33 @@ class ObsHttpServer:
                     code = (200 if doc["status"] == "ok"
                             else 503 if doc["status"] == "draining"
                             else 500)
+                elif path.startswith("/fleet/") and fleet_fn is not None:
+                    # fleet_fn returns None (404), a (body, ctype) pair
+                    # (pre-rendered text, e.g. the federated Prometheus
+                    # exposition), or a JSON-able document
+                    try:
+                        doc = fleet_fn(path[len("/fleet/"):], query)
+                    except Exception as exc:
+                        # structured refusal (FleetMergeError et al.):
+                        # the error reaches the operator as data
+                        doc = {"error": f"{type(exc).__name__}: {exc}"}
+                        body = (json.dumps(doc) + "\n").encode()
+                        self._reply(500, "application/json", body)
+                        return
+                    if doc is None:
+                        body = b"not found\n"
+                        ctype = "text/plain"
+                        code = 404
+                    elif isinstance(doc, tuple):
+                        raw, ctype = doc
+                        body = (raw if isinstance(raw, bytes)
+                                else raw.encode())
+                        code = 200
+                    else:
+                        body = (json.dumps(doc, sort_keys=True,
+                                           default=str) + "\n").encode()
+                        ctype = "application/json"
+                        code = 200
                 elif path.startswith("/debug/") and debug_fn is not None:
                     try:
                         doc = debug_fn(path[len("/debug/"):], query)

@@ -17,6 +17,7 @@ examples/serving_app.py is the recipe).
 """
 from __future__ import annotations
 
+import os
 import socketserver
 import threading
 import time
@@ -195,6 +196,18 @@ class _Handler(socketserver.StreamRequestHandler):
         summary: dict = {}
         first_batch_s = None
         entry = server.register_active(request)
+        # the admission slot releases the moment the scan's work is
+        # done — BEFORE the final frame reaches the client — so a
+        # serialized client (finish scan N, immediately send N+1) can
+        # never observe its own completed scan still holding the slot
+        # and be bounced with a spurious queue_full. Idempotent: the
+        # error paths release from the handler's finally instead.
+        released = [False]
+
+        def release_slot() -> None:
+            if not released[0]:
+                released[0] = True
+                server.controller.release(ticket)
 
         def on_progress(p):
             entry["progress"] = p.as_dict()  # the /debug/scans source
@@ -254,6 +267,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 first_batch_s = table_writer.first_batch_t - t_admit
                 summary["first_batch_s"] = round(first_batch_s, 6)
                 m["first_batch"].observe(first_batch_s)
+            release_slot()
             writer.json(FRAME_FINAL, summary)
             outcome = "ok"
         except ClientGone:
@@ -288,6 +302,10 @@ class _Handler(socketserver.StreamRequestHandler):
                 # the failover attempt on another replica resumes from
                 # here instead of re-streaming everything
                 payload["resume_token"] = session.resume_token()
+            # same slot discipline as the success path: the scan is
+            # over — release BEFORE the error frame reaches the client,
+            # so its immediate retry cannot bounce off the dead scan
+            release_slot()
             writer.try_json(FRAME_ERROR, payload)
             error_text = f"{type(exc).__name__}: {exc}"
             if code == "protocol":
@@ -297,7 +315,7 @@ class _Handler(socketserver.StreamRequestHandler):
                 # error-budget SLO or spend flight-recorder dumps
                 outcome = "rejected"
         finally:
-            server.controller.release(ticket)
+            release_slot()
             server.unregister_active(entry)
             # the Prometheus counter keeps its historical ok/error
             # vocabulary; the finer client_gone class lives on the
@@ -367,7 +385,19 @@ class ScanServer(socketserver.ThreadingTCPServer):
                  token_interval_s: float = 1.0,
                  memory_budget_mb: float = 0.0,
                  degrade_fraction: float = 0.75,
-                 shed_fraction: float = 0.9):
+                 shed_fraction: float = 0.9,
+                 fleet: bool = False,
+                 replica_id: str = "",
+                 heartbeat_interval_s: float = 2.0,
+                 fleet_scrape_timeout_s: float = 2.0,
+                 queue_wait_target_s: float = 0.5):
+        if fleet and not (server_options or {}).get("cache_dir"):
+            # checked before the listener binds: a config error must
+            # not leak a bound socket
+            raise ValueError(
+                "fleet mode needs a shared cache_dir in "
+                "server_options (the replica registry lives under "
+                "<cache_dir>/fleet)")
         super().__init__((host, port), _Handler)
         # max seconds ONE frame write may block on a non-reading peer
         # before the scan is cancelled as ClientGone (0 = unbounded)
@@ -415,12 +445,49 @@ class ScanServer(socketserver.ThreadingTCPServer):
         self._active_lock = threading.Lock()
         self.draining = False
         self._started_at = time.monotonic()
+        self._started_wall = time.time()
+        # -- fleet observability plane (opt-in; see cobrix_tpu.fleet) ---
+        # built ONLY when asked: a non-fleet server never imports the
+        # fleet package, never writes a heartbeat, never takes a
+        # fingerprint-heat timestamp — the zero-overhead contract
+        # tools/fleetcheck.py counter-asserts
+        self._fleet = None
+        self._heartbeater = None
+        self.queue_wait_target_s = max(0.0, float(queue_wait_target_s))
+        if fleet:
+            cache_dir = str(self.server_options.get("cache_dir"))
+            from ..fleet.federate import FleetFederator
+            from ..fleet.registry import (FingerprintHeat, Heartbeater,
+                                          ReplicaRegistry,
+                                          default_replica_id)
+            import socket as _socket
+
+            self.replica_id = (str(replica_id) if replica_id
+                               else default_replica_id())
+            self._fleet = {
+                "registry": ReplicaRegistry(
+                    os.path.join(cache_dir, "fleet"),
+                    interval_s=heartbeat_interval_s),
+                "heat": FingerprintHeat(),
+                "interval_s": max(0.05, float(heartbeat_interval_s)),
+                "host": _socket.gethostname(),
+            }
+            self._fleet["federator"] = FleetFederator(
+                self._fleet["registry"],
+                timeout_s=fleet_scrape_timeout_s)
+            self._heartbeater = Heartbeater(
+                self._fleet["registry"], self._fleet_record,
+                interval_s=self._fleet["interval_s"])
+        else:
+            self.replica_id = str(replica_id) or ""
         self._http: Optional[ObsHttpServer] = None
         if enable_http:
             self._http = ObsHttpServer(
                 snapshot_fn=self._health_snapshot,
                 debug_fn=self._debug,
                 pre_scrape=self._pre_scrape,
+                fleet_fn=(self._fleet_endpoint if self._fleet is not None
+                          else None),
                 host=http_host if http_host is not None else host,
                 port=http_port)
         self._thread: Optional[threading.Thread] = None
@@ -522,6 +589,8 @@ class ScanServer(socketserver.ThreadingTCPServer):
                            if session.metrics is not None else None)
             self._observe_record(record, tracer=tracer,
                                  field_costs=field_costs)
+            if self._fleet is not None:
+                self._note_fleet_heat(request, session.plan_fp)
         except Exception:
             import logging
 
@@ -541,12 +610,91 @@ class ScanServer(socketserver.ThreadingTCPServer):
         if self.audit is not None:
             self.audit.append(record)
 
+    # -- fleet plane -----------------------------------------------------
+
+    def _fleet_record(self):
+        """One heartbeat payload: the live admission/pressure snapshot
+        plus cache hit totals and fingerprint heat. Built per beat on
+        the heartbeat thread — never on a scan path."""
+        from ..fleet.registry import ReplicaRecord
+        from ..obs.metrics import scan_metrics, stream_metrics
+
+        snap = self.controller.snapshot()
+        cache: Dict[str, int] = {}
+        for key, value in scan_metrics()["io_cache"].items().items():
+            labels = dict(key)
+            name = f"{labels.get('plane', '?')}_{labels.get('result', '?')}"
+            cache[name] = cache.get(name, 0) + int(value)
+        stream = stream_metrics()
+        followers = sum(t.get("followers", 0)
+                        for t in snap.get("tenants", {}).values())
+        return ReplicaRecord(
+            replica_id=self.replica_id,
+            pid=os.getpid(),
+            host=self._fleet["host"],
+            scan_address=list(self.address),
+            http_address=(list(self.http_address)
+                          if self.http_address else None),
+            started_at=self._started_wall,
+            heartbeat_at=time.time(),
+            interval_s=self._fleet["interval_s"],
+            seq=self._next_heartbeat_seq(),
+            draining=self.draining,
+            pressure=(snap.get("pressure") or {}).get("level", "ok"),
+            active_scans=int(snap.get("active_scans") or 0),
+            queued_scans=int(snap.get("queued_scans") or 0),
+            followers=int(followers),
+            max_concurrent_scans=self.controller.max_concurrent_scans,
+            lag_bytes=int(stream["lag_bytes"].value()),
+            watermark_age_s=float(stream["watermark_age"].value()),
+            cache=cache,
+            heat=self._fleet["heat"].top(8))
+
+    def _next_heartbeat_seq(self) -> int:
+        self._fleet["seq"] = self._fleet.get("seq", 0) + 1
+        return self._fleet["seq"]
+
+    def _note_fleet_heat(self, request: ScanRequest,
+                         plan_fp: str) -> None:
+        """One heat bump per scan (fleet mode only): the plan
+        fingerprint plus each input path — the affinity currency the
+        routing front of ROADMAP item 5 will key on."""
+        keys = [f"file:{f}" for f in request.files]
+        if plan_fp:
+            keys.append(f"plan:{plan_fp}")
+        self._fleet["heat"].bump(keys)
+
+    def _fleet_endpoint(self, path: str, query: dict):
+        """`/fleet/<path>` documents (None -> 404). `replicas`, `slo`,
+        and `signals` are JSON; `metrics` is a federated Prometheus
+        exposition. A federation refusal (bucket mismatch) propagates
+        and the sidecar answers a structured 500."""
+        fed = self._fleet["federator"]
+        if path == "replicas":
+            return fed.view().replicas_doc()
+        if path == "metrics":
+            return (fed.cluster_exposition(),
+                    "text/plain; version=0.0.4; charset=utf-8")
+        if path == "slo":
+            return fed.slo_rollup()
+        if path == "signals":
+            from ..fleet.signals import derive_signals
+
+            view = fed.view()
+            return derive_signals(
+                view, history=fed.history(),
+                slo_rollup=fed.slo_rollup(view),
+                queue_wait_target_s=self.queue_wait_target_s)
+        return None
+
     # -- health + /debug -------------------------------------------------
 
     def _health_snapshot(self) -> dict:
         doc: dict = {}
         if self.draining:
             doc["status"] = "draining"
+        if self._fleet is not None:
+            doc["replica_id"] = self.replica_id
         doc.update(self.controller.snapshot())
         if self.slo is not None:
             doc["slo"] = self.slo.status()
@@ -609,6 +757,11 @@ class ScanServer(socketserver.ThreadingTCPServer):
     def start(self) -> "ScanServer":
         if self._http is not None:
             self._http.start()
+        if self._heartbeater is not None:
+            # first beat synchronously: the replica is a fleet member
+            # the moment start() returns, not one interval later
+            self._heartbeater._beat()
+            self._heartbeater.start()
         self._thread = threading.Thread(target=self.serve_forever,
                                         name="cobrix-serve-accept",
                                         daemon=True)
@@ -653,6 +806,11 @@ class ScanServer(socketserver.ThreadingTCPServer):
             self._thread.join(timeout=5)
             self._thread = None
         self.server_close()
+        if self._heartbeater is not None:
+            # clean exit unregisters: the fleet view drops this replica
+            # immediately instead of after heartbeat expiry
+            self._heartbeater.stop(unregister=True)
+            self._heartbeater = None
         if self._http is not None:
             self._http.stop()
         if getattr(self, "_installed_budget", False):
@@ -665,7 +823,9 @@ class ScanServer(socketserver.ThreadingTCPServer):
 def main(argv=None) -> int:
     """``python -m cobrix_tpu.serve [--host H] [--port P] [--http-port P]
     [--cache-dir DIR] [--max-concurrent N] [--audit-log PATH]
-    [--slo SPEC ...] [--flight-dir DIR] [--drain-timeout S]``
+    [--slo SPEC ...] [--flight-dir DIR] [--drain-timeout S]
+    [--fleet [--replica-id ID] [--heartbeat-interval S]
+    [--queue-wait-target S]]``
 
     SIGTERM/SIGINT start a graceful drain: the listener closes,
     `/healthz` answers 503 ``draining``, in-flight scans get
@@ -709,7 +869,25 @@ def main(argv=None) -> int:
                          "window), past 90%% admission sheds with "
                          "structured 'overloaded' rejections "
                          "(0 = no watermark)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="join the fleet observability plane: heartbeat "
+                         "into <cache-dir>/fleet and serve "
+                         "/fleet/{replicas,metrics,slo,signals} "
+                         "(requires --cache-dir)")
+    ap.add_argument("--replica-id", default="",
+                    help="fleet replica identity (default: "
+                         "hostname-pid)")
+    ap.add_argument("--heartbeat-interval", type=float, default=2.0,
+                    help="seconds between fleet heartbeats; a killed "
+                         "replica leaves the live view within about "
+                         "1.6 intervals")
+    ap.add_argument("--queue-wait-target", type=float, default=0.5,
+                    help="fleet autoscaling signal: queue-wait p90 over "
+                         "this many seconds recommends scale-up")
     args = ap.parse_args(argv)
+    if args.fleet and not args.cache_dir:
+        ap.error("--fleet requires --cache-dir (the replica registry "
+                 "lives in the shared cache root)")
     server_options = ({"cache_dir": args.cache_dir} if args.cache_dir
                       else None)
     srv = ScanServer(
@@ -722,7 +900,10 @@ def main(argv=None) -> int:
         slos=args.slo, flight_dir=args.flight_dir,
         flight_max_dumps=args.flight_max_dumps,
         drain_timeout_s=args.drain_timeout,
-        memory_budget_mb=args.memory_budget_mb)
+        memory_budget_mb=args.memory_budget_mb,
+        fleet=args.fleet, replica_id=args.replica_id,
+        heartbeat_interval_s=args.heartbeat_interval,
+        queue_wait_target_s=args.queue_wait_target)
     print(f"cobrix_tpu serving scans on {srv.address}, "
           f"obs on {srv.http_address}", flush=True)
     stop_signal = threading.Event()

@@ -18,7 +18,15 @@ This demo launches two replicas against a temp cache root, streams the
 same multisegment file through both as two different tenants (live
 progress frames on), proves the second replica's scan was warm from the
 first replica's work, and scrapes `/metrics` + `/healthz`.
+
+With ``--fleet`` the replicas additionally join the fleet
+observability plane (``--fleet --replica-id rN``): the demo then shows
+the cluster view any single replica serves — ``/fleet/replicas`` (the
+heartbeat registry), the federated ``/fleet/metrics`` exposition where
+cluster counters are the exact sums of both replicas' series, and the
+``/fleet/signals`` autoscaling recommendation.
 """
+import argparse
 import json
 import os
 import re
@@ -37,14 +45,18 @@ _ADDR = re.compile(r"serving scans on \('([^']+)', (\d+)\), "
                    r"obs on \('([^']+)', (\d+)\)")
 
 
-def launch_replica(cache_dir: str) -> tuple:
+def launch_replica(cache_dir: str, fleet: bool = False,
+                   replica_id: str = "") -> tuple:
     """One serving process; returns (proc, scan_addr, http_addr).
     ``--port 0`` lets the OS pick — the replica prints where it bound."""
     env = dict(os.environ, PYTHONPATH=REPO)
+    args = [sys.executable, "-m", "cobrix_tpu.serve",
+            "--port", "0", "--http-port", "0", "--cache-dir", cache_dir]
+    if fleet:
+        args += ["--fleet", "--replica-id", replica_id,
+                 "--heartbeat-interval", "0.5"]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "cobrix_tpu.serve",
-         "--port", "0", "--http-port", "0", "--cache-dir", cache_dir],
-        stdout=subprocess.PIPE, text=True, env=env, cwd=REPO)
+        args, stdout=subprocess.PIPE, text=True, env=env, cwd=REPO)
     line = proc.stdout.readline()
     m = _ADDR.search(line)
     if not m:
@@ -88,15 +100,46 @@ def streamed_scan(address, path: str, tenant: str) -> dict:
     return summary
 
 
-def main():
+def show_fleet_view(http_addr) -> None:
+    """The cluster surface ANY fleet replica serves: registry,
+    federated exposition, autoscaling recommendation."""
+    host, port = http_addr
+    base = f"http://{host}:{port}"
+
+    def get(path):
+        return urllib.request.urlopen(base + path, timeout=10).read()
+
+    replicas = json.loads(get("/fleet/replicas"))
+    print(f"/fleet/replicas: {replicas['live']} live — "
+          + ", ".join(f"{r['replica_id']}({r['state']})"
+                      for r in replicas["replicas"]))
+    metrics = get("/fleet/metrics").decode()
+    for line in metrics.splitlines():
+        if line.startswith("cobrix_serve_scans_admitted_total"):
+            print(f"  {line}")
+    signals = json.loads(get("/fleet/signals"))
+    print(f"/fleet/signals: desired_replicas="
+          f"{signals['desired_replicas']} "
+          f"(live={signals['live_replicas']}) — "
+          + "; ".join(signals["reasons"]))
+    hot = signals.get("cache_affinity") or []
+    if hot:
+        print("  cache affinity: " + ", ".join(
+            f"{h['key']} -> {h['replica']}" for h in hot[:3]))
+
+
+def main(fleet: bool = False):
     with tempfile.TemporaryDirectory() as workdir:
         path = os.path.join(workdir, "COMPANY.DETAILS.dat")
         with open(path, "wb") as f:
             f.write(generate_exp2(4000, seed=100))
         cache_dir = os.path.join(workdir, "shared-cache")
 
-        print("launching 2 replicas sharing one cache_dir...")
-        replicas = [launch_replica(cache_dir) for _ in range(2)]
+        print("launching 2 replicas sharing one cache_dir"
+              + (" (fleet mode)" if fleet else "") + "...")
+        replicas = [launch_replica(cache_dir, fleet=fleet,
+                                   replica_id=f"r{i}")
+                    for i in range(2)]
         try:
             # tenant "etl" lands on replica 1: cold — it builds the
             # sparse index into the shared cache
@@ -131,6 +174,13 @@ def main():
                 if line.startswith(("cobrix_serve_scans_admitted_total",
                                     "cobrix_serve_streamed_bytes_total")):
                     print(f"  {line}")
+
+            if fleet:
+                # the cluster-level view: one replica answers for the
+                # whole fleet (replica-labeled series + exact cluster
+                # totals, plus the autoscaling recommendation)
+                print("fleet view from replica 1:")
+                show_fleet_view(replicas[0][2])
         finally:
             for proc, _, _ in replicas:
                 proc.terminate()
@@ -140,4 +190,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the replicas as a fleet and show the "
+                         "/fleet cluster view")
+    main(fleet=ap.parse_args().fleet)

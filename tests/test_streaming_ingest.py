@@ -575,8 +575,16 @@ def test_follow_mode_parity_and_metrics(tmp_path):
             text = prometheus_text()
             assert "cobrix_serve_follow_sessions_total" in text
             assert "cobrix_stream_batches_total" in text
-            records = [r for r in read_audit_log(str(audit))
-                       if r.request_id == stream.request_id]
+            # the audit append runs in the handler's finally AFTER the
+            # trailer reached the client (by design — observability
+            # must never delay the stream), so give it a moment
+            deadline = time.monotonic() + 10
+            records = []
+            while not records and time.monotonic() < deadline:
+                records = [r for r in read_audit_log(str(audit))
+                           if r.request_id == stream.request_id]
+                if not records:
+                    time.sleep(0.05)
             assert records and records[0].follow is True
             assert records[0].outcome == "ok"
         finally:

@@ -101,7 +101,12 @@ def test_worker_crash_recovery_varlen(varlen_files, varlen_clean, plan):
     report = sup(data)
     assert report["worker_crashes"] >= 1
     assert report["re_dispatches"] >= 1
-    assert report["worker_respawns"] >= 1
+    # NOT pinned: worker_respawns. The pool only refills when the scan
+    # still needs the capacity — on a loaded box the surviving worker
+    # can absorb the re-dispatched shard with nothing else pending, and
+    # recovering WITHOUT a respawn is the cheaper, equally-correct
+    # outcome (the parity + shards_failed==0 asserts are the recovery
+    # contract; the pin made scheduling luck a test failure)
     assert report["shards_failed"] == 0
     assert data.diagnostics is None  # recovered fail_fast read is clean
 

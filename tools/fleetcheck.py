@@ -1,0 +1,446 @@
+"""Fleet observability smoke check: 3 replicas, one telemetry plane.
+
+Drives the cluster plane (cobrix_tpu.fleet) end to end the way ISSUE
+12's acceptance criteria demand:
+
+  1. three ``--fleet`` replica SUBPROCESSES share one ``cache_dir``;
+     the check waits until any replica's ``/fleet/replicas`` lists all
+     three live (heartbeat registry working cross-process);
+  2. concurrent tenant scans land on every replica, plus one
+     follow-mode subscription — then, on the QUIESCED fleet, the
+     federated ``/fleet/metrics`` exposition must carry cluster
+     counters **exactly equal** to the sum of the per-replica
+     ``/metrics`` values (and histograms bucket-wise), and the merged
+     text must pass the `obs.promparse` validator;
+  3. ``/fleet/slo`` totals must equal the sums of the per-replica
+     ``/debug/slo`` documents;
+  4. ``/fleet/signals`` must RESPOND to induced pressure: with
+     1-slot replicas, concurrent scans queue (and overflow into
+     structured rejections), so ``desired_replicas`` must exceed the
+     live count after the load window;
+  5. fleet mode OFF is counter-asserted zero-overhead in a fresh
+     subprocess: a served scan must leave ``cobrix_tpu.fleet``
+     unimported and write NO heartbeat (no ``<cache>/fleet`` dir);
+  6. a replica SIGKILLed mid-fleet must degrade the fleet view to the
+     live members within ~one heartbeat interval, with every
+     ``/fleet/*`` endpoint still answering a PARTIAL view.
+
+    python tools/fleetcheck.py            # quick (~30 s)
+    python tools/fleetcheck.py --sweep    # + kill during live load and
+                                          # rejoin (slow tier)
+
+Exit code 0 = every assertion held; 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+COPYBOOK = """
+        01  R.
+            05  KEY    PIC 9(7) COMP.
+            05  NAME   PIC X(9).
+"""
+RECORD_BYTES = 13
+
+_ADDR = re.compile(r"serving scans on \('([^']+)', (\d+)\), "
+                   r"obs on \('([^']+)', (\d+)\)")
+
+HEARTBEAT_S = 0.4
+
+
+def log(msg: str) -> None:
+    print(f"[fleetcheck] {msg}", flush=True)
+
+
+def make_records(n: int, start: int = 0) -> bytes:
+    return b"".join(
+        (start + i).to_bytes(4, "big")
+        + f"ROW{(start + i) % 1000000:06d}".encode("ascii")
+        for i in range(n))
+
+
+def launch_replica(cache_dir: str, replica_id: str, audit_dir: str):
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cobrix_tpu.serve",
+         "--port", "0", "--http-port", "0",
+         "--cache-dir", cache_dir,
+         "--fleet", "--replica-id", replica_id,
+         "--heartbeat-interval", str(HEARTBEAT_S),
+         "--max-concurrent", "1", "--tenant-concurrent", "1",
+         "--queue-wait-target", "0.02",
+         "--slo", "first_batch_p99=30.0", "--slo", "error_rate=0.01",
+         "--audit-log", os.path.join(audit_dir, f"{replica_id}.log")],
+        stdout=subprocess.PIPE, text=True, env=env, cwd=REPO)
+    line = proc.stdout.readline()
+    m = _ADDR.search(line)
+    if not m:
+        proc.terminate()
+        raise RuntimeError(f"replica {replica_id} failed to start: "
+                           f"{line!r}")
+    return (proc, (m.group(1), int(m.group(2))),
+            (m.group(3), int(m.group(4))))
+
+
+def http_get(addr, path: str, timeout: float = 10.0) -> bytes:
+    url = f"http://{addr[0]}:{addr[1]}{path}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def http_json(addr, path: str, timeout: float = 10.0) -> dict:
+    return json.loads(http_get(addr, path, timeout))
+
+
+def wait_for(predicate, deadline_s: float, what: str):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.1)
+    raise AssertionError(f"timed out after {deadline_s:.0f}s "
+                         f"waiting for {what}")
+
+
+def run_scans(replicas, path: str, rows_expected: int,
+              n_scans: int = 5, follow: bool = True) -> None:
+    """Concurrent tenant scans spread across replicas + ONE follow
+    subscription; every scan must deliver the full row set."""
+    from cobrix_tpu.serve import fetch_table, stream_scan
+
+    errors = []
+    results = []
+
+    def one_scan(i: int) -> None:
+        addr = replicas[i % len(replicas)][1]
+        tenant = ("etl", "bi")[i % 2]
+        try:
+            t = fetch_table(addr, path, tenant=tenant,
+                            copybook_contents=COPYBOOK)
+            results.append(t.num_rows)
+            if t.num_rows != rows_expected:
+                errors.append(f"scan {i}: {t.num_rows} rows, wanted "
+                              f"{rows_expected}")
+        except Exception as exc:
+            # 1-slot replicas + concurrent load: structured rejections
+            # are EXPECTED pressure evidence, anything else is a bug
+            from cobrix_tpu.serve import ServeError
+
+            if isinstance(exc, ServeError) and exc.code == "rejected":
+                results.append(-1)
+            else:
+                errors.append(f"scan {i}: {type(exc).__name__}: {exc}")
+
+    def one_follow() -> None:
+        try:
+            rows = 0
+            with stream_scan(replicas[-1][1], path, tenant="stream",
+                             copybook_contents=COPYBOOK,
+                             follow={"max_batches": 2,
+                                     "idle_timeout_s": 2.0}) as stream:
+                for batch in stream:
+                    rows += batch.num_rows
+            results.append(rows)
+            if rows != rows_expected:
+                errors.append(f"follow: {rows} rows, wanted "
+                              f"{rows_expected}")
+        except Exception as exc:
+            errors.append(f"follow: {type(exc).__name__}: {exc}")
+
+    threads = [threading.Thread(target=one_scan, args=(i,))
+               for i in range(n_scans)]
+    if follow:
+        threads.append(threading.Thread(target=one_follow))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    if errors:
+        raise AssertionError("; ".join(errors))
+    completed = sum(1 for r in results if r >= 0)
+    log(f"{completed} scans completed, "
+        f"{sum(1 for r in results if r < 0)} rejected under pressure")
+    if completed == 0:
+        raise AssertionError("no scan completed")
+
+
+def wait_quiesced(replicas) -> None:
+    def quiet():
+        for _proc, _scan, http in replicas:
+            doc = http_json(http, "/healthz")
+            if doc.get("active_scans") or doc.get("queued_scans"):
+                return False
+        return True
+
+    wait_for(quiet, 30, "fleet quiescence")
+
+
+def assert_exact_federation(replicas, fleet_http) -> None:
+    """Cluster counters == sum of per-replica counters, byte-exact on
+    a quiesced fleet; merged exposition validator-clean."""
+    from cobrix_tpu.obs import promparse as pp
+
+    per = {}
+    for i, (_proc, _scan, http) in enumerate(replicas):
+        per[f"r{i}"] = pp.parse_text(http_get(http, "/metrics")
+                                     .decode())
+    fleet_text = http_get(fleet_http, "/fleet/metrics").decode()
+    issues = pp.validate_text(fleet_text)
+    assert not issues, f"federated exposition lint: {issues[:5]}"
+    fleet = pp.parse_text(fleet_text)
+    checked = 0
+    for name, fams in per["r0"].items():
+        if fams.kind not in ("counter", "histogram"):
+            continue  # gauges move per scrape (uptime/rss)
+        assert name in fleet, f"{name} missing from federation"
+        # accumulate per-sample sums across replicas
+        sums = {}
+        for rid, pfams in per.items():
+            fam = pfams.get(name)
+            if fam is None:
+                continue
+            for s in fam.samples:
+                key = (s.name, s.labels)
+                sums[key] = sums.get(key, 0.0) + s.value
+                # the replica-labeled series must echo the source value
+                lab = tuple(sorted(s.labels + (("replica", rid),)))
+                got = fleet[name].value(
+                    labels=lab, suffix=s.name[len(name):])
+                assert got == s.value, (
+                    f"{name}{dict(lab)}: federated {got} != "
+                    f"replica {s.value}")
+        for (sname, labels), total in sums.items():
+            got = fleet[name].value(labels=labels,
+                                    suffix=sname[len(name):])
+            assert got == total, (
+                f"{sname}{dict(labels)}: cluster {got} != "
+                f"sum-of-replicas {total}")
+            checked += 1
+    assert checked > 20, f"only {checked} series checked"
+    log(f"federation exact on {checked} cluster series "
+        f"across {len(per)} replicas")
+
+
+def assert_slo_rollup(replicas, fleet_http) -> None:
+    fleet = http_json(fleet_http, "/fleet/slo")["slo"]
+    assert fleet, "fleet SLO rollup empty"
+    sums = {}
+    for _proc, _scan, http in replicas:
+        doc = http_json(http, "/debug/slo")["slo"]
+        for name, st in doc.items():
+            agg = sums.setdefault(name, {"good": 0, "bad": 0})
+            agg["good"] += st["good"]
+            agg["bad"] += st["bad"]
+    for name, agg in sums.items():
+        assert fleet[name]["good"] == agg["good"], (
+            name, fleet[name], agg)
+        assert fleet[name]["bad"] == agg["bad"], (name, fleet[name], agg)
+    assert sum(a["good"] + a["bad"] for a in sums.values()) > 0, \
+        "no SLO evaluations recorded"
+    log(f"/fleet/slo == sum of /debug/slo for {sorted(sums)}")
+
+
+def assert_signals_respond(fleet_http) -> None:
+    sig = http_json(fleet_http, "/fleet/signals")
+    log(f"signals: desired={sig['desired_replicas']} "
+        f"live={sig['live_replicas']} reasons={sig['reasons']}")
+    assert sig["actuates"] is False
+    assert sig["desired_replicas"] > sig["live_replicas"], (
+        "induced queue-wait + rejection pressure did not raise "
+        f"desired_replicas: {sig}")
+    joined = " ".join(sig["reasons"])
+    assert ("queue_wait" in joined or "rejection" in joined), sig
+
+
+def assert_zero_overhead_when_off(workdir: str, path: str) -> None:
+    """Fleet mode off => no fleet import, no heartbeat write, no fleet
+    dir — counter-asserted in a FRESH interpreter."""
+    cache2 = os.path.join(workdir, "cache-nofleet")
+    code = f"""
+import sys, os
+sys.path.insert(0, {REPO!r})
+from cobrix_tpu.serve import ScanServer, fetch_table
+srv = ScanServer(port=0, http_port=0,
+                 server_options={{"cache_dir": {cache2!r}}}).start()
+t = fetch_table(srv.address, {path!r}, tenant="etl",
+                copybook_contents={COPYBOOK!r})
+assert t.num_rows > 0
+srv.stop()
+assert not any(m.startswith("cobrix_tpu.fleet") for m in sys.modules), \\
+    "fleet imported with fleet mode off"
+assert not os.path.exists(os.path.join({cache2!r}, "fleet")), \\
+    "heartbeat written with fleet mode off"
+print("ZERO_OVERHEAD_OK")
+"""
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0 and "ZERO_OVERHEAD_OK" in out.stdout, (
+        out.stdout, out.stderr[-2000:])
+    log("fleet-off path counter-asserted zero-overhead "
+        "(no import, no heartbeat, no fleet dir)")
+
+
+def assert_kill_degrades(replicas, fleet_http, victim: int = 2) -> None:
+    """SIGKILL one replica; the fleet view must drop it from the live
+    set within ~one heartbeat interval and keep serving a partial
+    view."""
+    proc = replicas[victim][0]
+    proc.kill()  # SIGKILL: no drain, no unregister
+    proc.wait(timeout=10)
+    t_kill = time.monotonic()
+
+    def degraded():
+        doc = http_json(fleet_http, "/fleet/replicas")
+        live = [r["replica_id"] for r in doc["replicas"]
+                if r["state"] == "live"]
+        return None if f"r{victim}" in live else (doc, live)
+
+    doc, live = wait_for(degraded, HEARTBEAT_S * 4 + 2.0,
+                         "killed replica leaving the live set")
+    took = time.monotonic() - t_kill
+    assert f"r{victim}" not in live
+    # bounded by LIVE_FACTOR (1.6) intervals plus one poll step — "the
+    # fleet view degrades to live members within one heartbeat
+    # interval" of the record going overdue
+    assert took <= HEARTBEAT_S * 4 + 2.0
+    log(f"SIGKILLed r{victim} left the live view in {took:.2f}s "
+        f"(heartbeat {HEARTBEAT_S}s); live={live}")
+    # every endpoint still answers a PARTIAL view, never a crash/hang —
+    # and the dead replica's series are genuinely absent from it
+    text = http_get(fleet_http, "/fleet/metrics").decode()
+    assert f'replica="r{victim}"' not in text, (
+        f"federated exposition still carries the killed replica "
+        f"r{victim}")
+    sig = http_json(fleet_http, "/fleet/signals")
+    assert sig["live_replicas"] == len(replicas) - 1, sig
+    log("partial fleet view served after the kill "
+        f"(live_replicas={sig['live_replicas']})")
+
+
+def check_fleet(sweep: bool = False) -> bool:
+    with tempfile.TemporaryDirectory() as workdir:
+        path = os.path.join(workdir, "feed.dat")
+        n_rows = 4000
+        with open(path, "wb") as f:
+            f.write(make_records(n_rows))
+        cache_dir = os.path.join(workdir, "shared-cache")
+        audit_dir = os.path.join(workdir, "audit")
+        os.makedirs(audit_dir)
+        log("launching 3 fleet replicas sharing one cache_dir...")
+        replicas = [launch_replica(cache_dir, f"r{i}", audit_dir)
+                    for i in range(3)]
+        try:
+            fleet_http = replicas[0][2]
+
+            def all_live():
+                doc = http_json(fleet_http, "/fleet/replicas")
+                return doc if doc["live"] == 3 else None
+
+            wait_for(all_live, 15, "3 live replicas in the registry")
+            log("3 replicas live in /fleet/replicas")
+            # seed the signals history (the window baseline) BEFORE the
+            # load, so the post-load scrape sees in-window deltas
+            http_json(fleet_http, "/fleet/signals")
+            run_scans(replicas, path, n_rows,
+                      n_scans=8 if sweep else 5)
+            wait_quiesced(replicas)
+            # heartbeats carry post-scan state within one interval
+            time.sleep(HEARTBEAT_S * 2)
+            assert_exact_federation(replicas, fleet_http)
+            assert_slo_rollup(replicas, fleet_http)
+            assert_signals_respond(fleet_http)
+            # merged audit logs: the fleet-glob summary must see every
+            # replica (satellite: scanlog --merge)
+            out = subprocess.run(
+                [sys.executable, os.path.join(REPO, "tools",
+                                              "scanlog.py"),
+                 "summary", "--merge",
+                 os.path.join(audit_dir, "*.log")],
+                capture_output=True, text=True,
+                env=dict(os.environ, PYTHONPATH=REPO), timeout=60)
+            assert out.returncode == 0 and "fleet-wide" in out.stdout, \
+                (out.stdout, out.stderr)
+            log("scanlog --merge summarizes the fleet's audit logs")
+            assert_zero_overhead_when_off(workdir, path)
+            if sweep:
+                # kill UNDER LIVE LOAD: scans against the survivors
+                # must keep completing while the view degrades
+                loader_errors = []
+
+                def load_survivors():
+                    try:
+                        run_scans(replicas[:2], path, n_rows,
+                                  n_scans=2, follow=False)
+                    except Exception as exc:
+                        loader_errors.append(exc)
+
+                loader = threading.Thread(target=load_survivors)
+                loader.start()
+                assert_kill_degrades(replicas, fleet_http)
+                loader.join(timeout=120)
+                assert not loader_errors, (
+                    f"live load failed during the kill: "
+                    f"{loader_errors[0]}")
+                # a replacement replica REJOINS the fleet
+                replicas.append(launch_replica(cache_dir, "r3",
+                                               audit_dir))
+
+                def rejoined():
+                    doc = http_json(fleet_http, "/fleet/replicas")
+                    return any(r["replica_id"] == "r3"
+                               and r["state"] == "live"
+                               for r in doc["replicas"]) or None
+
+                wait_for(rejoined, 10, "replacement replica rejoining")
+                log("replacement replica r3 joined the live view")
+            else:
+                assert_kill_degrades(replicas, fleet_http)
+            return True
+        finally:
+            for proc, _scan, _http in replicas:
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc, _scan, _http in replicas:
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sweep", action="store_true",
+                    help="kill a replica under live load and prove "
+                         "rejoin (slow tier)")
+    args = ap.parse_args()
+    try:
+        ok = check_fleet(sweep=args.sweep)
+    except AssertionError as exc:
+        log(f"FAILED: {exc}")
+        return 1
+    log("all fleet assertions held")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    # SIGALRM backstop: a wedged fleet must fail loud, never hang CI
+    if hasattr(signal, "SIGALRM"):
+        signal.signal(signal.SIGALRM, lambda *a: (_ for _ in ()).throw(
+            TimeoutError("fleetcheck exceeded its global deadline")))
+        signal.alarm(600)
+    sys.exit(main())

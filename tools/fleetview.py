@@ -1,0 +1,228 @@
+"""Fleet dashboard: one live table over every serving replica.
+
+Points at the shared ``cache_dir`` a fleet heartbeats into (the same
+root every ``--fleet`` replica was started with) and renders the
+federated view in the terminal:
+
+    python tools/fleetview.py --cache-dir /shared/cache          # live
+    python tools/fleetview.py --cache-dir /shared/cache --once   # one
+    python tools/fleetview.py --cache-dir /shared/cache --json   # snap
+
+Columns per replica: liveness state, admission load (active/cap +
+queued), scan throughput over the refresh window (MB/s streamed),
+queue-wait p90, SLO burn (worst fast-window burn across objectives),
+memory-pressure level, follow-mode watermark lag. Below the table:
+cluster totals, the autoscaling recommendation (desired replicas +
+reasons), and the hottest cache-affinity fingerprints.
+
+``--json`` prints one machine-readable snapshot: the replica document,
+the SLO rollup, and the signals record (what ``/fleet/replicas|slo|
+signals`` serve, without needing a live replica to proxy through —
+fleetview federates client-side with the same library).
+
+Read-only: fleetview never writes into the registry and never touches
+the scan ports — it scrapes the HTTP sidecars exactly like the
+``/fleet/*`` endpoints do.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024.0
+    return f"{n:.1f}TB"
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v * 1000:.0f}ms" if v < 1.0 else f"{v:.2f}s"
+
+
+def _replica_counter(scrape, name: str, label_filter=None) -> float:
+    fam = scrape.families.get(name) if scrape.families else None
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for s in fam.samples:
+        labels = dict(s.labels)
+        if label_filter and any(labels.get(k) != v
+                                for k, v in label_filter.items()):
+            continue
+        total += s.value
+    return total
+
+
+def _replica_hist_q(scrape, name: str, q: float):
+    from cobrix_tpu.fleet.signals import _bucket_quantile
+    from cobrix_tpu.obs.promparse import fold_histogram
+
+    fam = scrape.families.get(name) if scrape.families else None
+    if fam is None:
+        return None
+    acc = fold_histogram(fam)
+    return _bucket_quantile(
+        {"buckets": sorted(acc["buckets"].items()),
+         "count": acc["count"], "sum": acc["sum"]}, q)
+
+
+def _worst_burn(slo_doc) -> str:
+    worst = None
+    for st in ((slo_doc or {}).get("slo") or {}).values():
+        burn = (st.get("burn_fast") or {}).get("burn")
+        if burn is not None and (worst is None or burn > worst):
+            worst = burn
+    if worst is None:
+        return "-"
+    flag = "!" if worst > 1.0 else ""
+    return f"{worst:.2f}{flag}"
+
+
+def render_table(view, prev_streamed: dict, dt_s: float,
+                 out=sys.stdout) -> dict:
+    """One frame; returns {replica_id: streamed_bytes} for the next
+    frame's throughput delta."""
+    rows = []
+    streamed_now = {}
+    for scrape in view.replicas:
+        rec = scrape.status.record
+        rid = rec.replica_id
+        streamed = _replica_counter(
+            scrape, "cobrix_serve_streamed_bytes_total")
+        streamed_now[rid] = streamed
+        if scrape.families is None:
+            rows.append((rid, scrape.status.state, "UNREACHABLE",
+                         "-", "-", "-", rec.pressure, "-"))
+            continue
+        delta = streamed - prev_streamed.get(rid, streamed)
+        mbps = (delta / dt_s / (1024 * 1024)) if dt_s > 0 else 0.0
+        rows.append((
+            rid, scrape.status.state,
+            f"{rec.active_scans}/{rec.max_concurrent_scans}"
+            f"+{rec.queued_scans}q",
+            f"{mbps:.1f}MB/s",
+            _fmt_s(_replica_hist_q(
+                scrape, "cobrix_serve_queue_wait_seconds", 0.90)),
+            _worst_burn(scrape.slo),
+            rec.pressure,
+            (_fmt_bytes(rec.lag_bytes) if rec.lag_bytes else "-"),
+        ))
+    hdr = ("REPLICA", "STATE", "LOAD", "THRU", "QWAIT p90",
+           "BURN", "PRESSURE", "LAG")
+    widths = [max(len(str(r[i])) for r in rows + [hdr])
+              for i in range(len(hdr))]
+    line = "  ".join(h.ljust(w) for h, w in zip(hdr, widths))
+    print(line, file=out)
+    print("-" * len(line), file=out)
+    for r in rows:
+        print("  ".join(str(v).ljust(w) for v, w in zip(r, widths)),
+              file=out)
+    return streamed_now
+
+
+def snapshot(cache_dir: str, timeout_s: float = 2.0,
+             federator=None) -> dict:
+    """One machine-readable federation pass (the --json body)."""
+    from cobrix_tpu.fleet.federate import FleetFederator
+    from cobrix_tpu.fleet.registry import ReplicaRegistry
+    from cobrix_tpu.fleet.signals import derive_signals
+
+    fed = federator or FleetFederator(
+        ReplicaRegistry(os.path.join(cache_dir, "fleet")),
+        timeout_s=timeout_s)
+    view = fed.view(force=True)
+    return {
+        "replicas": view.replicas_doc(),
+        "slo": fed.slo_rollup(view),
+        "signals": derive_signals(view, history=fed.history(),
+                                  slo_rollup=fed.slo_rollup(view)),
+    }
+
+
+def live(cache_dir: str, interval_s: float, timeout_s: float,
+         frames: int = 0, out=sys.stdout) -> int:
+    from cobrix_tpu.fleet.federate import FleetFederator
+    from cobrix_tpu.fleet.registry import ReplicaRegistry
+    from cobrix_tpu.fleet.signals import derive_signals
+
+    fed = FleetFederator(
+        ReplicaRegistry(os.path.join(cache_dir, "fleet")),
+        timeout_s=timeout_s)
+    prev: dict = {}
+    last_t = time.monotonic()
+    n = 0
+    try:
+        while True:
+            view = fed.view(force=True)
+            now = time.monotonic()
+            dt = now - last_t
+            last_t = now
+            if out is sys.stdout and sys.stdout.isatty() \
+                    and frames == 0:
+                print("\033[2J\033[H", end="", file=out)
+            print(f"cobrix fleet @ {time.strftime('%H:%M:%S')} — "
+                  f"{len(view.replicas)} replica(s), "
+                  f"{sum(1 for r in view.replicas if r.status.state == 'live')} live",
+                  file=out)
+            prev = render_table(view, prev, dt, out=out)
+            try:
+                sig = derive_signals(view, history=fed.history(),
+                                     slo_rollup=fed.slo_rollup(view))
+                print(f"\ndesired_replicas={sig['desired_replicas']} "
+                      f"(live={sig['live_replicas']}) — "
+                      + "; ".join(sig["reasons"]), file=out)
+                hot = sig.get("cache_affinity") or []
+                if hot:
+                    print("hot: " + ", ".join(
+                        f"{h['key']}@{h['replica']}({h['fleet_count']})"
+                        for h in hot[:4]), file=out)
+            except Exception as exc:
+                print(f"\nsignals unavailable: {exc}", file=out)
+            n += 1
+            if frames and n >= frames:
+                return 0
+            time.sleep(interval_s)
+    except KeyboardInterrupt:
+        return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--cache-dir", required=True,
+                    help="the fleet's shared cache root (replicas "
+                         "heartbeat under <cache-dir>/fleet)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh seconds in live mode")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-replica scrape timeout")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="one machine-readable snapshot "
+                         "(replicas + slo + signals) and exit")
+    args = ap.parse_args()
+    if args.json:
+        print(json.dumps(snapshot(args.cache_dir,
+                                  timeout_s=args.timeout),
+                         sort_keys=True, default=str))
+        return 0
+    return live(args.cache_dir, args.interval, args.timeout,
+                frames=1 if args.once else 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

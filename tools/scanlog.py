@@ -14,12 +14,22 @@ is the operator's grep with the schema built in:
     python tools/scanlog.py traceview TRACE.json [...]      # group
     python tools/scanlog.py traceview FLIGHT_DUMP_DIR/      # by id
 
+    # fleet mode: merge per-replica logs (each replica writes its own
+    # audit file — point --merge at them or at a glob)
+    python tools/scanlog.py summary --merge /var/log/replica-*.log
+    python tools/scanlog.py tail --merge r1.log r2.log r3.log \\
+                                 --trace-id 645c1539...
+
 * ``tail`` — newest records first, filtered by tenant / outcome /
   trace_id / request_id / breached SLO; resolves "this slow request's
   trace_id" to its audit record (and its flight-recorder dump path,
-  when one was written).
+  when one was written). With ``--merge`` over a fleet's logs, records
+  interleave by timestamp and carry a replica column, so one
+  ``--trace-id`` (or ``--request-id``) query follows a request ACROSS
+  replicas — including failover attempts tied by ``resume_of``.
 * ``summary`` — per-tenant and per-outcome counts, latency quantiles
-  (queue wait / first batch / e2e), breach counts, byte totals.
+  (queue wait / first batch / e2e), breach counts, byte totals. With
+  ``--merge``, a per-replica line each plus the fleet-wide rollup.
 * ``traceview`` — loads Chrome-trace artifacts (client-merged files,
   flight-recorder ``trace.json`` dumps, or a directory of either) and
   groups spans by the artifact's ``trace_id``: per request one line of
@@ -49,11 +59,68 @@ def _load_records(path: str, include_rotated: bool) -> List:
     return list(read_audit_log(path, include_rotated=include_rotated))
 
 
+def _expand_paths(paths: List[str]) -> List[str]:
+    """Glob-expand each path argument (a fleet points scanlog at
+    ``/var/log/replica-*.log``); literal paths pass through so a
+    missing file still errors loudly downstream."""
+    import glob as _glob
+
+    out: List[str] = []
+    for p in paths:
+        matches = sorted(_glob.glob(p)) if any(c in p for c in "*?[") \
+            else [p]
+        for m in (matches or [p]):
+            if m not in out:
+                out.append(m)
+    return out
+
+
+def _replica_labels(paths: List[str]) -> dict:
+    """path -> short replica label: the basename stem when unique
+    across the set, else the path relative to the common prefix
+    (absolute-normalized first — commonpath refuses mixed
+    absolute/relative input)."""
+    stems = [os.path.splitext(os.path.basename(p))[0] for p in paths]
+    if len(set(stems)) == len(stems):
+        return dict(zip(paths, stems))
+    resolved = {p: os.path.abspath(p) for p in paths}
+    prefix = (os.path.commonpath(list(resolved.values()))
+              if len(paths) > 1 else "")
+    return {p: (os.path.relpath(r, prefix) if prefix else p)
+            for p, r in resolved.items()}
+
+
+def _args_paths(args) -> tuple:
+    """(paths, merge) from an argparse namespace — tolerating the
+    pre-fleet single-``path`` shape for programmatic callers (tests
+    and scripts drive cmd_tail/cmd_summary with hand-built
+    namespaces)."""
+    paths = getattr(args, "paths", None)
+    if paths is None:
+        paths = [args.path]
+    return list(paths), bool(getattr(args, "merge", False))
+
+
+def _load_merged(files: List[str], include_rotated: bool) -> List:
+    """Records from every (already-expanded) log file, each stamped
+    with its replica label (``rec._replica``), merged oldest-first by
+    completion timestamp — the one total order a fleet of
+    independently-appending logs has."""
+    labels = _replica_labels(files)
+    records = []
+    for path in files:
+        for rec in _load_records(path, include_rotated):
+            rec._replica = labels[path]
+            records.append(rec)
+    records.sort(key=lambda r: r.ts)
+    return records
+
+
 def _fmt_latency(v: Optional[float]) -> str:
     return f"{v * 1000:8.1f}ms" if v is not None else "       - "
 
 
-def _render(rec) -> str:
+def _render(rec, merged: bool = False) -> str:
     flags = ""
     if getattr(rec, "resume_of", ""):
         flags = f" resume_of={rec.resume_of}"
@@ -62,7 +129,10 @@ def _render(rec) -> str:
     if rec.dump_path:
         flags += f" dump={rec.dump_path}"
     err = f" err={rec.error}" if rec.error else ""
-    return (f"{rec.request_id:<17} {rec.tenant:<10} {rec.outcome:<8} "
+    replica = (f"{getattr(rec, '_replica', '?'):<12} " if merged
+               else "")
+    return (f"{replica}{rec.request_id:<17} {rec.tenant:<10} "
+            f"{rec.outcome:<8} "
             f"rows={rec.rows:<9} q={_fmt_latency(rec.queue_wait_s)} "
             f"first={_fmt_latency(rec.first_batch_s)} "
             f"e2e={_fmt_latency(rec.e2e_s)} "
@@ -70,7 +140,13 @@ def _render(rec) -> str:
 
 
 def cmd_tail(args) -> int:
-    records = _load_records(args.path, args.all)
+    paths, merge = _args_paths(args)
+    files = _expand_paths(paths)
+    merged = merge or len(files) > 1
+    if merged:
+        records = _load_merged(files, args.all)
+    else:
+        records = _load_records(files[0], args.all)
     records.reverse()  # newest first
     out = []
     for rec in records:
@@ -94,8 +170,13 @@ def cmd_tail(args) -> int:
         if len(out) >= args.n:
             break
     for rec in out:
-        print(json.dumps(rec.as_dict(), sort_keys=True) if args.json
-              else _render(rec))
+        if args.json:
+            doc = rec.as_dict()
+            if merged:
+                doc["replica"] = getattr(rec, "_replica", "?")
+            print(json.dumps(doc, sort_keys=True))
+        else:
+            print(_render(rec, merged=merged))
     if not out:
         print("no matching records", file=sys.stderr)
         return 1
@@ -115,10 +196,42 @@ def _quantiles(values: List[float]) -> str:
 
 
 def cmd_summary(args) -> int:
-    records = _load_records(args.path, args.all)
+    paths, merge = _args_paths(args)
+    files = _expand_paths(paths)
+    merged = merge or len(files) > 1
+    if merged:
+        records = _load_merged(files, args.all)
+    else:
+        records = _load_records(files[0], args.all)
     if not records:
         print("no records", file=sys.stderr)
         return 1
+    if merged:
+        # per-replica rollup first: one line each, then the fleet-wide
+        # per-tenant view below (the quantiles an SLO is set against)
+        by_replica = {}
+        for rec in records:
+            r = by_replica.setdefault(getattr(rec, "_replica", "?"), {
+                "n": 0, "ok": 0, "bad": 0, "rows": 0,
+                "queue": [], "first": [], "e2e": []})
+            r["n"] += 1
+            r["ok" if rec.outcome == "ok" else "bad"] += 1
+            r["rows"] += rec.rows
+            for key, v in (("queue", rec.queue_wait_s),
+                           ("first", rec.first_batch_s),
+                           ("e2e", rec.e2e_s)):
+                if v is not None:
+                    r[key].append(v)
+        print(f"fleet: {len(records)} records from "
+              f"{len(by_replica)} replica log(s)")
+        for replica in sorted(by_replica):
+            r = by_replica[replica]
+            print(f"replica {replica}: n={r['n']} ok={r['ok']} "
+                  f"not_ok={r['bad']} rows={r['rows']}")
+            print(f"  queue wait   {_quantiles(r['queue'])}")
+            print(f"  first batch  {_quantiles(r['first'])}")
+            print(f"  e2e          {_quantiles(r['e2e'])}")
+        print("\nfleet-wide:")
     by_tenant = {}
     for rec in records:
         t = by_tenant.setdefault(rec.tenant, {
@@ -214,7 +327,12 @@ def main() -> int:
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     tail = sub.add_parser("tail", help="newest records, filtered")
-    tail.add_argument("path")
+    tail.add_argument("paths", nargs="+",
+                      help="audit log(s); globs allowed with --merge "
+                           "(multiple files imply it)")
+    tail.add_argument("--merge", action="store_true",
+                      help="merge several replicas' logs by timestamp "
+                           "with a replica column (fleet mode)")
     tail.add_argument("-n", type=int, default=20)
     tail.add_argument("--tenant", default="")
     tail.add_argument("--outcome", default="",
@@ -234,7 +352,12 @@ def main() -> int:
     tail.set_defaults(fn=cmd_tail)
 
     summary = sub.add_parser("summary", help="per-tenant rollup")
-    summary.add_argument("path")
+    summary.add_argument("paths", nargs="+",
+                         help="audit log(s); globs allowed with "
+                              "--merge (multiple files imply it)")
+    summary.add_argument("--merge", action="store_true",
+                         help="per-replica lines + fleet-wide rollup "
+                              "over several replicas' logs")
     summary.add_argument("--all", action="store_true")
     summary.set_defaults(fn=cmd_summary)
 
