@@ -656,6 +656,118 @@ def run_exp3_to_arrow(mb_target: float) -> dict:
     return result
 
 
+def run_exp_pushdown(mb_target: float) -> dict:
+    """Query-pushdown end-to-end: the exp3 wide copybook read with
+    `select` of 3 columns and a ~1%-selective COMPANY-ID filter,
+    against the full decode of the same input. The value is the
+    pushed-down read's effective MB/s (input bytes over wall time);
+    `speedup` is the claim tools/benchgate.py gates (>= 3x, ISSUE 13
+    acceptance): plan pruning must make the untouched columns actually
+    free, and the pre-decode drop must keep pruned records away from
+    the wide decode. Parity is asserted in-run: the pushed-down table
+    must equal post-hoc filter+null-projection of the full table."""
+    import tempfile
+
+    import pyarrow.compute as pc
+
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing.generators import EXP3_COPYBOOK, generate_exp3
+
+    est_per_record = 16072 * 0.33 + 68 * 0.67
+    n_records = max(256, int(mb_target * 1024 * 1024 / est_per_record))
+    raw = generate_exp3(n_records, seed=100)
+    mb = len(raw) / (1024 * 1024)
+    kw = dict(copybook_contents=EXP3_COPYBOOK, is_record_sequence="true",
+              segment_field="SEGMENT-ID",
+              schema_retention_policy="collapse_root",
+              redefine_segment_id_map="STATIC-DETAILS => C",
+              redefine_segment_id_map_1="CONTACTS => P")
+
+    def best_of(read_kw):
+        """Best of sequential and pipelined, like run_exp3_to_arrow —
+        a heavily-pruned scan finishes under the pipeline's scheduling
+        tick, so sequential often wins it while pipelined wins the
+        full decode."""
+        best = None
+        for variant in (read_kw, dict(read_kw, **_pipeline_kw())):
+            try:
+                t, table, metrics = _best_to_arrow(path, variant)
+            except Exception as exc:
+                _log(f"exp_pushdown variant failed: {exc}")
+                continue
+            if best is None or t < best[0]:
+                best = (t, table, metrics)
+        if best is None:
+            raise RuntimeError("every exp_pushdown variant failed")
+        return best
+
+    path = None
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+            f.write(raw)
+            path = f.name
+        full_best, full_table, _ = best_of(kw)
+        # a ~1%-selective predicate from the data itself: enough
+        # distinct COMPANY-IDs to cover ~1% of records
+        ids = full_table["COMPANY_ID"].to_pylist()
+        import collections
+
+        counts = collections.Counter(i for i in ids if i)
+        target = max(1, len(ids) // 100)
+        chosen, covered = [], 0
+        for value, cnt in counts.most_common():
+            if covered >= target:
+                break
+            chosen.append(value)
+            covered += cnt
+        filt = "COMPANY_ID in (%s)" % ", ".join(
+            "'%s'" % v for v in chosen)
+        select = "SEGMENT-ID,COMPANY-ID,COMPANY-NAME"
+        push_kw = dict(kw, select=select, filter=filt)
+        push_best, push_table, push_metrics = best_of(push_kw)
+        # parity: pushed-down == post-hoc filter of the full table on
+        # the selected columns, byte-identical
+        mask = pc.fill_null(pc.is_in(
+            full_table["COMPANY_ID"],
+            value_set=__import__("pyarrow").array(chosen)), False)
+        expect = full_table.filter(mask)
+        sel_cols = ["SEGMENT_ID", "COMPANY_ID"]
+        name_of = (lambda t: pc.struct_field(
+            t["STATIC_DETAILS"], "COMPANY_NAME").combine_chunks())
+        parity = (push_table.num_rows == expect.num_rows
+                  and push_table.select(sel_cols).equals(
+                      expect.select(sel_cols))
+                  and name_of(push_table).equals(name_of(expect)))
+        if not parity:
+            # a wrong-rows pushdown would otherwise RAISE the speedup
+            # (fewer rows decoded) and sail through the gate — parity
+            # failure must fail the experiment, not ride along as data
+            raise RuntimeError(
+                f"exp_pushdown parity violation: pushed-down "
+                f"{push_table.num_rows} rows vs post-hoc "
+                f"{expect.num_rows}")
+    finally:
+        if path:
+            os.unlink(path)
+    full_mbps = mb / full_best
+    push_mbps = mb / push_best
+    pushdown = push_metrics.get("pushdown") or {}
+    result = {
+        "metric": "exp_pushdown_to_arrow",
+        "value": round(push_mbps, 2),
+        "unit": "MB/s",
+        "full_MBps": round(full_mbps, 2),
+        "speedup": round(push_mbps / full_mbps, 2),
+        "rows_pruned": pushdown.get("records_pruned"),
+        "bytes_skipped": pushdown.get("bytes_skipped"),
+        "selectivity": pushdown.get("selectivity"),
+        "parity": bool(parity),
+        "roofline": _roofline_field(push_mbps),
+    }
+    _log(f"exp_pushdown: {result}")
+    return result
+
+
 def _headline(decode_only: dict, e2e: dict) -> dict:
     """Merge the two exp3 measurements into the emitted headline: the
     honest end-to-end number carries `value`/`vs_baseline`; the
@@ -1130,6 +1242,12 @@ def _side_metrics(mb_target: float) -> dict:
         side["exp_serve"] = run_serve_side_metric(min(mb_target, 24.0))
     except Exception as exc:
         _log(f"exp_serve side metric failed: {exc}")
+    try:
+        side["exp_pushdown"] = run_exp_pushdown(min(mb_target, 40.0))
+    except Exception as exc:
+        _log(f"exp_pushdown side metric failed: {exc}")
+        side["exp_pushdown"] = {"metric": "exp_pushdown_to_arrow",
+                                "error": str(exc)[:400]}
     return side
 
 

@@ -19,6 +19,7 @@ from .reader.stream import (ByteRangeSource, open_stream,
                             register_stream_backend, source_size)
 from .io import IoConfig, register_fsspec_backend
 from .streaming import ContinuousIngestor, SourceTruncated, tail_cobol
+from . import query
 from .copybook.datatypes import (
     CommentPolicy,
     DebugFieldsPolicy,
@@ -68,4 +69,5 @@ __all__ = [
     "RecordErrorPolicy",
     "ShardErrorPolicy",
     "ShardFailureInfo",
+    "query",
 ]

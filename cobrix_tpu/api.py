@@ -57,8 +57,12 @@ class Options:
     def __init__(self, options: Dict[str, object]):
         # Python-native callers pass mappings/lists directly (e.g.
         # occurs_mapping as a dict); the option layer is string-keyed like
-        # the reference's .option() map, so structured values carry as JSON
+        # the reference's .option() map, so structured values carry as
+        # JSON. query.Expr filters serialize via their canonical wire
+        # form, NOT str() — the grammar spelling cannot express fields
+        # named like its own keywords (SEGMENT, IN, NOT, ...)
         self._map = {str(k): (json.dumps(v) if isinstance(v, (dict, list))
+                              else v.canonical() if hasattr(v, "canonical")
                               else str(v))
                      for k, v in options.items()}
         self._used = set()
@@ -113,6 +117,21 @@ _ENUM_PARSERS = {
         "raw": DebugFieldsPolicy.RAW,
     },
 }
+
+
+def _normalize_filter_option(value: Optional[str]) -> Optional[str]:
+    """The `filter` option (grammar text, wire JSON, or the str() of a
+    query.Expr — all strings by the time the option layer sees them)
+    -> canonical wire JSON. Raises ValueError with the parse position
+    on malformed input, BEFORE any data is read."""
+    if not value:
+        return None
+    from .query.expr import normalize_filter
+
+    try:
+        return normalize_filter(value)
+    except (ValueError, TypeError) as exc:
+        raise ValueError(f"Invalid 'filter' option: {exc}") from exc
 
 
 def _parse_enum(opts: Options, key: str, default: str):
@@ -261,6 +280,7 @@ def parse_options(options: Dict[str, object],
         input_file_name_column=opts.get("with_input_file_name_col", ""),
         select=tuple(s.strip() for s in opts.get("select", "").split(",")
                      if s.strip()) or None,
+        filter=_normalize_filter_option(opts.get("filter")),
         record_error_policy=RecordErrorPolicy.parse(
             opts.get("record_error_policy", "fail_fast")),
         resync_window_bytes=opts.get_int("resync_window",
@@ -863,6 +883,12 @@ def read_cobol(path=None,
         raise ValueError("'path' must be specified for read_cobol.")
 
     params, opts = parse_options(options)
+    if params.filter and backend == "host":
+        raise ValueError(
+            "The 'filter' option requires a columnar execution path; "
+            "backend='host' walks records through the scalar oracle "
+            "and does not support pushdown. Drop the filter or use "
+            "the numpy/jax backend.")
     if explain and not params.field_costs:
         # explain wants the measured cost table; flip attribution on
         from dataclasses import replace as _dc_replace
@@ -903,10 +929,6 @@ def read_cobol(path=None,
 
             params = _dc_replace(params, input_split_size_mb=split_mb)
 
-    # Seg_Id columns exist only on the variable-length path (the reference
-    # fixed-length reader never generates them)
-    seg_count = (len(params.multisegment.segment_level_ids)
-                 if params.multisegment and is_var_len else 0)
     metrics = ReadMetrics(files=len(files), backend=backend,
                           hosts=max(hosts, 1))
     metrics.bytes_read = _total_input_bytes(files, metrics.io_stats)
@@ -933,11 +955,11 @@ def read_cobol(path=None,
                         f"not supported there (drop `hosts` for the "
                         f"{backend!r} backend)")
                 data = _read_cobol_multihost(
-                    files, copybook_contents, params, hosts, seg_count,
+                    files, copybook_contents, params, hosts,
                     debug_ignore_file_size, metrics)
             else:
                 data = _read_cobol_single_host(
-                    files, copybook_contents, params, backend, seg_count,
+                    files, copybook_contents, params, backend,
                     parallelism, pipe_workers, use_pipeline, is_var_len,
                     debug_ignore_file_size, metrics, io_cfg,
                     batch_tap=batch_tap)
@@ -1066,7 +1088,7 @@ def _abort_obs(obs_ctx, params: ReaderParameters) -> None:
 
 def _read_cobol_single_host(files, copybook_contents,
                             params: ReaderParameters, backend: str,
-                            seg_count: int, parallelism: int,
+                            parallelism: int,
                             pipe_workers: int, use_pipeline: bool,
                             is_var_len: bool,
                             debug_ignore_file_size: bool,
@@ -1099,14 +1121,9 @@ def _read_cobol_single_host(files, copybook_contents,
     # the output schema is a pure function of copybook + options; built
     # before the scan so the pipelined path can assemble per-chunk Arrow
     # tables against it while later chunks are still decoding
-    schema = CobolOutputSchema(
-        copybook_obj,
-        policy=params.schema_policy,
-        input_file_name_field=params.input_file_name_column,
-        generate_record_id=params.generate_record_id,
-        generate_seg_id_field_count=seg_count,
-        segment_id_prefix="",
-        corrupt_record_field=params.corrupt_record_column)
+    from .reader.schema import output_schema_for
+
+    schema = output_schema_for(copybook_obj, params, is_var_len)
 
     retry = _retry_policy(params)
     retries_seen: List[int] = []  # list.append is GIL-atomic across shards
@@ -1202,6 +1219,11 @@ def _read_cobol_single_host(files, copybook_contents,
     data.diagnostics = _aggregate_diagnostics(params, results,
                                               len(retries_seen),
                                               shard_failures)
+    pushdown = getattr(reader, "pushdown", None)
+    if pushdown is not None:
+        # pruning counters into the read's metrics BEFORE finalize, so
+        # the registry publication (Prometheus) sees them too
+        metrics.pushdown = pushdown.stats.as_dict()
     metrics.finalize(data, len(results))
     return data
 
@@ -1303,7 +1325,7 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
 
 
 def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
-                          seg_count: int, debug_ignore_file_size: bool,
+                          debug_ignore_file_size: bool,
                           metrics: Optional[ReadMetrics] = None
                           ) -> "CobolData":
     """The multi-host execution path: plan + fork + reassemble
@@ -1328,14 +1350,9 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
                                           io=_io_config(params))
         else:
             shards = plan_fixed_len_shards(reader, files, params, hosts)
-    schema = CobolOutputSchema(
-        reader.copybook,
-        policy=params.schema_policy,
-        input_file_name_field=params.input_file_name_column,
-        generate_record_id=params.generate_record_id,
-        generate_seg_id_field_count=seg_count,
-        segment_id_prefix="",
-        corrupt_record_field=params.corrupt_record_column)
+    from .reader.schema import output_schema_for
+
+    schema = output_schema_for(reader.copybook, params, is_var_len)
     with stage(metrics, "scan"):
         tables, shard_failures, supervision = multihost_scan(
             reader, shards, is_var_len, schema, hosts, prefix,

@@ -69,7 +69,7 @@ class ScanReport:
 
     def __init__(self, copybook_summary: dict, fields: List[dict],
                  groups: List[dict], plan: dict, cache_planes: dict,
-                 data=None, metrics=None):
+                 data=None, metrics=None, pushdown=None):
         self.copybook = copybook_summary
         self.fields = fields          # FieldPlan.describe() rows
         self.groups = groups          # FieldPlan.group_summary() rows
@@ -77,6 +77,10 @@ class ScanReport:
         self.cache_planes = cache_planes
         self.data = data              # CobolData (post-scan only)
         self.metrics = metrics        # ReadMetrics (post-scan only)
+        # query-pushdown section (query/pushdown.describe_pushdown):
+        # retained vs pruned fields, per-depth decisions, the
+        # late-materialized set — None when no select/filter configured
+        self.pushdown = pushdown
 
     # -- measured costs (post-scan) --------------------------------------
 
@@ -138,6 +142,13 @@ class ScanReport:
             "plan": self.plan,
             "cache_planes": self.cache_planes,
         }
+        if self.pushdown is not None:
+            out["pushdown"] = self.pushdown
+        if (self.metrics is not None
+                and self.metrics.pushdown is not None):
+            out.setdefault("pushdown", {})
+            out["pushdown"] = dict(out["pushdown"],
+                                   measured=self.metrics.pushdown)
         roof = self.roofline
         if roof is not None:
             out["roofline"] = roof
@@ -168,6 +179,37 @@ class ScanReport:
                 f"{name}={row['status']}"
                 for name, row in self.cache_planes.items()),
         ]
+        pd = self.pushdown
+        if pd is not None:
+            line = (f"pushdown: {pd['fields_retained']}/"
+                    f"{pd['fields_total']} fields retained "
+                    f"({pd['fields_pruned']} pruned from the plan)")
+            if pd.get("filter"):
+                line += f"; filter: {pd['filter']}"
+            lines.append(line)
+            depths = []
+            if pd.get("pre_decode_segment_drop"):
+                depths.append("segment-id drop on raw bytes "
+                              f"({','.join(pd['pre_decode_segment_drop'])})")
+            if pd.get("stage1_filter_fields"):
+                depths.append("stage-1 decode of "
+                              + ",".join(pd["stage1_filter_fields"]))
+            if pd.get("residual"):
+                depths.append(f"post-decode residual: {pd['residual']}")
+            if depths:
+                lines.append("  depths: " + "; ".join(depths))
+            if pd.get("late_materialized"):
+                lines.append("  late-materialized (decoded for the "
+                             "predicate, not assembled): "
+                             + ",".join(pd["late_materialized"]))
+            measured = (self.metrics.pushdown
+                        if self.metrics is not None else None)
+            if measured:
+                lines.append(
+                    f"  measured: {measured['records_pruned']}/"
+                    f"{measured['records_scanned']} records pruned, "
+                    f"{measured['bytes_skipped']} bytes skipped, "
+                    f"selectivity {measured['selectivity']}")
         roof = self.roofline
         if roof is not None:
             line = f"roofline: {roof['bandwidth_GBps']} GB/s calibrated"
@@ -233,6 +275,10 @@ def _execution_plan(params, files: List[str], total_bytes: int,
     }
     if params.select:
         plan["select"] = list(params.select)
+    if getattr(params, "filter", None):
+        from .query.expr import from_wire
+
+        plan["filter"] = str(from_wire(params.filter))
     if mode == "fixed-length" and total_bytes:
         chunk_bytes = max(1, int(params.pipeline_chunk_mb * 1024 * 1024))
         plan["est_chunks"] = max(1, -(-total_bytes // chunk_bytes))
@@ -303,6 +349,8 @@ def explain(copybook: Optional[str] = None,
         from .obs.roofline import measured_bandwidth
 
         measured_bandwidth()
+    from .query.pushdown import describe_pushdown
+
     return ScanReport(
         copybook_summary=_copybook_summary(copybook_obj, plan),
         fields=plan.describe(),
@@ -310,6 +358,7 @@ def explain(copybook: Optional[str] = None,
         plan=_execution_plan(params, files, total_bytes, backend, hosts),
         cache_planes=_cache_planes(dict(scope.stats), None,
                                    params.cache_dir),
+        pushdown=describe_pushdown(copybook_obj, params),
     )
 
 
@@ -324,6 +373,8 @@ def build_scan_report(params, files: List[str], data,
     # plan-cache hit by construction (the read compiled it); describes
     # the whole layout (active_segment=None) like the pre-scan report
     plan = cached_compile_plan(copybook_obj, None, select=params.select)
+    from .query.pushdown import describe_pushdown
+
     return ScanReport(
         copybook_summary=_copybook_summary(copybook_obj, plan),
         fields=plan.describe(),
@@ -334,4 +385,5 @@ def build_scan_report(params, files: List[str], data,
                                    params.cache_dir),
         data=data,
         metrics=metrics,
+        pushdown=describe_pushdown(copybook_obj, params),
     )

@@ -76,6 +76,11 @@ class ScanRecord:
     # True for continuous-ingest follow sessions (serve follow=true):
     # long-lived by design, so e2e latency objectives skip them
     follow: bool = False
+    # query pushdown: records dropped before the full decode and the
+    # scan's selectivity (kept/scanned; None when no filter ran) — a
+    # tenant's filtered scans are distinguishable from tiny files
+    records_pruned: int = 0
+    selectivity: Optional[float] = None
 
     def as_dict(self) -> dict:
         out = asdict(self)
@@ -112,6 +117,7 @@ def record_from_summary(request_id: str, trace_id: str, tenant: str,
         if n and key.endswith("_hits"):
             cache[f"plan_{key}"] = int(n)
     roof = metrics.get("roofline") or {}
+    pushdown = metrics.get("pushdown") or {}
     return ScanRecord(
         request_id=request_id, trace_id=trace_id, tenant=tenant,
         outcome=outcome, ts=time.time(),
@@ -124,7 +130,9 @@ def record_from_summary(request_id: str, trace_id: str, tenant: str,
         roofline_fraction=roof.get("fraction"),
         cache=cache, error=error,
         resume_of=resume_of or str(summary.get("resume_of") or ""),
-        follow=bool(summary.get("follow")))
+        follow=bool(summary.get("follow")),
+        records_pruned=int(pushdown.get("records_pruned") or 0),
+        selectivity=pushdown.get("selectivity"))
 
 
 class AuditLog:

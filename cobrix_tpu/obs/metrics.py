@@ -385,6 +385,18 @@ def scan_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "cobrix_io_remote_bytes_total",
             "Bytes fetched from remote storage backends",
             label_names=("source",)),
+        # -- query pushdown (cobrix_tpu.query) --------------------------
+        "records_pruned": r.counter(
+            "cobrix_records_pruned_total",
+            "Records dropped by filter pushdown before the full "
+            "decode, by depth (segment = raw-byte segment-id "
+            "conjuncts, filter = stage-1 predicate decode, residual "
+            "= post-decode fallback paths)",
+            label_names=("depth",)),
+        "bytes_skipped": r.counter(
+            "cobrix_bytes_skipped_total",
+            "Record bytes that never reached the full decode because "
+            "filter pushdown dropped their records"),
         # achieved scan bytes/s of the most recent read as a fraction
         # of the calibrated host memory bandwidth (obs.roofline) — the
         # decode-throughput-law view: a regression shows as a smaller

@@ -146,6 +146,12 @@ class ReadMetrics:
     # finished obs.Tracer span records when the read traced (trace_file
     # or an explicitly attached tracer); None otherwise
     spans: Optional[list] = None
+    # query-pushdown pruning counters (records_scanned/records_pruned
+    # by depth, bytes_skipped, selectivity — query/pushdown.
+    # PushdownStats.as_dict); None when the read carried no filter.
+    # In-process executions only: multihost workers prune in their own
+    # processes and their counters stay there
+    pushdown: Optional[dict] = None
 
     def __post_init__(self):
         from .io.stats import IoStats
@@ -292,6 +298,13 @@ class ReadMetrics:
         if io.get("bytes_from_cache"):
             m["remote_bytes"].labels(source="cache").inc(
                 io["bytes_from_cache"])
+        pd = self.pushdown or {}
+        for depth in ("segment", "filter", "residual"):
+            count = pd.get(f"records_pruned_{depth}", 0)
+            if count:
+                m["records_pruned"].labels(depth=depth).inc(count)
+        if pd.get("bytes_skipped"):
+            m["bytes_skipped"].inc(pd["bytes_skipped"])
         roof = self.roofline()
         if roof is not None:
             m["roofline"].set(roof["fraction"])
@@ -316,6 +329,8 @@ class ReadMetrics:
             out["plan_cache"] = self.plan_cache
         if self.io is not None:
             out["io"] = self.io
+        if self.pushdown is not None:
+            out["pushdown"] = self.pushdown
         fc = self.field_costs
         if fc is not None:
             out["field_costs"] = fc

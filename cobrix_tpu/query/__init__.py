@@ -1,0 +1,45 @@
+"""Query pushdown subsystem: typed filter expressions + projection,
+pushed through plan compilation, the chunk scan, and every serving
+surface (ROADMAP item 2 — the modern equivalent of the reference's
+Spark DataSource pushdown, which it never had: its TableScan decodes
+every field of every record, CobolScanners.scala:38-55).
+
+Public surface:
+
+* ``col/lit`` + operator overloads, ``parse_filter`` — build a filter
+  expression (``expr.Expr``), pass it (or its string form) as the
+  ``filter=`` option of ``read_cobol``/``tail_cobol``/the serve 'R'
+  frame/Flight tickets.
+* ``dataset()`` — a ``pyarrow.dataset``-shaped scan surface whose
+  scanner lowers pyarrow compute expressions into the same pushdown
+  pipeline, so DuckDB/Polars-class engines plan SQL over mainframe
+  files and the pruning arrives for free.
+
+Pushdown depths (see README "Query pushdown"):
+
+1. **plan pruning** — the FieldPlan compiles only selected +
+   filter-referenced fields (zero decode, zero assembly for the rest);
+2. **pre-decode record drop** — segment-id conjuncts evaluate against
+   the raw record bytes in the chunk scan; remaining predicates run as
+   a narrow stage-1 decode of ONLY the filter columns, and dropped
+   records never reach the full decode;
+3. **late materialization** — filter-only columns decode for the
+   predicate but are never assembled into the output table.
+"""
+from .expr import (  # noqa: F401
+    And,
+    Comparison,
+    Expr,
+    Field,
+    IsIn,
+    Literal,
+    Not,
+    Or,
+    SegmentIs,
+    col,
+    lit,
+    normalize_filter,
+    parse_filter,
+    segment_is,
+)
+from .dataset import CobolDataset, CobolFragment, CobolScanner, dataset  # noqa: F401

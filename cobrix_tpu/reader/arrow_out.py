@@ -682,6 +682,43 @@ class ArrowBatchBuilder:
         fc.commit(tok, (name,), fieldcost.PLANE_ASSEMBLE, 0, 0)
         return arr
 
+    def _subtree_planned(self, st: Statement) -> bool:
+        """True when any leaf under `st` has a compiled column. False
+        means the whole subtree was pruned by the projection and its
+        output is pure nulls — buildable without walking slots."""
+        planned = getattr(self.decoder, "planned_statement_ids", None)
+        if planned is None:
+            return True
+        if id(st) in planned:
+            return True
+        if isinstance(st, Group):
+            return any(self._subtree_planned(c) for c in st.children)
+        return False
+
+    def _flat_null_values(self, st: Statement, max_size: int):
+        """Record-major all-null values array for a PRUNED constant-size
+        OCCURS subtree (primitive elements, or a group of primitive
+        non-array children) — shape-identical to what the per-slot walk
+        would build (valid structs, null leaves), at O(fields) cost
+        instead of O(slots). None -> caller takes the slow exact path."""
+        pa = _pa()
+        total = self.n * max_size
+        if not isinstance(st, Group):
+            return pa.nulls(total,
+                            type=to_arrow_type(primitive_data_type(st)))
+        names, children = [], []
+        for child in st.children:
+            if child.is_filler:
+                continue
+            if isinstance(child, Group) or child.is_array:
+                return None
+            names.append(child.name)
+            children.append(pa.nulls(
+                total, type=to_arrow_type(primitive_data_type(child))))
+        if not children:
+            return None
+        return pa.StructArray.from_arrays(children, names=names)
+
     def _list_array_impl(self, st: Statement, slot_path):
         pa = _pa()
         n, max_size = self.n, st.array_max_size
@@ -690,9 +727,17 @@ class ArrowBatchBuilder:
                 and n * max_size < 2**31 - 1):
             # constant-size OCCURS: one flat record-major values array,
             # uniform offsets — no per-slot arrays, no interleave take
-            flat = (self._flat_struct_values(st, slot_path, max_size)
-                    if isinstance(st, Group)
-                    else self._flat_slot_values(st, slot_path, max_size))
+            flat = None
+            if not self._subtree_planned(st):
+                # projection pruned the whole plane: zero assembly —
+                # the pushdown claim that an unselected wide OCCURS
+                # (exp3's 2000-element STRATEGY) costs nothing
+                flat = self._flat_null_values(st, max_size)
+            if flat is None:
+                flat = (self._flat_struct_values(st, slot_path, max_size)
+                        if isinstance(st, Group)
+                        else self._flat_slot_values(st, slot_path,
+                                                    max_size))
             if flat is not None:
                 offsets = np.arange(n + 1, dtype=np.int32) * max_size
                 return pa.ListArray.from_arrays(pa.array(offsets), flat)

@@ -902,6 +902,13 @@ class ColumnarDecoder:
         # column index -> its kernel group (group-batched Arrow builds)
         self.group_of_col: Dict[int, _KernelGroup] = {
             c.index: g for g in self.kernel_groups for c in g.columns}
+        # statements with at least one compiled column: a projected plan
+        # (select/filter pushdown) leaves pruned statements out, and the
+        # Arrow builder emits whole pruned subtrees as cheap null bodies
+        # instead of walking thousands of absent OCCURS slots
+        self.planned_statement_ids = frozenset(
+            id(c.statement) for c in self.plan.columns
+            if c.statement is not None)
         # marshaled merged-numeric descriptors, keyed by the group subset
         # (decode() always passes the full list; decode_raw passes masked
         # subsets) — rebuilt per decode call they cost ~5ms on a

@@ -45,6 +45,12 @@ class FixedLenReader:
         self.segment_redefine_map = dict(
             seg.segment_id_redefine_map) if seg else {}
         self._seg_decoders: dict = decoder_cache_for(self.copybook)
+        # predicate pushdown (query/pushdown.py): bound once per reader,
+        # shared (with its counters) by every shard/chunk of the read
+        from ..query.pushdown import BoundFilter
+
+        self.pushdown = BoundFilter.build(params.filter, self.copybook,
+                                          params)
 
     @property
     def record_size(self) -> int:
@@ -197,6 +203,17 @@ class FixedLenReader:
             else:
                 lengths = (np.full(matrix.shape[0], width, dtype=np.int64)
                            if width < self.copybook.record_size else None)
+        positions = None
+        if self.pushdown is not None:
+            with timed_stage(stage_times, "decode"):
+                positions = self._pushdown_positions(
+                    trimmed, lengths, backend,
+                    segment_ids=(self._segment_values(matrix)
+                                 if self.pushdown.segment_values
+                                 is not None else None))
+            result.records_framed = trimmed.shape[0]
+            trimmed = trimmed[positions]
+            lengths = lengths[positions] if lengths is not None else None
         with timed_stage(stage_times, "decode"):
             batch = self.decoder(backend).decode(trimmed, lengths=lengths)
         n = batch.n_records
@@ -204,11 +221,46 @@ class FixedLenReader:
         if obs is not None and obs.metrics is not None and n:
             obs.metrics["record_length"].observe_repeat(
                 self.record_size, n)
-        positions = np.arange(n, dtype=np.int64)
+        if positions is None:
+            positions = np.arange(n, dtype=np.int64)
         result.n_rows = n
         result.segments.append(SegmentBatch(
             batch, None, positions, first_record_id + positions))
         return result
+
+    def _pushdown_positions(self, matrix: np.ndarray,
+                            lengths: Optional[np.ndarray], backend: str,
+                            active: str = "",
+                            segment_ids=None,
+                            base: Optional[np.ndarray] = None
+                            ) -> np.ndarray:
+        """Kept record positions after the filter: segment-id conjuncts
+        drop on raw bytes, then the stage-1 decode of ONLY the filter
+        columns evaluates the value predicate. `matrix`/`lengths` cover
+        the records at `base` (all rows when base is None)."""
+        pd = self.pushdown
+        n = matrix.shape[0]
+        kept = np.arange(n, dtype=np.int64)
+        pruned_segment = 0
+        if pd.segment_values is not None and segment_ids is not None:
+            mask = segment_ids.mask_of(set(pd.segment_values))
+            if base is not None:
+                mask = mask[base]
+            kept = kept[mask]
+            pruned_segment = n - len(kept)
+        pruned_filter = 0
+        if pd.value_expr is not None and len(kept):
+            sub = matrix if len(kept) == n else matrix[kept]
+            sub_len = (lengths if lengths is None or len(kept) == n
+                       else lengths[kept])
+            keep = pd.mask_matrix(self, active, backend, sub, sub_len)
+            pruned_filter = len(kept) - int(keep.sum())
+            kept = kept[keep]
+        pd.stats.note(scanned=n, pruned_segment=pruned_segment,
+                      pruned_filter=pruned_filter,
+                      bytes_skipped=(pruned_segment + pruned_filter)
+                      * self.record_size)
+        return kept if base is None else base[kept]
 
     def _policy_tail(self, data, ignore_file_size: bool,
                      file_name: str) -> int:
@@ -296,6 +348,9 @@ class FixedLenReader:
 
         trimmed, width = self._trimmed_matrix(matrix)
         result.n_rows = matrix.shape[0]
+        if self.pushdown is not None:
+            result.records_framed = matrix.shape[0]
+            result.n_rows = 0
         for active in set(segment_ids.map_uniq(self.segment_redefine_map)):
             positions = np.nonzero(segment_ids.mask_of_mapped(
                 self.segment_redefine_map, active))[0].astype(np.int64)
@@ -307,6 +362,20 @@ class FixedLenReader:
             else:
                 lengths = (np.full(len(positions), width, dtype=np.int64)
                            if width < self.copybook.record_size else None)
+            if self.pushdown is not None:
+                positions = self._pushdown_positions(
+                    trimmed[positions], lengths, backend, active=active,
+                    segment_ids=segment_ids, base=positions)
+                if rec_lengths is not None:
+                    lengths = np.minimum(np.maximum(
+                        rec_lengths[positions]
+                        - self.params.start_offset, 0), width)
+                elif lengths is not None:
+                    lengths = np.full(len(positions), width,
+                                      dtype=np.int64)
+                result.n_rows += len(positions)
+                if not len(positions):
+                    continue
             decoded = decoder.decode(trimmed[positions], lengths=lengths)
             result.segments.append(SegmentBatch(
                 decoded, active or None, positions,

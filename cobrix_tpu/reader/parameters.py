@@ -89,6 +89,14 @@ class ReaderParameters:
     # column projection: decode only these fields (others emit null).
     # A TPU-native extension — the reference decodes every field per record
     select: Optional[Sequence[str]] = None
+    # predicate pushdown: the canonical wire-JSON form of a
+    # cobrix_tpu.query filter expression (query/expr.py), normalized by
+    # the option parser so every surface (serve 'R' frames, Flight
+    # tickets, resume/plan fingerprints) sees ONE deterministic
+    # spelling. None = no filter. Bound to the copybook per reader
+    # (query/pushdown.BoundFilter); rows failing it are dropped before
+    # the full decode wherever a static columnar plan exists
+    filter: Optional[str] = None
     # -- fault tolerance (Spark parse-mode analogue; not a reference
     # option — the reference is fail-fast only) --------------------------
     record_error_policy: RecordErrorPolicy = RecordErrorPolicy.FAIL_FAST

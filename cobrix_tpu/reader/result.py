@@ -215,10 +215,18 @@ class FileResult:
         from .arrow_out import arrow_schema, rows_to_table, segment_table
 
         # a table assembled eagerly (pipeline engine's per-chunk assemble
-        # stage) serves any later call for the same schema directly
-        if self._arrow_cache is not None \
-                and self._arrow_cache_schema is output_schema:
-            return self._arrow_cache
+        # stage, or the generic filter path) serves any later call for
+        # the same schema directly — by identity first, then by Arrow
+        # structural equality: the API layer builds its OWN
+        # CobolOutputSchema instance from the same inputs, and a
+        # reader-side filtered table must not be thrown away and
+        # rebuilt from Python rows just because the instances differ
+        if self._arrow_cache is not None:
+            if self._arrow_cache_schema is output_schema:
+                return self._arrow_cache
+            if self._arrow_cache.schema.equals(
+                    arrow_schema(output_schema.schema)):
+                return self._arrow_cache
         # prefer the kernel outputs even when rows were also materialized
         # (to_rows caching must not reroute to_arrow onto the row fallback)
         if not self.segments:

@@ -110,6 +110,27 @@ def primitive_data_type(p: Primitive):
     raise TypeError(f"Unknown AST object {dt!r}")
 
 
+def output_schema_for(copybook, params, is_var_len: bool
+                      ) -> "CobolOutputSchema":
+    """The read's output schema from one (copybook, parameters) pair —
+    the SINGLE construction every layer shares (api single-host and
+    multihost paths, the readers' generic filter path, the dataset
+    schema probe), so the schema a pre-built table was assembled under
+    can never drift from the one the API layer asks for. Seg_Id
+    columns exist only on the variable-length path (the reference
+    fixed-length reader never generates them), hence `is_var_len`."""
+    seg_count = (len(params.multisegment.segment_level_ids)
+                 if params.multisegment and is_var_len else 0)
+    return CobolOutputSchema(
+        copybook,
+        policy=params.schema_policy,
+        input_file_name_field=params.input_file_name_column,
+        generate_record_id=params.generate_record_id,
+        generate_seg_id_field_count=seg_count,
+        segment_id_prefix="",
+        corrupt_record_field=params.corrupt_record_column)
+
+
 class CobolOutputSchema:
     """Nested and flat output schemas + generated-field bookkeeping
     (reference reader/schema/CobolSchema.scala:38-76 and

@@ -214,6 +214,12 @@ class VarLenReader:
             seg.segment_id_redefine_map) if seg else {}
         self._decoders: Dict[str, ColumnarDecoder] = \
             decoder_cache_for(self.copybook)
+        # predicate pushdown (query/pushdown.py): bound once per reader,
+        # shared (with its counters) by every shard/chunk of the read
+        from ..query.pushdown import BoundFilter
+
+        self.pushdown = BoundFilter.build(params.filter, self.copybook,
+                                          params)
         # variable-size OCCURS that shift later fields make the static
         # columnar plan inapplicable — those records decode on the host.
         # Walked over the whole record (all 01-level roots in one pass): a
@@ -900,6 +906,16 @@ class VarLenReader:
 
         start = params.start_offset
         kept = np.nonzero(keep)[0]
+        if self.pushdown is not None:
+            # scanned = records the PUSHDOWN examined: level-gating and
+            # the legacy segment_id_filter dropped theirs above, and
+            # counting them as scanned-but-unpruned would overstate
+            # selectivity in the audit/fleet rollups
+            kept = self._pushdown_kept(
+                self.pushdown, kept, data, offsets, lengths,
+                segment_ids, start, backend, n_scanned=len(kept))
+            keep = np.zeros(n, dtype=bool)
+            keep[kept] = True
         result.n_rows = len(kept)
 
         # Decode ONCE over every kept record with the full (all-redefines)
@@ -968,6 +984,56 @@ class VarLenReader:
                     level_ids_per_record.take(positions)
                     if level_ids_per_record is not None else None)))
 
+    def _pushdown_kept(self, pushdown, kept: np.ndarray, data,
+                       offsets: np.ndarray, lengths: np.ndarray,
+                       segment_ids, start: int, backend: str,
+                       n_scanned: int) -> np.ndarray:
+        """Pushdown over the kept records of a framed shard: segment-id
+        conjuncts drop on the raw id bytes (depth 2, no decode at
+        all), then the stage-1 decode of ONLY the filter columns
+        evaluates the value predicate — per active segment, so a field
+        owned by one redefine evaluates null (and therefore drops) on
+        other segments' records, exactly like a post-hoc filter on the
+        assembled nested table."""
+        pruned_segment = 0
+        bytes_skipped = 0
+        if pushdown.segment_values is not None and segment_ids is not None \
+                and len(kept):
+            mask = segment_ids.mask_of(set(pushdown.segment_values))[kept]
+            pruned_segment = len(kept) - int(mask.sum())
+            if pruned_segment:
+                bytes_skipped += int(lengths[kept][~mask].sum())
+            kept = kept[mask]
+        pruned_filter = 0
+        if pushdown.value_expr is not None and len(kept):
+            if segment_ids is None or not self.segment_redefine_map:
+                mask = pushdown.mask_raw(
+                    self, "", backend, data, offsets[kept],
+                    lengths[kept], start_offset=start)
+            else:
+                mask = np.zeros(len(kept), dtype=bool)
+                for active in set(segment_ids.map_uniq(
+                        self.segment_redefine_map)):
+                    amask = segment_ids.mask_of_mapped(
+                        self.segment_redefine_map, active)[kept]
+                    idx = np.nonzero(amask)[0]
+                    if not len(idx):
+                        continue
+                    sub = kept[idx]
+                    m = pushdown.mask_raw(
+                        self, active, backend, data, offsets[sub],
+                        lengths[sub], start_offset=start)
+                    mask[idx[m]] = True
+            pruned_filter = len(kept) - int(mask.sum())
+            if pruned_filter:
+                bytes_skipped += int(lengths[kept][~mask].sum())
+            kept = kept[mask]
+        pushdown.stats.note(scanned=n_scanned,
+                            pruned_segment=pruned_segment,
+                            pruned_filter=pruned_filter,
+                            bytes_skipped=bytes_skipped)
+        return kept
+
     def read_rows_columnar(self, stream: SimpleStream, file_id: int = 0,
                            backend: str = "numpy",
                            segment_id_prefix: Optional[str] = None,
@@ -1032,6 +1098,12 @@ class VarLenReader:
                         start_record_id=start_record_id,
                         input_file_name=ctx["input_file_name"])
                     if ctx["n"] else None)
+                if self.pushdown is not None:
+                    # no static columnar plan -> the whole filter runs
+                    # post-decode on the assembled table (correct,
+                    # unpruned; the explain report calls this depth out)
+                    self.pushdown.filter_result_generic(
+                        result, self._output_schema())
                 return result
             rows = list(self.iter_rows(
                 stream, file_id=file_id,
@@ -1041,6 +1113,9 @@ class VarLenReader:
                 ledger=ledger))
             result.rows = rows
             result.n_rows = len(rows)
+            if self.pushdown is not None:
+                self.pushdown.filter_result_generic(
+                    result, self._output_schema())
             return result
         fast = self._frame_fast(stream, ledger=ledger,
                                 stage_times=stage_times)
@@ -1060,6 +1135,12 @@ class VarLenReader:
                        if seg else None)
         level_count = len(seg.segment_level_ids) if seg else 0
         segment_filter = set(seg.segment_id_filter) if seg and seg.segment_id_filter else None
+        pushdown = self.pushdown
+        pd_segments = (set(pushdown.segment_values)
+                       if pushdown is not None
+                       and pushdown.segment_values is not None else None)
+        pd_scanned = pd_pruned_segment = pd_pruned_filter = 0
+        pd_bytes_skipped = 0
 
         framed = []   # (record_index, active_redefine, data, level_ids)
         record_reader = self.make_record_reader(
@@ -1079,6 +1160,15 @@ class VarLenReader:
                 if segment_filter is not None \
                         and segment_id not in segment_filter:
                     continue
+                if pushdown is not None:
+                    pd_scanned += 1
+                    if pd_segments is not None \
+                            and segment_id not in pd_segments:
+                        # depth-2 pushdown: the segment-id conjunct
+                        # drops the record at framing time
+                        pd_pruned_segment += 1
+                        pd_bytes_skipped += len(data)
+                        continue
                 active = self.segment_redefine_map.get(segment_id, "")
                 framed.append((record_index, active, data, level_ids))
         result.records_framed = (record_reader.record_index + 1
@@ -1102,8 +1192,14 @@ class VarLenReader:
                 decoder = self._decoder_for_segment(active, backend)
                 # pack to the plan's byte extent, not the full record
                 # size — narrow segments of a wide copybook decode from
-                # narrow matrices
+                # narrow matrices (wide enough for the stage-1 filter
+                # columns too: the predicate may reach past the
+                # projected plan)
                 rs = decoder.plan.max_extent
+                if pushdown is not None \
+                        and pushdown.value_expr is not None:
+                    rs = max(rs, pushdown._stage1_decoder(
+                        self, active, backend).plan.max_extent)
                 batch = np.zeros((len(positions), rs), dtype=np.uint8)
                 lengths = np.zeros(len(positions), dtype=np.int64)
                 for row_i, pos in enumerate(positions):
@@ -1111,6 +1207,27 @@ class VarLenReader:
                     batch[row_i, :len(payload)] = np.frombuffer(payload,
                                                                 np.uint8)
                     lengths[row_i] = len(payload)
+                if pushdown is not None \
+                        and pushdown.value_expr is not None \
+                        and len(positions):
+                    keep = pushdown.mask_matrix(self, active, backend,
+                                                batch, lengths)
+                    if not keep.all():
+                        dropped = int(len(keep) - keep.sum())
+                        pd_pruned_filter += dropped
+                        # FULL record bytes, not the stage-extent-
+                        # clamped payload — bytes_skipped must agree
+                        # with the fast path for the same file+filter
+                        pd_bytes_skipped += sum(
+                            len(framed[p][2])
+                            for p, k in zip(positions, keep) if not k)
+                        result.n_rows -= dropped
+                        batch = batch[keep]
+                        lengths = lengths[keep]
+                        positions = [p for p, k in zip(positions, keep)
+                                     if k]
+                        if not positions:
+                            continue
                 decoded = decoder.decode(batch, lengths=lengths)
                 has_levels = level_count > 0
                 result.segments.append(SegmentBatch(
@@ -1120,7 +1237,24 @@ class VarLenReader:
                                dtype=np.int64),
                     seg_level_ids=([framed[p][3] for p in positions]
                                    if has_levels else None)))
+        if pushdown is not None:
+            pushdown.stats.note(scanned=pd_scanned,
+                                pruned_segment=pd_pruned_segment,
+                                pruned_filter=pd_pruned_filter,
+                                bytes_skipped=pd_bytes_skipped)
         return result
+
+
+    def _output_schema(self):
+        """The read's CobolOutputSchema, built reader-side for the
+        generic (post-decode) filter paths through the SAME shared
+        constructor the API layer uses, so the filtered table types
+        identically (FileResult.to_arrow then serves it for the API's
+        structurally-equal schema instance)."""
+        from .schema import output_schema_for
+
+        return output_schema_for(self.copybook, self.params,
+                                 is_var_len=True)
 
 
 def file_record_id_base(file_order: int) -> int:

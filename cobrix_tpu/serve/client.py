@@ -562,6 +562,14 @@ def stream_scan(address, files,
     if isinstance(files, (str, bytes)):
         files = [files]
     replicas = _normalize_replicas(address)
+    flt = options.get("filter")
+    if flt is not None and not isinstance(flt, str):
+        # a query.Expr filter: ship the canonical wire JSON (str()'s
+        # grammar spelling cannot express fields named like grammar
+        # keywords) — the 'R' frame stays plain JSON either way
+        options = dict(options, filter=(flt.canonical()
+                                        if hasattr(flt, "canonical")
+                                        else str(flt)))
     request_id = request_id or new_trace_id()[:16]
     trace_id = trace_id or new_trace_id()
     tracer = None

@@ -13,6 +13,18 @@ so any language with sockets can speak it. Frame types:
                                      max_records, progress,
                                      request_id, trace_id, trace,
                                      follow?, resume?}
+                              — `options` is the read_cobol option
+                              surface; in particular `select` and
+                              `filter` (cobrix_tpu.query expression,
+                              grammar or wire JSON) push projection
+                              and predicates into the server-side
+                              scan: smaller bridge payloads, and the
+                              trailer reports the pruning counters.
+                              With "follow" they turn the
+                              subscription into a filtered change
+                              stream. Both are part of the chunk-plan
+                              fingerprint, so resume tokens never
+                              splice differently-filtered row sets.
                               — request_id/trace_id are the request's
                               identity triple (with tenant): minted by
                               the client (or an upstream service),

@@ -537,6 +537,12 @@ class ScanSession:
                 # when attribution was off (the zero-overhead default)
                 "field_costs": m.field_costs,
                 "roofline": m.roofline(),
+                # pruning counters when the request pushed a filter
+                # down (records_pruned by depth, bytes_skipped,
+                # selectivity) — what distinguishes a tenant's
+                # filtered scan from a tiny file in /debug and fleet
+                # rollups
+                "pushdown": m.pushdown,
             }
         if req.want_trace and self.tracer is not None:
             # the client asked for the server-side spans: ship them with

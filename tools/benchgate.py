@@ -34,6 +34,10 @@ from typing import Dict, List, Optional
 
 DEFAULT_TOLERANCE = 0.25   # the 2-core CI box swings ±15-20% run to run
 DEFAULT_MIN_HISTORY = 2
+# absolute floor for exp_pushdown's speedup-vs-full-decode (the ISSUE 13
+# acceptance claim: select 3-of-110 + ~1% filter must be >= 3x) — gated
+# even with NO history, unlike the noise-relative metrics
+DEFAULT_PUSHDOWN_FLOOR = 3.0
 
 
 def load_bench_doc(path: str) -> Optional[dict]:
@@ -80,18 +84,44 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
 
     add(doc)
     add(doc.get("decode_only"))
-    for key in ("exp1", "exp2", "hierarchical", "exp_serve"):
+    for key in ("exp1", "exp2", "hierarchical", "exp_serve",
+                "exp_pushdown"):
         add(doc.get(key))
+    # the pushdown experiment's speedup vs full decode gates as its own
+    # metric: the >=3x claim must hold run over run, not just once. A
+    # doc that RAN the experiment but produced no speedup (it raised —
+    # incl. the in-run parity assertion) gates as value 0: the
+    # acceptance claim must not go unenforced exactly when the
+    # experiment is broken
+    pd = doc.get("exp_pushdown")
+    if isinstance(pd, dict):
+        speedup = pd.get("speedup")
+        out["exp_pushdown_speedup"] = {
+            "value": (float(speedup)
+                      if isinstance(speedup, (int, float)) else 0.0),
+            "fraction": None}
     return out
 
 
 def gate(fresh: Dict[str, dict], history: List[Dict[str, dict]],
-         tolerance: float, min_history: int) -> List[dict]:
+         tolerance: float, min_history: int,
+         pushdown_floor: float = DEFAULT_PUSHDOWN_FLOOR) -> List[dict]:
     """Evaluate every fresh metric against its history series; returns
     one row per comparable metric with verdict 'ok' | 'regression' |
-    'insufficient_history'."""
+    'insufficient_history'. `exp_pushdown_speedup` additionally gates
+    against an ABSOLUTE floor — the 3x pushdown claim needs no history
+    to be falsifiable."""
     rows: List[dict] = []
     for name, entry in sorted(fresh.items()):
+        if name == "exp_pushdown_speedup" and pushdown_floor > 0:
+            value = entry["value"]
+            rows.append({
+                "metric": name, "basis": "absolute_floor",
+                "value": round(value, 3), "floor": pushdown_floor,
+                "history_n": 0,
+                "verdict": ("ok" if value >= pushdown_floor
+                            else "regression")})
+            continue
         series_frac = [h[name]["fraction"] for h in history
                        if name in h and h[name]["fraction"]]
         series_raw = [h[name]["value"] for h in history if name in h]
@@ -143,7 +173,9 @@ def run_gate(fresh_path: str, history_glob: str, tolerance: float,
                 "insufficient_history": "--  "}[r["verdict"]]
         line = (f"{mark} {r['metric']:<36} {r['basis']:<17} "
                 f"value={r['value']}")
-        if "median" in r:
+        if r["basis"] == "absolute_floor":
+            line += f" floor={r['floor']}"
+        elif "median" in r:
             line += (f" median={r['median']} floor={r['floor']} "
                      f"x{r['ratio']}")
         else:
@@ -209,6 +241,30 @@ def _smoke() -> int:
     rows = gate(extract_metrics(_doc(40.0, 20.0)), hist[:1], 0.25, 2)
     check("thin history abstains",
           all(r["verdict"] == "insufficient_history" for r in rows))
+
+    # exp_pushdown speedup gates on the absolute 3x floor, history-free
+    pd_doc = {"metric": "exp3_to_arrow", "value": 100.0, "unit": "MB/s",
+              "exp_pushdown": {"metric": "exp_pushdown_to_arrow",
+                               "value": 900.0, "unit": "MB/s",
+                               "speedup": 4.5}}
+    rows = gate(extract_metrics(pd_doc), [], 0.25, 2)
+    check("pushdown speedup >= floor passes with no history",
+          any(r["metric"] == "exp_pushdown_speedup"
+              and r["verdict"] == "ok" for r in rows))
+    pd_doc["exp_pushdown"]["speedup"] = 1.4
+    rows = gate(extract_metrics(pd_doc), [], 0.25, 2)
+    check("pushdown speedup below the 3x floor is caught",
+          any(r["metric"] == "exp_pushdown_speedup"
+              and r["verdict"] == "regression" for r in rows))
+
+    # an errored experiment (no speedup field) must gate as a failure,
+    # not silently skip the floor
+    pd_doc["exp_pushdown"] = {"metric": "exp_pushdown_to_arrow",
+                              "error": "boom"}
+    rows = gate(extract_metrics(pd_doc), [], 0.25, 2)
+    check("errored pushdown experiment fails the floor",
+          any(r["metric"] == "exp_pushdown_speedup"
+              and r["verdict"] == "regression" for r in rows))
 
     # envelope parsing: failed rounds are excluded from the baseline
     import tempfile

@@ -1,0 +1,279 @@
+"""Query-pushdown smoke check: parity, pruning counters, serve trip.
+
+Drives the cobrix_tpu.query subsystem end to end in one process:
+
+  1. **parity** — for fixed-length and variable-length (RDW multiseg)
+     inputs, a `select` + `filter` pushed-down read must be
+     byte-identical to the full decode post-hoc filtered with pyarrow
+     (and the unselected columns nulled), sequential AND pipelined;
+  2. **pruning counters** — `ReadMetrics.pushdown` must report the
+     dropped records and skipped bytes (a filter that prunes nothing
+     prunes nothing honestly), and the pre-scan
+     `explain(copybook=...)` report must show the pruned plan;
+  3. **serve round-trip** — the same select/filter through a
+     ScanServer 'R' frame: streamed rows equal the in-process result,
+     and the trailer carries the pushdown counters;
+  4. **dataset surface** — `query.dataset(...).scanner(columns=...,
+     filter=<pyarrow expression>)` lowers into the same pipeline and
+     matches post-hoc projection/filtering;
+  5. `--sweep` adds the execution-grid pass (sequential / pipelined /
+     multihost x fixed / VRL) — slow; tier-1 runs the quick mode.
+
+    python tools/querycheck.py            # quick (~2 MB inputs)
+    python tools/querycheck.py --mb 16    # bigger inputs
+    python tools/querycheck.py --sweep    # execution grid (slow)
+
+Exit code 0 = all checks hold; 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _log(msg: str) -> None:
+    print(f"querycheck: {msg}", flush=True)
+
+
+def _fail(msg: str) -> bool:
+    print(f"querycheck: FAILED: {msg}", flush=True)
+    return False
+
+
+def _fixed_file(mb: float) -> str:
+    from cobrix_tpu.testing.generators import generate_transactions
+
+    n = max(512, int(mb * 1024 * 1024) // 45)
+    path = tempfile.mktemp(suffix=".dat")
+    with open(path, "wb") as f:
+        f.write(bytes(generate_transactions(n, seed=29)))
+    return path
+
+
+def _vrl_file(mb: float) -> str:
+    from cobrix_tpu.testing.generators import generate_exp3
+
+    per = 16072 * 0.33 + 68 * 0.67
+    n = max(128, int(mb * 1024 * 1024 / per))
+    path = tempfile.mktemp(suffix=".dat")
+    with open(path, "wb") as f:
+        f.write(bytes(generate_exp3(n, seed=29)))
+    return path
+
+
+def _posthoc(table, mask_fn):
+    import pyarrow.compute as pc
+
+    return table.filter(pc.fill_null(mask_fn(table), False))
+
+
+def check_parity_fixed(path: str, extra: dict) -> bool:
+    import pyarrow.compute as pc
+
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing.generators import TRANSDATA_COPYBOOK
+
+    kw = dict(copybook_contents=TRANSDATA_COPYBOOK,
+              schema_retention_policy="collapse_root", **extra)
+    full = read_cobol(path, **kw).to_arrow()
+    filt_expr = "CURRENCY in ('USD', 'EUR') and AMOUNT > 0"
+    data = read_cobol(path, select="COMPANY_NAME,AMOUNT",
+                      filter=filt_expr, **kw)
+    got = data.to_arrow()
+    import pyarrow as pa
+
+    expect = _posthoc(full, lambda t: pc.and_kleene(
+        pc.is_in(t["CURRENCY"], value_set=pa.array(["USD", "EUR"])),
+        pc.greater(t["AMOUNT"], __import__("decimal").Decimal(0))))
+    if got.num_rows != expect.num_rows:
+        return _fail(f"fixed row count {got.num_rows} != "
+                     f"{expect.num_rows} ({extra})")
+    for col in ("COMPANY_NAME", "AMOUNT"):
+        if not got[col].equals(expect[col]):
+            return _fail(f"fixed column {col} mismatch ({extra})")
+    # late materialization: filter columns decode but assemble null
+    if got["CURRENCY"].null_count != got.num_rows:
+        return _fail("filter-only column CURRENCY was materialized")
+    pd = (data.metrics.pushdown or {}) if data.metrics else {}
+    if extra.get("hosts") is None and not pd.get("records_pruned"):
+        return _fail(f"no pruning counted ({pd})")
+    _log(f"fixed parity ok ({extra or 'sequential'}): "
+         f"{got.num_rows} rows, pruned {pd.get('records_pruned')}")
+    return True
+
+
+def check_parity_vrl(path: str, extra: dict) -> bool:
+    import pyarrow.compute as pc
+
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing.generators import EXP3_COPYBOOK
+
+    kw = dict(copybook_contents=EXP3_COPYBOOK,
+              is_record_sequence="true", segment_field="SEGMENT_ID",
+              schema_retention_policy="collapse_root",
+              redefine_segment_id_map="STATIC-DETAILS => C",
+              **{"redefine-segment-id-map:1": "CONTACTS => P"},
+              **extra)
+    full = read_cobol(path, **kw).to_arrow()
+    data = read_cobol(path, filter="segment('C')", **kw)
+    got = data.to_arrow()
+    expect = _posthoc(full, lambda t: pc.equal(t["SEGMENT_ID"], "C"))
+    if not got.equals(expect):
+        return _fail(f"vrl segment() result differs ({extra})")
+    pd = (data.metrics.pushdown or {}) if data.metrics else {}
+    if extra.get("hosts") is None and not pd.get(
+            "records_pruned_segment"):
+        return _fail(f"segment conjunct did not prune pre-decode ({pd})")
+    _log(f"vrl parity ok ({extra or 'sequential'}): "
+         f"{got.num_rows} rows, segment-pruned "
+         f"{pd.get('records_pruned_segment')}")
+    return True
+
+
+def check_explain() -> bool:
+    from cobrix_tpu.explain import explain
+    from cobrix_tpu.testing.generators import EXP3_COPYBOOK
+
+    rep = explain(copybook_contents=EXP3_COPYBOOK,
+                  is_record_sequence="true",
+                  segment_field="SEGMENT_ID",
+                  schema_retention_policy="collapse_root",
+                  redefine_segment_id_map="STATIC-DETAILS => C",
+                  **{"redefine-segment-id-map:1": "CONTACTS => P"},
+                  select="COMPANY_ID",
+                  filter="segment('C') and TAXPAYER_TYPE == 'A'")
+    pd = rep.as_dict().get("pushdown")
+    if not pd:
+        return _fail("pre-scan explain has no pushdown section")
+    if not pd.get("fields_pruned"):
+        return _fail(f"explain reports no pruned fields: {pd}")
+    if pd.get("pre_decode_segment_drop") != ["C"]:
+        return _fail(f"segment drop not reported: {pd}")
+    if "TAXPAYER_TYPE" not in (pd.get("late_materialized") or []):
+        return _fail(f"late-materialized set wrong: {pd}")
+    _log(f"explain ok: {pd['fields_retained']}/{pd['fields_total']} "
+         "fields retained")
+    return True
+
+
+def check_serve(path: str) -> bool:
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.serve.client import stream_scan
+    from cobrix_tpu.serve.server import ScanServer
+    from cobrix_tpu.testing.generators import TRANSDATA_COPYBOOK
+
+    cb = tempfile.mktemp(suffix=".cob")
+    with open(cb, "w") as f:
+        f.write(TRANSDATA_COPYBOOK)
+    srv = ScanServer().start()
+    try:
+        kw = dict(copybook=cb, schema_retention_policy="collapse_root")
+        local = read_cobol(path, copybook_contents=TRANSDATA_COPYBOOK,
+                           schema_retention_policy="collapse_root",
+                           filter="CURRENCY == 'USD'").to_arrow()
+        with stream_scan(srv.address, [path],
+                         filter="CURRENCY == 'USD'", **kw) as s:
+            streamed = pa.Table.from_batches(list(s))
+            summary = s.summary
+        if streamed.replace_schema_metadata(None) != \
+                local.replace_schema_metadata(None):
+            return _fail("serve streamed result differs from local")
+        pd = (summary.get("metrics") or {}).get("pushdown") or {}
+        if not pd.get("records_pruned"):
+            return _fail(f"serve trailer has no pruning counters: "
+                         f"{summary.get('metrics')}")
+        _log(f"serve ok: {streamed.num_rows} rows streamed, trailer "
+             f"pruned {pd['records_pruned']} "
+             f"(selectivity {pd.get('selectivity')})")
+        return True
+    finally:
+        srv.stop()
+        os.unlink(cb)
+
+
+def check_dataset(path: str) -> bool:
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    import cobrix_tpu.query as q
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing.generators import TRANSDATA_COPYBOOK
+
+    dset = q.dataset(path, copybook_contents=TRANSDATA_COPYBOOK,
+                     schema_retention_policy="collapse_root")
+    expr = (pc.field("CURRENCY") == "USD")
+    got = dset.scanner(columns=["COMPANY_ID", "AMOUNT"],
+                       filter=expr).to_table()
+    full = read_cobol(path, copybook_contents=TRANSDATA_COPYBOOK,
+                      schema_retention_policy="collapse_root").to_arrow()
+    expect = _posthoc(full, lambda t: pc.equal(t["CURRENCY"], "USD")
+                      ).select(["COMPANY_ID", "AMOUNT"])
+    if not got.equals(expect):
+        return _fail("dataset scanner result differs from post-hoc")
+    n = dset.count_rows(filter=expr)
+    if n != expect.num_rows:
+        return _fail(f"dataset count_rows {n} != {expect.num_rows}")
+    reader = dset.scanner(columns=["COMPANY_ID"],
+                          filter=expr).to_reader()
+    if reader.read_all().num_rows != expect.num_rows:
+        return _fail("dataset to_reader row count differs")
+    _log(f"dataset ok: {got.num_rows} rows via pyarrow-expression "
+         "lowering")
+    return True
+
+
+def check_query(mb: float, sweep: bool = False) -> bool:
+    fixed = _fixed_file(mb)
+    vrl = _vrl_file(mb)
+    try:
+        grids = [{}]
+        if sweep:
+            grids += [
+                {"pipeline_workers": "2", "chunk_size_mb": "0.25"},
+                {"pipeline_workers": "-1"},
+                {"hosts": "2"},
+            ]
+        ok = True
+        for extra in grids:
+            ok = check_parity_fixed(fixed, dict(extra)) and ok
+            ok = check_parity_vrl(vrl, dict(extra)) and ok
+        if not sweep:
+            # quick mode still proves one pipelined pass
+            ok = check_parity_fixed(
+                fixed, {"pipeline_workers": "2",
+                        "chunk_size_mb": "0.25"}) and ok
+        ok = check_explain() and ok
+        ok = check_serve(fixed) and ok
+        ok = check_dataset(fixed) and ok
+        return ok
+    finally:
+        for p in (fixed, vrl):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mb", type=float, default=2.0,
+                    help="approx input size per file (default 2)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="execution grid (sequential/pipelined/"
+                         "multihost) — slow")
+    args = ap.parse_args()
+    ok = check_query(args.mb, sweep=args.sweep)
+    print("OK: query pushdown parity + counters + serve round-trip hold"
+          if ok else "FAILED: querycheck found divergence", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

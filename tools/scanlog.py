@@ -236,12 +236,18 @@ def cmd_summary(args) -> int:
     for rec in records:
         t = by_tenant.setdefault(rec.tenant, {
             "ok": 0, "error": 0, "rejected": 0, "client_gone": 0,
-            "rows": 0, "bytes": 0,
+            "rows": 0, "bytes": 0, "pruned": 0, "filtered": 0,
             "queue": [], "first": [], "e2e": [], "breaches": 0})
         t[rec.outcome] = t.get(rec.outcome, 0) + 1
         t["rows"] += rec.rows
         t["bytes"] += rec.bytes_streamed
         t["breaches"] += 1 if rec.slo_breaches else 0
+        # filter-pushdown rollup: a tenant whose scans prune heavily is
+        # reading few rows because it ASKED for few, not because its
+        # files are tiny — the distinction fleet capacity planning needs
+        t["pruned"] += getattr(rec, "records_pruned", 0) or 0
+        if getattr(rec, "selectivity", None) is not None:
+            t["filtered"] += 1
         for key, v in (("queue", rec.queue_wait_s),
                        ("first", rec.first_batch_s),
                        ("e2e", rec.e2e_s)):
@@ -250,11 +256,15 @@ def cmd_summary(args) -> int:
     print(f"{len(records)} records, {len(by_tenant)} tenant(s)")
     for tenant in sorted(by_tenant):
         t = by_tenant[tenant]
-        print(f"\ntenant {tenant}: ok={t['ok']} error={t['error']} "
-              f"rejected={t['rejected']} "
-              f"client_gone={t['client_gone']} rows={t['rows']} "
-              f"streamed={t['bytes'] / 1e6:.1f}MB "
-              f"slo_breaches={t['breaches']}")
+        line = (f"\ntenant {tenant}: ok={t['ok']} error={t['error']} "
+                f"rejected={t['rejected']} "
+                f"client_gone={t['client_gone']} rows={t['rows']} "
+                f"streamed={t['bytes'] / 1e6:.1f}MB "
+                f"slo_breaches={t['breaches']}")
+        if t["filtered"]:
+            line += (f" filtered_scans={t['filtered']} "
+                     f"records_pruned={t['pruned']}")
+        print(line)
         print(f"  queue wait   {_quantiles(t['queue'])}")
         print(f"  first batch  {_quantiles(t['first'])}")
         print(f"  e2e          {_quantiles(t['e2e'])}")
