@@ -1221,6 +1221,90 @@ def run_serve_side_metric(mb_target: float) -> dict:
     return result
 
 
+def run_sink_side_metric(mb_target: float) -> dict:
+    """exp_sink: the transactional lakehouse sink (cobrix_tpu.sink) vs
+    bare streaming decode, same exp1 input tailed from a static file.
+    Two numbers matter: sink end-to-end MB/s (tail + decode + Parquet
+    serialization + staged write + fsync'd manifest commit + durable
+    checkpoint ack per batch — the whole exactly-once protocol), and
+    the overhead fraction vs a consumer that decodes the identical
+    batches and throws them away: that gap is the price of the
+    durability guarantee, and it should stay a modest multiple, not an
+    order of magnitude."""
+    import shutil
+    import tempfile
+
+    from cobrix_tpu.sink import read_dataset, sink_cobol
+    from cobrix_tpu.streaming import tail_cobol
+    from cobrix_tpu.testing.generators import EXP1_COPYBOOK, generate_exp1
+
+    n_records = max(64, int(mb_target * 1024 * 1024) // 1493)
+    data = generate_exp1(n_records, seed=77)
+    mb = data.nbytes / (1024 * 1024)
+    work = tempfile.mkdtemp(prefix="bench-sink-")
+    errors = []
+    try:
+        path = os.path.join(work, "feed.dat")
+        with open(path, "wb") as f:
+            f.write(data.tobytes())
+        # both sides pay exactly ONE idle_timeout_s wait by
+        # construction (the tail drains the static file, then idles
+        # once before finalize) — subtract that constant so MB/s
+        # measures the work, not the poll clock
+        idle_s = 0.2
+        kw = dict(copybook_contents=EXP1_COPYBOOK,
+                  poll_interval_s=0.02, idle_timeout_s=idle_s,
+                  finalize_on_idle=True)
+
+        def stream_only() -> float:
+            t0 = time.perf_counter()
+            rows = 0
+            for batch in tail_cobol(path, **kw):
+                rows += len(batch.to_arrow())
+            if rows != n_records:
+                errors.append(f"stream decoded {rows} rows "
+                              f"!= {n_records}")
+            return time.perf_counter() - t0 - idle_s
+
+        def sink_run() -> float:
+            ckpt = os.path.join(work, "ck")
+            dataset = os.path.join(work, "dataset")
+            for stale in (ckpt, dataset):
+                shutil.rmtree(stale, ignore_errors=True)
+            t0 = time.perf_counter()
+            result = sink_cobol(
+                tail_cobol(path, checkpoint_dir=ckpt, **kw), dataset)
+            elapsed = time.perf_counter() - t0 - idle_s
+            if result.records != n_records:
+                errors.append(f"sink committed {result.records} rows "
+                              f"!= {n_records}")
+            if not read_dataset(dataset).num_rows == n_records:
+                errors.append("sink read-back row count diverged")
+            return elapsed
+
+        stream_s = min(stream_only() for _ in range(2))
+        sink_s = min(sink_run() for _ in range(2))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    result = {
+        "metric": "exp_sink_e2e",
+        "value": round(mb / sink_s, 1),
+        "unit": "MB/s",
+        "roofline": _roofline_field(mb / sink_s),
+        "rows": n_records,
+        "stream_decode_MBps": round(mb / stream_s, 1),
+        "sink_total_s": round(sink_s, 4),
+        "stream_total_s": round(stream_s, 4),
+        # >1.0 = the durable commit protocol costs this factor over
+        # decode-and-discard streaming of the same batches
+        "sink_overhead_x": round(sink_s / stream_s, 2),
+    }
+    if errors:
+        result["error"] = "; ".join(errors)
+    _log(f"side metric exp_sink: {result}")
+    return result
+
+
 def _side_metrics(mb_target: float) -> dict:
     """exp1/exp2/hierarchical/serving profiles as named JSON fields; a
     side-metric failure must never break the headline bench."""
@@ -1242,6 +1326,10 @@ def _side_metrics(mb_target: float) -> dict:
         side["exp_serve"] = run_serve_side_metric(min(mb_target, 24.0))
     except Exception as exc:
         _log(f"exp_serve side metric failed: {exc}")
+    try:
+        side["exp_sink"] = run_sink_side_metric(min(mb_target, 16.0))
+    except Exception as exc:
+        _log(f"exp_sink side metric failed: {exc}")
     try:
         side["exp_pushdown"] = run_exp_pushdown(min(mb_target, 40.0))
     except Exception as exc:

@@ -19,6 +19,8 @@ from .reader.stream import (ByteRangeSource, open_stream,
                             register_stream_backend, source_size)
 from .io import IoConfig, register_fsspec_backend
 from .streaming import ContinuousIngestor, SourceTruncated, tail_cobol
+from .sink import (DatasetSink, SinkCorruption, SinkSchemaError,
+                   read_dataset, sink_cobol)
 from . import query
 from .copybook.datatypes import (
     CommentPolicy,
@@ -60,6 +62,11 @@ __all__ = [
     "ContinuousIngestor",
     "tail_cobol",
     "SourceTruncated",
+    "DatasetSink",
+    "SinkCorruption",
+    "SinkSchemaError",
+    "read_dataset",
+    "sink_cobol",
     "ReadMetrics",
     "profile_trace",
     "ScanProgress",

@@ -554,6 +554,9 @@ class CobolData:
         # the read's error ledger (permissive policies; None under
         # fail_fast) — aggregated over every file/shard by read_cobol
         self.diagnostics: Optional[ReadDiagnostics] = None
+        # copybook plan fingerprint (plan.cache.parse_fingerprint),
+        # stamped by read_cobol — the sink's schema-drift sentinel
+        self.plan_fingerprint: str = ""
 
     @classmethod
     def from_results(cls, results: List["FileResult"],
@@ -603,6 +606,29 @@ class CobolData:
 
     def to_pandas(self):
         return self.to_arrow().to_pandas()
+
+    def to_dataset(self, dataset_dir: str, file_format: str = "parquet",
+                   partition_by=(), target_file_mb: float = 64.0,
+                   retry=None):
+        """One-shot atomic export into a transactional sink dataset
+        (`cobrix_tpu.sink`): every data file is staged and finalized,
+        then ONE manifest record commits them all — a crash at any
+        instant leaves the dataset exactly as it was. Re-exporting into
+        the same dataset appends a new commit; a dataset written under
+        a different copybook/schema fingerprint is refused
+        (`SinkSchemaError`). Returns the `DatasetSink` (its
+        ``recovery`` report and ``to_table()`` read-back included)."""
+        from .reader.arrow_out import arrow_schema as _arrow_schema
+        from .sink import DatasetSink, schema_fingerprint
+
+        schema = _arrow_schema(self.schema)
+        sink = DatasetSink(
+            dataset_dir, arrow_schema=schema,
+            schema_fp=schema_fingerprint(schema, self.plan_fingerprint),
+            file_format=file_format, partition_by=partition_by,
+            target_file_mb=target_file_mb, retry=retry)
+        sink.commit_table(self.to_arrow(), source="read_cobol")
+        return sink
 
     def to_arrow(self):
         """pyarrow Table with schema-declared types, built from the kernel
@@ -978,6 +1004,9 @@ def read_cobol(path=None,
         # record order — the callback sees the same batches, just with
         # one-shot latency
         batch_tap.emit_data(data)
+    from .plan.cache import parse_fingerprint
+
+    data.plan_fingerprint = parse_fingerprint(copybook_contents, params)
     if explain:
         from .explain import build_scan_report
 

@@ -508,6 +508,47 @@ def stream_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
     }
 
 
+def sink_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The lakehouse-sink metric set (cobrix_tpu.sink): what the
+    transactional dataset writer durably committed, how far behind the
+    live sources it is, and the crash-recovery counters an operator
+    alerts on (a nonzero recovery count after a restart is NORMAL —
+    it is the exactly-once protocol working; a growing corruption
+    count under plane="sink" is not). Same idempotent-registration
+    contract as `scan_metrics`."""
+    r = registry or _default
+    return {
+        "batches": r.counter(
+            "cobrix_sink_committed_batches_total",
+            "Micro-batches durably committed to sink datasets "
+            "(manifest record appended + fsync'd before the ack)"),
+        "records": r.counter(
+            "cobrix_sink_committed_records_total",
+            "Rows durably committed to sink datasets"),
+        "bytes": r.counter(
+            "cobrix_sink_committed_bytes_total",
+            "Serialized data-file bytes durably committed to sink "
+            "datasets"),
+        "files": r.counter(
+            "cobrix_sink_committed_files_total",
+            "Data files durably committed to sink datasets"),
+        "lag_bytes": r.gauge(
+            "cobrix_sink_lag_bytes",
+            "Stable source bytes not yet committed to the sink "
+            "dataset (set after every commit by sink_cobol)"),
+        "recovered_commits": r.counter(
+            "cobrix_sink_recovered_commits_total",
+            "Uncommitted manifest records truncated at restart "
+            "recovery; each one is a batch the checkpoint never acked "
+            "and that re-drives exactly once"),
+        "quarantined_files": r.counter(
+            "cobrix_sink_quarantined_files_total",
+            "Staged/orphaned/uncommitted data files moved to the "
+            "dataset quarantine at recovery (inspect with "
+            "tools/fsckcache.py --sink)"),
+    }
+
+
 # -- fleet federation merge policy -----------------------------------------
 
 # How each GAUGE aggregates across replicas when fleet/federate.py rolls
@@ -529,6 +570,7 @@ FLEET_GAUGE_MERGE = {
     "cobrix_serve_queued_scans": "sum",
     "cobrix_stream_lag_bytes": "sum",
     "cobrix_stream_watermark_age_seconds": "max",
+    "cobrix_sink_lag_bytes": "sum",
 }
 
 

@@ -1,0 +1,53 @@
+"""Transactional lakehouse sink: crash-consistent Parquet/Arrow-IPC
+datasets driven by the exactly-once ingest ack window.
+
+The streaming tier (`cobrix_tpu.streaming`) promises exactly-once only
+"with the consumer's help": record your output position in the ack's
+``app_state``, truncate your output back to it on restart. This package
+IS that consumer, done right, as a product surface:
+
+* `sink_cobol(tail_cobol(...), dataset_dir)` — continuous
+  mainframe→lakehouse pipeline: each micro-batch is staged, finalized,
+  and committed by a CRC-stamped manifest record whose position rides
+  the checkpoint's ``app_state``; SIGKILL anywhere recovers to a
+  dataset byte-identical to a one-shot read of the final sources.
+* `read_cobol(...).to_dataset(dataset_dir)` — one-shot atomic batch
+  export (one manifest commit; a crash leaves the dataset unchanged).
+* `read_dataset(dataset_dir)` — checksum-verified read-back in commit
+  order; the committed files are also plain Parquet/Arrow-IPC under
+  ``data/``, consumable by any engine.
+* `fsck_sink` / ``tools/fsckcache.py --sink`` — offline verify/repair.
+
+Corruption detections count under Prometheus plane ``"sink"``
+(``cobrix_cache_corruption_total``); commit/recovery counters are the
+``cobrix_sink_*`` series (`obs.metrics.sink_metrics`).
+"""
+from .drive import SinkResult, sink_cobol, sink_for_ingestor
+from .manifest import (
+    SinkCorruption,
+    SinkError,
+    SinkSchemaError,
+    schema_fingerprint,
+)
+from .writer import (
+    ADOPT,
+    DatasetSink,
+    fsck_sink,
+    read_dataset,
+    set_sink_fault_hook,
+)
+
+__all__ = [
+    "ADOPT",
+    "DatasetSink",
+    "SinkCorruption",
+    "SinkError",
+    "SinkResult",
+    "SinkSchemaError",
+    "fsck_sink",
+    "read_dataset",
+    "schema_fingerprint",
+    "set_sink_fault_hook",
+    "sink_cobol",
+    "sink_for_ingestor",
+]

@@ -217,6 +217,7 @@ class ContinuousIngestor:
         self.finalize_on_idle = finalize_on_idle
         self.auto_ack = auto_ack
         contents = load_copybook_contents(copybook, copybook_contents)
+        self.copybook_contents = contents
         self.params, _opts = parse_options(options, streaming=True)
         _validate_tailable(self.params)
         self.is_var_len = self.params.needs_var_len_reader
@@ -285,6 +286,15 @@ class ContinuousIngestor:
         self._restore()
 
     # -- durable state ---------------------------------------------------
+
+    @property
+    def plan_fingerprint(self) -> str:
+        """Stable digest of (copybook text, parse-relevant options) —
+        the sink's schema-drift sentinel: a dataset written under one
+        fingerprint refuses batches produced under another."""
+        from ..plan.cache import parse_fingerprint
+
+        return parse_fingerprint(self.copybook_contents, self.params)
 
     @property
     def app_state(self):

@@ -481,6 +481,209 @@ def crash_consumer_after(batches: int):
     return hook
 
 
+# -- lakehouse-sink fault injection --------------------------------------
+#
+# The injectors below break the TRANSACTIONAL SINK (cobrix_tpu.sink):
+# consumers killed between staging a data file and committing its
+# manifest record, manifest records torn or bit-flipped on disk, and
+# dataset volumes that fail writes — the crash matrix
+# (tests/test_sink.py, tools/sinkcheck.py) drives the commit protocol's
+# recovery through every window. Once-markers use the same O_EXCL
+# cross-process claim as ShardFaultPlan: a RESTARTED consumer re-
+# installs the plan, but the marker guarantees each fault fires exactly
+# once across the whole kill/restart sequence.
+
+SINK_KILL_POINTS = ("pre_stage", "post_stage", "pre_commit",
+                    "post_commit")
+
+
+class SinkKilled(Exception):
+    """Raised by a SinkFaultPlan in ``action='raise'`` mode — the
+    in-process stand-in for SIGKILL (the commit is abandoned exactly
+    where the kill landed; recovery runs on the next sink open)."""
+
+
+class SinkFaultPlan:
+    """Kill plan keyed by commit kill-window (and optionally commit
+    seq). ``action='exit'`` dies via ``os._exit(137)`` (subprocess
+    harnesses — tools/sinkcheck.py); ``action='raise'`` raises
+    `SinkKilled` (in-process tests: abandon the sink+ingestor, rebuild
+    from the checkpoint, continue).
+
+        plan = SinkFaultPlan(state_dir, action="raise")
+        plan.kill("pre_commit")          # first commit reaching the
+                                         # stage-write→manifest window
+        plan.kill("post_commit", seq=3)  # commit #3, after the append,
+                                         # before the ack
+        with plan.installed():
+            sink_cobol(tail_cobol(...), dataset_dir)
+    """
+
+    def __init__(self, state_dir: str, action: str = "exit"):
+        if action not in ("exit", "raise"):
+            raise ValueError(f"action must be 'exit' or 'raise', "
+                             f"got {action!r}")
+        self.state_dir = str(state_dir)
+        os.makedirs(self.state_dir, exist_ok=True)
+        self.action = action
+        self._kills: dict = {}
+
+    def kill(self, point: str, seq: Optional[int] = None,
+             once: bool = True) -> "SinkFaultPlan":
+        if point not in SINK_KILL_POINTS:
+            raise ValueError(f"unknown sink kill point {point!r}; "
+                             f"one of {SINK_KILL_POINTS}")
+        self._kills[(point, seq)] = once
+        return self
+
+    def _marker(self, point: str, seq: Optional[int]) -> str:
+        return os.path.join(self.state_dir,
+                            f"sink_fault_{point}_{seq or 'any'}")
+
+    def fired(self, point: str, seq: Optional[int] = None) -> bool:
+        return os.path.exists(self._marker(point, seq))
+
+    def _claim(self, point: str, seq: Optional[int]) -> bool:
+        try:
+            fd = os.open(self._marker(point, seq),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        os.close(fd)
+        return True
+
+    def __call__(self, point: str, seq: int) -> None:
+        for key in ((point, seq), (point, None)):
+            if key not in self._kills:
+                continue
+            once = self._kills[key]
+            if once and not self._claim(*key):
+                continue
+            if not once:
+                self._claim(*key)  # fired() breadcrumb
+            if self.action == "exit":
+                os._exit(137)
+            raise SinkKilled(
+                f"injected sink kill at {point} (commit seq {seq})")
+
+    def installed(self):
+        """Context manager installing this plan as the sink fault hook
+        (uninstalled on exit, even on test failure)."""
+        import contextlib
+
+        from ..sink.writer import set_sink_fault_hook
+
+        @contextlib.contextmanager
+        def _ctx():
+            set_sink_fault_hook(self)
+            try:
+                yield self
+            finally:
+                set_sink_fault_hook(None)
+        return _ctx()
+
+
+def corrupt_sink_manifest(dataset_dir: str, mode: str = "bitflip",
+                          which: int = -1) -> str:
+    """Corrupt one record of a LOCAL sink dataset's manifest in place;
+    returns the manifest path.
+
+    * ``mode='bitflip'`` — flip one bit inside record `which` (default
+      the last record; the CRC stamp must catch it even when the JSON
+      stays parseable);
+    * ``mode='torn'`` — tear the manifest mid-way through record
+      `which` (a crashed appender / lost tail page).
+
+    Recovery must treat damage past the checkpointed position as a
+    self-healing truncation and damage inside it as loud
+    `SinkCorruption` — never silence, never replay."""
+    from ..sink.manifest import MANIFEST_NAME
+
+    path = os.path.join(dataset_dir, MANIFEST_NAME)
+    data = open(path, "rb").read()
+    lines = data.split(b"\n")[:-1]  # trailing "" after final newline
+    if not lines:
+        raise FileNotFoundError(f"no manifest records under {path}")
+    idx = which % len(lines)
+    start = sum(len(ln) + 1 for ln in lines[:idx])
+    if mode == "bitflip":
+        # flip a LOW bit inside the record's payload region (past the
+        # opening brace, ahead of the newline) so the line often stays
+        # valid JSON — only the CRC can catch it
+        pos = start + min(len(lines[idx]) - 2, 20)
+        data = flip_bit(data, pos, bit=0)
+    elif mode == "torn":
+        data = data[:start + max(1, len(lines[idx]) // 2)]
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    with open(path, "wb") as f:
+        f.write(data)
+    return path
+
+
+class sink_write_faults:
+    """Context manager making every DATASET-VOLUME write fail the way
+    a full or read-only volume does (``mode='enospc'`` => OSError
+    ENOSPC, ``mode='readonly'`` => OSError EROFS) while reads keep
+    working. Patches the sink writer's durable-write call sites
+    (`_local_write` staging/meta writes, `_local_append` manifest
+    appends)::
+
+        with sink_write_faults("enospc"):
+            sink.commit_table(table)   # raises ENOSPC, NOTHING
+                                       # half-committed
+
+    The contract under test: unlike cache planes, the sink must fail
+    LOUDLY (an un-persistable commit must never be acked) and
+    atomically (the manifest is unchanged; recovery quarantines any
+    finalized-but-unreferenced files)."""
+
+    def __init__(self, mode: str = "enospc",
+                 fail_writes: bool = True, fail_appends: bool = True):
+        import errno
+
+        self.errno = {"enospc": errno.ENOSPC,
+                      "readonly": errno.EROFS}[mode]
+        self.mode = mode
+        self.write_attempts = 0
+        self.append_attempts = 0
+        self.fail_writes = fail_writes
+        self.fail_appends = fail_appends
+        self._saved = None
+
+    def __enter__(self):
+        from ..sink import writer
+
+        fault = self
+        self._saved = (writer._local_write, writer._local_append)
+
+        def failing_write(path, data):
+            fault.write_attempts += 1
+            if fault.fail_writes:
+                raise OSError(fault.errno,
+                              f"injected {fault.mode} on sink write",
+                              path)
+            return fault._saved[0](path, data)
+
+        def failing_append(path, data):
+            fault.append_attempts += 1
+            if fault.fail_appends:
+                raise OSError(fault.errno,
+                              f"injected {fault.mode} on sink append",
+                              path)
+            return fault._saved[1](path, data)
+
+        writer._local_write = failing_write
+        writer._local_append = failing_append
+        return self
+
+    def __exit__(self, *exc):
+        from ..sink import writer
+
+        writer._local_write, writer._local_append = self._saved
+        return False
+
+
 # -- distributed-supervision fault injection -----------------------------
 #
 # The injectors below break WORKERS, not bytes: a multihost worker

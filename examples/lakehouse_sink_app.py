@@ -1,0 +1,102 @@
+"""Mainframe -> lakehouse, exactly once (cobrix_tpu.sink): a live
+EBCDIC feed tailed into a transactional Parquet dataset, killed
+mid-commit, and recovered — the dataset ends byte-identical to a
+one-shot read of the final feed, and the committed files are plain
+Parquet any engine (DuckDB, Polars, Spark, pyarrow.dataset) can scan."""
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from cobrix_tpu import read_cobol, read_dataset, sink_cobol, tail_cobol
+from cobrix_tpu.testing.faults import (LiveAppender, SinkFaultPlan,
+                                       SinkKilled)
+
+COPYBOOK = """
+        01  TXN.
+            05  REGION  PIC X(2).
+            05  ACCOUNT PIC 9(7) COMP.
+            05  MEMO    PIC X(9).
+"""
+
+
+def records(n, start=0):
+    return b"".join(
+        ("EU" if i % 3 else "US").encode("cp037")
+        + i.to_bytes(4, "big")
+        + f"TXN{i % 1000000:06d}".encode("cp037")
+        for i in range(start, start + n))
+
+
+def main():
+    work = tempfile.mkdtemp(prefix="lakehouse-sink-")
+    feed = os.path.join(work, "TXN.FEED.dat")
+    ckpt = os.path.join(work, "checkpoints")
+    dataset = os.path.join(work, "dataset")
+
+    # a mainframe transfer growing the feed in torn, non-record-aligned
+    # chunks while we consume it
+    open(feed, "wb").write(records(2000))
+    appender = LiveAppender(feed, records(6000, 2000),
+                            slice_sizes=(37, 11, 53), pause_s=0.001)
+    appender.start()
+
+    def tailer():
+        return tail_cobol(feed, copybook_contents=COPYBOOK,
+                          schema_retention_policy="collapse_root",
+                          checkpoint_dir=ckpt, poll_interval_s=0.05,
+                          idle_timeout_s=1.0, finalize_on_idle=True,
+                          batch_max_mb=0.02)
+
+    # run 1: the consumer dies between finalizing a data file and
+    # committing its manifest record — the worst crash window
+    plan = SinkFaultPlan(work, action="raise").kill("pre_commit", seq=3)
+    try:
+        with plan.installed():
+            sink_cobol(tailer(), dataset,
+                       partition_by=["REGION"],
+                       target_file_mb=0.1)
+    except SinkKilled:
+        print("consumer killed between stage-write and manifest commit")
+
+    # run 2: restart from the checkpoint — recovery quarantines the
+    # orphaned file and the batch re-drives exactly once
+    result = sink_cobol(tailer(), dataset,
+                        partition_by=["REGION"],
+                        target_file_mb=0.1)
+    appender.join(10)
+    print(f"recovery: {result.recovery}")
+    print(f"committed {result.records_total} rows, "
+          f"{result.batches} batches this run")
+
+    got = read_dataset(dataset)
+    want = read_cobol(feed, copybook_contents=COPYBOOK,
+                      schema_retention_policy="collapse_root") \
+        .to_arrow().replace_schema_metadata(None)
+    # one final drain may still be pending if the appender outran the
+    # idle timeout; drive once more until the watermark catches up
+    while got.num_rows < want.num_rows:
+        sink_cobol(tailer(), dataset, partition_by=["REGION"],
+                   target_file_mb=0.1)
+        got = read_dataset(dataset)
+    # partitioning regroups rows inside each commit (one file per
+    # REGION value), so compare as row SETS via a total sort key
+    assert got.sort_by("ACCOUNT").equals(want.sort_by("ACCOUNT")), \
+        "dataset != one-shot read"
+    print(f"dataset row-identical to a one-shot read "
+          f"({got.num_rows} rows, zero duplicates, zero gaps)")
+
+    # the committed files are ordinary hive-partitioned Parquet:
+    import pyarrow.dataset as pads
+
+    engine_view = pads.dataset(os.path.join(dataset, "data"),
+                               format="parquet", partitioning="hive")
+    print("any engine sees:", engine_view.count_rows(), "rows across",
+          sorted(os.listdir(os.path.join(dataset, "data"))))
+    shutil.rmtree(work, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -26,7 +26,8 @@ def _run_example(name: str):
 
 @pytest.mark.parametrize("name", ["types_app", "multisegment_app",
                                   "codec_app", "hierarchical_app",
-                                  "remote_storage_app"])
+                                  "remote_storage_app",
+                                  "lakehouse_sink_app"])
 def test_example_runs(name, capsys):
     _run_example(name)
     out = capsys.readouterr().out

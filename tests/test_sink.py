@@ -1,0 +1,707 @@
+"""Transactional lakehouse sink (cobrix_tpu.sink).
+
+The crash matrix of ISSUE 14: a kill in ANY commit window — pre-stage,
+post-stage-pre-commit, post-commit-pre-ack — followed by a restart must
+leave the dataset byte-identical to a one-shot read of the final
+sources (Parquet and Arrow-IPC, fixed and VRL, rotation mid-sink);
+manifest bit flips and torn tails self-heal off the checkpointed
+position with corruption counted under plane "sink"; damage INSIDE the
+committed region is a loud structured `SinkCorruption` (never a silent
+replay) that `fsck_sink --repair` resolves offline; and a full/read-
+only dataset volume fails the commit loudly and atomically — never a
+half-commit, never an ack for an un-persisted batch.
+"""
+import errno
+import os
+
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from cobrix_tpu import read_cobol, read_dataset, sink_cobol, tail_cobol
+from cobrix_tpu.io.integrity import corruption_counter
+from cobrix_tpu.obs.metrics import sink_metrics
+from cobrix_tpu.sink import (
+    DatasetSink,
+    SinkCorruption,
+    SinkSchemaError,
+    fsck_sink,
+    schema_fingerprint,
+    sink_for_ingestor,
+)
+from cobrix_tpu.sink.manifest import MANIFEST_NAME
+from cobrix_tpu.testing.faults import (
+    SINK_KILL_POINTS,
+    SinkFaultPlan,
+    SinkKilled,
+    corrupt_sink_manifest,
+    rotate_source,
+    sink_write_faults,
+)
+from tests.util import hard_timeout
+
+FIXED_COPYBOOK = """
+        01  R.
+            05  REGION PIC X(2).
+            05  KEY    PIC 9(7) COMP.
+            05  NAME   PIC X(9).
+"""
+FIXED_OPTS = {"copybook_contents": FIXED_COPYBOOK}
+RECORD_BYTES = 15
+
+VRL_COPYBOOK = """
+        01  R.
+            05  K  PIC X(6).
+"""
+VRL_OPTS = {"copybook_contents": VRL_COPYBOOK,
+            "is_record_sequence": "true",
+            "generate_record_id": "true"}
+
+
+def fixed_records(n: int, start: int = 0) -> bytes:
+    return b"".join(
+        ("EU" if i % 3 else "US").encode("cp037")
+        + i.to_bytes(4, "big")
+        + f"ROW{i % 1000000:06d}".encode("cp037")
+        for i in range(start, start + n))
+
+
+def rdw_records(n: int, start: int = 0) -> bytes:
+    out = []
+    for i in range(start, start + n):
+        payload = f"K{i:05d}".encode("cp037")
+        out.append(bytes([0, 0, len(payload) % 256,
+                          len(payload) // 256]) + payload)
+    return b"".join(out)
+
+
+def bare(table):
+    return table.replace_schema_metadata(None)
+
+
+def one_shot(path, options):
+    return bare(read_cobol(str(path), **options).to_arrow())
+
+
+def tail(src, ckpt, options, **kw):
+    kw.setdefault("poll_interval_s", 0.02)
+    kw.setdefault("idle_timeout_s", 0.3)
+    kw.setdefault("finalize_on_idle", True)
+    kw.setdefault("batch_max_mb", 0.01)
+    return tail_cobol(str(src), checkpoint_dir=str(ckpt), **options,
+                      **kw)
+
+
+# -- one-shot export -------------------------------------------------------
+
+
+@pytest.mark.parametrize("file_format", ["parquet", "arrow"])
+def test_one_shot_export_parity(tmp_path, file_format):
+    """to_dataset -> read_dataset round-trips byte-identically; a
+    second export of the same read appends a second commit."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(500))
+    data = read_cobol(str(src), **FIXED_OPTS)
+    want = bare(data.to_arrow())
+    ds = tmp_path / f"ds-{file_format}"
+    sink = data.to_dataset(str(ds), file_format=file_format)
+    got = read_dataset(str(ds))
+    assert got.equals(want)
+    assert sink.to_table().equals(want)
+    assert fsck_sink(str(ds))["clean"]
+    data.to_dataset(str(ds), file_format=file_format)
+    assert read_dataset(str(ds)).num_rows == 2 * want.num_rows
+
+
+def test_rolling_file_targets(tmp_path):
+    """A large commit rolls into multiple ~target-size files whose
+    concatenation is the original table."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(4000))
+    data = read_cobol(str(src), **FIXED_OPTS)
+    ds = tmp_path / "ds"
+    data.to_dataset(str(ds), file_format="arrow", target_file_mb=0.01)
+    files = os.listdir(ds / "data")
+    assert len(files) > 2
+    assert read_dataset(str(ds)).equals(bare(data.to_arrow()))
+
+
+def test_partitioning_hive_layout(tmp_path):
+    """partition_by creates hive-style value dirs (nested struct
+    fields spell ROOT.FIELD); all rows survive the regrouping."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(300))
+    data = read_cobol(str(src), **FIXED_OPTS)
+    ds = tmp_path / "ds"
+    data.to_dataset(str(ds), partition_by=["R.REGION"])
+    assert sorted(os.listdir(ds / "data")) == ["REGION=EU", "REGION=US"]
+    got = read_dataset(str(ds))
+    want = bare(data.to_arrow())
+    assert got.num_rows == want.num_rows
+    keys = sorted(r["KEY"] for r in got.column("R").to_pylist())
+    assert keys == list(range(300))
+    with pytest.raises(SinkSchemaError):
+        data.to_dataset(str(tmp_path / "ds2"), partition_by=["NOPE"])
+
+
+def test_schema_and_config_drift_refused(tmp_path):
+    """A dataset written under one copybook fingerprint / format /
+    partition spec refuses producers with another."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(50))
+    data = read_cobol(str(src), **FIXED_OPTS)
+    ds = tmp_path / "ds"
+    data.to_dataset(str(ds))
+    flat = read_cobol(str(src), schema_retention_policy="collapse_root",
+                      **FIXED_OPTS)
+    with pytest.raises(SinkSchemaError):
+        flat.to_dataset(str(ds))
+    with pytest.raises(SinkSchemaError):
+        data.to_dataset(str(ds), file_format="arrow")
+    with pytest.raises(SinkSchemaError):
+        data.to_dataset(str(ds), partition_by=["R.REGION"])
+    # same fingerprint, different sessions: appending is fine
+    data.to_dataset(str(ds))
+    assert read_dataset(str(ds)).num_rows == 100
+
+
+def test_empty_dataset_reads_back_schema(tmp_path):
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(10))
+    ing = tail(src, tmp_path / "ck", FIXED_OPTS)
+    sink = sink_for_ingestor(ing, str(tmp_path / "ds"))
+    got = read_dataset(str(tmp_path / "ds"))
+    assert got.num_rows == 0
+    assert got.schema.equals(sink.arrow_schema)
+    ing.close()
+
+
+# -- the crash matrix ------------------------------------------------------
+
+
+def drive_with_kills(src, ckpt, dataset, fault_dir, options,
+                     file_format, kill_points=SINK_KILL_POINTS,
+                     max_cycles=12):
+    """Run sink_cobol under a SinkFaultPlan until a run completes; each
+    cycle rebuilds the ingestor from the checkpoint exactly like a
+    crashed consumer's restart. Returns the number of kills taken."""
+    plan = SinkFaultPlan(str(fault_dir), action="raise")
+    for point in kill_points:
+        plan.kill(point)
+    cycles = 0
+    while True:
+        ing = tail(src, ckpt, options)
+        with plan.installed():
+            try:
+                sink_cobol(ing, str(dataset), file_format=file_format,
+                           target_file_mb=0.01)
+                return cycles
+            except SinkKilled:
+                cycles += 1
+                ing.close()
+        assert cycles < max_cycles, "kill/restart loop did not converge"
+
+
+@pytest.mark.parametrize("file_format", ["parquet", "arrow"])
+@pytest.mark.parametrize("layout", ["fixed", "vrl"])
+def test_crash_matrix_byte_identical(tmp_path, file_format, layout):
+    """SIGKILL-shaped aborts in EVERY commit window, then restart ⇒
+    dataset byte-identical to a one-shot read (no duplicated, dropped,
+    or torn rows), with the recovery counters advanced."""
+    with hard_timeout(120, "sink crash matrix"):
+        payload = (fixed_records(2000) if layout == "fixed"
+                   else rdw_records(2000))
+        options = FIXED_OPTS if layout == "fixed" else VRL_OPTS
+        src = tmp_path / "in.dat"
+        src.write_bytes(payload)
+        m = sink_metrics()
+        recovered_before = m["recovered_commits"].value()
+        quarantined_before = m["quarantined_files"].value()
+        kills = drive_with_kills(src, tmp_path / "ck",
+                                 tmp_path / "ds", tmp_path / "faults",
+                                 options, file_format)
+        assert kills == len(SINK_KILL_POINTS)
+        got = read_dataset(str(tmp_path / "ds"))
+        assert got.equals(one_shot(src, options))
+        # post_stage/pre_commit kills orphan finalized+staged files;
+        # post_commit kills truncate an uncommitted manifest record
+        assert m["recovered_commits"].value() > recovered_before
+        assert m["quarantined_files"].value() > quarantined_before
+        assert fsck_sink(str(tmp_path / "ds"))["clean"]
+
+
+def test_rotation_mid_sink(tmp_path):
+    """Source rename-rotation WHILE the sink drives (triggered from the
+    on_commit tap): the old generation — including late appends through
+    the held descriptor — lands exactly once, then the new one."""
+    with hard_timeout(120, "rotation mid-sink"):
+        src = tmp_path / "app.log"
+        src.write_bytes(fixed_records(60))
+        state = {"rotated": False}
+
+        def rotate_once(info):
+            if not state["rotated"]:
+                state["rotated"] = True
+                rotated = rotate_source(str(src),
+                                        fixed_records(30, 1000))
+                with open(rotated, "ab") as f:
+                    f.write(fixed_records(10, 60))
+
+        ing = tail(src, tmp_path / "ck", FIXED_OPTS,
+                   idle_timeout_s=1.0, batch_max_mb=0.0005)
+        sink_cobol(ing, str(tmp_path / "ds"), on_commit=rotate_once)
+        got = read_dataset(str(tmp_path / "ds"))
+        keys = sorted(r["KEY"] for r in got.column("R").to_pylist())
+        assert keys == list(range(70)) + list(range(1000, 1030))
+        assert fsck_sink(str(tmp_path / "ds"))["clean"]
+
+
+def test_crash_then_rotation_recovery(tmp_path):
+    """A kill mid-sink followed by a rotation BEFORE the restart: the
+    recovered consumer drains the relocated old generation through the
+    inode/head-CRC alias, exactly once."""
+    with hard_timeout(120, "crash+rotation"):
+        src = tmp_path / "app.log"
+        # tail the glob so a restart can relocate the renamed old
+        # generation by inode + head CRC (the documented recovery path)
+        pattern = str(tmp_path / "app.log*")
+        src.write_bytes(fixed_records(120))
+        plan = SinkFaultPlan(str(tmp_path / "faults"), action="raise")
+        plan.kill("post_commit", seq=2)
+        ing = tail(pattern, tmp_path / "ck", FIXED_OPTS,
+                   batch_max_mb=0.0005)
+        with plan.installed():
+            with pytest.raises(SinkKilled):
+                sink_cobol(ing, str(tmp_path / "ds"))
+        ing.close()
+        rotate_source(str(src), fixed_records(40, 5000))
+        ing2 = tail(pattern, tmp_path / "ck", FIXED_OPTS,
+                    idle_timeout_s=0.6)
+        sink_cobol(ing2, str(tmp_path / "ds"))
+        got = read_dataset(str(tmp_path / "ds"))
+        keys = sorted(r["KEY"] for r in got.column("R").to_pylist())
+        assert keys == list(range(120)) + list(range(5000, 5040))
+
+
+def test_memory_url_dataset_target(tmp_path):
+    """The whole protocol — commit, kill, recovery, read-back — over an
+    fsspec target (memory://): object-store datasets work end to end."""
+    pytest.importorskip("fsspec")
+    with hard_timeout(120, "memory sink"):
+        src = tmp_path / "in.dat"
+        src.write_bytes(fixed_records(800))
+        ds = "memory://sinktests/ds-crash"
+        kills = drive_with_kills(src, tmp_path / "ck", ds,
+                                 tmp_path / "faults", FIXED_OPTS,
+                                 "parquet",
+                                 kill_points=("post_stage",
+                                              "post_commit"))
+        assert kills == 2
+        assert read_dataset(ds).equals(one_shot(src, FIXED_OPTS))
+
+
+# -- manifest corruption ---------------------------------------------------
+
+
+def _kill_post_commit(tmp_path, n_records=800):
+    """A consumer killed between the manifest append and the ack: the
+    canonical unacked-tail state the corruption tests damage."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(n_records))
+    plan = SinkFaultPlan(str(tmp_path / "faults"), action="raise")
+    plan.kill("post_commit", seq=2)
+    ing = tail(src, tmp_path / "ck", FIXED_OPTS)
+    with plan.installed():
+        with pytest.raises(SinkKilled):
+            sink_cobol(ing, str(tmp_path / "ds"))
+    ing.close()
+    return src
+
+
+@pytest.mark.parametrize("mode", ["bitflip", "torn"])
+def test_manifest_tail_damage_self_heals(tmp_path, mode):
+    """Bit flip / torn tail in the UNACKED manifest region: recovery
+    truncates off the checkpointed position, counts plane="sink", and
+    the restarted stream converges byte-identically."""
+    with hard_timeout(120, "manifest tail damage"):
+        src = _kill_post_commit(tmp_path)
+        corrupt_sink_manifest(str(tmp_path / "ds"), mode=mode,
+                              which=-1)
+        before = corruption_counter().value(plane="sink")
+        ing2 = tail(src, tmp_path / "ck", FIXED_OPTS)
+        sink_cobol(ing2, str(tmp_path / "ds"))
+        assert corruption_counter().value(plane="sink") > before
+        got = read_dataset(str(tmp_path / "ds"))
+        assert got.equals(one_shot(src, FIXED_OPTS))
+
+
+def test_committed_region_damage_is_loud(tmp_path):
+    """A bit flip INSIDE the committed (acked) manifest region must
+    raise structured SinkCorruption — self-healing would either drop
+    committed rows or replay batches — and fsck --repair restores
+    reader consistency offline."""
+    with hard_timeout(120, "committed-region damage"):
+        src = tmp_path / "in.dat"
+        src.write_bytes(fixed_records(1500))
+        ing = tail(src, tmp_path / "ck", FIXED_OPTS)
+        sink_cobol(ing, str(tmp_path / "ds"))
+        corrupt_sink_manifest(str(tmp_path / "ds"), mode="bitflip",
+                              which=0)
+        before = corruption_counter().value(plane="sink")
+        ing2 = tail(src, tmp_path / "ck", FIXED_OPTS)
+        with pytest.raises(SinkCorruption):
+            sink_cobol(ing2, str(tmp_path / "ds"))
+        ing2.close()
+        assert corruption_counter().value(plane="sink") > before
+        report = fsck_sink(str(tmp_path / "ds"))
+        assert not report["clean"]
+        assert report["manifest_defect"]
+        fsck_sink(str(tmp_path / "ds"), repair=True)
+        # readers are consistent again (the damaged record and its
+        # successors were dropped + quarantined, loudly)
+        repaired = read_dataset(str(tmp_path / "ds"))
+        assert repaired.num_rows == 0
+        assert fsck_sink(str(tmp_path / "ds"))["clean"]
+
+
+def test_midfile_manifest_damage_is_loud_for_readers(tmp_path):
+    """A bit flip in a manifest record WITH committed records after it
+    must raise for readers AND for ADOPT reopens — serving or adopting
+    the valid prefix would silently drop the later commits. Terminal
+    damage (the crashed-append shape) still reads as the valid
+    prefix."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(200))
+    data = read_cobol(str(src), **FIXED_OPTS)
+    ds = tmp_path / "ds"
+    sink = data.to_dataset(str(ds))
+    sink.commit_table(bare(data.to_arrow()))
+    sink.commit_table(bare(data.to_arrow()))
+    corrupt_sink_manifest(str(ds), mode="bitflip", which=0)
+    with pytest.raises(SinkCorruption):
+        read_dataset(str(ds))
+    with pytest.raises(SinkCorruption):
+        DatasetSink(str(ds))  # ADOPT reopen must not truncate history
+    fsck_sink(str(ds), repair=True)
+    assert fsck_sink(str(ds))["clean"]
+    # terminal damage on the rebuilt dataset: valid prefix, no raise
+    sink2 = DatasetSink(str(ds))
+    sink2.commit_table(bare(data.to_arrow()))
+    corrupt_sink_manifest(str(ds), mode="torn", which=-1)
+    read_dataset(str(ds))
+
+
+def test_lost_meta_on_nonempty_dataset_is_loud(tmp_path):
+    """A missing or corrupt _sink_meta.json on a NON-empty dataset
+    must refuse (identity/ownership can't be re-derived) — silently
+    re-creating it would bypass the drift and ownership guards. Idle
+    restarts after a recovery stay quiet (no recovery-record loop)."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(200))
+    ds = tmp_path / "ds"
+    ing = tail(src, tmp_path / "ck", FIXED_OPTS)
+    sink_cobol(ing, str(ds))
+    os.unlink(ds / "_sink_meta.json")
+    ing2 = tail(src, tmp_path / "ck", FIXED_OPTS)
+    with pytest.raises(SinkCorruption):
+        sink_cobol(ing2, str(ds))
+    ing2.close()
+
+
+def test_idle_restarts_do_not_loop_recovery(tmp_path):
+    """After one genuine recovery, repeated restarts with nothing new
+    to commit must not keep truncating and re-appending recovery
+    records (a healthy idle stream shows zero recovery work)."""
+    src = _kill_post_commit(tmp_path)
+    ing = tail(src, tmp_path / "ck", FIXED_OPTS)
+    sink_cobol(ing, str(tmp_path / "ds"))  # the genuine recovery
+    for _ in range(2):
+        ing = tail(src, tmp_path / "ck", FIXED_OPTS)
+        res = sink_cobol(ing, str(tmp_path / "ds"))
+        assert res.batches == 0
+        assert res.recovery["truncated_commits"] == 0
+        assert res.recovery["quarantined_files"] == 0
+        assert res.recovery["staged_quarantined"] == 0
+    # and the second idle restart saw nothing to truncate at all
+    assert res.recovery["truncated_bytes"] == 0
+    assert read_dataset(str(tmp_path / "ds")) \
+        .equals(one_shot(src, FIXED_OPTS))
+
+
+def test_sink_quarantine_is_unbounded(tmp_path):
+    """A repair that quarantines MANY committed files must hold every
+    one of them (the cache planes' 32-entry quarantine bound would
+    turn a repair into silent permanent loss)."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(3000))
+    data = read_cobol(str(src), **FIXED_OPTS)
+    ds = tmp_path / "ds"
+    # one commit rolled into dozens of tiny files, plus a second commit
+    sink = data.to_dataset(str(ds), file_format="arrow",
+                           target_file_mb=0.001)
+    sink.commit_table(bare(data.to_arrow()))
+    n_files = len(
+        [f for f in os.listdir(ds / "data")])
+    assert n_files > 34
+    corrupt_sink_manifest(str(ds), mode="bitflip", which=0)
+    fsck_sink(str(ds), repair=True)
+    held = os.listdir(ds / "quarantine")
+    assert len(held) >= n_files  # every quarantined file survived
+    assert fsck_sink(str(ds))["clean"]
+
+
+def test_reader_detects_data_file_damage(tmp_path):
+    """A committed data file whose bytes no longer match the manifest
+    CRC reads back as SinkCorruption, never as silently wrong rows."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(200))
+    read_cobol(str(src), **FIXED_OPTS).to_dataset(str(tmp_path / "ds"))
+    data_dir = tmp_path / "ds" / "data"
+    victim = os.path.join(data_dir, sorted(os.listdir(data_dir))[0])
+    blob = bytearray(open(victim, "rb").read())
+    blob[len(blob) // 2] ^= 0x01
+    open(victim, "wb").write(bytes(blob))
+    with pytest.raises(SinkCorruption):
+        read_dataset(str(tmp_path / "ds"))
+    report = fsck_sink(str(tmp_path / "ds"))
+    assert report["data_corrupt"] == 1 and not report["clean"]
+
+
+# -- volume faults ---------------------------------------------------------
+
+
+def test_enospc_fails_loudly_and_atomically(tmp_path):
+    """A full dataset volume: commit_table raises the backend's OWN
+    error (ENOSPC), the manifest is unchanged, nothing is acked — and
+    the SAME sink commits cleanly once the volume recovers."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(300))
+    data = read_cobol(str(src), **FIXED_OPTS)
+    ds = tmp_path / "ds"
+    sink = data.to_dataset(str(ds))
+    manifest_before = (ds / MANIFEST_NAME).read_bytes()
+    with sink_write_faults("enospc") as faults:
+        with pytest.raises(OSError) as info:
+            sink.commit_table(bare(data.to_arrow()))
+    # exhausted retries re-raise the backend's own error type; the
+    # original errno rides the cause chain (io/ retry semantics)
+    assert info.value.errno == errno.ENOSPC \
+        or info.value.__cause__.errno == errno.ENOSPC
+    assert faults.write_attempts >= 1
+    assert (ds / MANIFEST_NAME).read_bytes() == manifest_before
+    sink.commit_table(bare(data.to_arrow()))
+    assert read_dataset(str(ds)).num_rows == 2 * data.to_arrow().num_rows
+
+
+def test_readonly_manifest_append_never_half_commits(tmp_path):
+    """EROFS on the manifest append AFTER data files finalized: the
+    commit raises, the manifest holds no torn record, and the next
+    open quarantines the finalized-but-unreferenced files."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(300))
+    data = read_cobol(str(src), **FIXED_OPTS)
+    ds = tmp_path / "ds"
+    sink = data.to_dataset(str(ds))
+    want = read_dataset(str(ds))
+    with sink_write_faults("readonly", fail_writes=False) as faults:
+        with pytest.raises(OSError) as info:
+            sink.commit_table(bare(data.to_arrow()))
+    assert info.value.errno == errno.EROFS \
+        or info.value.__cause__.errno == errno.EROFS
+    assert faults.append_attempts >= 1
+    # the failed commit's finalized files are orphans; ADOPT reopen
+    # quarantines them and the dataset reads back unchanged
+    reopened = DatasetSink(str(ds))
+    assert reopened.recovery["quarantined_files"] > 0
+    assert read_dataset(str(ds)).equals(want)
+
+
+def test_staging_write_retries_through_transient_fault(tmp_path):
+    """A transient volume error during staging retries under the
+    RetryPolicy backoff and the commit succeeds (exhausted retries
+    re-raise the backend's own type — proven above)."""
+    from cobrix_tpu.sink import writer as writer_mod
+
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(200))
+    data = read_cobol(str(src), **FIXED_OPTS)
+    sink = data.to_dataset(str(tmp_path / "ds"))
+    original = writer_mod._local_write
+    state = {"fails": 2, "attempts": 0}
+
+    def flaky_write(path, payload):
+        state["attempts"] += 1
+        if state["fails"] > 0:
+            state["fails"] -= 1
+            raise OSError(errno.EIO, "transient volume blip", path)
+        return original(path, payload)
+
+    writer_mod._local_write = flaky_write
+    try:
+        sink.commit_table(bare(data.to_arrow()))
+    finally:
+        writer_mod._local_write = original
+    assert state["attempts"] >= 3
+    assert read_dataset(str(tmp_path / "ds")).num_rows \
+        == 2 * data.to_arrow().num_rows
+
+
+# -- observability + offline tooling --------------------------------------
+
+
+def test_sink_metrics_in_prometheus(tmp_path):
+    from cobrix_tpu import prometheus_text
+
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(100))
+    m = sink_metrics()
+    before = m["batches"].value()
+    bytes_before = m["bytes"].value()
+    read_cobol(str(src), **FIXED_OPTS).to_dataset(str(tmp_path / "ds"))
+    assert m["batches"].value() == before + 1
+    assert m["bytes"].value() > bytes_before
+    text = prometheus_text()
+    for name in ("cobrix_sink_committed_batches_total",
+                 "cobrix_sink_committed_bytes_total",
+                 "cobrix_sink_committed_files_total",
+                 "cobrix_sink_recovered_commits_total"):
+        assert name in text
+
+
+def test_fsck_sink_orphan_report(tmp_path):
+    """Stray staging/data files (a crashed writer nobody recovered)
+    show up in the offline report; --repair quarantines them."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(100))
+    read_cobol(str(src), **FIXED_OPTS).to_dataset(str(tmp_path / "ds"))
+    (tmp_path / "ds" / "staging").mkdir(exist_ok=True)
+    (tmp_path / "ds" / "staging" / "part-999.parquet").write_bytes(b"x")
+    (tmp_path / "ds" / "data" / "part-stray.parquet").write_bytes(b"y")
+    report = fsck_sink(str(tmp_path / "ds"))
+    assert report["staging_orphans"] == 1
+    assert report["data_orphans"] == 1
+    assert not report["clean"]
+    repaired = fsck_sink(str(tmp_path / "ds"), repair=True)
+    assert repaired["quarantined"] == 2
+    assert fsck_sink(str(tmp_path / "ds"))["clean"]
+
+
+def test_foreign_app_state_starts_from_zero(tmp_path):
+    """A manual (unowned) sink reopened with an app_state belonging to
+    a DIFFERENT consumer protocol recovers as nothing-committed: the
+    dataset truncates and re-drives rather than trusting foreign
+    positions."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(100))
+    table = bare(read_cobol(str(src), **FIXED_OPTS).to_arrow())
+    sink = DatasetSink(str(tmp_path / "ds"),
+                       arrow_schema=table.schema,
+                       committed_state=None)
+    sink.commit_table(table)
+    reopened = DatasetSink(str(tmp_path / "ds"),
+                           committed_state={"their_protocol": 123})
+    assert reopened.recovery["truncated_commits"] == 1
+    assert read_dataset(str(tmp_path / "ds")).num_rows == 0
+
+
+def test_ownership_guards_committed_history(tmp_path):
+    """Recoveries that would silently discard another producer's
+    committed batches refuse loudly instead: a different stream, a
+    checkpoint-less rerun, and a one-shot append into a stream-owned
+    dataset are all structured SinkError refusals."""
+    from cobrix_tpu.sink import SinkError
+
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(200))
+    ds = tmp_path / "ds"
+    ing = tail(src, tmp_path / "ck-a", FIXED_OPTS)
+    sink_cobol(ing, str(ds))
+    committed = read_dataset(str(ds))
+    assert committed.num_rows == 200
+    # a DIFFERENT stream (fresh checkpoint dir) must not truncate
+    ing_b = tail(src, tmp_path / "ck-b", FIXED_OPTS)
+    with pytest.raises(SinkError):
+        sink_cobol(ing_b, str(ds))
+    ing_b.close()
+    # a checkpoint-less drive over a committed dataset must not wipe it
+    ing_c = tail_cobol(str(src), poll_interval_s=0.02,
+                       idle_timeout_s=0.3, finalize_on_idle=True,
+                       **FIXED_OPTS)
+    with pytest.raises(SinkError):
+        sink_cobol(ing_c, str(ds))
+    ing_c.close()
+    # a one-shot export into a stream-owned dataset would be chopped
+    # by that stream's next recovery — refused too
+    with pytest.raises(SinkError):
+        read_cobol(str(src), **FIXED_OPTS).to_dataset(str(ds))
+    # nothing was lost by any refusal
+    assert read_dataset(str(ds)).equals(committed)
+    # the RIGHT stream still resumes cleanly
+    ing_a2 = tail(src, tmp_path / "ck-a", FIXED_OPTS)
+    sink_cobol(ing_a2, str(ds))
+    assert read_dataset(str(ds)).equals(committed)
+
+
+def test_schema_fingerprint_stability(tmp_path):
+    """Identically-configured producers fingerprint identically; a
+    changed copybook or changed row-shaping option does not."""
+    src = tmp_path / "in.dat"
+    src.write_bytes(fixed_records(20))
+    a = read_cobol(str(src), **FIXED_OPTS)
+    b = read_cobol(str(src), **FIXED_OPTS)
+    assert a.plan_fingerprint == b.plan_fingerprint
+    flat = read_cobol(str(src), schema_retention_policy="collapse_root",
+                      **FIXED_OPTS)
+    schema_a = a.to_arrow().schema
+    assert schema_fingerprint(schema_a, a.plan_fingerprint) \
+        == schema_fingerprint(b.to_arrow().schema, b.plan_fingerprint)
+    assert schema_fingerprint(flat.to_arrow().schema,
+                              flat.plan_fingerprint) \
+        != schema_fingerprint(schema_a, a.plan_fingerprint)
+
+
+def test_sinkcheck_sigkill_subprocess():
+    """The real-SIGKILL harness (tools/sinkcheck.py): a consumer
+    subprocess killed once in every commit window plus a parent
+    SIGKILL, restarted from the checkpoint, dataset byte-identical
+    (the tier-1 smoke; --sweep widens it under the slow tier)."""
+    import importlib.util
+
+    with hard_timeout(300, "sinkcheck"):
+        spec = importlib.util.spec_from_file_location(
+            "sinkcheck", os.path.join(os.path.dirname(__file__),
+                                      os.pardir, "tools",
+                                      "sinkcheck.py"))
+        sinkcheck = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sinkcheck)
+        assert sinkcheck.check_kill_matrix(
+            "fixed", sinkcheck.make_records(1500),
+            {"copybook_contents": sinkcheck.COPYBOOK})
+
+
+@pytest.mark.slow
+def test_sink_kill_fuzz_sweep(tmp_path):
+    """Randomized kill-point fuzz across formats and layouts (the slow
+    tier of the sink chaos matrix)."""
+    import random
+
+    with hard_timeout(600, "sink fuzz sweep"):
+        for seed in range(4):
+            rng = random.Random(seed)
+            layout = rng.choice(["fixed", "vrl"])
+            file_format = rng.choice(["parquet", "arrow"])
+            payload = (fixed_records(3000) if layout == "fixed"
+                       else rdw_records(3000))
+            options = FIXED_OPTS if layout == "fixed" else VRL_OPTS
+            src = tmp_path / f"in{seed}.dat"
+            src.write_bytes(payload)
+            points = [rng.choice(SINK_KILL_POINTS)
+                      for _ in range(rng.randint(1, 3))]
+            drive_with_kills(src, tmp_path / f"ck{seed}",
+                             tmp_path / f"ds{seed}",
+                             tmp_path / f"faults{seed}", options,
+                             file_format,
+                             kill_points=tuple(dict.fromkeys(points)))
+            got = read_dataset(str(tmp_path / f"ds{seed}"))
+            assert got.equals(one_shot(src, options)), \
+                f"seed {seed} diverged"

@@ -151,6 +151,45 @@ def check_checkpoints(checkpoint_dir: str, repair: bool) -> dict:
     return stats
 
 
+def check_sink(dataset_dir: str, repair: bool,
+               out=sys.stdout) -> bool:
+    """Verify (and optionally repair) one transactional sink dataset
+    (cobrix_tpu.sink): meta CRC, every manifest record, every committed
+    data file against its manifest length+CRC, staging orphans, and
+    finalized files no record references. ``--repair`` truncates the
+    manifest at the first unverifiable record and quarantines every
+    orphan — reader consistency is restored; a stream whose checkpoint
+    committed past the truncation refuses to resume (loudly) and must
+    be restarted explicitly."""
+    from cobrix_tpu.sink import fsck_sink
+
+    stats = fsck_sink(dataset_dir, repair=repair)
+    print(f"sink   : meta {'ok' if stats['meta_ok'] else 'CORRUPT'}, "
+          f"{stats['commits']} commit(s), {stats['data_ok']} file(s) "
+          f"ok, {stats['data_corrupt']} corrupt, "
+          f"{stats['data_missing']} missing", file=out)
+    if stats["manifest_defect"]:
+        print(f"  MANIFEST {stats['manifest_defect']}"
+              + (f"  [truncated {stats['truncated_bytes']}B]"
+                 if repair else ""), file=out)
+    print(f"  orphans: {stats['staging_orphans']} staged, "
+          f"{stats['data_orphans']} unreferenced"
+          + (f"; quarantined {stats['quarantined']}" if repair else ""),
+          file=out)
+    print(f"  quarantine: {stats['quarantine_held']} held entr(ies)",
+          file=out)
+    if not repair:
+        return bool(stats["clean"])
+    # a repair only succeeds if the dataset actually verifies clean
+    # afterwards (a corrupt _sink_meta.json, for one, is unrepairable)
+    after = fsck_sink(dataset_dir, repair=False)
+    if not after["clean"]:
+        print("  REPAIR INCOMPLETE: dataset still unclean "
+              f"({ {k: v for k, v in after.items() if v and k != 'clean'} })",
+              file=out)
+    return bool(after["clean"])
+
+
 def check_quarantine(cache_dir: str) -> dict:
     root = os.path.join(cache_dir, "quarantine")
     try:
@@ -259,6 +298,34 @@ def smoke() -> bool:
         fail("corrupt checkpoint slot reported clean")
     if not fsck(cache_dir, repair=True, out=open(os.devnull, "w")):
         fail("--repair did not clear the checkpoint plane")
+    # sink plane: build a dataset, kill a commit mid-protocol, assert
+    # fsck detects the orphan + torn manifest, repair, assert clean and
+    # the committed table unchanged
+    from cobrix_tpu.sink import fsck_sink, read_dataset
+    from cobrix_tpu.testing.faults import (SinkFaultPlan, SinkKilled,
+                                           corrupt_sink_manifest)
+
+    sink_dir = os.path.join(workdir, "sinkds")
+    sink = read_cobol(f"{scheme}://input", **opts).to_dataset(sink_dir)
+    committed = read_dataset(sink_dir)
+    extra = committed.slice(0, 16)
+    sink.commit_table(extra)  # commit 2: the record the tear destroys
+    plan = SinkFaultPlan(workdir, action="raise").kill("pre_commit")
+    with plan.installed():
+        try:
+            sink.commit_table(extra)  # commit 3 dies mid-protocol
+            fail("sink kill plan did not fire")
+        except SinkKilled:
+            pass
+    if check_sink(sink_dir, repair=False, out=open(os.devnull, "w")):
+        fail("fsck missed the killed commit's orphaned data file")
+    corrupt_sink_manifest(sink_dir, mode="torn", which=-1)
+    if not check_sink(sink_dir, repair=True, out=open(os.devnull, "w")):
+        fail("--repair did not clear the sink plane")
+    if not check_sink(sink_dir, repair=False, out=open(os.devnull, "w")):
+        fail("sink not clean after repair")
+    if not read_dataset(sink_dir).equals(committed):
+        fail("sink repair did not preserve the committed prefix")
     # ENOSPC on cache writes degrades, never fails the scan
     import shutil
 
@@ -291,15 +358,24 @@ def main() -> int:
                     help="continuous-ingest checkpoint dir to verify "
                          "(default: <cache_dir>/checkpoints when it "
                          "exists)")
+    ap.add_argument("--sink", default="",
+                    help="transactional sink dataset dir to verify "
+                         "(cobrix_tpu.sink; may be given with or "
+                         "without a cache_dir)")
     ap.add_argument("--smoke", action="store_true",
                     help="self-test on a throwaway cache (no network)")
     args = ap.parse_args()
     if args.smoke:
         return 0 if smoke() else 1
-    if not args.cache_dir:
-        ap.error("give a cache_dir or --smoke")
-    return 0 if fsck(args.cache_dir, repair=args.repair,
-                     checkpoint_dir=args.checkpoint_dir) else 1
+    if not args.cache_dir and not args.sink:
+        ap.error("give a cache_dir, --sink, or --smoke")
+    ok = True
+    if args.cache_dir:
+        ok = fsck(args.cache_dir, repair=args.repair,
+                  checkpoint_dir=args.checkpoint_dir)
+    if args.sink:
+        ok = check_sink(args.sink, repair=args.repair) and ok
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

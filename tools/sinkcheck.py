@@ -1,0 +1,250 @@
+"""Lakehouse-sink smoke check: exactly-once commits under SIGKILL.
+
+Drives cobrix_tpu.sink end to end the way the crash matrix demands:
+
+  1. a LiveAppender grows a fixed-length file in torn, non-record-
+     aligned increments while a consumer SUBPROCESS runs
+     ``sink_cobol(tail_cobol(...), dataset_dir)`` with a durable
+     checkpoint dir;
+  2. a `SinkFaultPlan` kills the consumer (os._exit, SIGKILL-shaped)
+     once in EACH commit window — pre_stage, post_stage, pre_commit,
+     post_commit — across successive restarts (O_EXCL once-markers
+     coordinate the sweep), plus one parent SIGKILL at a random
+     instant;
+  3. after the feed drains, `read_dataset` MUST be byte-identical to a
+     one-shot `read_cobol(...).to_arrow()` of the final file: zero
+     duplicates, zero gaps, across every kill window;
+  4. the kills that landed after staging/finalize MUST have left
+     quarantined orphans (the recovery evidence), and
+     `fsck_sink` must report the dataset clean afterwards.
+
+    python tools/sinkcheck.py             # quick (4-window sweep)
+    python tools/sinkcheck.py --sweep     # + VRL + random-seq kill
+                                          # fuzz (slow; tier-1 runs
+                                          # quick)
+
+Exit code 0 = every assertion held; 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+COPYBOOK = """
+        01  R.
+            05  REGION PIC X(2).
+            05  KEY    PIC 9(7) COMP.
+            05  NAME   PIC X(9).
+"""
+
+RDW_COPYBOOK = """
+        01  R.
+            05  K  PIC X(6).
+"""
+
+
+def make_records(n: int, start: int = 0) -> bytes:
+    return b"".join(
+        ("EU" if i % 3 else "US").encode("cp037")
+        + i.to_bytes(4, "big")
+        + f"ROW{i % 1000000:06d}".encode("cp037")
+        for i in range(start, start + n))
+
+
+def make_rdw_records(n: int, start: int = 0) -> bytes:
+    out = []
+    for i in range(start, start + n):
+        payload = f"K{i:05d}".encode("cp037")
+        out.append(bytes([0, 0, len(payload) % 256,
+                          len(payload) // 256]) + payload)
+    return b"".join(out)
+
+
+def consume(source: str, checkpoint_dir: str, dataset_dir: str,
+            fault_dir: str, kill_points, options: dict) -> int:
+    """The consumer subprocess body: recover + sink until the feed is
+    idle, dying wherever the installed fault plan says. Exit 0 = feed
+    idle (the caller decides whether it is truly drained)."""
+    from cobrix_tpu.sink import sink_cobol
+    from cobrix_tpu.streaming import tail_cobol
+    from cobrix_tpu.testing.faults import SinkFaultPlan
+
+    plan = SinkFaultPlan(fault_dir, action="exit")
+    for point in kill_points:
+        plan.kill(point)
+    ing = tail_cobol(source, checkpoint_dir=checkpoint_dir,
+                     poll_interval_s=0.05, idle_timeout_s=1.0,
+                     finalize_on_idle=True, **options)
+    with plan.installed():
+        sink_cobol(ing, dataset_dir, target_file_mb=0.1)
+    return 0
+
+
+def _spawn_consumer(source, checkpoint_dir, dataset_dir, fault_dir,
+                    kill_points, options) -> subprocess.Popen:
+    import json as _json
+
+    code = (
+        "import sys, json; sys.path.insert(0, {root!r});\n"
+        "import importlib.util as iu;\n"
+        "spec = iu.spec_from_file_location('sinkcheck', {me!r});\n"
+        "m = iu.module_from_spec(spec); spec.loader.exec_module(m);\n"
+        "sys.exit(m.consume({src!r}, {ckpt!r}, {ds!r}, {faults!r}, "
+        "json.loads({kp!r}), json.loads({opts!r})))"
+    ).format(root=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        me=os.path.abspath(__file__), src=source, ckpt=checkpoint_dir,
+        ds=dataset_dir, faults=fault_dir,
+        kp=_json.dumps(list(kill_points)),
+        opts=_json.dumps(options))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen([sys.executable, "-c", code], env=env)
+
+
+def check_kill_matrix(tag: str, payload: bytes, options: dict,
+                      parent_kill: bool = True) -> bool:
+    """Grow a file tornly; kill/restart the sinking consumer through
+    every commit window; assert dataset == one-shot read + recovery
+    evidence + fsck-clean."""
+    import pyarrow as pa  # noqa: F401 — fail fast if missing
+
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.sink import fsck_sink, read_dataset
+    from cobrix_tpu.testing.faults import SINK_KILL_POINTS, LiveAppender
+
+    work = tempfile.mkdtemp(prefix=f"sinkcheck-{tag}-")
+    src = os.path.join(work, "feed.dat")
+    ckpt = os.path.join(work, "ckpt")
+    faults = os.path.join(work, "faults")
+    dataset = os.path.join(work, "dataset")
+    os.makedirs(faults)
+    open(src, "wb").write(payload[:len(payload) // 4])
+    appender = LiveAppender(src, payload[len(payload) // 4:],
+                            slice_sizes=(7, 3, 11, 2, 29),
+                            pause_s=0.003).start()
+    cycles = 0
+    deadline = time.monotonic() + 240
+    while True:
+        proc = _spawn_consumer(src, ckpt, dataset, faults,
+                               SINK_KILL_POINTS, options)
+        if parent_kill and cycles == 1:
+            # one cycle dies by PARENT SIGKILL at a random instant on
+            # top of the deterministic window sweep
+            time.sleep(0.2 + 0.3 * (cycles % 2))
+            proc.send_signal(signal.SIGKILL)
+        rc = proc.wait()
+        cycles += 1
+        if rc == 0 and appender.done:
+            break
+        if time.monotonic() > deadline:
+            print(f"FAIL [{tag}]: kill/restart loop did not drain "
+                  f"within 240s (rc={rc})")
+            return False
+    fired = sorted(os.listdir(faults))
+    if len(fired) < len(SINK_KILL_POINTS):
+        print(f"FAIL [{tag}]: only {fired} kill window(s) fired")
+        return False
+    got = read_dataset(dataset)
+    want = read_cobol(src, **options).to_arrow() \
+        .replace_schema_metadata(None)
+    if not got.equals(want):
+        print(f"FAIL [{tag}]: dataset != one-shot read "
+              f"({got.num_rows} vs {want.num_rows} rows over "
+              f"{cycles} kill cycles)")
+        return False
+    # kills after staging/finalize leave quarantined orphans — the
+    # recovery evidence the crash windows MUST produce
+    held = os.listdir(os.path.join(dataset, "quarantine")) \
+        if os.path.isdir(os.path.join(dataset, "quarantine")) else []
+    if not held:
+        print(f"FAIL [{tag}]: post-stage kills left no quarantined "
+              "orphans (recovery did not run?)")
+        return False
+    report = fsck_sink(dataset)
+    if not report["clean"]:
+        print(f"FAIL [{tag}]: fsck reports the recovered dataset "
+              f"unclean: {report}")
+        return False
+    print(f"ok [{tag}]: {got.num_rows} rows byte-identical across "
+          f"{cycles} kill/restart cycles ({len(fired)} kill windows, "
+          f"{len(held)} quarantined orphan(s), fsck clean)")
+    return True
+
+
+def check_kill_fuzz(tag: str, payload: bytes, options: dict,
+                    kills: int = 6, seed: int = 0) -> bool:
+    """Randomized kill fuzz (the --sweep tier): each cycle kills at a
+    random window via a fresh fault dir, until the feed drains."""
+    import random
+
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.sink import read_dataset
+    from cobrix_tpu.testing.faults import SINK_KILL_POINTS, LiveAppender
+
+    rng = random.Random(seed)
+    work = tempfile.mkdtemp(prefix=f"sinkcheck-fuzz-{tag}-")
+    src = os.path.join(work, "feed.dat")
+    ckpt = os.path.join(work, "ckpt")
+    dataset = os.path.join(work, "dataset")
+    open(src, "wb").write(payload[:len(payload) // 3])
+    appender = LiveAppender(src, payload[len(payload) // 3:],
+                            slice_sizes=(13, 5, 31),
+                            pause_s=0.002).start()
+    cycles = 0
+    deadline = time.monotonic() + 300
+    while True:
+        fault_dir = os.path.join(work, f"faults-{cycles}")
+        os.makedirs(fault_dir, exist_ok=True)
+        points = ([rng.choice(SINK_KILL_POINTS)]
+                  if cycles < kills else [])
+        proc = _spawn_consumer(src, ckpt, dataset, fault_dir, points,
+                               options)
+        rc = proc.wait()
+        cycles += 1
+        if rc == 0 and appender.done:
+            break
+        if time.monotonic() > deadline:
+            print(f"FAIL [{tag}]: fuzz loop did not drain (rc={rc})")
+            return False
+    got = read_dataset(dataset)
+    want = read_cobol(src, **options).to_arrow() \
+        .replace_schema_metadata(None)
+    if not got.equals(want):
+        print(f"FAIL [{tag}]: fuzz dataset != one-shot "
+              f"({got.num_rows} vs {want.num_rows} rows)")
+        return False
+    print(f"ok [{tag}]: fuzz {got.num_rows} rows byte-identical over "
+          f"{cycles} cycles")
+    return True
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sweep", action="store_true",
+                    help="+ VRL and random kill fuzz (slow)")
+    ap.add_argument("--records", type=int, default=4000)
+    args = ap.parse_args()
+    fixed_opts = {"copybook_contents": COPYBOOK}
+    ok = check_kill_matrix("fixed", make_records(args.records),
+                           fixed_opts)
+    if args.sweep:
+        vrl_opts = {"copybook_contents": RDW_COPYBOOK,
+                    "is_record_sequence": "true",
+                    "generate_record_id": "true"}
+        ok = check_kill_matrix(
+            "vrl", make_rdw_records(args.records), vrl_opts) and ok
+        ok = check_kill_fuzz(
+            "fixed", make_records(args.records * 2), fixed_opts) and ok
+    print("SINKCHECK", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
