@@ -598,11 +598,37 @@ def run(backend: str, mb_target: float) -> dict:
     }
 
 
+def _assert_native_assembly_parity(kw: dict) -> bool:
+    """In-run guard for the fused native assembly: a small exp3 sample
+    read with native dispatch ON must be byte-identical to the
+    pure-Python fallback. The diff itself is tools/asmcheck.py's
+    check_profile (rows + tables + schema metadata + diagnostics
+    ledgers) — ONE harness for bench, tests, and the smoke tool, so
+    they cannot drift apart. A wrong-bytes fast path would RAISE the
+    throughput numbers, so a mismatch must fail the bench, never ride
+    along as data. Returns True when the native path was actually
+    exercised (False = no .so, the numbers are pure-Python and the
+    parity claim is vacuous)."""
+    from cobrix_tpu import native
+    from cobrix_tpu.testing.generators import generate_exp3
+
+    if not native.available():
+        return False
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import asmcheck
+
+    asmcheck.check_profile("bench_exp3_parity",
+                           generate_exp3(256, seed=100), kw)
+    return True
+
+
 def run_exp3_to_arrow(mb_target: float) -> dict:
     """exp3 multiseg-wide END-TO-END: file -> RDW framing -> segment
     split -> decode -> Arrow table, the same span the reference's
     8.0 MB/s covers (its job wrote Parquet columns, not raw decodes).
-    Best of pipelined and sequential, like exp1/exp2."""
+    Best of pipelined and sequential, like exp1/exp2. Native-vs-Python
+    assembly parity is asserted in-run BEFORE any number is emitted."""
     import tempfile
 
     from cobrix_tpu.testing.generators import EXP3_COPYBOOK, generate_exp3
@@ -615,6 +641,8 @@ def run_exp3_to_arrow(mb_target: float) -> dict:
               segment_field="SEGMENT-ID",
               redefine_segment_id_map="STATIC-DETAILS => C",
               redefine_segment_id_map_1="CONTACTS => P")
+    # wrong bytes must fail the bench here, not pass it faster
+    native_exercised = _assert_native_assembly_parity(kw)
     path = None
     try:
         with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
@@ -649,6 +677,7 @@ def run_exp3_to_arrow(mb_target: float) -> dict:
         "rows_per_s": int(table.num_rows / best),
         "pipelined_MBps": (round(mb / pipe_best, 1) if pipe_best else None),
         "sequential_MBps": (round(mb / seq_best, 1) if seq_best else None),
+        "native_assembly": native_exercised,
         "roofline": _roofline_field(mbps),
         "top_fields": top,
     }
@@ -771,14 +800,21 @@ def run_exp_pushdown(mb_target: float) -> dict:
 def _headline(decode_only: dict, e2e: dict) -> dict:
     """Merge the two exp3 measurements into the emitted headline: the
     honest end-to-end number carries `value`/`vs_baseline`; the
-    kernel-only number rides along as `decode_only`. A failed e2e run
-    falls back to the decode headline with the error recorded."""
+    kernel-only number rides along as `decode_only`, and their ratio is
+    emitted as `e2e_vs_decode_only` — the assembly-overhead metric
+    tools/benchgate.py gates against an absolute floor (ROADMAP item 1:
+    end-to-end trending toward decode-only). A failed e2e run falls
+    back to the decode headline with the error recorded (and NO ratio,
+    which the gate treats as a floor failure, not a free pass)."""
     if "value" not in e2e:
         out = dict(decode_only)
         out["to_arrow"] = e2e  # the error record — never silently lost
         return out
     out = dict(e2e)
     out["decode_only"] = decode_only
+    dv = decode_only.get("value")
+    if isinstance(dv, (int, float)) and dv > 0:
+        out["e2e_vs_decode_only"] = round(e2e["value"] / dv, 4)
     return out
 
 

@@ -95,12 +95,17 @@ class PipelineExecutor:
       (stage "read");
     * `process_fn(payload)` frames/decodes on the worker pool (timing its
       own stages through the shared StageTimes);
-    * `finalize_fn(result)` — optional — runs on ONE dedicated stage
-      thread (Arrow assembly). Assembly is deliberately not fanned out:
-      its numpy/pyarrow glue is GIL-heavy and measurably ANTI-scales
-      across threads, while the decode kernels (ctypes + OpenMP, GIL
-      released) scale — so the shape that wins is a decode pool overlapped
-      with a single assembler, not symmetric workers doing everything.
+    * `finalize_fn(result)` — optional — is the Arrow-assembly stage.
+      Historically it ran on ONE dedicated stage thread: the Python
+      numpy/pyarrow assembly glue was GIL-heavy and measurably
+      ANTI-scaled across threads. With the fused native assembly
+      (arrow_out: decode -> Arrow buffers in one GIL-released pass) that
+      constraint no longer holds, so `parallel_finalize=True` lets
+      assembly ride the decode workers — each worker finalizes the chunk
+      it just decoded, and the dedicated assembler thread disappears.
+      Callers enable it exactly when assembly is native-capable
+      (numpy backend + native library); the single-assembler shape
+      remains for GIL-bound assembly (host fallback, no .so).
 
     Results return in task order regardless of completion order. A chunk
     whose read/process raises is re-queued once before counting as
@@ -115,7 +120,8 @@ class PipelineExecutor:
                  error_policy: ShardErrorPolicy = ShardErrorPolicy.FAIL_FAST,
                  chunk_retries: int = 1,
                  stall_timeout_s: float = DEFAULT_STALL_TIMEOUT_S,
-                 failure_info: Optional[Callable] = None):
+                 failure_info: Optional[Callable] = None,
+                 parallel_finalize: bool = False):
         self.workers = max(1, workers)
         self.max_inflight = max_inflight if max_inflight > 0 \
             else self.workers + 2
@@ -126,6 +132,7 @@ class PipelineExecutor:
         self.error_policy = error_policy
         self.chunk_retries = max(0, chunk_retries)
         self.stall_timeout_s = stall_timeout_s
+        self.parallel_finalize = parallel_finalize
         # failure_info(index, attempts, reason, error) -> ShardFailureInfo
         self.failure_info = failure_info or _default_failure_info
         self.shard_failures: List[ShardFailureInfo] = []
@@ -385,6 +392,32 @@ class PipelineExecutor:
             except queue.Empty:
                 return None
 
+        def run_finalize(i: int, finalize_fn, result) -> None:
+            """One chunk's Arrow-assembly stage (on the dedicated
+            assembler thread, or inline on a decode worker when
+            parallel_finalize is on)."""
+            with lock:
+                if terminal(i) or stop.is_set():
+                    return
+                inflight[i] = ("assemble", time.monotonic())
+            try:
+                with maybe_parent(tracer, chunk_span[i]):
+                    finalize_fn(result)
+            except BaseException as exc:
+                # assembly is deterministic — no retry
+                attempts[i] = attempts[i] or 1
+                fail_chunk(i, "error", exc)
+                return
+            done = False
+            with lock:
+                if not terminal(i):
+                    state[i] = "done"
+                    inflight.pop(i, None)
+                    done = True
+            if done:
+                chunk_terminal_obs(i, failed=False)
+            touch()
+
         def worker_loop() -> None:
             _cap_omp_width(self.workers)
             while not workers_exit.is_set():
@@ -417,6 +450,12 @@ class PipelineExecutor:
                 if not chunk_decoded(i, result, finalize_fn):
                     continue
                 if has_finalize and finalize_fn is not None:
+                    if self.parallel_finalize:
+                        # GIL-free native assembly: finalize right here
+                        # on the decode worker — no single-assembler
+                        # bottleneck, no extra queue hop
+                        run_finalize(i, finalize_fn, result)
+                        continue
                     with lock:
                         inflight[i] = ("assemble_queued", time.monotonic())
                     if not bounded_put(fq, (i, finalize_fn, result)):
@@ -434,27 +473,7 @@ class PipelineExecutor:
                     i, finalize_fn, result = fq.get(timeout=_TICK_S)
                 except queue.Empty:
                     continue
-                with lock:
-                    if terminal(i) or stop.is_set():
-                        continue
-                    inflight[i] = ("assemble", time.monotonic())
-                try:
-                    with maybe_parent(tracer, chunk_span[i]):
-                        finalize_fn(result)
-                except BaseException as exc:
-                    # assembly is deterministic — no retry
-                    attempts[i] = attempts[i] or 1
-                    fail_chunk(i, "error", exc)
-                    continue
-                done = False
-                with lock:
-                    if not terminal(i):
-                        state[i] = "done"
-                        inflight.pop(i, None)
-                        done = True
-                if done:
-                    chunk_terminal_obs(i, failed=False)
-                touch()
+                run_finalize(i, finalize_fn, result)
 
         def obs_target(fn):
             """Stage-thread entry: the read's ObsContext (tracer parentage,
@@ -471,7 +490,7 @@ class PipelineExecutor:
                                     name=f"cobrix-pipe-{k}", daemon=True)
                    for k in range(self.workers)]
         finalizer = None
-        if has_finalize:
+        if has_finalize and not self.parallel_finalize:
             finalizer = threading.Thread(target=obs_target(finalizer_loop),
                                          name="cobrix-pipe-assemble",
                                          daemon=True)
@@ -553,6 +572,8 @@ class PipelineExecutor:
             "busy_s": round(busy, 6),
             "overlap": round(busy / wall, 3) if wall > 0 else 0.0,
         }
+        if has_finalize:
+            self.report["parallel_assembly"] = bool(self.parallel_finalize)
         if any(counters.values()):
             self.report.update(counters)
         if degrade_events[0]:
@@ -742,26 +763,57 @@ def _finalizers(count: int, output_schema, ex: PipelineExecutor,
     chunk's Arrow table is handed out incrementally as
     `on_batch(chunk_index, table)` — the streaming tap the serving tier
     rides (first-batch latency instead of whole-table latency). Calls
-    are serialized (one dedicated assembly thread) but arrive in chunk
+    are SERIALIZED (by the single assembly thread, or by an explicit
+    lock when assembly rides the decode workers) but arrive in chunk
     COMPLETION order; consumers that need record order re-order by
     index (serve.session.OrderedBatchEmitter). An on_batch exception
     fails the chunk like any assembly error: fail_fast aborts the scan
     (a dead client must cancel its scan), partial ledgers the chunk."""
     if not assemble:
         return [None] * count
+    # parallel assembly: heavy table builds overlap freely, but the
+    # batch tap keeps its documented one-call-at-a-time contract
+    tap_lock = threading.Lock() if ex.parallel_finalize else None
 
     def make(i: int):
         def finalize(result) -> None:
             _assemble(result, output_schema, ex.stage_times)
             if on_batch is not None:
-                on_batch(i, result._arrow_cache)
+                if tap_lock is not None:
+                    with tap_lock:
+                        on_batch(i, result._arrow_cache)
+                else:
+                    on_batch(i, result._arrow_cache)
         return finalize
 
     return [make(i) for i in range(count)]
 
 
-def _executor_for(params, workers: int,
-                  failure_info: Callable) -> PipelineExecutor:
+def _native_assembly_capable(backend: str, decoder=None) -> bool:
+    """Assembly is GIL-free (fused native decode->Arrow) for the numpy
+    backend with the native library loaded — the condition under which
+    fanning assembly across the decode workers wins instead of
+    anti-scaling. A plan carrying GIL-bound assembly columns (host
+    fallback, custom charsets, UTF16/HEX/RAW per-value builds) keeps the
+    single dedicated assembler: fanning THOSE out is the shape PR 2
+    measured as anti-scaling."""
+    from .. import native
+    from ..plan.compiler import Codec
+
+    if backend != "numpy" or not native.available():
+        return False
+    if decoder is None:
+        return True
+    if getattr(decoder, "non_standard_ascii_charset", False):
+        return False
+    gil_bound = (Codec.HOST_FALLBACK, Codec.UTF16_STRING,
+                 Codec.HEX_STRING, Codec.RAW_BYTES)
+    return not any(g.codec in gil_bound and len(g.columns)
+                   for g in decoder.kernel_groups)
+
+
+def _executor_for(params, workers: int, failure_info: Callable,
+                  parallel_finalize: bool = False) -> PipelineExecutor:
     """An executor wired with the read's supervision knobs."""
     return PipelineExecutor(
         workers, params.pipeline_max_inflight, stage_times=StageTimes(),
@@ -769,7 +821,8 @@ def _executor_for(params, workers: int,
         scan_deadline_s=params.scan_deadline_s,
         error_policy=params.shard_error_policy,
         chunk_retries=min(1, max(0, params.shard_max_retries)),
-        failure_info=failure_info)
+        failure_info=failure_info,
+        parallel_finalize=parallel_finalize)
 
 
 def pipelined_fixed_scan(reader, files, params, backend: str,
@@ -803,7 +856,16 @@ def pipelined_fixed_scan(reader, files, params, backend: str,
             record_index=c.first_record_id, attempts=attempts,
             reason=reason, error=error)
 
-    ex = _executor_for(params, workers, failure_info)
+    def plan_decoder():
+        try:
+            return reader.decoder(backend)
+        except Exception:
+            return None  # the scan itself will surface the real error
+
+    ex = _executor_for(
+        params, workers, failure_info,
+        parallel_finalize=(assemble and _native_assembly_capable(
+            backend, plan_decoder())))
 
     def read_fn(c: FixedChunk):
         def read() -> object:
@@ -869,7 +931,18 @@ def pipelined_var_len_scan(reader, shards, params, backend: str,
             offset_to=s.offset_to, record_index=s.record_index,
             attempts=attempts, reason=reason, error=error)
 
-    ex = _executor_for(params, workers, failure_info)
+    def plan_decoder():
+        try:
+            # the full (all-redefines) plan is a superset of every
+            # per-segment plan, so its GIL-bound check is conservative
+            return reader._decoder_for_segment("", backend)
+        except Exception:
+            return None  # the scan itself will surface the real error
+
+    ex = _executor_for(
+        params, workers, failure_info,
+        parallel_finalize=(assemble and _native_assembly_capable(
+            backend, plan_decoder())))
 
     def read_fn(shard):
         def read() -> object:

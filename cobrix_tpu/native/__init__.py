@@ -11,7 +11,6 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 import threading
 from typing import Optional, Tuple
 
@@ -19,13 +18,22 @@ import numpy as np
 
 _logger = logging.getLogger(__name__)
 
+from . import build as _buildmod
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_HERE, "framing.cpp")
-_LIB_PATH = os.path.join(_HERE, "_libframing.so")
+_LIB_PATH = _buildmod.lib_path()
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
+# operator/test kill switch: every native entry point reports
+# unavailable, exercising the pure-Python fallbacks without touching the
+# .so on disk (tools/asmcheck.py and the in-bench parity assertion ride
+# this). Env var for subprocesses, set_disabled() for in-process tests.
+# Truthy spellings only: COBRIX_NATIVE_DISABLE=0/false/off keeps native
+# dispatch ON (a bare bool() would silently disable it).
+_disabled = (os.environ.get("COBRIX_NATIVE_DISABLE", "").strip().lower()
+             in ("1", "true", "yes", "on"))
 
 MAX_RDW_RECORD_SIZE = 100 * 1024 * 1024
 
@@ -36,32 +44,31 @@ _U16P = np.ctypeslib.ndpointer(dtype=np.uint16, flags="C_CONTIGUOUS")
 _U64P = np.ctypeslib.ndpointer(dtype=np.uint64, flags="C_CONTIGUOUS")
 
 
+def set_disabled(flag: bool) -> None:
+    """Force the pure-Python fallbacks on (True) or restore native
+    dispatch (False). Parity harnesses flip this to compare the two
+    paths in one process; the loaded library itself is untouched."""
+    global _disabled
+    _disabled = bool(flag)
+
+
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-std=c++17",
-           _SRC, "-o", _LIB_PATH]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, timeout=120)
-    except (OSError, subprocess.TimeoutExpired) as exc:
-        _logger.warning("native framing build failed (%s); using NumPy "
-                        "fallbacks", exc)
-        return False
-    if proc.returncode != 0:
-        _logger.warning("native framing build failed:\n%s",
-                        proc.stderr.decode(errors="replace"))
-        return False
-    return True
+    ok, message = _buildmod.build()
+    if not ok:
+        _logger.warning("%s; using NumPy fallbacks", message)
+    return ok
 
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
+    if _disabled:
+        return None
     if _lib is not None or _build_failed:
         return _lib
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        needs_build = (not os.path.exists(_LIB_PATH)
-                       or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC))
-        if needs_build and not _build():
+        if _buildmod.needs_build() and not _build():
             _build_failed = True
             return None
         try:
@@ -159,6 +166,18 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, _I64P, _I64P, ctypes.c_int64, ctypes.c_void_p,
             _U16P, ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
             _I64P, _I64P]
+        lib.assemble_cols_arrow.restype = None
+        lib.assemble_cols_arrow.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64,
+            _I64P, _I32P, _I32P, _I32P, _I32P,
+            _I32P, _I32P, _I64P, _I32P,
+            ctypes.c_void_p, _I64P, ctypes.c_void_p, _I64P, _U8P]
+        lib.pack_validity.restype = ctypes.c_int64
+        lib.pack_validity.argtypes = [_U8P, ctypes.c_int64,
+                                      ctypes.c_int64, _U8P]
+        lib.simd_level.restype = ctypes.c_int32
+        lib.simd_level.argtypes = []
         _lib = lib
         return _lib
 
@@ -836,6 +855,95 @@ def decode_bcd_cols_raw(data, rec_offsets, rec_lengths, col_offsets,
     lib.decode_bcd_cols_raw(buf, offs, lens, n, cols, ncols, width,
                             int(fits32), values.ctypes.data, valid)
     return values, valid.view(bool)
+
+
+# ---------------------------------------------------------------------------
+# fused one-pass columnar assembly (columnar.cpp)
+# ---------------------------------------------------------------------------
+
+# decode kinds (columnar.cpp DecodeKind)
+ASM_KIND_BINARY = 0
+ASM_KIND_BCD = 1
+ASM_KIND_DISPLAY_E = 2
+ASM_KIND_DISPLAY_A = 3
+ASM_KIND_BINARY_WIDE = 4
+ASM_KIND_BCD_WIDE = 5
+ASM_KIND_DISPLAY_E_WIDE = 6
+ASM_KIND_DISPLAY_A_WIDE = 7
+ASM_KIND_IEEE_F32 = 8
+ASM_KIND_IEEE_F64 = 9
+ASM_KIND_IBM_F32 = 10
+ASM_KIND_IBM_F64 = 11
+
+# output kinds (columnar.cpp OutKind) and their Arrow buffer item sizes
+ASM_OUT_INT32 = 0
+ASM_OUT_INT64 = 1
+ASM_OUT_FLOAT32 = 2
+ASM_OUT_FLOAT64 = 3
+ASM_OUT_DECIMAL128 = 4
+ASM_OUT_ITEMSIZE = {ASM_OUT_INT32: 4, ASM_OUT_INT64: 8,
+                    ASM_OUT_FLOAT32: 4, ASM_OUT_FLOAT64: 8,
+                    ASM_OUT_DECIMAL128: 16}
+ASM_OUT_DTYPE = {ASM_OUT_INT32: np.int32, ASM_OUT_INT64: np.int64,
+                 ASM_OUT_FLOAT32: np.float32, ASM_OUT_FLOAT64: np.float64}
+
+# decimal128 shift modes (columnar.cpp DecMode)
+ASM_DEC_STATIC = 0
+ASM_DEC_DOTS = 1
+ASM_DEC_DIGIT_COUNT = 2
+
+
+def assemble_cols_arrow(data, rec_offsets, rec_lengths, extent: int,
+                        col_offsets, widths, kinds, flags, dyn_sfs,
+                        out_kinds, dec_modes, shifts, maxds,
+                        out_ptrs, out_strides, valid_ptrs, valid_strides,
+                        n: int):
+    """Fused decode -> Arrow assembly over many columns in one native
+    pass with the GIL released: values land in the caller's final-dtype
+    buffers (strided, so flat OCCURS planes share one buffer), validity
+    lands in per-column byte planes for `pack_validity`. Descriptor
+    arrays must be C-contiguous of matching length; `rec_offsets` None
+    means `data` is a packed [n, extent] batch. Returns the per-column
+    exact-representation bool array (False -> the caller rebuilds that
+    decimal column via its Python fallback), or None when the native
+    library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = _as_u8(data)
+    ncols = len(col_offsets)
+    ok = np.empty(ncols, dtype=np.uint8)
+    lib.assemble_cols_arrow(
+        buf, extent,
+        None if rec_offsets is None else rec_offsets.ctypes.data,
+        None if rec_lengths is None else rec_lengths.ctypes.data,
+        n, ncols, col_offsets, widths, kinds, flags, dyn_sfs,
+        out_kinds, dec_modes, shifts, maxds,
+        out_ptrs.ctypes.data, out_strides,
+        valid_ptrs.ctypes.data, valid_strides, ok)
+    return ok.view(bool)
+
+
+def pack_validity(mask: np.ndarray):
+    """Validity byte plane -> (Arrow validity bitmap bytes, null count);
+    None when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    m = np.ascontiguousarray(mask, dtype=np.uint8)
+    n = m.shape[0]
+    bitmap = np.empty((n + 7) // 8, dtype=np.uint8)
+    nulls = lib.pack_validity(m, n, 1, bitmap)
+    return bitmap, int(nulls)
+
+
+def simd_level() -> int:
+    """Runtime SIMD capability the loaded library reports (0 scalar,
+    1 SSE4.2, 2 AVX2); -1 when the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return -1
+    return int(lib.simd_level())
 
 
 def pack_records(data, offsets: np.ndarray, lengths: np.ndarray,

@@ -1,0 +1,103 @@
+"""Reproducible build of the native kernel library.
+
+`_libframing.so` is compiled from framing.cpp + columnar.cpp (sharing
+decode_cells.h) with one fixed flag set, by exactly one code path: the
+lazy first-use build in `native.__init__` and this CLI both call
+`build()` here, so "rebuilt by hand" and "rebuilt implicitly" cannot
+drift apart.
+
+    python -m cobrix_tpu.native.build            # rebuild if stale
+    python -m cobrix_tpu.native.build --force    # rebuild regardless
+    python -m cobrix_tpu.native.build --check    # exit 1 if stale/absent
+
+The library is cached next to the sources and considered stale whenever
+ANY source or header is newer than it (a header-only edit must trigger a
+rebuild — both translation units inline its cell math).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List, Tuple
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SOURCES = ["framing.cpp", "columnar.cpp"]
+HEADERS = ["decode_cells.h"]
+LIB_NAME = "_libframing.so"
+FLAGS = ["-O3", "-shared", "-fPIC", "-fopenmp", "-std=c++17"]
+BUILD_TIMEOUT_S = 240
+
+
+def lib_path() -> str:
+    return os.path.join(HERE, LIB_NAME)
+
+
+def source_paths() -> List[str]:
+    return [os.path.join(HERE, s) for s in SOURCES]
+
+
+def command() -> List[str]:
+    cxx = os.environ.get("COBRIX_CXX", "g++")
+    return [cxx, *FLAGS, *source_paths(), "-o", lib_path()]
+
+
+def needs_build() -> bool:
+    lib = lib_path()
+    if not os.path.exists(lib):
+        return True
+    lib_mtime = os.path.getmtime(lib)
+    for name in SOURCES + HEADERS:
+        p = os.path.join(HERE, name)
+        if os.path.exists(p) and os.path.getmtime(p) > lib_mtime:
+            return True
+    return False
+
+
+def build() -> Tuple[bool, str]:
+    """(ok, message). Compiles to a temp path and renames so a crashed
+    build can never leave a torn .so for the next import to dlopen."""
+    cmd = command()
+    tmp = lib_path() + ".tmp"
+    cmd = cmd[:-1] + [tmp]
+    try:
+        proc = subprocess.run(cmd, capture_output=True,
+                              timeout=BUILD_TIMEOUT_S)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        return False, f"native build failed to run ({exc})"
+    if proc.returncode != 0:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False, ("native build failed:\n"
+                       + proc.stderr.decode(errors="replace"))
+    os.replace(tmp, lib_path())
+    return True, " ".join(command())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--force", action="store_true",
+                    help="rebuild even when the library looks fresh")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when the library is stale or absent, "
+                         "without building")
+    args = ap.parse_args(argv)
+    stale = needs_build()
+    if args.check:
+        print(f"{lib_path()}: {'STALE/ABSENT' if stale else 'fresh'}")
+        return 1 if stale else 0
+    if not stale and not args.force:
+        print(f"{lib_path()}: fresh (use --force to rebuild)")
+        return 0
+    ok, message = build()
+    print(message, file=sys.stdout if ok else sys.stderr)
+    if ok:
+        print(f"built {lib_path()}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

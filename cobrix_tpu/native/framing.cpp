@@ -21,6 +21,10 @@
 #include <omp.h>
 #endif
 
+// shared per-cell decode math (also used by columnar.cpp's fused
+// decode->Arrow assembly pass — the two must never diverge)
+#include "decode_cells.h"
+
 extern "C" {
 
 // Per-thread OpenMP team size (nthreads-var is a per-thread ICV). The
@@ -205,67 +209,8 @@ void pack_records(const uint8_t* data, int64_t data_size,
 // parity contract with the reference's malformed->null policy).
 // ---------------------------------------------------------------------------
 
-// COMP/COMP-4/COMP-5/COMP-9 two's-complement ints
-// (BinaryNumberDecoders.scala:21-121 equivalents, all 16 variants via
-// signed_/big_endian/width). Unsigned 4/8-byte values with the top bit
-// set are null.
-// Per-cell narrow decoders, shared by the per-group kernels and the
-// merged one-pass kernel below.
-static inline void decode_binary_cell(const uint8_t* p, int32_t width,
-                                      int32_t is_signed, int32_t big_endian,
-                                      int64_t* out_v, uint8_t* out_ok) {
-  uint64_t acc = 0;
-  if (big_endian) {
-    for (int32_t i = 0; i < width; ++i) acc = (acc << 8) | p[i];
-  } else {
-    for (int32_t i = width - 1; i >= 0; --i) acc = (acc << 8) | p[i];
-  }
-  uint8_t ok = 1;
-  int64_t v;
-  if (is_signed) {
-    if (width < 8) {
-      uint64_t sign_bit = 1ULL << (8 * width - 1);
-      if (acc & sign_bit) {
-        v = (int64_t)acc - (int64_t)(1ULL << (8 * width));
-      } else {
-        v = (int64_t)acc;
-      }
-    } else {
-      v = (int64_t)acc;
-    }
-  } else {
-    if ((width == 4 || width == 8) && (acc & (1ULL << (8 * width - 1)))) {
-      ok = 0;
-      acc = 0;
-    }
-    v = (int64_t)acc;
-  }
-  *out_v = ok ? v : 0;
-  *out_ok = ok;
-}
-
-static inline void decode_bcd_cell(const uint8_t* p, int32_t width,
-                                   int64_t* out_v, uint8_t* out_ok) {
-  uint64_t acc = 0;
-  uint8_t ok = 1;
-  for (int32_t i = 0; i < width; ++i) {
-    uint8_t hi = p[i] >> 4;
-    uint8_t lo = p[i] & 0x0F;
-    if (hi >= 10) ok = 0;
-    acc = acc * 10 + hi;
-    if (i + 1 < width) {
-      if (lo >= 10) ok = 0;
-      acc = acc * 10 + lo;
-    }
-  }
-  uint8_t sign = p[width - 1] & 0x0F;
-  if (sign != 0x0C && sign != 0x0D && sign != 0x0F) ok = 0;
-  // negate in uint64: -(int64_t)acc would be signed-overflow UB at 2^63
-  int64_t v = (sign == 0x0D) ? (int64_t)(0 - acc) : (int64_t)acc;
-  *out_v = ok ? v : 0;
-  *out_ok = ok;
-}
-
+// Per-cell narrow decoders (decode_cells.h), shared by the per-group
+// kernels here, the merged one-pass kernel below, and columnar.cpp.
 void decode_binary_cols(const uint8_t* batch, int64_t n, int64_t extent,
                         const int64_t* col_offsets, int64_t ncols,
                         int32_t width, int32_t is_signed, int32_t big_endian,
@@ -310,18 +255,6 @@ void decode_bcd_cols(const uint8_t* batch, int64_t n, int64_t extent,
 // as the decode itself). A column wholly or partly past a record's end
 // decodes as invalid, matching the packed path's zero padding + length
 // masking.
-
-// BCD pair LUT: value = hi*10+lo per byte (255 marks an invalid digit
-// nibble). Shared by the raw COMP-3 kernel's all-but-last-byte loop.
-static uint8_t kBcdPair[256];
-static bool InitBcdPair() {
-  for (int b = 0; b < 256; ++b) {
-    int hi = b >> 4, lo = b & 0x0F;
-    kBcdPair[b] = (hi >= 10 || lo >= 10) ? 255 : (uint8_t)(hi * 10 + lo);
-  }
-  return true;
-}
-static const bool kBcdPairInit = InitBcdPair();
 
 // EBCDIC -> Unicode code-point transcode of all same-width string columns
 // in one gather+LUT pass (the numpy path pays two GIL-bound fancy-index
@@ -819,16 +752,7 @@ void decode_bcd_cols_raw(const uint8_t* data,
 // little-endian two's-complement value. ok[r]=0 when the value cannot be
 // represented exactly (negative shift would need rounding division;
 // overflow past 128 bits) — the caller falls back per column.
-typedef unsigned __int128 u128p;
-// load-time init (like kBcdPair): the ThreadPoolExecutor decode path can
-// enter concurrently with the GIL released — no lazy statics here
-static u128p kPow10[39];
-static bool InitPow10() {
-  kPow10[0] = 1;
-  for (int i = 1; i < 39; ++i) kPow10[i] = kPow10[i - 1] * 10;
-  return true;
-}
-static const bool kPow10Init = InitPow10();
+typedef cobrix_u128 u128p;
 
 void decimal128_from_limbs(const uint64_t* hi, const uint64_t* lo,
                            const uint8_t* neg, const uint8_t* valid,
@@ -949,99 +873,6 @@ void decimal128_batch(int64_t n, int64_t k,
   }
 }
 
-}  // extern "C" (reopened below; the display helper is a C++ template)
-
-// Byte-class LUTs for the DISPLAY state machine: low nibble = digit value
-// (0xF = none); flag bits: 0x10 plus-sign, 0x20 minus-sign, 0x40 decimal
-// point, 0x80 space. A byte classifying to exactly 0x0F is unknown.
-static uint8_t kDisplayClass[2][256];
-static bool InitDisplayClass() {
-  for (int b = 0; b < 256; ++b) {
-    uint8_t e = 0x0F, a = 0x0F;
-    // EBCDIC (StringDecoders.decodeEbcdicNumber :154)
-    if (b >= 0xF0 && b <= 0xF9) e = (uint8_t)(b - 0xF0);
-    else if (b >= 0xC0 && b <= 0xC9) e = (uint8_t)(0x10 | (b - 0xC0));
-    else if (b >= 0xD0 && b <= 0xD9) e = (uint8_t)(0x20 | (b - 0xD0));
-    else if (b == 0x60) e = 0x2F;
-    else if (b == 0x4E) e = 0x1F;
-    else if (b == 0x4B || b == 0x6B) e = 0x4F;
-    else if (b == 0x40 || b == 0x00) e = 0x8F;
-    // ASCII (StringDecoders.decodeAsciiNumber)
-    if (b >= 0x30 && b <= 0x39) a = (uint8_t)(b - 0x30);
-    else if (b == 0x2D) a = 0x2F;
-    else if (b == 0x2B) a = 0x1F;
-    else if (b == 0x2E || b == 0x2C) a = 0x4F;
-    else if (b <= 0x20) a = 0x8F;
-    kDisplayClass[0][b] = e;
-    kDisplayClass[1][b] = a;
-  }
-  return true;
-}
-static const bool kDisplayClassInit = InitDisplayClass();
-
-// One zoned-decimal field: the shared DISPLAY byte-classification state
-// machine (StringDecoders.decodeEbcdicNumber :154 / decodeAsciiNumber),
-// templated over the accumulator so the narrow (uint64) and wide
-// (unsigned __int128) kernels cannot diverge.
-template <typename AccT>
-static inline void decode_display_field(
-    const uint8_t* p, int32_t width, int32_t kind, int32_t is_signed,
-    int32_t allow_dot, int32_t require_digits, int32_t dyn_sf,
-    AccT* acc_out, uint8_t* ok_out, bool* negative_out,
-    int64_t* dots_out) {
-  const uint8_t* cls = kDisplayClass[kind];
-  AccT acc = 0;
-  int32_t n_signs = 0, n_dots = 0, n_digits = 0, digits_after_dot = 0;
-  bool negative = false, unknown = false, interior_space = false;
-  bool seen_meaningful = false, space_after_meaningful = false;
-  for (int32_t i = 0; i < width; ++i) {
-    const uint8_t cl = cls[p[i]];
-    const uint8_t d = cl & 0x0F;
-    bool dot = false, space = false;
-    if (d < 10) {
-      acc = acc * 10 + d;
-      ++n_digits;
-      if (n_dots > 0) ++digits_after_dot;
-      if (cl & 0x30) {
-        ++n_signs;
-        if (cl & 0x20) negative = true;
-      }
-    } else if (cl & 0x30) {  // bare sign
-      ++n_signs;
-      if (cl & 0x20) negative = true;
-    } else if (cl & 0x40) {
-      dot = true;
-      ++n_dots;
-    } else if (cl & 0x80) {
-      space = true;
-    } else {
-      unknown = true;
-    }
-    if (kind == 1) {  // ASCII edge-space rule
-      bool meaningful = (d < 10) || dot;
-      if (meaningful) {
-        if (space_after_meaningful) interior_space = true;
-        seen_meaningful = true;
-      } else if (space && seen_meaningful) {
-        space_after_meaningful = true;
-      }
-    }
-  }
-  uint8_t ok = !unknown && n_signs <= 1;
-  if (kind == 1 && interior_space) ok = 0;
-  if (require_digits && n_digits < 1) ok = 0;
-  if (allow_dot) { if (n_dots > 1) ok = 0; }
-  else if (n_dots != 0) ok = 0;
-  if (!is_signed && negative) ok = 0;
-  *acc_out = acc;
-  *ok_out = ok;
-  *negative_out = negative;
-  *dots_out = dyn_sf < 0 ? (int64_t)(-dyn_sf) + n_digits
-                         : (int64_t)digits_after_dot;
-}
-
-extern "C" {
-
 // Zoned decimal DISPLAY numerics, EBCDIC (kind=0) and ASCII (kind=1).
 // dot_scale = digit count right of the single decimal point, or
 // |dyn_sf| + digit count for PIC P columns (dyn_sf < 0).
@@ -1141,7 +972,7 @@ void decode_numeric_groups(
 // decodeEbcdicBigNumber; same layout as ops/batch_np decode_*_wide).
 // ---------------------------------------------------------------------------
 
-typedef unsigned __int128 u128;
+typedef cobrix_u128 u128;
 
 void decode_bcd_wide_cols(const uint8_t* batch, int64_t n, int64_t extent,
                           const int64_t* col_offsets, int64_t ncols,

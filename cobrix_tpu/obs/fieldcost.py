@@ -29,11 +29,18 @@ Attribution rules:
   time of attribution regions nested inside it — so planes never
   double-count (thread-local nesting stack, no locks on the fast path);
 * two planes per field: ``decode_s`` (work inside the decode stage:
-  numeric kernels, host fallback) and ``assemble_s`` (Arrow
-  materialization, including the lazily-deferred string transcode,
-  which by design runs during output materialization, not decode).
-  sum(decode_s) over all fields therefore tracks the decode-stage busy
-  time, which is what makes a regression attributable.
+  eager numeric kernels, host fallback, masked-segment kernels) and
+  ``assemble_s`` (Arrow materialization — the fused native one-pass
+  decode->Arrow assembly, the lazily-deferred string transcode, and
+  lazy numeric plane materialization all run at output time by design,
+  so they charge here). sum(decode_s) over all fields therefore tracks
+  the decode-stage busy time: exactly on the pure-Python path (where
+  every kernel runs inside the stage), as an upper bound on the native
+  path (where deferred groups leave only framing/pack work in the
+  stage). The fused assembly's coarse per-pass timings are taken in
+  Python AROUND the GIL-released native call, split across its columns
+  by bytes touched — `explain=True` never loses the assemble_s plane
+  to native code.
 
 Overhead discipline: when attribution is off, every call site gates on
 `current()` returning None — one thread-local read, no timers taken.

@@ -6,6 +6,14 @@ algorithmic blueprint for the JAX/TPU kernels in `batch_jax` (same math,
 `jnp` instead of `np`), the CPU fast path, and the bridge between the
 per-value oracle (`scalar_decoders`) and the device kernels in tests.
 
+It is also the parity oracle for the native fused assembly
+(native/columnar.cpp): the integer cell decoders live once in
+native/decode_cells.h, and the float decoders below
+(`decode_ibm_float32`/`decode_ibm_float64`/`decode_ieee_float`) are
+transcribed there bit for bit — including the reference's sign-mask-as-
+exponent-mask quirk — so an edit to either copy without the other is a
+parity break that tests/test_native_assembly.py will catch.
+
 All numeric decoders return (values, valid) where `valid=False` encodes the
 reference's malformed->null policy. Fixed-point families return an int64
 mantissa; the static scale lives in the plan (CodecParams), so downstream

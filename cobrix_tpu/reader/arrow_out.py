@@ -22,7 +22,9 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .. import native
 from ..copybook.ast import Group, Primitive, Statement
+from ..copybook.datatypes import Integral
 from ..obs import fieldcost
 from ..plan.compiler import Codec
 from ..copybook.datatypes import SchemaRetentionPolicy, TrimPolicy
@@ -31,6 +33,7 @@ from .columnar import (
     _NATIVE_TRIM_MODES,
     _STRING_CODECS,
     _dyn_scale,
+    _is_wide,
     _resolve_occurs,
     DecodedBatch,
     fixed_point_exponent,
@@ -179,6 +182,94 @@ def _warm_pa_lazy_imports() -> None:
     pa.array(np.zeros(1, dtype=np.int64), mask=np.array([True]))
 
 
+def _asm_descriptor(spec, pa_type):
+    """(kind, flags, dyn_sf, out_kind, dec_mode, shift, maxd) descriptor
+    for the fused native decode->Arrow kernel, or None when the column's
+    shape must keep its existing path. The rules mirror the per-column
+    assembly routes byte for byte: same decode variants, same decimal
+    shift/precision bounds (decimal128_batch), same fallback windows."""
+    pa = _pa()
+    codec = spec.codec
+    p = spec.params
+    wide = _is_wide(spec)
+    if codec is Codec.BINARY:
+        kind = (native.ASM_KIND_BINARY_WIDE if wide
+                else native.ASM_KIND_BINARY)
+        flags = int(bool(p.signed)) | (int(bool(p.big_endian)) << 1)
+        dyn_sf = 0
+    elif codec is Codec.BCD:
+        kind = native.ASM_KIND_BCD_WIDE if wide else native.ASM_KIND_BCD
+        flags = 0
+        dyn_sf = 0
+    elif codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
+        base = (native.ASM_KIND_DISPLAY_E if codec is Codec.DISPLAY_NUM
+                else native.ASM_KIND_DISPLAY_A)
+        kind = base + (4 if wide else 0)
+        allow_dot = bool(p.explicit_decimal)
+        require_digits = isinstance(spec.dtype, Integral) or allow_dot
+        flags = (int(bool(p.signed)) | (int(allow_dot) << 2)
+                 | (int(require_digits) << 3))
+        dyn_sf = min(p.scale_factor, 0)
+    elif codec in _FLOAT_CODECS:
+        kind = {Codec.FLOAT_IEEE: native.ASM_KIND_IEEE_F32,
+                Codec.DOUBLE_IEEE: native.ASM_KIND_IEEE_F64,
+                Codec.FLOAT_IBM: native.ASM_KIND_IBM_F32,
+                Codec.DOUBLE_IBM: native.ASM_KIND_IBM_F64}[codec]
+        flags = int(bool(p.big_endian)) << 1
+        dyn_sf = 0
+        wide = False
+    else:
+        return None
+
+    dec_mode = native.ASM_DEC_STATIC
+    shift = 0
+    maxd = 0
+    if pa.types.is_decimal(pa_type):
+        if codec in _FLOAT_CODECS:
+            return None
+        out_kind = native.ASM_OUT_DECIMAL128
+        if p.explicit_decimal or _dyn_scale(spec):
+            if codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
+                # per-value exponent from the decoded dot_scale plane
+                dec_mode = native.ASM_DEC_DOTS
+                shift = pa_type.scale
+            elif codec is Codec.BINARY and p.scale_factor < 0:
+                # binary PIC P: exponent = |sf| + decimal digit count of
+                # the value (columnar._binary_dyn_dots / _wide_dyn_dots)
+                dec_mode = native.ASM_DEC_DIGIT_COUNT
+                shift = pa_type.scale + p.scale_factor
+            else:
+                return None
+        else:
+            shift = pa_type.scale + fixed_point_exponent(spec)
+            if not 0 <= shift <= 38:
+                return None  # the per-column fallback owns this window
+        # the same precision-bound rule as decimal128_batch callers: wide
+        # limbs and >18-digit mantissas bound by the declared precision
+        # (overflow -> exact-Decimal fallback); narrow <=18 stays unbounded
+        maxd = pa_type.precision if (wide or pa_type.precision > 18) else 0
+    elif pa.types.is_integer(pa_type):
+        if wide or codec in _FLOAT_CODECS:
+            return None
+        if not (pa.types.is_int32(pa_type) or pa.types.is_int64(pa_type)):
+            return None
+        out_kind = (native.ASM_OUT_INT32 if pa.types.is_int32(pa_type)
+                    else native.ASM_OUT_INT64)
+    elif pa.types.is_floating(pa_type):
+        if codec not in _FLOAT_CODECS:
+            return None
+        is_f32 = pa.types.is_float32(pa_type)
+        # the decode width must match the output width exactly (the
+        # kernel writes the decoded float in its natural precision)
+        if is_f32 != (codec in (Codec.FLOAT_IEEE, Codec.FLOAT_IBM)):
+            return None
+        out_kind = (native.ASM_OUT_FLOAT32 if is_f32
+                    else native.ASM_OUT_FLOAT64)
+    else:
+        return None
+    return (kind, flags, dyn_sf, out_kind, dec_mode, shift, maxd)
+
+
 class ArrowBatchBuilder:
     """Builds Arrow arrays for one DecodedBatch — either a single active
     segment (`active`), or a decode-once whole-plan batch where
@@ -300,6 +391,210 @@ class ArrowBatchBuilder:
         return pa.array(self.batch.column_values(col, relevant=relevant),
                         type=pa_type)
 
+    # -- fused native assembly (decode -> Arrow buffers in one pass) -------
+
+    def _asm_call(self, specs, descs, out_ptrs, out_strides, valid_ptrs,
+                  valid_strides):
+        """One fused-kernel invocation over prepared destinations: the
+        GIL is released for the whole decode+assemble pass. Returns the
+        per-column ok array, or None when the library is unavailable."""
+        batch = self.batch
+        k = len(specs)
+        col_offsets = np.fromiter((s.offset for s in specs), np.int64, k)
+        widths = np.fromiter((s.width for s in specs), np.int32, k)
+        kinds = np.fromiter((d[0] for d in descs), np.int32, k)
+        flags = np.fromiter((d[1] for d in descs), np.int32, k)
+        dyn_sfs = np.fromiter((d[2] for d in descs), np.int32, k)
+        out_kinds = np.fromiter((d[3] for d in descs), np.int32, k)
+        dec_modes = np.fromiter((d[4] for d in descs), np.int32, k)
+        shifts = np.fromiter((d[5] for d in descs), np.int64, k)
+        maxds = np.fromiter((d[6] for d in descs), np.int32, k)
+        rs = batch.raw_source
+        if rs is not None:
+            src, offs, lens = rs
+            extent = src.size
+        else:
+            src = np.ascontiguousarray(batch.data)
+            offs = lens = None
+            extent = src.shape[1] if src.ndim == 2 else 0
+        return native.assemble_cols_arrow(
+            src, offs, lens, extent, col_offsets, widths, kinds, flags,
+            dyn_sfs, out_kinds, dec_modes, shifts, maxds,
+            out_ptrs, out_strides, valid_ptrs, valid_strides, self.n)
+
+    def _native_scalar_array(self, col: int):
+        """pa.Array for a scalar (non-OCCURS-slot) numeric/float column
+        from the batch-wide fused assembly, or None (ineligible column,
+        exact-Decimal fallback, library unavailable). The first call
+        assembles EVERY eligible deferred column of the batch in one
+        native pass; later leaves hit the cache."""
+        cache = self.batch._asm_cache
+        if cache is None:
+            cache = self._build_native_scalars()
+            self.batch._asm_cache = cache
+        return cache.get(col)
+
+    def _build_native_scalars(self) -> dict:
+        batch = self.batch
+        if not native.available():
+            return {}
+        entries = []
+        lengths = batch.lengths
+        for c in self.decoder.plan.columns:
+            if c.slot_path or c.statement is None:
+                continue
+            out = batch._out.get(c.index)
+            if out is None or "lazy_numeric" not in out:
+                continue  # planes already exist: existing routes serve them
+            pa_type = to_arrow_type(primitive_data_type(c.statement))
+            desc = _asm_descriptor(c, pa_type)
+            if desc is None:
+                continue
+            relevant = self._relevant_of(c)
+            if lengths is not None:
+                trunc = lengths < c.offset + c.width
+                if relevant is not None:
+                    trunc = trunc & relevant
+                if bool(trunc.any()):
+                    continue  # the scalar path owns partial-field rules
+            if desc[3] == native.ASM_OUT_DECIMAL128 \
+                    and self.redefine_masks is not None and c.segment:
+                continue  # masked decimals keep the per-column routes
+            entries.append((c, pa_type, desc))
+        if not entries:
+            return {}
+        fc = self.fc
+        tok = fc.begin() if fc is not None else None
+        arrays = self._assemble_scalar_entries(entries)
+        if tok is not None:
+            plan = self.decoder.plan
+            # coarse per-pass timing split by bytes touched, taken in
+            # Python around the GIL-released native call — explain's
+            # assemble plane keeps seeing native assembly. The kernel
+            # label keeps the per-codec family (the explain table's
+            # "which kernel decodes this field" contract). Columns the
+            # pass could NOT serve (decimal ok=False) are excluded:
+            # their fallback rebuild re-times itself, and charging them
+            # here too would double-count (the fieldcost discard rule)
+            served = [c for c, _, _ in entries if c.index in arrays]
+            if served:
+                fc.commit_weighted(
+                    tok,
+                    [((plan.cost_name(c),), c.width, self.n * c.width,
+                      f"{c.codec.value}/w{c.width}") for c in served],
+                    fieldcost.PLANE_ASSEMBLE, self.n)
+            else:
+                fc.discard(tok)
+        return arrays
+
+    def _assemble_scalar_entries(self, entries) -> dict:
+        pa = _pa()
+        n = self.n
+        k = len(entries)
+        bufs, valids = [], []
+        out_ptrs = np.empty(k, dtype=np.uintp)
+        out_strides = np.empty(k, dtype=np.int64)
+        valid_ptrs = np.empty(k, dtype=np.uintp)
+        valid_strides = np.ones(k, dtype=np.int64)
+        for j, (c, pa_type, d) in enumerate(entries):
+            out_kind = d[3]
+            if out_kind == native.ASM_OUT_DECIMAL128:
+                buf = np.empty((n, 16), dtype=np.uint8)
+            else:
+                buf = np.empty(n, dtype=native.ASM_OUT_DTYPE[out_kind])
+            valid = np.empty(n, dtype=np.uint8)
+            bufs.append(buf)
+            valids.append(valid)
+            out_ptrs[j] = buf.ctypes.data
+            out_strides[j] = native.ASM_OUT_ITEMSIZE[out_kind]
+            valid_ptrs[j] = valid.ctypes.data
+        ok = self._asm_call([c for c, _, _ in entries],
+                            [d for _, _, d in entries],
+                            out_ptrs, out_strides, valid_ptrs,
+                            valid_strides)
+        if ok is None:
+            return {}
+        result = {}
+        for j, (c, pa_type, d) in enumerate(entries):
+            if not ok[j]:
+                continue  # exact-Decimal fallback rebuilds this column
+            packed = native.pack_validity(valids[j])
+            if packed is None:
+                break
+            bitmap, nulls = packed
+            vbuf = None if nulls == 0 else pa.py_buffer(bitmap)
+            result[c.index] = pa.Array.from_buffers(
+                pa_type, n, [vbuf, pa.py_buffer(bufs[j])],
+                null_count=nulls)
+        return result
+
+    def _native_flat_values(self, st, cols, spec0, pa_type, max_size: int):
+        """Record-major flat values array for ALL slots of one OCCURS
+        numeric leaf via the fused kernel: every slot column writes into
+        one shared buffer (slot s of row i at i*S+s) with one shared
+        validity plane — the per-slot stack/astype/pack glue disappears.
+        None -> caller's existing paths."""
+        batch = self.batch
+        if not native.available():
+            return None
+        outm = batch._out
+        for c in cols:
+            o = outm.get(c)
+            if o is None or "lazy_numeric" not in o:
+                return None  # planes exist: the stack path serves them
+        key = (id(st), cols[0])
+        cached = batch._asm_flat_cache.get(key)
+        if cached is not None:
+            return cached
+        desc = _asm_descriptor(spec0, pa_type)
+        if desc is None:
+            return None
+        pa = _pa()
+        n = self.n
+        total = n * max_size
+        out_kind = desc[3]
+        item = native.ASM_OUT_ITEMSIZE[out_kind]
+        if out_kind == native.ASM_OUT_DECIMAL128:
+            flat = np.empty((total, 16), dtype=np.uint8)
+        else:
+            flat = np.empty(total, dtype=native.ASM_OUT_DTYPE[out_kind])
+        valid = np.empty(total, dtype=np.uint8)
+        k = len(cols)
+        base = int(flat.ctypes.data)
+        vbase = int(valid.ctypes.data)
+        out_ptrs = np.fromiter((base + j * item for j in range(k)),
+                               np.uintp, k)
+        out_strides = np.full(k, max_size * item, dtype=np.int64)
+        valid_ptrs = np.fromiter((vbase + j for j in range(k)),
+                                 np.uintp, k)
+        valid_strides = np.full(k, max_size, dtype=np.int64)
+        specs = [self.decoder.plan.columns[c] for c in cols]
+        fc = self.fc
+        tok = fc.begin() if fc is not None else None
+        ok = self._asm_call(specs, [desc] * k, out_ptrs, out_strides,
+                            valid_ptrs, valid_strides)
+        arr = None
+        if ok is not None and bool(ok.all()):
+            packed = native.pack_validity(valid)
+            if packed is not None:
+                bitmap, nulls = packed
+                vb = None if nulls == 0 else pa.py_buffer(bitmap)
+                arr = pa.Array.from_buffers(
+                    pa_type, total, [vb, pa.py_buffer(flat)],
+                    null_count=nulls)
+        if tok is not None:
+            if arr is not None:
+                fc.commit(tok, (self.decoder.plan.cost_name(spec0),),
+                          fieldcost.PLANE_ASSEMBLE, n * spec0.width * k,
+                          n * k, f"{spec0.codec.value}/w{spec0.width}")
+            else:
+                # failed fused attempt: the fallback path re-times this
+                # plane; charging both would double-count it
+                fc.discard(tok)
+        if arr is not None:
+            batch._asm_flat_cache[key] = arr
+        return arr
+
     def _leaf_array(self, st: Primitive, slot_path):
         pa = _pa()
         pa_type = to_arrow_type(primitive_data_type(st))
@@ -336,6 +631,12 @@ class ArrowBatchBuilder:
                 # truncated variable-length tails: the scalar path owns
                 # the partial-field rules
                 return self._python_fallback(col, pa_type, relevant)
+        if spec.codec not in _STRING_CODECS:
+            # fused one-pass native assembly: deferred numeric columns
+            # decode straight into this column's Arrow buffers
+            arr = self._native_scalar_array(col)
+            if arr is not None:
+                return arr
         if spec.codec in _STRING_CODECS:
             # one-pass native transcode+trim straight into Arrow buffers
             # (no code-point matrix, no Arrow trim kernel)
@@ -611,7 +912,7 @@ class ArrowBatchBuilder:
         pa_type = to_arrow_type(primitive_data_type(st))
         is_decimal = pa.types.is_decimal(pa_type)
         if not (pa.types.is_integer(pa_type) or pa.types.is_floating(pa_type)
-                or (is_decimal and pa_type.precision <= 18)):
+                or is_decimal):
             return None
         cols = [self.decoder.slot_map.get((id(st), slot_path + (k,)))
                 for k in range(max_size)]
@@ -628,6 +929,11 @@ class ArrowBatchBuilder:
             last = self.decoder.plan.columns[cols[-1]]
             if bool((lengths < last.offset + last.width).any()):
                 return None  # truncated tails own the partial-field rules
+        arr = self._native_flat_values(st, cols, spec0, pa_type, max_size)
+        if arr is not None:
+            return arr
+        if is_decimal and pa_type.precision > 18:
+            return None  # the stack path below is exact-int64 only
         outs = [self.batch.column_arrays(c) for c in cols]
         if any("values" not in o or "values_hi" in o for o in outs):
             return None

@@ -318,6 +318,10 @@ class DecodedBatch:
         self._maker_cache: Dict[tuple, object] = {}
         self._arrow_str_cache: Dict[int, tuple] = {}  # id(group) -> (masks, buffers)
         self._arrow_dec_cache: Dict[int, dict] = {}   # id(group) -> {col: Array|None}
+        # fused native assembly caches (arrow_out): scalar col -> pa.Array,
+        # and (id(statement), slot_path) -> flat OCCURS values array
+        self._asm_cache: Optional[dict] = None
+        self._asm_flat_cache: Dict[tuple, object] = {}
         # actual byte length of each record when shorter than the padded row
         # (variable-length files); columns past a record's end are null /
         # truncated like reference Primitive.decodeTypeValue (Primitive.scala:102)
@@ -340,7 +344,94 @@ class DecodedBatch:
         if "lazy_string" in out:
             self._materialize_strings(out["lazy_string"][0])
             out = self._out[col]
+        elif "lazy_numeric" in out:
+            self._materialize_numeric(out["lazy_numeric"][0])
+            out = self._out[col]
         return out
+
+    # -- lazy numeric planes ----------------------------------------------
+
+    def _materialize_numeric(self, g: "_KernelGroup") -> None:
+        """Resolve one lazily-deferred numeric kernel group into the
+        (values, valid[, dot_scale]) planes the row/value paths consume.
+        Arrow consumers normally never get here — the fused native
+        assembly (arrow_out) emits deferred columns straight into Arrow
+        buffers — so this cost lands on whoever actually needs Python
+        planes (rows, dependee counts, diagnostics)."""
+        fc = self.field_costs
+        tok = fc.begin() if fc is not None else None
+        self._materialize_numeric_impl([g])
+        if tok is not None:
+            # deferred decode runs at output materialization, so it
+            # charges the assemble plane like lazy strings (keeping the
+            # decode plane aligned with the decode-STAGE busy time)
+            fc.commit(tok, g.names, fieldcost.PLANE_ASSEMBLE,
+                      self.n_records * g.width, self.n_records, g.label)
+
+    def materialize_numeric_all(self) -> None:
+        """Resolve EVERY still-deferred numeric group in one bulk pass —
+        row materialization calls this so whole-batch consumers keep the
+        merged one-pass decode instead of a per-group trickle."""
+        gs, seen = [], set()
+        for out in self._out.values():
+            lz = out.get("lazy_numeric")
+            if lz is not None and id(lz[0]) not in seen:
+                seen.add(id(lz[0]))
+                gs.append(lz[0])
+        if not gs:
+            return
+        fc = self.field_costs
+        tok = fc.begin() if fc is not None else None
+        self._materialize_numeric_impl(gs)
+        if tok is not None:
+            fc.commit_weighted(
+                tok,
+                [(g.names, g.width, self.n_records * g.width, g.label)
+                 for g in gs],
+                fieldcost.PLANE_ASSEMBLE, self.n_records)
+
+    def _materialize_numeric_impl(self, groups) -> None:
+        # dispatches through the decoder's existing kernels directly
+        # (merged pass first), NOT through _run_groups: its fieldcost
+        # regions charge the decode plane, and deferred work running at
+        # materialization time belongs on the assemble plane (charged by
+        # the callers above)
+        dec = self.decoder
+        if self.raw_source is None:
+            src = self.data
+            rest = list(groups)
+        else:
+            buf, offs, lens = self.raw_source
+            rest = []
+            for g in groups:
+                res = None
+                if g.codec is Codec.BINARY and not g.wide:
+                    signed, big_endian, fits32, _ = g.variant
+                    res = native.decode_binary_cols_raw(
+                        buf, offs, lens, g.offsets, g.width, signed,
+                        big_endian, fits32=fits32)
+                elif g.codec is Codec.BCD and not g.wide:
+                    fits32, _ = g.variant
+                    res = native.decode_bcd_cols_raw(
+                        buf, offs, lens, g.offsets, g.width,
+                        fits32=fits32)
+                if res is not None:
+                    dec._store_numeric(g, self._out, *res)
+                else:
+                    rest.append(g)
+            if not rest:
+                return
+            extent = max((int(g.offsets.max()) + g.width
+                          for g in rest if len(g.columns)), default=1)
+            src = native.pack_records(buf, offs, lens, extent)
+        rest = dec._run_groups_merged(rest, src, self._out)
+        for g in rest:
+            if g.codec is Codec.HOST_FALLBACK or g.codec in _STRING_CODECS:
+                continue
+            if not dec._run_group_native(g, src, self._out):
+                slab = src[:, g.offsets[:, None]
+                           + np.arange(g.width)[None, :]]
+                dec._run_group_numpy(g, slab, self._out)
 
     def _materialize_strings(self, g: "_KernelGroup") -> None:
         """Resolve a lazily-deferred string kernel group into the code-point
@@ -722,6 +813,9 @@ class DecodedBatch:
         (used when a batch holds non-contiguous records, e.g. one segment
         of a multisegment file). `handler`: the RecordHandler seam — group
         records materialize through handler.create instead of tuples."""
+        # whole-batch row materialization touches every column: resolve
+        # all deferred numeric groups in one bulk (merged) pass up front
+        self.materialize_numeric_all()
         # one compiled maker per DISTINCT active segment; mixed-active
         # batches (decode-once) dispatch per row
         if active_segments is None or not len(active_segments):
@@ -1015,6 +1109,13 @@ class ColumnarDecoder:
             g_rows = n
             gmask = (None if g.codec in _STRING_CODECS
                      else self._group_segment_mask(g, segment_row_masks))
+            if gmask is None and self._lazy_numeric_ok(g):
+                # deferred like the string groups: the Arrow path emits
+                # these columns straight from the raw image through the
+                # fused native assembly; rows materialize planes lazily
+                for pos, c in enumerate(g.columns):
+                    outputs[c.index] = {"lazy_numeric": (g, pos)}
+                continue
             if g.codec is Codec.BINARY and not g.wide:
                 signed, big_endian, fits32, _ = g.variant
                 goffs, glens = subset(gmask)
@@ -1124,18 +1225,39 @@ class ColumnarDecoder:
 
     def _decode_numpy(self, arr: np.ndarray) -> Dict[int, dict]:
         outputs: Dict[int, dict] = {}
-        self._run_groups(self.kernel_groups, arr, outputs)
+        self._run_groups(self.kernel_groups, arr, outputs, defer=True)
         return outputs
 
+    def _lazy_numeric_ok(self, g: "_KernelGroup") -> bool:
+        """Numeric/float groups DEFER on the numpy backend when the
+        native library can emit their Arrow buffers directly (the fused
+        one-pass assembly in arrow_out): Arrow consumers then never pay
+        for the intermediate [n, ncols] planes at all, and the row/value
+        paths materialize them on demand."""
+        return (self.backend == "numpy" and native.available()
+                and len(g.columns) > 0
+                and (g.codec in _NUMERIC_CODECS
+                     or g.codec in _FLOAT_CODECS))
+
     def _run_groups(self, groups, arr: np.ndarray,
-                    outputs: Dict[int, dict]) -> None:
+                    outputs: Dict[int, dict], defer: bool = False) -> None:
         """Per-group numpy-path dispatch (native single-pass kernel when
         available, else gather + vectorized numpy) over a packed batch.
         Narrow numeric groups first go through ONE merged native pass —
         each record's bytes are touched once for the whole numeric plane
         instead of once per kernel group (exp1's type-variety profile has
-        59 such groups)."""
+        59 such groups). `defer=True` (the decode entry points) parks
+        fused-assembly-eligible numeric groups as lazy markers instead."""
         fc = fieldcost.current()
+        if defer:
+            rest = []
+            for g in groups:
+                if self._lazy_numeric_ok(g):
+                    for pos, c in enumerate(g.columns):
+                        outputs[c.index] = {"lazy_numeric": (g, pos)}
+                else:
+                    rest.append(g)
+            groups = rest
         groups = self._run_groups_merged(groups, arr, outputs, fc)
         n = arr.shape[0]
         for g in groups:

@@ -157,15 +157,37 @@ class TestAttributionParity:
         kw = {}
         if mode == "pipelined":
             kw = dict(pipeline_workers="2", chunk_size_mb="0.8")
-        rep = read_cobol(exp1_file, copybook_contents=EXP1_COPYBOOK,
-                         explain=True, **kw)
-        rep.data.to_arrow()
+        # The fused native assembly DEFERS numeric decode into the
+        # assemble plane (like lazy strings), so the two-sided anchor is
+        # the pure-Python path's contract: pin it with native off.
+        from cobrix_tpu import native
+
+        native.set_disabled(True)
+        try:
+            rep = read_cobol(exp1_file, copybook_contents=EXP1_COPYBOOK,
+                             explain=True, **kw)
+            rep.data.to_arrow()
+        finally:
+            native.set_disabled(False)
         stage = rep.decode_busy_s()
         attributed = rep.attributed_decode_s()
         assert stage and stage > 0
         # the acceptance bound: per-field decode busy sums to within
         # 15% of the measured decode-stage busy time
         assert attributed == pytest.approx(stage, rel=0.15)
+        if not native.available():
+            return
+        # native path: deferred decode rides the assemble plane; the
+        # decode plane must never EXCEED the decode stage, and the
+        # assemble plane must carry the fused assembly's time
+        rep_n = read_cobol(exp1_file, copybook_contents=EXP1_COPYBOOK,
+                           explain=True, **kw)
+        rep_n.data.to_arrow()
+        stage_n = rep_n.decode_busy_s()
+        assert stage_n and stage_n > 0
+        assert rep_n.attributed_decode_s() <= stage_n * 1.15
+        table = rep_n.as_dict()["field_costs"]
+        assert sum(v["assemble_s"] for v in table.values()) > 0
 
     def test_vrl_sequential_vs_pipelined(self, tmp_path):
         path = tmp_path / "txn.rdw"

@@ -1,0 +1,225 @@
+"""Native one-pass columnar assembly: byte parity with pure Python.
+
+The fused native kernels (native/columnar.cpp) decode record bytes
+straight into Arrow buffers — validity bitmaps, int32/int64/float data,
+decimal128 values — with the GIL released. Every test here reads the
+same input twice in one process, native dispatch ON then forced OFF
+(`native.set_disabled`), and asserts rows, Arrow tables, schema
+metadata, and error ledgers are identical: a wrong-bytes fast path must
+fail loudly, never ride a speedup.
+
+The whole module SKIPS VISIBLY when the native library is unavailable —
+these tests exist to exercise it, so silently passing without it would
+be a lie (rebuild via `python -m cobrix_tpu.native.build`).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from cobrix_tpu import native, read_cobol  # noqa: E402
+from cobrix_tpu.testing import generators as g  # noqa: E402
+from util import hard_timeout  # noqa: E402
+
+import asmcheck  # noqa: E402  (tools/asmcheck.py — the smoke harness)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(),
+    reason="native library unavailable — rebuild with "
+           "`python -m cobrix_tpu.native.build` (fallback-only parity "
+           "is vacuous here, so this skip must stay visible)")
+
+
+def _exp3_kw(**extra):
+    kw = dict(copybook_contents=g.EXP3_COPYBOOK,
+              is_record_sequence="true", segment_field="SEGMENT-ID",
+              redefine_segment_id_map="STATIC-DETAILS => C",
+              redefine_segment_id_map_1="CONTACTS => P")
+    kw.update(extra)
+    return kw
+
+
+def _hier_kw(**extra):
+    seg_opts = {f"redefine_segment_id_map:{i}": f"{name} => {sid}"
+                for i, (sid, name) in enumerate(
+                    g.HIERARCHICAL_SEGMENT_MAP.items())}
+    child_opts = {f"segment-children:{i}": f"{parent} => {child}"
+                  for i, (child, parent) in enumerate(
+                      g.HIERARCHICAL_PARENT_MAP.items())}
+    kw = dict(copybook_contents=g.HIERARCHICAL_COPYBOOK,
+              is_record_sequence="true", segment_field="SEGMENT-ID",
+              **seg_opts, **child_opts)
+    kw.update(extra)
+    return kw
+
+
+MODES = {
+    "sequential": {},
+    "pipelined": dict(pipeline_workers="2", chunk_size_mb="0.5"),
+    "multihost": dict(hosts="2"),
+}
+
+
+class TestParityMatrix:
+    """fixed / VRL / hierarchical x sequential / pipelined / multihost,
+    all through the asmcheck harness (its quick mode IS this tier-1
+    coverage; `--sweep` extends it under the slow marker)."""
+
+    @pytest.mark.parametrize("mode", list(MODES))
+    def test_fixed(self, mode):
+        with hard_timeout(240, f"fixed native parity ({mode})"):
+            asmcheck.check_profile(
+                f"exp1/{mode}", g.generate_exp1(500, seed=7).tobytes(),
+                dict(copybook_contents=g.EXP1_COPYBOOK, **MODES[mode]))
+
+    @pytest.mark.parametrize("mode", list(MODES))
+    def test_vrl_multiseg(self, mode):
+        with hard_timeout(240, f"VRL native parity ({mode})"):
+            asmcheck.check_profile(
+                f"exp3/{mode}", g.generate_exp3(400, seed=7),
+                _exp3_kw(**MODES[mode]))
+
+    @pytest.mark.parametrize("mode", ["sequential", "pipelined"])
+    def test_hierarchical(self, mode):
+        with hard_timeout(240, f"hierarchical native parity ({mode})"):
+            asmcheck.check_profile(
+                f"hier/{mode}", g.generate_hierarchical(80, seed=7),
+                _hier_kw(**MODES[mode]))
+
+
+class TestSemanticsEdges:
+    def test_permissive_policy_null_rows(self, tmp_path):
+        """Corrupted numeric fields under the permissive policy must
+        null/ledger identically on both paths."""
+        data = bytearray(g.generate_exp1(400, seed=3).tobytes())
+        data[100:140] = b"\xff" * 40  # stomp fields of record 0
+        data[1493 * 7 + 60: 1493 * 7 + 90] = b"\x00" * 30
+        asmcheck.check_profile(
+            "exp1_permissive", bytes(data),
+            dict(copybook_contents=g.EXP1_COPYBOOK,
+                 record_error_policy="permissive"))
+
+    def test_pruned_occurs_null_body(self):
+        """Projection pruning the 2000-slot OCCURS plane: the null-body
+        fast path and the fused assembly must agree with pure Python."""
+        asmcheck.check_profile(
+            "exp3_pruned", g.generate_exp3(300, seed=7),
+            _exp3_kw(select="SEGMENT-ID,COMPANY-ID,COMPANY-NAME"))
+
+    def test_decimal128_columns(self):
+        """Narrow + wide (>18 digit) COMP-3, explicit-decimal DISPLAY,
+        COMP-2 floats: the decimal128 two-limb build and float kernels
+        match the Python fallbacks bit for bit."""
+        asmcheck.check_profile(
+            "decimals", asmcheck._decimals_data(1200),
+            dict(copybook_contents=asmcheck.DECIMALS_COPYBOOK))
+
+    def test_asmcheck_quick_harness(self):
+        """The smoke tool's own quick mode stays green (tier-1 wiring
+        for tools/asmcheck.py; --sweep runs under the slow marker)."""
+        assert asmcheck.run_quick(records=200, mb=1.0) == 0
+
+    def test_rows_after_arrow_and_arrow_after_rows(self, tmp_path):
+        """Deferred numeric planes: either materialization order yields
+        the same rows AND the same table."""
+        path = tmp_path / "e3.dat"
+        path.write_bytes(g.generate_exp3(200, seed=9))
+        a = read_cobol(str(path), **_exp3_kw())
+        t_first = a.to_arrow()
+        rows_after = a.to_rows()
+        b = read_cobol(str(path), **_exp3_kw())
+        rows_first = b.to_rows()
+        t_after = b.to_arrow()
+        assert rows_after == rows_first
+        assert t_first.equals(t_after)
+
+    def test_parallel_assembly_engages(self, tmp_path):
+        """The pipeline's one-assembly-thread constraint is lifted
+        exactly when assembly is native: the report says so, and the
+        dedicated assembler shape returns when native is off."""
+        path = tmp_path / "e1.dat"
+        path.write_bytes(g.generate_exp1(2000, seed=5).tobytes())
+        kw = dict(copybook_contents=g.EXP1_COPYBOOK,
+                  pipeline_workers="2", chunk_size_mb="0.8")
+        out = read_cobol(str(path), **kw)
+        out.to_arrow()
+        assert out.metrics.pipeline["parallel_assembly"] is True
+        native.set_disabled(True)
+        try:
+            out_py = read_cobol(str(path), **kw)
+            t_py = out_py.to_arrow()
+        finally:
+            native.set_disabled(False)
+        assert out_py.metrics.pipeline["parallel_assembly"] is False
+        assert out.to_arrow().equals(t_py)
+
+
+class TestServeStreamed:
+    def test_serve_streamed_parity(self):
+        """A streamed serve scan (native assembly server-side) matches
+        the pure-Python in-process table byte for byte."""
+        from cobrix_tpu.serve import ScanServer, fetch_table
+
+        with tempfile.NamedTemporaryFile(suffix=".dat",
+                                         delete=False) as f:
+            f.write(g.generate_exp3(300, seed=7))
+            path = f.name
+        srv = ScanServer().start()
+        try:
+            with hard_timeout(240, "serve streamed native parity"):
+                remote = fetch_table(srv.address, path, **_exp3_kw())
+                native.set_disabled(True)
+                try:
+                    local = read_cobol(path, **_exp3_kw()).to_arrow()
+                finally:
+                    native.set_disabled(False)
+                assert remote.schema.metadata == local.schema.metadata
+                assert remote.equals(local)
+        finally:
+            srv.stop()
+            os.unlink(path)
+
+
+class TestNativePrimitives:
+    def test_pack_validity_matches_numpy(self):
+        rng = np.random.default_rng(3)
+        for n in (0, 1, 7, 8, 9, 63, 64, 1000):
+            mask = rng.integers(0, 2, n).astype(np.uint8)
+            res = native.pack_validity(mask)
+            assert res is not None
+            bitmap, nulls = res
+            assert nulls == int((mask == 0).sum())
+            expect = np.packbits(mask.view(bool), bitorder="little")
+            assert bytes(bitmap) == bytes(expect)
+
+    def test_simd_level_reported(self):
+        assert native.simd_level() >= 0
+
+    def test_build_is_fresh(self):
+        """available() implies the .so is newer than every source —
+        build.py's staleness rule is what keeps rebuilds reproducible."""
+        from cobrix_tpu.native import build as b
+
+        assert b.needs_build() is False
+
+    def test_set_disabled_round_trip(self):
+        assert native.available()
+        native.set_disabled(True)
+        try:
+            assert not native.available()
+            assert native.simd_level() == -1
+        finally:
+            native.set_disabled(False)
+        assert native.available()
+
+
+@pytest.mark.slow
+def test_asmcheck_sweep():
+    assert asmcheck.run_sweep(records=300, mb=1.0) == 0

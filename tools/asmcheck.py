@@ -1,0 +1,231 @@
+"""Native-assembly smoke check: fused decode->Arrow vs pure Python.
+
+The native layer (cobrix_tpu/native/columnar.cpp) emits Arrow buffers
+straight from record bytes — validity bitmaps, int32/int64/float data
+buffers, decimal128 values — with the GIL released. A wrong-bytes fast
+path would be a silent correctness bug wearing a speedup, so this check
+reads every profile twice in one process — native dispatch ON, then
+forced OFF (`native.set_disabled`) — and asserts rows, Arrow tables,
+schema metadata, and error ledgers are identical.
+
+    python tools/asmcheck.py                  # quick (~1-2 MB/profile)
+    python tools/asmcheck.py --records 200    # tiny record-count mode
+    python tools/asmcheck.py --sweep          # adds pipelined/multihost
+                                              # modes + permissive-policy
+                                              # corrupt-input fuzz (slow;
+                                              # tier-1 runs quick)
+
+Exit code 0 = byte-identical everywhere; 1 = any mismatch (or the
+native library is unavailable — this check exists to exercise it).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DECIMALS_COPYBOOK = """
+       01  REC.
+           05  ID        PIC 9(6).
+           05  AMT-BCD   PIC S9(11)V99 COMP-3.
+           05  AMT-WIDE  PIC S9(20)V9(4) COMP-3.
+           05  RATE      PIC S9(3)V9(2).
+           05  QTY       PIC S9(8) COMP.
+           05  PRICE     COMP-2.
+           05  NAME      PIC X(12).
+"""
+
+
+def _decimals_data(n: int, seed: int = 11) -> bytes:
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    out = bytearray()
+    for i in range(n):
+        rec = bytearray()
+        rec += bytes(0xF0 + int(d) for d in f"{i % 999999:06d}")
+        # S9(11)V99 COMP-3: 13 digits -> 7 bytes
+        v = int(rng.integers(-10**12, 10**12))
+        rec += _bcd(v, 7)
+        # S9(20)V9(4) COMP-3: 24 digits -> 13 bytes (wide plane)
+        w = int(rng.integers(-10**17, 10**17)) * int(rng.integers(1, 999))
+        rec += _bcd(w, 13)
+        # S9(3)V9(2) zoned: 5 digits, trailing overpunch
+        r = int(rng.integers(-99999, 99999))
+        rec += _zoned(r, 5)
+        rec += int(rng.integers(-10**7, 10**7)).to_bytes(
+            4, "big", signed=True)
+        import struct
+
+        rec += struct.pack(">d", float(rng.normal()))
+        rec += f"NAME{i:08d}".encode("cp037")
+        out += rec
+    return bytes(out)
+
+
+def _bcd(value: int, width: int) -> bytes:
+    digits = str(abs(value)).zfill(width * 2 - 1)[-(width * 2 - 1):]
+    nibbles = [int(d) for d in digits] + [0x0D if value < 0 else 0x0C]
+    return bytes((nibbles[i] << 4) | nibbles[i + 1]
+                 for i in range(0, len(nibbles), 2))
+
+
+def _zoned(value: int, width: int) -> bytes:
+    digits = str(abs(value)).zfill(width)[-width:]
+    body = bytes(0xF0 + int(d) for d in digits[:-1])
+    last = int(digits[-1])
+    return body + bytes([(0xD0 if value < 0 else 0xC0) + last])
+
+
+def _profiles(records: int | None, mb: float):
+    from cobrix_tpu.testing import generators as g
+
+    n1 = records or max(64, int(mb * 1024 * 1024) // 1493)
+    n3 = records or max(64, int(mb * 1024 * 1024 / 5350))
+    nh = (records // 4 if records else max(40, int(mb * 1024 * 1024 / 1350)))
+    seg_opts = {f"redefine_segment_id_map:{i}": f"{name} => {sid}"
+                for i, (sid, name) in enumerate(
+                    g.HIERARCHICAL_SEGMENT_MAP.items())}
+    child_opts = {f"segment-children:{i}": f"{parent} => {child}"
+                  for i, (child, parent) in enumerate(
+                      g.HIERARCHICAL_PARENT_MAP.items())}
+    return [
+        ("exp1_fixed", g.generate_exp1(n1, seed=7).tobytes(),
+         dict(copybook_contents=g.EXP1_COPYBOOK)),
+        ("exp3_multiseg", g.generate_exp3(n3, seed=7),
+         dict(copybook_contents=g.EXP3_COPYBOOK,
+              is_record_sequence="true", segment_field="SEGMENT-ID",
+              redefine_segment_id_map="STATIC-DETAILS => C",
+              redefine_segment_id_map_1="CONTACTS => P")),
+        ("exp3_pruned_occurs", g.generate_exp3(n3, seed=7),
+         dict(copybook_contents=g.EXP3_COPYBOOK,
+              is_record_sequence="true", segment_field="SEGMENT-ID",
+              redefine_segment_id_map="STATIC-DETAILS => C",
+              redefine_segment_id_map_1="CONTACTS => P",
+              select="SEGMENT-ID,COMPANY-ID,COMPANY-NAME")),
+        ("hierarchical", g.generate_hierarchical(nh, seed=7),
+         dict(copybook_contents=g.HIERARCHICAL_COPYBOOK,
+              is_record_sequence="true", segment_field="SEGMENT-ID",
+              **seg_opts, **child_opts)),
+        ("decimals", _decimals_data(records or 1500),
+         dict(copybook_contents=DECIMALS_COPYBOOK)),
+    ]
+
+
+def _snapshot(path: str, kw: dict):
+    from cobrix_tpu import read_cobol
+
+    t0 = time.perf_counter()
+    out = read_cobol(path, **kw)
+    table = out.to_arrow()
+    dt = time.perf_counter() - t0
+    diag = out.diagnostics.as_dict() if out.diagnostics is not None else None
+    # multihost results are Arrow-backed by contract (no Python rows)
+    rows = None if "hosts" in kw else out.to_rows()
+    return rows, table, diag, dt
+
+
+def check_profile(name: str, data: bytes, kw: dict) -> dict:
+    from cobrix_tpu import native
+
+    if not native.available():
+        raise RuntimeError("native library unavailable — asmcheck "
+                           "exists to exercise it (rebuild via "
+                           "python -m cobrix_tpu.native.build)")
+    with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+        f.write(data)
+        path = f.name
+    try:
+        rows_n, table_n, diag_n, dt_n = _snapshot(path, kw)
+        native.set_disabled(True)
+        try:
+            rows_p, table_p, diag_p, dt_p = _snapshot(path, kw)
+        finally:
+            native.set_disabled(False)
+    finally:
+        os.unlink(path)
+    if rows_n != rows_p:
+        raise AssertionError(f"{name}: row mismatch native vs python")
+    if not table_n.equals(table_p):
+        raise AssertionError(f"{name}: Arrow table mismatch")
+    if table_n.schema.metadata != table_p.schema.metadata:
+        raise AssertionError(f"{name}: schema metadata mismatch")
+    if diag_n != diag_p:
+        raise AssertionError(f"{name}: diagnostics ledger mismatch")
+    return {"rows": table_n.num_rows, "native_s": round(dt_n, 3),
+            "python_s": round(dt_p, 3)}
+
+
+def run_quick(records: int | None, mb: float) -> int:
+    failures = 0
+    for name, data, kw in _profiles(records, mb):
+        try:
+            stats = check_profile(name, data, kw)
+        except Exception as exc:
+            failures += 1
+            print(f"FAIL {name}: {exc}")
+            continue
+        print(f"ok   {name:<20} rows={stats['rows']:<8} "
+              f"native={stats['native_s']}s python={stats['python_s']}s")
+    return failures
+
+
+def run_sweep(records: int | None, mb: float) -> int:
+    """Adds execution modes and a permissive-policy corrupt read."""
+    failures = run_quick(records, mb)
+    modes = [("pipelined", dict(pipeline_workers="2",
+                                chunk_size_mb="0.5")),
+             ("multihost", dict(hosts="2"))]
+    for name, data, kw in _profiles(records or 400, mb):
+        if name == "hierarchical":
+            continue  # single-shard layouts: modes covered by tests
+        for mode, extra in modes:
+            try:
+                stats = check_profile(f"{name}/{mode}",
+                                      data, dict(kw, **extra))
+            except Exception as exc:
+                failures += 1
+                print(f"FAIL {name}/{mode}: {exc}")
+                continue
+            print(f"ok   {name + '/' + mode:<26} rows={stats['rows']}")
+    # permissive policy: a corrupted record must null/ledger identically
+    from cobrix_tpu.testing import generators as g
+
+    data = bytearray(g.generate_exp1((records or 400), seed=3).tobytes())
+    data[100:108] = b"\xff" * 8  # stomp numeric fields of record 0
+    try:
+        stats = check_profile(
+            "exp1_permissive", bytes(data),
+            dict(copybook_contents=g.EXP1_COPYBOOK,
+                 record_error_policy="permissive"))
+        print(f"ok   exp1_permissive           rows={stats['rows']}")
+    except Exception as exc:
+        failures += 1
+        print(f"FAIL exp1_permissive: {exc}")
+    return failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mb", type=float, default=1.5,
+                    help="approx MB per profile (default 1.5)")
+    ap.add_argument("--records", type=int, default=None,
+                    help="tiny record-count mode (overrides --mb)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="add pipelined/multihost modes + permissive fuzz")
+    args = ap.parse_args()
+    failures = (run_sweep(args.records, args.mb) if args.sweep
+                else run_quick(args.records, args.mb))
+    if failures:
+        print(f"asmcheck: {failures} FAILURE(S)")
+        return 1
+    print("asmcheck: native and pure-Python assembly byte-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
