@@ -1257,6 +1257,121 @@ def run_serve_side_metric(mb_target: float) -> dict:
     return result
 
 
+def run_serve_fleet_metric(mb_target: float) -> dict:
+    """exp_serve fleet mode: aggregate routed throughput as the fleet
+    scales N=1 -> 2 -> 4 replicas behind the routing front. Four files
+    spread across the fleet by cache affinity (each file's scans pin to
+    the replica whose caches are warm for it), so the aggregate MB/s of
+    a concurrent scan mix should GROW with N while the warm-affinity
+    hit rate stays high — that pair is the scaling claim PR 16's router
+    exists to earn. Served from ``memory://`` so the io cache planes
+    (and the peer tier's wire path) engage exactly as they would
+    against object storage."""
+    import shutil
+    import tempfile
+    import threading
+
+    import fsspec
+
+    from cobrix_tpu.fleet.router import RoutingFront, route_scan
+    from cobrix_tpu.serve import ScanServer
+    from cobrix_tpu.testing.generators import EXP1_COPYBOOK, generate_exp1
+
+    n_files = 4
+    per_file = max(64, int(mb_target * 1024 * 1024 / n_files) // 1493)
+    fs = fsspec.filesystem("memory")
+    paths = []
+    for i in range(n_files):
+        data = generate_exp1(per_file, seed=200 + i)
+        with fs.open(f"/bench-fleet/f{i}.dat", "wb") as f:
+            f.write(data.tobytes())
+        paths.append((f"memory://bench-fleet/f{i}.dat",
+                      data.nbytes / (1024 * 1024)))
+    total_mb = sum(mb for _, mb in paths)
+    kw = dict(copybook_contents=EXP1_COPYBOOK)
+    hb_s = 0.2
+    work = tempfile.mkdtemp(prefix="bench-fleet-")
+    errors = []
+    per_n = {}
+    try:
+        for n in (1, 2, 4):
+            fleet_dir = os.path.join(work, f"fleet-{n}")
+            servers = [
+                ScanServer(
+                    port=0, enable_http=False,
+                    server_options={"cache_dir": os.path.join(
+                        work, f"cache-{n}-{i}")},
+                    fleet=True, replica_id=f"bench-{n}-{i}",
+                    heartbeat_interval_s=hb_s,
+                    fleet_dir=fleet_dir).start()
+                for i in range(n)]
+            front = RoutingFront(fleet_dir, slo_aware=False)
+            try:
+                deadline = time.monotonic() + 15
+                while (len(front.registry.read()) < n
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                # warm pass: every file scanned once (caches + heat)
+                for path, _mb in paths:
+                    route_scan(front, path, tenant="bench",
+                               **kw).table()
+                time.sleep(hb_s * 2)  # heat rides the next heartbeat
+                base = front.state()
+                threads, rows = [], []
+
+                def one(path):
+                    t = route_scan(front, path, tenant="bench",
+                                   **kw).table()
+                    rows.append(t.num_rows)
+
+                for _round in range(2):
+                    for path, _mb in paths:
+                        threads.append(threading.Thread(
+                            target=one, args=(path,)))
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=300)
+                wall = time.perf_counter() - t0
+                if len(rows) != len(threads) \
+                        or sum(rows) != per_file * len(threads):
+                    errors.append(f"N={n}: row mismatch {sum(rows)}")
+                st = front.state()
+                decisions = st["decisions"] - base["decisions"]
+                hits = st["affinity_hits"] - base["affinity_hits"]
+                per_n[str(n)] = {
+                    "aggregate_MBps": round(total_mb * 2 / wall, 1),
+                    "affinity_hit_rate": round(
+                        hits / max(1, decisions), 2),
+                    "routed": st["routed"],
+                }
+            finally:
+                for srv in servers:
+                    srv.stop()
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+        try:
+            fs.rm("/bench-fleet", recursive=True)
+        except Exception:
+            pass
+    agg4 = per_n.get("4", {}).get("aggregate_MBps", 0.0)
+    agg1 = per_n.get("1", {}).get("aggregate_MBps", 0.0)
+    result = {
+        "metric": "exp_serve_fleet_aggregate",
+        "value": agg4,
+        "unit": "MB/s",
+        "scaling_4x": round(agg4 / agg1, 2) if agg1 else None,
+        "warm_affinity_hit_rate": per_n.get("4", {}).get(
+            "affinity_hit_rate"),
+        "per_n": per_n,
+    }
+    if errors:
+        result["error"] = "; ".join(errors)
+    _log(f"side metric exp_serve fleet: {result}")
+    return result
+
+
 def run_sink_side_metric(mb_target: float) -> dict:
     """exp_sink: the transactional lakehouse sink (cobrix_tpu.sink) vs
     bare streaming decode, same exp1 input tailed from a static file.
@@ -1362,6 +1477,12 @@ def _side_metrics(mb_target: float) -> dict:
         side["exp_serve"] = run_serve_side_metric(min(mb_target, 24.0))
     except Exception as exc:
         _log(f"exp_serve side metric failed: {exc}")
+    if isinstance(side.get("exp_serve"), dict):
+        try:
+            side["exp_serve"]["fleet"] = run_serve_fleet_metric(
+                min(mb_target, 8.0))
+        except Exception as exc:
+            _log(f"exp_serve fleet metric failed: {exc}")
     try:
         side["exp_sink"] = run_sink_side_metric(min(mb_target, 16.0))
     except Exception as exc:

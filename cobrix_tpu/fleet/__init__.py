@@ -10,21 +10,41 @@ as ``/fleet/{replicas,metrics,slo,signals}`` (serve/http.py) and
 rendered by ``tools/fleetview.py``; ``tools/fleetcheck.py`` is the
 3-replica end-to-end proof.
 
+PR 16 adds the self-healing data plane on top of the observability
+plane: `router.py` (a health-aware, cache-affine routing front — as a
+library via `RoutingFront`/`route_scan` or as a frame-level proxy via
+``python -m cobrix_tpu.serve --route``) and `actuator.py` (the opt-in
+supervisor that turns `derive_signals`' ``desired_replicas`` into
+actual replica subprocess lifecycle, with hysteresis, flap damping and
+crash-restart backoff).
+
 Everything here is OFF unless `ScanServer(fleet=True)` /
 ``python -m cobrix_tpu.serve --fleet`` opts in: a non-fleet server
 never imports this package, writes no heartbeat, takes no timestamp —
 the zero-overhead contract the tests counter-assert.
 """
+from .actuator import (FleetActuator, read_actuator_events,
+                       read_actuator_state)
 from .federate import FleetFederator, FleetMergeError, FleetView
 from .registry import Heartbeater, ReplicaRecord, ReplicaRegistry
+from .router import (RouteServer, RoutingFront, read_router_state,
+                     route_scan, run_route_server)
 from .signals import derive_signals
 
 __all__ = [
+    "FleetActuator",
     "FleetFederator",
     "FleetMergeError",
     "FleetView",
     "Heartbeater",
     "ReplicaRecord",
     "ReplicaRegistry",
+    "RouteServer",
+    "RoutingFront",
     "derive_signals",
+    "read_actuator_events",
+    "read_actuator_state",
+    "read_router_state",
+    "route_scan",
+    "run_route_server",
 ]

@@ -1,0 +1,456 @@
+"""The autoscale actuator: `desired_replicas` becomes replica lifecycle.
+
+PR 13's `derive_signals` deliberately stopped at a *recommendation
+record* — it computes ``desired_replicas`` and actuates nothing. This
+module is the opt-in other half: a supervisor that owns a set of
+serving-replica **subprocesses** (spawned as ``python -m
+cobrix_tpu.serve --fleet ...``, the same entry point an operator runs)
+and reconciles the running count toward the recommendation.
+
+Strictly bounded authority — the actuator will only ever touch
+processes IT spawned:
+
+* it never signals, drains, or counts replicas an operator started by
+  hand, even when they register in the same fleet directory (they
+  contribute to the *desired* math via the registry, but scale-down
+  only ever picks from the actuator's own children)
+* scale-down is graceful: SIGTERM, which the serve entry point maps to
+  `drain()` (PR 8 semantics — stop accepting, finish in-flight scans,
+  flush audit) with a bounded grace before SIGKILL
+* `stop()` tears down every child the same way; the zero-orphan
+  guarantee is `stop()` returning with every child's exit code reaped.
+
+Stability machinery, because raw `desired_replicas` oscillates:
+
+* **hysteresis** — a new desired value must persist for ``hold_beats``
+  consecutive polls before the actuator acts on it
+* **flap damping** — at least ``flap_damp_s`` between scale events,
+  and at most ONE replica added or removed per event
+* **crash restart with backoff** — a child that exits uninvited is
+  respawned immediately the first time (a crashed replica must be back
+  inside two heartbeat intervals), then with exponential backoff
+  (``backoff_base_s`` doubling to ``backoff_max_s``) while it keeps
+  crashing; a child that stayed up long enough resets its backoff.
+
+Every decision is appended to ``<fleet_dir>/actuator/events.jsonl``
+and the current world to ``state.json`` (CRC-stamped) — that is what
+`tools/fleetview.py` renders next to the replica table.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .registry import ReplicaRegistry
+
+_ADDR = re.compile(r"serving scans on \('([^']+)', (\d+)\), "
+                   r"obs on \('([^']+)', (\d+)\)")
+
+# a child that survived this many heartbeat intervals earns its backoff
+# reset — the crash loop is over
+STABLE_BEATS = 10.0
+
+
+class _Child:
+    """One actuator-owned replica subprocess."""
+
+    def __init__(self, slot: int, replica_id: str,
+                 proc: subprocess.Popen):
+        self.slot = slot
+        self.replica_id = replica_id
+        self.proc = proc
+        self.started_at = time.monotonic()
+        self.scan_address: Optional[tuple] = None
+        self.http_address: Optional[tuple] = None
+        self.restarts = 0
+        self.backoff_s = 0.0       # next respawn delay if it crashes
+        self.respawn_at = 0.0      # monotonic; 0 = not pending
+        self.stopping = False      # we sent SIGTERM on purpose
+        self.stop_deadline = 0.0
+        self._reader = threading.Thread(
+            target=self._drain_stdout, name=f"cobrix-actuator-{slot}",
+            daemon=True)
+        self._reader.start()
+
+    def _drain_stdout(self) -> None:
+        # parse the serve banner for addresses, then keep draining so
+        # the child never blocks on a full pipe
+        try:
+            for line in self.proc.stdout:
+                m = _ADDR.search(line)
+                if m:
+                    self.scan_address = (m.group(1), int(m.group(2)))
+                    self.http_address = (m.group(3), int(m.group(4)))
+        except (OSError, ValueError):
+            pass
+
+
+class FleetActuator:
+    """Reconcile running actuator-owned replicas toward a desired
+    count. `start()` runs the loop in a daemon thread; `step()` is one
+    reconciliation pass (tests drive it directly for determinism)."""
+
+    def __init__(self, cache_dir: str,
+                 fleet_dir: str = "",
+                 min_replicas: int = 1,
+                 max_replicas: int = 4,
+                 poll_interval_s: float = 0.5,
+                 hold_beats: int = 3,
+                 flap_damp_s: float = 10.0,
+                 backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 30.0,
+                 heartbeat_interval_s: float = 2.0,
+                 drain_grace_s: float = 20.0,
+                 replica_prefix: str = "auto-",
+                 host: str = "127.0.0.1",
+                 server_args: Optional[List[str]] = None,
+                 desired_fn: Optional[Callable[[], int]] = None,
+                 env: Optional[dict] = None):
+        self.cache_dir = cache_dir
+        self.fleet_dir = fleet_dir or os.path.join(cache_dir, "fleet")
+        self.registry = ReplicaRegistry(self.fleet_dir,
+                                        interval_s=heartbeat_interval_s)
+        self.min_replicas = max(0, int(min_replicas))
+        self.max_replicas = max(self.min_replicas, int(max_replicas))
+        self.poll_interval_s = max(0.05, float(poll_interval_s))
+        self.hold_beats = max(1, int(hold_beats))
+        self.flap_damp_s = max(0.0, float(flap_damp_s))
+        self.backoff_base_s = max(0.05, float(backoff_base_s))
+        self.backoff_max_s = max(self.backoff_base_s,
+                                 float(backoff_max_s))
+        self.heartbeat_interval_s = max(0.05,
+                                        float(heartbeat_interval_s))
+        self.drain_grace_s = max(0.0, float(drain_grace_s))
+        self.replica_prefix = replica_prefix
+        self.host = host
+        self.server_args = list(server_args or [])
+        self.desired_fn = desired_fn
+        self.env = env
+        self._children: Dict[int, _Child] = {}
+        self._next_slot = 0
+        self._desired_seen: Optional[int] = None
+        self._desired_streak = 0
+        self._last_scale_at = 0.0
+        self._federator = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(os.path.join(self.fleet_dir, "actuator"),
+                    exist_ok=True)
+
+    # -- spawning ---------------------------------------------------------
+
+    def _spawn_cmd(self, replica_id: str) -> List[str]:
+        cmd = [sys.executable, "-m", "cobrix_tpu.serve",
+               "--host", self.host, "--port", "0", "--http-port", "0",
+               "--cache-dir", self.cache_dir, "--fleet",
+               "--replica-id", replica_id,
+               "--heartbeat-interval", str(self.heartbeat_interval_s),
+               "--drain-timeout", str(self.drain_grace_s)]
+        if self.fleet_dir:
+            cmd += ["--fleet-dir", self.fleet_dir]
+        return cmd + self.server_args
+
+    def _spawn(self, slot: int, restarts: int = 0,
+               backoff_s: float = 0.0) -> _Child:
+        replica_id = f"{self.replica_prefix}{slot}"
+        env = dict(self.env if self.env is not None else os.environ)
+        # the child must import this package from the same tree
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (pkg_root + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        proc = subprocess.Popen(
+            self._spawn_cmd(replica_id), stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True, env=env)
+        child = _Child(slot, replica_id, proc)
+        child.restarts = restarts
+        child.backoff_s = backoff_s
+        self._children[slot] = child
+        self._event("spawn", replica_id, pid=proc.pid,
+                    restarts=restarts)
+        return child
+
+    # -- the reconciliation pass ------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._reap(now)
+            self._respawn_due(now)
+            self._finish_stops(now)
+            self._reconcile(now)
+            self._write_state()
+
+    def _reap(self, now: float) -> None:
+        for child in list(self._children.values()):
+            rc = child.proc.poll()
+            if rc is None or child.respawn_at:
+                continue
+            if child.stopping:
+                # the scale-down (or stop()) we asked for completed
+                self._event("stopped", child.replica_id, code=rc)
+                del self._children[child.slot]
+                continue
+            uptime = now - child.started_at
+            if uptime > STABLE_BEATS * self.heartbeat_interval_s:
+                child.backoff_s = 0.0  # it had recovered; start fresh
+            # first crash respawns immediately — the fleet must be
+            # whole again within two heartbeat intervals
+            delay = child.backoff_s
+            child.backoff_s = min(
+                self.backoff_max_s,
+                max(self.backoff_base_s, child.backoff_s * 2.0))
+            child.respawn_at = now + delay
+            self._event("crash", child.replica_id, code=rc,
+                        uptime_s=round(uptime, 3),
+                        respawn_in_s=round(delay, 3))
+
+    def _respawn_due(self, now: float) -> None:
+        for child in list(self._children.values()):
+            if child.respawn_at and now >= child.respawn_at:
+                backoff = child.backoff_s
+                restarts = child.restarts + 1
+                del self._children[child.slot]
+                self._spawn(child.slot, restarts=restarts,
+                            backoff_s=backoff)
+
+    def _finish_stops(self, now: float) -> None:
+        for child in list(self._children.values()):
+            if (child.stopping and child.proc.poll() is None
+                    and now >= child.stop_deadline):
+                # drain grace exhausted: the hard line
+                try:
+                    child.proc.kill()
+                except OSError:
+                    pass
+                self._event("killed", child.replica_id)
+
+    def _desired(self) -> int:
+        if self.desired_fn is not None:
+            want = int(self.desired_fn())
+        else:
+            want = self._signals_desired()
+        return max(self.min_replicas, min(self.max_replicas, want))
+
+    def _signals_desired(self) -> int:
+        """Default policy: PR 13's recommendation over the live fleet
+        view. Unreachable sidecars degrade to 'hold current'."""
+        from .federate import FleetFederator
+        from .signals import derive_signals
+
+        if self._federator is None:
+            self._federator = FleetFederator(self.registry,
+                                             timeout_s=1.0)
+        try:
+            view = self._federator.view()
+            doc = derive_signals(view,
+                                 min_replicas=self.min_replicas,
+                                 max_replicas=self.max_replicas)
+            return int(doc.get("desired_replicas",
+                               len(self._children)))
+        except Exception:
+            return len(self._children) or self.min_replicas
+
+    def _reconcile(self, now: float) -> None:
+        active = [c for c in self._children.values()
+                  if not c.stopping]
+        current = len(active)
+        want = self._desired()
+        if want == self._desired_seen:
+            self._desired_streak += 1
+        else:
+            self._desired_seen = want
+            self._desired_streak = 1
+        if want == current:
+            return
+        # below the floor is not a scale decision, it is repair: the
+        # hold/damp gates exist to stop flapping, not to leave the
+        # fleet short-handed
+        repairing = current < self.min_replicas
+        if not repairing:
+            if self._desired_streak < self.hold_beats:
+                return
+            if now - self._last_scale_at < self.flap_damp_s:
+                return
+        self._last_scale_at = now
+        if want > current:
+            self._spawn(self._next_slot)
+            self._next_slot += 1
+            self._event("scale_up", "", toward=want, running=current)
+        else:
+            # newest first: the longest-lived caches stay
+            victim = max(active, key=lambda c: c.started_at)
+            victim.stopping = True
+            victim.stop_deadline = now + self.drain_grace_s + 5.0
+            try:
+                victim.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+            self._event("scale_down", victim.replica_id,
+                        toward=want, running=current)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "FleetActuator":
+        # bring the floor up before the first poll tick
+        with self._lock:
+            while len(self._children) < self.min_replicas:
+                self._spawn(self._next_slot)
+                self._next_slot += 1
+            self._write_state()
+        self._thread = threading.Thread(target=self._run,
+                                        name="cobrix-actuator",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            try:
+                self.step()
+            except Exception:
+                # the supervisor outlives any single bad pass
+                pass
+
+    def stop(self, grace_s: Optional[float] = None) -> None:
+        """Tear down every child: SIGTERM (graceful drain), bounded
+        wait, SIGKILL stragglers, reap all. No orphans survive this
+        returning."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.poll_interval_s * 4 + 5)
+            self._thread = None
+        grace = (self.drain_grace_s + 5.0 if grace_s is None
+                 else max(0.0, float(grace_s)))
+        with self._lock:
+            children = list(self._children.values())
+            for child in children:
+                child.stopping = True
+                if child.proc.poll() is None:
+                    try:
+                        child.proc.send_signal(signal.SIGTERM)
+                    except OSError:
+                        pass
+            deadline = time.monotonic() + grace
+            for child in children:
+                left = deadline - time.monotonic()
+                try:
+                    child.proc.wait(timeout=max(0.0, left))
+                except subprocess.TimeoutExpired:
+                    try:
+                        child.proc.kill()
+                    except OSError:
+                        pass
+                    child.proc.wait()
+                self._event("stopped", child.replica_id,
+                            code=child.proc.returncode)
+            self._children.clear()
+            self._write_state()
+
+    def replicas(self) -> List[dict]:
+        with self._lock:
+            return [self._child_doc(c)
+                    for c in self._children.values()]
+
+    def _child_doc(self, c: _Child) -> dict:
+        rc = c.proc.poll()
+        if c.respawn_at:
+            state = "backoff"
+        elif c.stopping:
+            state = "draining"
+        elif rc is not None:
+            state = "exited"
+        else:
+            state = "running"
+        return {"replica_id": c.replica_id, "slot": c.slot,
+                "pid": c.proc.pid, "state": state,
+                "restarts": c.restarts,
+                "scan_address": list(c.scan_address or ()) or None,
+                "uptime_s": round(time.monotonic() - c.started_at, 3)}
+
+    # -- the paper trail (fleetview reads these) --------------------------
+
+    def _event(self, event: str, replica_id: str, **detail) -> None:
+        doc = {"ts": time.time(), "event": event,
+               "replica_id": replica_id}
+        doc.update(detail)
+        path = os.path.join(self.fleet_dir, "actuator",
+                            "events.jsonl")
+        try:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(doc, sort_keys=True) + "\n")
+        except OSError:
+            pass
+
+    def _write_state(self) -> None:
+        from ..io.integrity import stamp_json_payload
+        from ..utils.atomic import write_atomic
+
+        doc = stamp_json_payload({
+            "generated_at": time.time(),
+            "pid": os.getpid(),
+            "desired": self._desired_seen,
+            "running": sum(1 for c in self._children.values()
+                           if not c.stopping
+                           and c.proc.poll() is None),
+            "min_replicas": self.min_replicas,
+            "max_replicas": self.max_replicas,
+            "replicas": [self._child_doc(c)
+                         for c in self._children.values()],
+        })
+        try:
+            write_atomic(
+                os.path.join(self.fleet_dir, "actuator", "state.json"),
+                json.dumps(doc, sort_keys=True))
+        except OSError:
+            pass
+
+    def __enter__(self) -> "FleetActuator":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def read_actuator_state(fleet_dir: str) -> Optional[dict]:
+    """The actuator's stamped state.json, or None (absent/torn)."""
+    from ..io.integrity import verify_json_payload
+
+    path = os.path.join(fleet_dir, "actuator", "state.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(doc, dict) and verify_json_payload(doc):
+        doc.pop("payload_crc32", None)
+        return doc
+    return None
+
+
+def read_actuator_events(fleet_dir: str, tail: int = 50) -> List[dict]:
+    """The last `tail` events from events.jsonl (torn lines skipped)."""
+    path = os.path.join(fleet_dir, "actuator", "events.jsonl")
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+    except OSError:
+        return []
+    out: List[dict] = []
+    for line in lines[-tail:]:
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            out.append(doc)
+    return out

@@ -175,14 +175,24 @@ class ReplicaRegistry:
         """Every unexpired replica, sorted by id. Corrupt records are
         quarantined+counted (io.integrity) and skipped — a bit-flipped
         heartbeat must read as an absent replica, never as a phantom
-        member with garbage endpoints. ``gc=True`` also unlinks records
-        past the GC horizon (the heartbeater does this occasionally;
-        plain readers never mutate)."""
+        member with garbage endpoints. Records claiming the same
+        ``replica_id`` collapse to the file-mtime-newest one — a
+        SIGKILLed replica restarting under its old identity reclaims
+        the heartbeat as one member, not a live+stale pair.
+        ``gc=True`` also unlinks records past the GC horizon (the
+        heartbeater does this occasionally; plain readers never
+        mutate)."""
         from ..io.integrity import note_corruption, quarantine, \
             verify_json_payload
 
         now = time.time() if now is None else now
         out: List[ReplicaStatus] = []
+        # replica_id -> (file mtime, index into out): a replica that
+        # restarted with the SAME id before its old heartbeat expired
+        # must read as ONE member (newest file wins), never a live+stale
+        # pair — a pair double-counts capacity and makes routers place
+        # traffic on an endpoint that no longer exists
+        newest: dict = {}
         try:
             names = sorted(os.listdir(self.replica_dir))
         except OSError:
@@ -228,10 +238,18 @@ class ReplicaRegistry:
                         pass
                 continue
             state = "live" if age <= interval * LIVE_FACTOR else "stale"
-            out.append(ReplicaStatus(
+            status = ReplicaStatus(
                 record=record, state=state, age_s=max(0.0, age),
                 clock_skew_s=(record.heartbeat_at - st.st_mtime
-                              if record.heartbeat_at else 0.0)))
+                              if record.heartbeat_at else 0.0))
+            prev = newest.get(record.replica_id)
+            if prev is not None:
+                if st.st_mtime >= prev[0]:
+                    newest[record.replica_id] = (st.st_mtime, prev[1])
+                    out[prev[1]] = status
+                continue
+            newest[record.replica_id] = (st.st_mtime, len(out))
+            out.append(status)
         return out
 
 

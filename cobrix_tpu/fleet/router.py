@@ -1,0 +1,547 @@
+"""The fleet routing front: health-aware, cache-affine scan placement.
+
+PR 12's observability plane publishes everything a router needs —
+heartbeat liveness, draining flags, memory-pressure levels, SLO burn,
+and per-replica fingerprint heat (`cache_affinity`). This module is the
+consumer: `RoutingFront` turns a scan's identity (its input files, or
+the plan fingerprint a resume token carries) into an ORDERED replica
+preference list, and `RouteServer` wraps that decision in a frame-level
+TCP proxy so unmodified clients get routed scans by pointing at one
+address.
+
+Placement, in priority order:
+
+1. **Affinity**: if a live replica's heartbeat heat says it already
+   served this plan/file (``plan:<fp>`` / ``file:<path>`` keys), that
+   replica goes first — its block/sparse-index/compiled-plan caches
+   are warm, which is the whole aggregate-throughput game (ROADMAP
+   item 2).
+2. **Rendezvous hash**: otherwise (and for the rest of the order)
+   replicas are ranked by highest-random-weight hash of
+   (scan key, replica_id) — deterministic, minimal churn when
+   membership changes, no coordination.
+
+Health rules — all route AROUND a replica before any client touches
+it (each exclusion is counted on
+``cobrix_route_around_total{replica,reason}``):
+
+    stale_heartbeat   heartbeat older than LIVE_FACTOR x interval
+    draining          the replica said so (rejects new scans anyway)
+    memory_shed       pressure == "shed": admission is refusing work
+    slo_fast_burn     fast-window error budget burn > 1.0
+    recent_failure    the router itself just watched a proxied stream
+                      die on this replica (faster than heartbeat decay)
+
+Excluded replicas are appended to the TAIL of the preference list
+rather than dropped: when the whole fleet is unhealthy, a degraded
+replica still beats no replica, and client-side failover walks the
+tail naturally.
+
+Failover composition: the proxy never retries mid-stream itself — when
+an upstream dies it simply cuts the client connection. The client's
+existing resume machinery (serve/client.py, PR 9) reconnects *to the
+router* with its resume token; the router sees the dead replica in its
+recent-failure memory and places the resumed attempt on the
+next-preferred healthy replica, which skips already-delivered records.
+Byte-identical delivery therefore holds end to end, including
+follow-mode subscriptions (the resume token's watermark seeds the new
+replica's ingestor).
+
+Router state (per-replica routed share, affinity hit rate,
+routed-around reasons) is published as a CRC-stamped JSON record under
+``<fleet_dir>/router/`` — `tools/fleetview.py` renders it next to the
+replica table.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .registry import ReplicaRegistry, ReplicaStatus, _safe_replica_id
+
+# a router-observed upstream death outruns heartbeat staleness: route
+# around the replica for this long (it re-earns traffic by heartbeating)
+DEFAULT_FAILURE_COOLDOWN_S = 3.0
+# fast-window burn past this routes around (1.0 = burning budget)
+SLO_FAST_BURN_LIMIT = 1.0
+# router records older than this are dead routers, not rendered
+ROUTER_STATE_MAX_AGE_S = 60.0
+
+
+def _rendezvous_order(key: str,
+                      statuses: Sequence[ReplicaStatus]
+                      ) -> List[ReplicaStatus]:
+    """Highest-random-weight ordering: stable per key, minimal movement
+    under membership churn (only the dead replica's keys move)."""
+    return sorted(
+        statuses,
+        key=lambda st: hashlib.sha256(
+            f"{key}|{st.record.replica_id}".encode("utf-8", "replace")
+        ).digest(),
+        reverse=True)
+
+
+def affinity_keys(files, plan_fp: str = "") -> List[str]:
+    """The heat-key vocabulary shared with the server side
+    (ScanServer._note_fleet_heat): ``plan:<fp>`` + ``file:<path>``."""
+    keys = [f"plan:{plan_fp}"] if plan_fp else []
+    keys.extend(f"file:{f}" for f in (files or []))
+    return keys
+
+
+class RoutingFront:
+    """The routing decision as a library: `replicas_for(...)` returns
+    ``[(replica_id, (host, port)), ...]`` in preference order;
+    `addresses_for(...)` is the same minus the ids (feed it straight to
+    `serve.client.stream_scan`, which fails over down the list)."""
+
+    def __init__(self, fleet_dir: str,
+                 router_id: str = "",
+                 slo_aware: bool = True,
+                 federator=None,
+                 scrape_timeout_s: float = 1.0,
+                 failure_cooldown_s: float = DEFAULT_FAILURE_COOLDOWN_S,
+                 heat_min_count: int = 1,
+                 publish_interval_s: float = 1.0):
+        self.fleet_dir = fleet_dir
+        self.registry = ReplicaRegistry(fleet_dir)
+        self.router_id = router_id or f"router-{socket.gethostname()}-{os.getpid()}"
+        self.slo_aware = slo_aware
+        self.failure_cooldown_s = max(0.0, float(failure_cooldown_s))
+        self.heat_min_count = max(1, int(heat_min_count))
+        self.publish_interval_s = max(0.0, float(publish_interval_s))
+        self._federator = federator
+        self._scrape_timeout_s = scrape_timeout_s
+        self._lock = threading.Lock()
+        self._failed_at: Dict[str, float] = {}
+        self._last_publish = 0.0
+        # decision ledger (what publish()/fleetview render)
+        self.decisions = 0
+        self.affinity_hits = 0
+        self.routed: Dict[str, int] = {}
+        self.around: Dict[str, Dict[str, int]] = {}
+        self.failures: Dict[str, int] = {}
+
+    # -- health ----------------------------------------------------------
+
+    def _burning_ids(self) -> set:
+        """Replica ids whose own /debug/slo reports fast-window burn
+        past the limit. Scrapes ride the federator's 1s view cache; an
+        unreachable sidecar yields no exclusion (the heartbeat rules
+        already cover dead replicas)."""
+        if not self.slo_aware:
+            return set()
+        if self._federator is None:
+            from .federate import FleetFederator
+
+            self._federator = FleetFederator(
+                self.registry, timeout_s=self._scrape_timeout_s)
+        try:
+            view = self._federator.view()
+        except Exception:
+            return set()
+        out = set()
+        for scrape in view.replicas:
+            for st in ((scrape.slo or {}).get("slo") or {}).values():
+                burn = (st.get("burn_fast") or {}).get("burn")
+                if burn is not None and burn > SLO_FAST_BURN_LIMIT:
+                    out.add(scrape.status.record.replica_id)
+                    break
+        return out
+
+    def note_failure(self, replica_id: str) -> None:
+        """The router watched a proxied stream die on this replica:
+        route around it for `failure_cooldown_s` — heartbeat staleness
+        takes LIVE_FACTOR x interval to notice, a resumed client
+        retries in milliseconds."""
+        with self._lock:
+            self._failed_at[replica_id] = time.monotonic()
+            self.failures[replica_id] = \
+                self.failures.get(replica_id, 0) + 1
+
+    def _recently_failed(self, replica_id: str) -> bool:
+        with self._lock:
+            t = self._failed_at.get(replica_id)
+        return (t is not None
+                and time.monotonic() - t < self.failure_cooldown_s)
+
+    # -- the decision ----------------------------------------------------
+
+    def replicas_for(self, files, plan_fp: str = ""
+                     ) -> List[Tuple[str, Tuple[str, int]]]:
+        burning = self._burning_ids()
+        healthy: List[ReplicaStatus] = []
+        excluded: List[Tuple[ReplicaStatus, str]] = []
+        for st in self.registry.read():
+            rec = st.record
+            if not rec.scan_address:
+                continue
+            if st.state != "live":
+                reason = "stale_heartbeat"
+            elif rec.draining:
+                reason = "draining"
+            elif rec.pressure == "shed":
+                reason = "memory_shed"
+            elif rec.replica_id in burning:
+                reason = "slo_fast_burn"
+            elif self._recently_failed(rec.replica_id):
+                reason = "recent_failure"
+            else:
+                healthy.append(st)
+                continue
+            excluded.append((st, reason))
+        keys = affinity_keys(files, plan_fp)
+        key0 = keys[0] if keys else "-"
+        ordered = _rendezvous_order(key0, healthy)
+        # affinity override: the healthy replica already hot for this
+        # scan goes first, whatever the hash says
+        hot = None
+        if keys:
+            key_set = set(keys)
+            best = 0
+            for st in ordered:
+                count = sum(int(h.get("count", 0))
+                            for h in st.record.heat
+                            if h.get("key") in key_set)
+                if count >= self.heat_min_count and count > best:
+                    best, hot = count, st
+        if hot is not None:
+            ordered = [hot] + [st for st in ordered if st is not hot]
+        out = [(st.record.replica_id,
+                (str(st.record.scan_address[0]),
+                 int(st.record.scan_address[1])))
+               for st in ordered]
+        # unhealthy tail: last resorts, not dropped — an all-degraded
+        # fleet still routes somewhere and failover walks the tail
+        out.extend((st.record.replica_id,
+                    (str(st.record.scan_address[0]),
+                     int(st.record.scan_address[1])))
+                   for st, _ in _sort_excluded(excluded, key0))
+        self._note_decision(out, excluded, bool(hot))
+        return out
+
+    def addresses_for(self, files,
+                      plan_fp: str = "") -> List[Tuple[str, int]]:
+        return [addr for _, addr in self.replicas_for(files, plan_fp)]
+
+    def _note_decision(self, out, excluded, affinity_hit: bool) -> None:
+        from ..obs.metrics import route_metrics
+
+        m = route_metrics()
+        with self._lock:
+            self.decisions += 1
+            if affinity_hit:
+                self.affinity_hits += 1
+            if out:
+                head = out[0][0]
+                self.routed[head] = self.routed.get(head, 0) + 1
+            for st, reason in excluded:
+                per = self.around.setdefault(st.record.replica_id, {})
+                per[reason] = per.get(reason, 0) + 1
+        try:
+            if out:
+                m["decisions"].labels(replica=out[0][0]).inc()
+            m["affinity"].labels(
+                result="hot" if affinity_hit else "cold").inc()
+            for st, reason in excluded:
+                m["around"].labels(replica=st.record.replica_id,
+                                   reason=reason).inc()
+        except Exception:
+            pass
+        if (self.publish_interval_s and
+                time.monotonic() - self._last_publish
+                >= self.publish_interval_s):
+            self.publish()
+
+    # -- state publication (fleetview reads this) ------------------------
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "router_id": self.router_id,
+                "generated_at": time.time(),
+                "decisions": self.decisions,
+                "affinity_hits": self.affinity_hits,
+                "routed": dict(self.routed),
+                "around": {rid: dict(reasons)
+                           for rid, reasons in self.around.items()},
+                "failures": dict(self.failures),
+            }
+
+    def publish(self) -> None:
+        """CRC-stamped router record under <fleet_dir>/router/ — same
+        write discipline as heartbeats; a torn record reads as absent.
+        Best-effort: a full disk must not fail routing."""
+        from ..io.integrity import stamp_json_payload
+        from ..utils.atomic import write_atomic
+
+        self._last_publish = time.monotonic()
+        doc = stamp_json_payload(self.state())
+        path = os.path.join(self.fleet_dir, "router",
+                            _safe_replica_id(self.router_id) + ".json")
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            write_atomic(path, json.dumps(doc, sort_keys=True))
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        if self.publish_interval_s:
+            self.publish()
+
+
+def _sort_excluded(excluded, key0):
+    """Tail order: still-rejecting-but-alive states first (draining /
+    shed / burn recover fastest), transport-suspect states last."""
+    rank = {"draining": 0, "memory_shed": 0, "slo_fast_burn": 0,
+            "recent_failure": 1, "stale_heartbeat": 2}
+    return sorted(
+        excluded,
+        key=lambda pair: (rank.get(pair[1], 3), hashlib.sha256(
+            f"{key0}|{pair[0].record.replica_id}".encode(
+                "utf-8", "replace")).digest()))
+
+
+def read_router_state(fleet_dir: str,
+                      max_age_s: float = ROUTER_STATE_MAX_AGE_S
+                      ) -> List[dict]:
+    """Every fresh, CRC-valid router record under <fleet_dir>/router/
+    (fleetview's source). Read-only; stale records are skipped, not
+    deleted."""
+    from ..io.integrity import verify_json_payload
+
+    root = os.path.join(fleet_dir, "router")
+    out: List[dict] = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return out
+    now = time.time()
+    for name in names:
+        if not name.endswith(".json") or name.startswith(".tmp-"):
+            continue
+        path = os.path.join(root, name)
+        try:
+            if now - os.stat(path).st_mtime > max_age_s:
+                continue
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict) and verify_json_payload(doc):
+            doc.pop("payload_crc32", None)
+            out.append(doc)
+    return out
+
+
+def route_scan(front, files, **kwargs):
+    """Routed `stream_scan`: resolve the preference order through a
+    `RoutingFront` (or a fleet_dir path) and open the stream against
+    it. The client's failover/resume machinery walks the SAME ordered
+    list, so a replica death mid-stream resumes on the router's
+    next-preferred replica. Returns a ScanStream."""
+    from ..serve.client import stream_scan
+
+    if isinstance(front, str):
+        front = RoutingFront(front, slo_aware=False)
+    file_list = [files] if isinstance(files, (str, bytes)) else list(files)
+    addrs = front.addresses_for(file_list)
+    if not addrs:
+        raise ConnectionError(
+            f"no replicas registered under {front.fleet_dir}")
+    # replica_seed=0 pins the router's preference order — the seeded
+    # rotation is for UNrouted replica lists
+    kwargs.setdefault("replica_seed", 0)
+    return stream_scan(addrs, files, **kwargs)
+
+
+# -- the --route server mode ------------------------------------------------
+
+# a connecting client must produce its request frame promptly (mirrors
+# serve.server.REQUEST_READ_TIMEOUT_S)
+ROUTE_REQUEST_TIMEOUT_S = 30.0
+ROUTE_CONNECT_TIMEOUT_S = 5.0
+
+
+class _RouteHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        from ..serve.protocol import (FRAME_ERROR, FRAME_FINAL,
+                                      FRAME_REQUEST, FrameWriter,
+                                      ProtocolError, error_payload,
+                                      parse_json, read_frame,
+                                      write_frame)
+
+        server: "RouteServer" = self.server  # type: ignore[assignment]
+        front = server.front
+        writer = FrameWriter(self.wfile)
+        try:
+            self.connection.settimeout(ROUTE_REQUEST_TIMEOUT_S)
+            ftype, payload = read_frame(self.rfile)
+            if ftype != FRAME_REQUEST:
+                raise ProtocolError(
+                    f"expected a request frame, got {ftype!r}")
+            doc = parse_json(payload)
+        except Exception as exc:
+            writer.try_json(FRAME_ERROR, error_payload(exc, "protocol"))
+            return
+        if "peer_block" in doc:
+            # peers fetch from each other directly; a peer_block at the
+            # router is answerable but pointless — structured miss
+            writer.try_json(FRAME_FINAL, {"found": False})
+            return
+        plan_fp = str((doc.get("resume") or {}).get("plan") or "")
+        targets = front.replicas_for(doc.get("files") or [],
+                                     plan_fp=plan_fp)
+        upstream = None
+        chosen = None
+        for rid, addr in targets:
+            try:
+                upstream = socket.create_connection(
+                    addr, timeout=ROUTE_CONNECT_TIMEOUT_S)
+                chosen = rid
+                break
+            except OSError:
+                front.note_failure(rid)
+        if upstream is None:
+            writer.try_json(FRAME_ERROR, {
+                "error": "AdmissionRejected: no reachable replica "
+                         "behind the routing front",
+                "code": "rejected", "reason": "no_replicas"})
+            return
+        clean = False
+        try:
+            upstream.settimeout(server.upstream_timeout_s or None)
+            self.connection.settimeout(server.upstream_timeout_s or None)
+            uw = upstream.makefile("wb")
+            write_frame(uw, FRAME_REQUEST, payload)
+            uw.flush()
+            # client->upstream watchdog: the protocol is one request
+            # frame then silence, so any read result here means the
+            # client hung up — tear the upstream down with it
+            threading.Thread(
+                target=_watch_client, name="cobrix-route-watch",
+                args=(self.connection, upstream), daemon=True).start()
+            uf = upstream.makefile("rb")
+            while True:
+                ftype, fpayload = read_frame(uf)
+                if ftype == FRAME_REQUEST:
+                    raise ProtocolError("request frame from upstream")
+                with writer._lock:
+                    write_frame(writer._f, ftype, fpayload)
+                    writer._f.flush()
+                if ftype in (FRAME_FINAL, FRAME_ERROR):
+                    clean = True
+                    break
+        except (OSError, ValueError, ConnectionError, ProtocolError):
+            # upstream died mid-stream (or the client vanished and the
+            # relay write failed). Charge the replica only when IT was
+            # the dead end; the client's resume machinery reconnects to
+            # this router and lands on the next-preferred replica
+            if chosen is not None:
+                front.note_failure(chosen)
+        finally:
+            _shutdown_socket(upstream)
+        # shutdown, not just close: the watcher thread blocked in
+        # recv() holds the open file description alive, so a bare
+        # close() would never deliver FIN to the client — on a cut
+        # stream the client must see a transport error NOW (-> resume),
+        # and on a clean one queued final frames still flush first
+        _shutdown_socket(self.connection)
+
+
+def _shutdown_socket(sock) -> None:
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+def _watch_client(client_sock, upstream_sock) -> None:
+    try:
+        while True:
+            data = client_sock.recv(4096)
+            if not data:
+                break
+    except OSError:
+        pass
+    # same shutdown-not-close reasoning: the handler thread is blocked
+    # reading this socket and must wake to notice the client is gone
+    _shutdown_socket(upstream_sock)
+
+
+class RouteServer(socketserver.ThreadingTCPServer):
+    """The `--route` server mode: a frame-level proxy in front of the
+    fleet. One connection = one routed scan; the decision happens at
+    the request frame, after which bytes relay verbatim (the router
+    never re-frames Arrow data)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 front: Optional[RoutingFront] = None,
+                 fleet_dir: str = "",
+                 upstream_timeout_s: float = 300.0):
+        if front is None:
+            if not fleet_dir:
+                raise ValueError("RouteServer needs a RoutingFront or "
+                                 "a fleet_dir to build one")
+            front = RoutingFront(fleet_dir)
+        self.front = front
+        self.upstream_timeout_s = max(0.0, float(upstream_timeout_s))
+        super().__init__((host, port), _RouteHandler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self.server_address
+
+    def start(self) -> "RouteServer":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="cobrix-route-accept",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.server_close()
+        self.front.close()
+
+
+def run_route_server(host: str, port: int, fleet_dir: str,
+                     heartbeat_interval_s: float = 2.0) -> int:
+    """The `python -m cobrix_tpu.serve --route` entry point: run a
+    RouteServer until SIGTERM/SIGINT."""
+    import signal
+
+    front = RoutingFront(fleet_dir)
+    front.registry.interval_s = max(0.05, float(heartbeat_interval_s))
+    srv = RouteServer(host, port, front=front)
+    print(f"cobrix_tpu routing scans on {srv.address}, "
+          f"fleet root {fleet_dir}", flush=True)
+    stop_signal = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop_signal.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    srv.start()
+    stop_signal.wait()
+    srv.stop()
+    print("cobrix_tpu route: stopped", flush=True)
+    return 0

@@ -14,6 +14,9 @@ production implementations the engine runs against object storage:
 * `blockcache`    — `BlockCache` + `CachingSource`: a persistent
   on-disk LRU block cache keyed by (url, file fingerprint, range);
   repeated scans of hot remote files skip the network entirely.
+* `peercache`     — `PeerCacheTier`: on a local block miss, ask a warm
+  fleet peer's cache over the serve wire protocol before falling back
+  to the backend (strictly bounded, CRC-verified, never an error).
 * `index_store`   — `SparseIndexStore`: the variable-length sparse
   index persisted per file *version*, so the inherently-sequential
   indexing pass runs once and warm re-scans go straight to parallel
@@ -30,10 +33,13 @@ from .integrity import (checksum, corruption_counter, sweep_cache_root,
 from .fsspec_source import (FsspecSource, fsspec_listing, open_fsspec_source,
                             register_fsspec_backend)
 from .index_store import SparseIndexStore, index_config_fingerprint
+from .peercache import PeerCacheTier, registry_peers_fn
 from .prefetch import ReadAheadSource
 from .stats import IoStats
 
 __all__ = [
+    "PeerCacheTier",
+    "registry_peers_fn",
     "IoConfig",
     "wrap_source",
     "BlockCache",

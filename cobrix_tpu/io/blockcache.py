@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Tuple
 from ..reader.stream import ByteRangeSource
 from ..utils.atomic import write_atomic
 from .integrity import (
+    BLOCK_HEADER,
     frame_block,
     note_corruption,
     quarantine,
@@ -62,6 +63,31 @@ _BLOCK_FORMAT = "blkv2"
 
 def _h(text: str) -> str:
     return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()[:20]
+
+
+def raw_block_entry(cache_dir: str, url: str, fingerprint: str,
+                    start: int, end: int) -> Optional[bytes]:
+    """Side-effect-free peek for the peer cache tier (io/peercache.py):
+    the on-disk FRAMED entry (``magic + crc32 + payload``) for aligned
+    block [start, end) of this file version, or None. Computes the
+    generation path directly — no instance, no sweep, no stale-url
+    cleanup, no LRU touch — because the serving replica answers
+    peer_block requests from whatever is on disk *right now*; the CRC
+    travels to the requester, who verifies. Only the length is
+    sanity-checked here so a torn tail is a local miss instead of a
+    peer-side CRC failure."""
+    gen = os.path.join(
+        cache_dir, "blocks",
+        f"{_h(url)}-{_h(f'{fingerprint}|{_BLOCK_FORMAT}')}")
+    path = os.path.join(gen, f"{start}-{end}.blk")
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError:
+        return None
+    if len(data) != BLOCK_HEADER + (end - start):
+        return None
+    return data
 
 
 def read_span(inner: ByteRangeSource, start: int, end: int) -> bytes:
@@ -348,6 +374,26 @@ class CachingSource(ByteRangeSource):
                 parts.append(cached)
                 idx += 1
                 continue
+            # peer tier (io/peercache.py, attached by fleet-mode
+            # servers): a warm peer answers before the backend does.
+            # Strictly optional — a peer miss/timeout/corruption falls
+            # through to the coalesced backend fetch below, and a peer
+            # hit writes through locally so the NEXT scan is a local hit
+            tier = getattr(self._cache, "peer_tier", None)
+            if tier is not None:
+                peer = tier.fetch(self._url, self._fingerprint, bs, be)
+                if peer is not None:
+                    self._cache.put(self._gen_dir, bs, be, peer,
+                                    io_stats=self._io_stats)
+                    if self._io_stats is not None:
+                        self._io_stats.bump("block_misses")
+                        self._io_stats.bump("peer_hits")
+                        self._io_stats.bump("bytes_from_peer", len(peer))
+                    parts.append(peer)
+                    idx += 1
+                    continue
+                if self._io_stats is not None:
+                    self._io_stats.bump("peer_misses")
             # coalesce the run of consecutive missing blocks
             run_end = idx
             while (run_end < last

@@ -1,0 +1,276 @@
+"""Peer block-cache tier: answer cold misses from a warm replica.
+
+A fleet of serving replicas with *separate* ``cache_dir`` roots (one
+per node's local disk) duplicates backend fetches: replica B's first
+scan of a file replica A already cached goes all the way back to
+object storage. This tier rides the existing serve wire protocol to
+close that gap — on a local block miss, `CachingSource` asks ONE warm
+peer for the framed on-disk entry before falling back to the backend:
+
+    client miss -> 'R' frame {"peer_block": {url, fingerprint,
+                                             start, end}}
+    peer hit    -> 'D' frame(s): the raw on-disk entry
+                   (``magic + crc32 + payload``, io/integrity framing —
+                   the CRC travels with the bytes) + 'F' {found: true}
+    peer miss   -> 'F' {found: false}
+
+Strict degradation discipline, in order of importance:
+
+* a peer failure is a MISS, never an error and never short bytes: any
+  timeout, refused connection, protocol violation, or CRC mismatch
+  falls through to the backend fetch the caller was about to do anyway
+* the whole peer attempt is bounded by one wall-clock budget
+  (``timeout_s``) — a slow peer cannot make a cold scan slower than
+  the backend it is supposed to beat
+* single-flight per block: concurrent readers missing the same block
+  coalesce onto one peer round trip (followers wait bounded, then
+  share the leader's result)
+* a peer that just failed is skipped for ``cooldown_s`` — one dead
+  replica must not tax every subsequent miss with a connect timeout
+* frames are CRC-verified via `io.integrity.unframe_block` before a
+  byte reaches the caller; a corrupt frame counts against the peer's
+  cooldown like any failure.
+
+Peer discovery is injectable (``peers_fn``): fleet-mode servers pass a
+registry reader (`registry_peers_fn`) that excludes self, draining,
+shed-pressure, and non-live members; tests pass a static list.
+
+Observability: `cobrix_io_peer_cache_events_total{result=...}` and
+`cobrix_io_peer_bytes_total` (obs/metrics.py) keep peer hits
+distinguishable from local block-cache hits on ``/metrics``; the
+owning read's `IoStats` bag gets ``peer_hits`` / ``peer_misses`` /
+``bytes_from_peer``.
+"""
+from __future__ import annotations
+
+import hashlib
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .integrity import unframe_block
+
+# a peer_block response larger than this is a protocol violation (blocks
+# are io_block_mb-aligned; even generous configs stay far under)
+MAX_PEER_BLOCK_BYTES = 64 * 1024 * 1024
+
+
+def _events():
+    from ..obs.metrics import scan_metrics
+
+    return scan_metrics()
+
+
+def registry_peers_fn(registry, self_id: str,
+                      ttl_s: float = 1.0) -> Callable[[], List[Tuple[str, Tuple[str, int]]]]:
+    """A ``peers_fn`` over the fleet registry: live, non-draining,
+    non-shed members other than ``self_id``, with their scan addresses.
+    Registry reads are cached for ``ttl_s`` — a per-block fetch must
+    not become a per-block directory listing."""
+    lock = threading.Lock()
+    state = {"t": 0.0, "peers": []}
+
+    def peers() -> List[Tuple[str, Tuple[str, int]]]:
+        now = time.monotonic()
+        with lock:
+            if now - state["t"] < ttl_s:
+                return list(state["peers"])
+        out: List[Tuple[str, Tuple[str, int]]] = []
+        for st in registry.read():
+            rec = st.record
+            if (rec.replica_id == self_id or st.state != "live"
+                    or rec.draining or rec.pressure == "shed"
+                    or not rec.scan_address):
+                continue
+            out.append((rec.replica_id,
+                        (str(rec.scan_address[0]),
+                         int(rec.scan_address[1]))))
+        with lock:
+            state["t"] = now
+            state["peers"] = out
+        return list(out)
+
+    return peers
+
+
+class PeerCacheTier:
+    """The client half: `fetch(url, fingerprint, start, end)` returns
+    the verified block payload from a warm peer, or None (a miss —
+    the caller proceeds to the backend). Attached to the process's
+    shared `BlockCache` instance as ``cache.peer_tier`` so
+    `CachingSource` finds it without any config plumbing through the
+    read-option surface."""
+
+    def __init__(self, peers_fn: Callable[[], List[Tuple[str, Tuple[str, int]]]],
+                 replica_id: str = "",
+                 timeout_s: float = 2.0,
+                 cooldown_s: float = 5.0,
+                 max_peers_per_block: int = 2):
+        self.peers_fn = peers_fn
+        self.replica_id = replica_id
+        self.timeout_s = max(0.05, float(timeout_s))
+        self.cooldown_s = max(0.0, float(cooldown_s))
+        self.max_peers_per_block = max(1, int(max_peers_per_block))
+        self._lock = threading.Lock()
+        self._inflight: Dict[tuple, threading.Event] = {}
+        self._cooldown: Dict[str, float] = {}  # replica_id -> until
+        # running totals for harnesses/tests (Prometheus counters are
+        # process-global; these are THIS tier's)
+        self.stats: Dict[str, int] = {}
+
+    # -- accounting ------------------------------------------------------
+
+    def _count(self, result: str, nbytes: int = 0) -> None:
+        with self._lock:
+            self.stats[result] = self.stats.get(result, 0) + 1
+        try:
+            m = _events()
+            m["peer_cache"].labels(result=result).inc()
+            if nbytes:
+                m["peer_bytes"].inc(nbytes)
+        except Exception:
+            pass
+
+    def _note_failure(self, peer_id: str) -> None:
+        if self.cooldown_s:
+            with self._lock:
+                self._cooldown[peer_id] = (time.monotonic()
+                                           + self.cooldown_s)
+
+    def _usable(self, peer_id: str) -> bool:
+        with self._lock:
+            until = self._cooldown.get(peer_id, 0.0)
+        return time.monotonic() >= until
+
+    # -- peer ordering ---------------------------------------------------
+
+    def _candidates(self, key: str) -> List[Tuple[str, Tuple[str, int]]]:
+        """Peers ordered by rendezvous hash of the block key, so the
+        SAME peer is asked for the same block fleet-wide — the block
+        converges onto few copies instead of smearing across every
+        cache."""
+        try:
+            peers = [p for p in self.peers_fn() if self._usable(p[0])]
+        except Exception:
+            return []
+
+        def score(peer):
+            return hashlib.sha256(
+                f"{key}|{peer[0]}".encode("utf-8", "replace")).digest()
+
+        return sorted(peers, key=score, reverse=True)
+
+    # -- the wire round trip ---------------------------------------------
+
+    def _ask_peer(self, address: Tuple[str, int], spec: dict,
+                  expect_len: int, deadline: float) -> Optional[bytes]:
+        budget = deadline - time.monotonic()
+        if budget <= 0:
+            return None
+        from ..serve.protocol import (FRAME_DATA, FRAME_ERROR,
+                                      FRAME_FINAL, FRAME_REQUEST,
+                                      parse_json, read_frame,
+                                      write_json_frame)
+
+        sock = socket.create_connection(address, timeout=budget)
+        try:
+            sock.settimeout(max(0.05, deadline - time.monotonic()))
+            wf = sock.makefile("wb")
+            write_json_frame(wf, FRAME_REQUEST, {"peer_block": spec})
+            wf.flush()
+            wf.close()
+            rf = sock.makefile("rb")
+            chunks: List[bytes] = []
+            total = 0
+            while True:
+                ftype, payload = read_frame(rf)
+                if ftype == FRAME_DATA:
+                    total += len(payload)
+                    if total > MAX_PEER_BLOCK_BYTES:
+                        raise ConnectionError("peer_block oversized")
+                    chunks.append(payload)
+                    continue
+                if ftype == FRAME_FINAL:
+                    doc = parse_json(payload)
+                    if not doc.get("found"):
+                        return None
+                    break
+                if ftype == FRAME_ERROR:
+                    raise ConnectionError(
+                        f"peer refused: {parse_json(payload).get('error')}")
+                raise ConnectionError(
+                    f"unexpected frame {ftype!r} in peer_block reply")
+            framed = b"".join(chunks)
+            payload = unframe_block(framed, expect_len)
+            if payload is None:
+                # the CRC traveled with the bytes and failed HERE: the
+                # peer's disk (or the wire) lied — treat like any peer
+                # failure, nothing corrupt ever reaches the caller
+                self._count("corrupt")
+                raise ConnectionError("peer_block failed crc verify")
+            return payload
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def fetch(self, url: str, fingerprint: str, start: int,
+              end: int) -> Optional[bytes]:
+        """The verified payload for aligned block [start, end) of
+        (url, fingerprint), or None. Never raises."""
+        key = (url, fingerprint, int(start), int(end))
+        with self._lock:
+            ev = self._inflight.get(key)
+            leader = ev is None
+            if leader:
+                ev = threading.Event()
+                self._inflight[key] = ev
+        if not leader:
+            # single-flight follower: share the leader's round trip
+            if not ev.wait(self.timeout_s):
+                self._count("coalesced")
+                return None
+            result = getattr(ev, "result", None)
+            self._count("coalesced" if result is None else "hit",
+                        len(result) if result else 0)
+            return result
+        result: Optional[bytes] = None
+        try:
+            spec = {"url": url, "fingerprint": fingerprint,
+                    "start": int(start), "end": int(end)}
+            keystr = f"{url}|{fingerprint}|{start}-{end}"
+            deadline = time.monotonic() + self.timeout_s
+            timed_out = False
+            for peer_id, address in \
+                    self._candidates(keystr)[:self.max_peers_per_block]:
+                if time.monotonic() >= deadline:
+                    timed_out = True
+                    break
+                try:
+                    result = self._ask_peer(address, spec,
+                                            end - start, deadline)
+                except (OSError, ValueError, ConnectionError):
+                    self._note_failure(peer_id)
+                    continue
+                if result is not None:
+                    break
+            if result is not None:
+                self._count("hit", len(result))
+            elif timed_out:
+                self._count("timeout")
+            else:
+                self._count("miss")
+            return result
+        except Exception:
+            # the never-an-error contract: an unforeseen failure in the
+            # tier itself is still just a miss
+            self._count("error")
+            result = None
+            return None
+        finally:
+            ev.result = result  # type: ignore[attr-defined]
+            with self._lock:
+                self._inflight.pop(key, None)
+            ev.set()

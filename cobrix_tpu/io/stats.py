@@ -30,6 +30,9 @@ KEYS = (
     "prefetch_unused",    # prefetched blocks never consumed
     "bytes_fetched",      # bytes actually pulled from the storage backend
     "bytes_from_cache",   # bytes served from the persistent block cache
+    "peer_hits",          # local misses answered by a warm fleet peer
+    "peer_misses",        # peer-tier attempts that fell through to backend
+    "bytes_from_peer",    # bytes served out of a peer's block cache
 )
 
 

@@ -385,6 +385,20 @@ def scan_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "cobrix_io_remote_bytes_total",
             "Bytes fetched from remote storage backends",
             label_names=("source",)),
+        # -- peer block-cache tier (cobrix_tpu.io.peercache) -------------
+        # distinct from cobrix_io_cache_events_total on purpose: a peer
+        # hit is still a LOCAL miss, and capacity planning needs the two
+        # planes separable on /metrics
+        "peer_cache": r.counter(
+            "cobrix_io_peer_cache_events_total",
+            "Peer block-cache fetch attempts by outcome (hit/miss/"
+            "timeout/corrupt/error/coalesced); every non-hit degrades "
+            "to a backend fetch, never an error",
+            label_names=("result",)),
+        "peer_bytes": r.counter(
+            "cobrix_io_peer_bytes_total",
+            "Block bytes served out of warm peer caches instead of the "
+            "storage backend"),
         # -- query pushdown (cobrix_tpu.query) --------------------------
         "records_pruned": r.counter(
             "cobrix_records_pruned_total",
@@ -639,4 +653,35 @@ def serve_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "cobrix_serve_first_batch_seconds",
             "Time from admission to the first streamed batch",
             buckets=SERVE_WAIT_BUCKETS),
+        "peer_served": r.counter(
+            "cobrix_serve_peer_blocks_total",
+            "peer_block requests answered by this replica, by outcome "
+            "(hit = framed block shipped, miss = not in local cache)",
+            label_names=("result",)),
+    }
+
+
+def route_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
+    """The routing front's metric set (cobrix_tpu.fleet.router): where
+    scans were sent, why replicas were routed around, and whether the
+    cache-affinity hint decided the pick. Counters only — a router
+    process federates cleanly with replica expositions."""
+    r = registry or _default
+    return {
+        "decisions": r.counter(
+            "cobrix_route_decisions_total",
+            "Routing decisions by the replica chosen first",
+            label_names=("replica",)),
+        "around": r.counter(
+            "cobrix_route_around_total",
+            "Replicas excluded from routing, by replica and reason "
+            "(stale_heartbeat/draining/memory_shed/slo_fast_burn/"
+            "recent_failure)",
+            label_names=("replica", "reason")),
+        "affinity": r.counter(
+            "cobrix_route_affinity_total",
+            "Routing decisions by affinity outcome (hot = a heartbeat "
+            "heat hint chose the head replica, cold = rendezvous hash "
+            "only)",
+            label_names=("result",)),
     }

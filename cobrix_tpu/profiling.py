@@ -298,6 +298,11 @@ class ReadMetrics:
         if io.get("bytes_from_cache"):
             m["remote_bytes"].labels(source="cache").inc(
                 io["bytes_from_cache"])
+        if io.get("bytes_from_peer"):
+            # peer-tier EVENTS are counted live by PeerCacheTier; here
+            # only the byte volume joins the backend/cache split
+            m["remote_bytes"].labels(source="peer").inc(
+                io["bytes_from_peer"])
         pd = self.pushdown or {}
         for depth in ("segment", "filter", "residual"):
             count = pd.get(f"records_pruned_{depth}", 0)

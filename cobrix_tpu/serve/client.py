@@ -44,6 +44,7 @@ from __future__ import annotations
 import io
 import socket
 import time
+import zlib
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..reader.stream import RetryPolicy
@@ -352,7 +353,16 @@ class ScanStream:
             return False
         self.failovers += 1
         self._close_attempt()
-        self._replica_idx = (self._replica_idx + 1) % len(self._replicas)
+        if len(self._replicas) > 1:
+            # demote the replica that just failed to the END of the
+            # rotation: later failovers on THIS stream try every other
+            # replica before coming back to a known-bad one
+            failed = self._replicas.pop(self._replica_idx)
+            self._replicas.append(failed)
+            # the replica that shifted into this slot is next; when the
+            # failed one was last, wrap to the head (it is at the tail
+            # again, so plain modulo would retry it immediately)
+            self._replica_idx %= (len(self._replicas) - 1)
         return True
 
     # -- iteration -------------------------------------------------------
@@ -529,6 +539,7 @@ def stream_scan(address, files,
                 trace: bool = False,
                 max_failovers: int = DEFAULT_MAX_FAILOVERS,
                 follow=False,
+                replica_seed: Optional[int] = None,
                 **options) -> ScanStream:
     """Open one streamed scan against a ScanServer (or replica set).
 
@@ -549,7 +560,12 @@ def stream_scan(address, files,
     `stream.write_chrome_trace(path)` then emits ONE merged Chrome
     trace for the request. `max_failovers` bounds mid-stream recovery
     attempts per logical request (0 = fail on the first interruption,
-    the pre-resume behavior).
+    the pre-resume behavior). With several replicas the initial pick
+    rotates deterministically by `request_id` (independent requests
+    spread across the set; a retried request lands where it did
+    before); `replica_seed` overrides the rotation — 0 pins the
+    caller's order. A replica that fails mid-stream is demoted to the
+    end of the rotation for the remainder of the stream.
 
     `follow`: True (or an options dict — poll_interval_s,
     idle_timeout_s, max_batches, batch_max_mb, tail_grace_s,
@@ -572,6 +588,16 @@ def stream_scan(address, files,
                                         else str(flt)))
     request_id = request_id or new_trace_id()[:16]
     trace_id = trace_id or new_trace_id()
+    if len(replicas) > 1:
+        # spread initial load across the replica set instead of
+        # hammering whichever happens to be listed first; the rotation
+        # is a deterministic function of the request id (or an explicit
+        # replica_seed — 0 pins the caller's order, which routed scans
+        # and order-sensitive tests rely on)
+        seed = (replica_seed if replica_seed is not None
+                else zlib.crc32(request_id.encode("utf-8", "replace")))
+        off = seed % len(replicas)
+        replicas = replicas[off:] + replicas[:off]
     tracer = None
     if trace:
         tracer = Tracer(process_name="client-request",

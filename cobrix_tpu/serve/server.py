@@ -143,7 +143,14 @@ class _Handler(socketserver.StreamRequestHandler):
             if ftype != FRAME_REQUEST:
                 raise ProtocolError(
                     f"expected a request frame, got {ftype!r}")
-            request = ScanRequest(parse_json(payload))
+            doc = parse_json(payload)
+            if "peer_block" in doc:
+                # the peer block-cache tier (io/peercache.py): another
+                # replica asking for one framed cache entry. Served
+                # outside admission — a bounded disk read, no scan
+                server._serve_peer_block(writer, doc["peer_block"])
+                return
+            request = ScanRequest(doc)
             tenant = request.tenant
             # the scan may legitimately run long between frames, but no
             # single SEND may block unboundedly: a connected peer that
@@ -390,7 +397,10 @@ class ScanServer(socketserver.ThreadingTCPServer):
                  replica_id: str = "",
                  heartbeat_interval_s: float = 2.0,
                  fleet_scrape_timeout_s: float = 2.0,
-                 queue_wait_target_s: float = 0.5):
+                 queue_wait_target_s: float = 0.5,
+                 fleet_dir: str = "",
+                 peer_cache: bool = True,
+                 peer_timeout_s: float = 2.0):
         if fleet and not (server_options or {}).get("cache_dir"):
             # checked before the listener binds: a config error must
             # not leak a bound socket
@@ -453,6 +463,7 @@ class ScanServer(socketserver.ThreadingTCPServer):
         # tools/fleetcheck.py counter-asserts
         self._fleet = None
         self._heartbeater = None
+        self._peer_cache_host = None  # the BlockCache holding our tier
         self.queue_wait_target_s = max(0.0, float(queue_wait_target_s))
         if fleet:
             cache_dir = str(self.server_options.get("cache_dir"))
@@ -465,8 +476,13 @@ class ScanServer(socketserver.ThreadingTCPServer):
             self.replica_id = (str(replica_id) if replica_id
                                else default_replica_id())
             self._fleet = {
+                # fleet_dir decouples the membership root from the
+                # block-cache root: replicas on per-node disks keep
+                # private cache_dirs but still share one registry (the
+                # split the peer cache tier exists for). Default: the
+                # shared-cache_dir layout PR 12 shipped.
                 "registry": ReplicaRegistry(
-                    os.path.join(cache_dir, "fleet"),
+                    fleet_dir or os.path.join(cache_dir, "fleet"),
                     interval_s=heartbeat_interval_s),
                 "heat": FingerprintHeat(),
                 "interval_s": max(0.05, float(heartbeat_interval_s)),
@@ -478,6 +494,23 @@ class ScanServer(socketserver.ThreadingTCPServer):
             self._heartbeater = Heartbeater(
                 self._fleet["registry"], self._fleet_record,
                 interval_s=self._fleet["interval_s"])
+            if peer_cache:
+                # attach the peer tier to the process's shared block
+                # cache: every CachingSource under this cache_dir now
+                # asks a warm peer before the storage backend
+                from ..io.blockcache import shared_block_cache
+                from ..io.peercache import (PeerCacheTier,
+                                            registry_peers_fn)
+
+                max_bytes = int(float(self.server_options.get(
+                    "cache_max_mb", 1024.0)) * 1024 * 1024)
+                host_cache = shared_block_cache(cache_dir, max_bytes)
+                host_cache.peer_tier = PeerCacheTier(
+                    registry_peers_fn(self._fleet["registry"],
+                                      self.replica_id),
+                    replica_id=self.replica_id,
+                    timeout_s=peer_timeout_s)
+                self._peer_cache_host = host_cache
         else:
             self.replica_id = str(replica_id) or ""
         self._http: Optional[ObsHttpServer] = None
@@ -609,6 +642,47 @@ class ScanServer(socketserver.ThreadingTCPServer):
                             field_costs=field_costs)
         if self.audit is not None:
             self.audit.append(record)
+
+    # -- peer block serving ----------------------------------------------
+
+    def _serve_peer_block(self, writer: FrameWriter, spec) -> None:
+        """Answer one peer_block request: the raw framed cache entry as
+        'D' frame(s) + 'F' {found}. Read-only and unauthenticated like
+        the rest of the scan plane; a miss (absent entry, no cache_dir,
+        torn tail) is a structured {found: false}, never an error. Any
+        server with a cache_dir answers — the REQUESTING side is what
+        fleet mode gates."""
+        try:
+            url = str(spec["url"])
+            fingerprint = str(spec["fingerprint"])
+            start, end = int(spec["start"]), int(spec["end"])
+            if not (0 <= start < end):
+                raise ValueError(f"bad range [{start}, {end})")
+            from ..io.peercache import MAX_PEER_BLOCK_BYTES
+
+            if end - start > MAX_PEER_BLOCK_BYTES:
+                raise ValueError("peer_block range too large")
+        except (KeyError, TypeError, ValueError) as exc:
+            writer.try_json(FRAME_ERROR, error_payload(exc, "protocol"))
+            return
+        entry = None
+        cache_dir = self.server_options.get("cache_dir")
+        if cache_dir:
+            from ..io.blockcache import raw_block_entry
+
+            entry = raw_block_entry(str(cache_dir), url, fingerprint,
+                                    start, end)
+        try:
+            if entry is None:
+                self.metrics["peer_served"].labels(result="miss").inc()
+                writer.json(FRAME_FINAL, {"found": False})
+            else:
+                self.metrics["peer_served"].labels(result="hit").inc()
+                writer.data(entry)
+                writer.json(FRAME_FINAL,
+                            {"found": True, "bytes": len(entry)})
+        except ClientGone:
+            pass  # the asking peer gave up (its timeout): nothing owed
 
     # -- fleet plane -----------------------------------------------------
 
@@ -811,6 +885,11 @@ class ScanServer(socketserver.ThreadingTCPServer):
             # immediately instead of after heartbeat expiry
             self._heartbeater.stop(unregister=True)
             self._heartbeater = None
+        if self._peer_cache_host is not None:
+            # the server owns the tier it attached: a stopped server's
+            # peers must not be consulted by unrelated in-process reads
+            self._peer_cache_host.peer_tier = None
+            self._peer_cache_host = None
         if self._http is not None:
             self._http.stop()
         if getattr(self, "_installed_budget", False):
@@ -874,6 +953,22 @@ def main(argv=None) -> int:
                          "into <cache-dir>/fleet and serve "
                          "/fleet/{replicas,metrics,slo,signals} "
                          "(requires --cache-dir)")
+    ap.add_argument("--fleet-dir", default="",
+                    help="membership root override (default "
+                         "<cache-dir>/fleet): replicas with PRIVATE "
+                         "per-node cache dirs share one registry here, "
+                         "and the peer block-cache tier fills the gap")
+    ap.add_argument("--no-peer-cache", action="store_true",
+                    help="fleet mode: do not consult warm peers on "
+                         "local block-cache misses")
+    ap.add_argument("--peer-timeout", type=float, default=2.0,
+                    help="wall-clock budget for one peer block fetch "
+                         "before degrading to the storage backend")
+    ap.add_argument("--route", action="store_true",
+                    help="run the fleet ROUTING FRONT instead of a scan "
+                         "server: consistent-hash + health-aware proxy "
+                         "over the registry's live replicas "
+                         "(requires --cache-dir or --fleet-dir)")
     ap.add_argument("--replica-id", default="",
                     help="fleet replica identity (default: "
                          "hostname-pid)")
@@ -885,6 +980,17 @@ def main(argv=None) -> int:
                     help="fleet autoscaling signal: queue-wait p90 over "
                          "this many seconds recommends scale-up")
     args = ap.parse_args(argv)
+    if args.route:
+        if not (args.cache_dir or args.fleet_dir):
+            ap.error("--route requires --cache-dir or --fleet-dir "
+                     "(the routing front reads the replica registry)")
+        from ..fleet.router import run_route_server
+
+        return run_route_server(
+            host=args.host, port=args.port,
+            fleet_dir=(args.fleet_dir
+                       or os.path.join(args.cache_dir, "fleet")),
+            heartbeat_interval_s=args.heartbeat_interval)
     if args.fleet and not args.cache_dir:
         ap.error("--fleet requires --cache-dir (the replica registry "
                  "lives in the shared cache root)")
@@ -903,7 +1009,10 @@ def main(argv=None) -> int:
         memory_budget_mb=args.memory_budget_mb,
         fleet=args.fleet, replica_id=args.replica_id,
         heartbeat_interval_s=args.heartbeat_interval,
-        queue_wait_target_s=args.queue_wait_target)
+        queue_wait_target_s=args.queue_wait_target,
+        fleet_dir=args.fleet_dir,
+        peer_cache=not args.no_peer_cache,
+        peer_timeout_s=args.peer_timeout)
     print(f"cobrix_tpu serving scans on {srv.address}, "
           f"obs on {srv.http_address}", flush=True)
     stop_signal = threading.Event()

@@ -1,0 +1,518 @@
+"""PR 16's self-healing data plane: routing front + peer cache + chaos.
+
+The fleet pieces individually (registry identity reclaim, router
+health rules, peer-tier degradation discipline) and then composed the
+way production composes them: a client streaming THROUGH the
+`RouteServer` proxy while the preferred replica dies mid-stream. The
+matrix crosses that death with fixed-width, variable-length (RDW),
+follow-mode, and pushdown scans, and each cell must deliver a table
+BYTE-IDENTICAL to an uninterrupted local read — the router's
+note_failure + the PR 9 resume token composing exactly-once, with no
+SLO double-burn on the resumed attempt. The subprocess chaos harness
+(tools/routecheck.py: actuator-owned fleet, SIGKILL under load,
+respawn budget) runs here too so tier-1 exercises real process death.
+"""
+import importlib.util
+import json
+import os
+import shutil
+import socket
+import threading
+import time
+
+import pyarrow as pa
+import pytest
+
+from cobrix_tpu import read_cobol
+from cobrix_tpu.fleet.registry import (
+    LIVE_FACTOR,
+    ReplicaRecord,
+    ReplicaRegistry,
+)
+from cobrix_tpu.fleet.router import (
+    RouteServer,
+    RoutingFront,
+    read_router_state,
+    route_scan,
+)
+from cobrix_tpu.io.peercache import PeerCacheTier
+from cobrix_tpu.obs.audit import read_audit_log
+from cobrix_tpu.serve import ScanServer, fetch_table, stream_scan
+from cobrix_tpu.testing.generators import EXP2_COPYBOOK, generate_exp2
+
+from test_resume import _CuttingProxy
+from util import hard_timeout
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+COPYBOOK = """
+        01  R.
+            05  KEY    PIC 9(7) COMP.
+            05  NAME   PIC X(9).
+"""
+OPTS = dict(copybook_contents=COPYBOOK, chunk_size_mb="0.05",
+            pipeline_workers="2")
+EXP2_OPTS = dict(copybook_contents=EXP2_COPYBOOK,
+                 is_record_sequence="true",
+                 segment_field="SEGMENT-ID",
+                 redefine_segment_id_map="STATIC-DETAILS => C",
+                 **{"redefine_segment_id_map:1": "CONTACTS => P"})
+
+
+def make_records(n: int) -> bytes:
+    return b"".join(
+        i.to_bytes(4, "big") + f"ROW{i % 1000000:06d}".encode("ascii")
+        for i in range(n))
+
+
+def _load_tool(name: str):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _rec(rid: str, port: int = 0, **kw) -> ReplicaRecord:
+    now = time.time()
+    defaults = dict(replica_id=rid, pid=os.getpid(), host="t",
+                    scan_address=["127.0.0.1", port],
+                    started_at=now - 10, heartbeat_at=now,
+                    interval_s=60.0)
+    defaults.update(kw)
+    return ReplicaRecord(**defaults)
+
+
+# ---------------------------------------------------------------------------
+# registry: same-id restart reclaims the heartbeat as ONE member
+# ---------------------------------------------------------------------------
+
+def test_same_id_restart_reclaims_one_member(tmp_path):
+    """A replica that restarts under its old identity before the old
+    heartbeat expires must read as ONE live member carrying the NEW
+    endpoints — a live+stale pair double-counts capacity and routes
+    traffic onto a dead port."""
+    reg = ReplicaRegistry(str(tmp_path / "fleet"), interval_s=60)
+    reg.write(_rec("alpha", 1001, pid=111))
+    # a second FILE claiming the same replica_id (a stranded record
+    # from before a node rename; sorts BEFORE the canonical file so
+    # listing order cannot be what saves us)
+    stray = os.path.join(reg.replica_dir, "0-alpha-stray.json")
+    shutil.copy(reg.path_for("alpha"), stray)
+    past = time.time() - 20
+    os.utime(stray, (past, past))
+    # the restart: same id, new pid + port, fresher mtime
+    reg.write(_rec("alpha", 2002, pid=222))
+    statuses = reg.read()
+    assert len(statuses) == 1, [s.record.replica_id for s in statuses]
+    assert statuses[0].record.pid == 222
+    assert statuses[0].record.scan_address == ["127.0.0.1", 2002]
+    assert statuses[0].state == "live"
+    # flipped freshness: when the stray is the NEWER record it wins —
+    # mtime decides, not file name
+    future = time.time() + 5
+    os.utime(stray, (future, future))
+    statuses = reg.read()
+    assert len(statuses) == 1
+    assert statuses[0].record.pid == 111
+
+
+# ---------------------------------------------------------------------------
+# routing front: health rules, affinity, failure cooldown, publication
+# ---------------------------------------------------------------------------
+
+def _front(fleet: str, **kw) -> RoutingFront:
+    kw.setdefault("slo_aware", False)
+    kw.setdefault("publish_interval_s", 0.0)
+    return RoutingFront(fleet, **kw)
+
+
+def test_health_rules_order_healthy_first_unhealthy_tail(tmp_path):
+    fleet = str(tmp_path / "fleet")
+    reg = ReplicaRegistry(fleet)
+    reg.write(_rec("a", 1001))
+    reg.write(_rec("b", 1002))
+    reg.write(_rec("drainer", 1003, draining=True))
+    reg.write(_rec("shedder", 1004, pressure="shed"))
+    reg.write(_rec("ghost", 1005))
+    p = reg.path_for("ghost")
+    old = time.time() - 60.0 * (LIVE_FACTOR + 1)
+    os.utime(p, (old, old))
+    front = _front(fleet)
+    out = front.replicas_for(["f.dat"])
+    ids = [rid for rid, _ in out]
+    # healthy lead; degraded-but-alive next; transport-suspect LAST;
+    # nothing is ever dropped (an all-degraded fleet still routes)
+    assert set(ids[:2]) == {"a", "b"}
+    assert set(ids[2:4]) == {"drainer", "shedder"}
+    assert ids[4] == "ghost"
+    assert out == front.replicas_for(["f.dat"])  # deterministic
+    st = front.state()
+    assert st["decisions"] == 2
+    assert st["around"]["drainer"] == {"draining": 2}
+    assert st["around"]["shedder"] == {"memory_shed": 2}
+    assert st["around"]["ghost"] == {"stale_heartbeat": 2}
+    assert st["routed"][ids[0]] == 2
+
+
+def test_affinity_overrides_hash_and_counts_hits(tmp_path):
+    fleet = str(tmp_path / "fleet")
+    reg = ReplicaRegistry(fleet)
+    for rid, port in (("a", 1001), ("b", 1002), ("c", 1003)):
+        heat = ([{"key": "file:/data/f.dat", "count": 7}]
+                if rid == "c" else [])
+        reg.write(_rec(rid, port, heat=heat))
+    front = _front(fleet)
+    out = front.replicas_for(["/data/f.dat"])
+    assert out[0][0] == "c"  # the warm replica leads, hash or not
+    assert front.state()["affinity_hits"] == 1
+    # a DIFFERENT file has no heat anywhere: pure rendezvous, no hit
+    front.replicas_for(["/data/other.dat"])
+    assert front.state()["affinity_hits"] == 1
+
+
+def test_failure_cooldown_beats_heartbeat_then_recovers(tmp_path):
+    fleet = str(tmp_path / "fleet")
+    reg = ReplicaRegistry(fleet)
+    reg.write(_rec("a", 1001))
+    reg.write(_rec("b", 1002))
+    front = _front(fleet, failure_cooldown_s=0.3)
+    first = front.replicas_for(["f.dat"])[0][0]
+    # the router watched first's stream die: instantly tail-ranked,
+    # long before its (still fresh) heartbeat could say anything
+    front.note_failure(first)
+    out = front.replicas_for(["f.dat"])
+    assert out[0][0] != first and out[-1][0] == first
+    assert front.state()["around"][first] == {"recent_failure": 1}
+    assert front.state()["failures"][first] == 1
+    time.sleep(0.35)  # cooldown expires -> re-earns its slot
+    assert front.replicas_for(["f.dat"])[0][0] == first
+
+
+def test_router_state_publishes_crc_stamped_and_survives_garbage(
+        tmp_path):
+    fleet = str(tmp_path / "fleet")
+    reg = ReplicaRegistry(fleet)
+    reg.write(_rec("a", 1001))
+    front = _front(fleet, router_id="r-test")
+    front.replicas_for(["f.dat"])
+    front.publish()
+    docs = read_router_state(fleet)
+    assert [d["router_id"] for d in docs] == ["r-test"]
+    assert docs[0]["decisions"] == 1
+    # a torn/corrupt record reads as ABSENT, never as a phantom router
+    rdir = os.path.join(fleet, "router")
+    torn = os.path.join(rdir, "torn.json")
+    with open(torn, "w") as f:
+        f.write('{"router_id": "evil", "decisions": 9')
+    doc = json.load(open(os.path.join(rdir, "r-test.json")))
+    doc["decisions"] = 999  # valid JSON, stale CRC
+    with open(os.path.join(rdir, "forged.json"), "w") as f:
+        json.dump(doc, f)
+    assert [d["router_id"] for d in read_router_state(fleet)] \
+        == ["r-test"]
+
+
+def test_route_scan_refuses_an_empty_fleet(tmp_path):
+    with pytest.raises(ConnectionError):
+        route_scan(str(tmp_path / "nofleet"), "f.dat",
+                   copybook_contents=COPYBOOK)
+
+
+# ---------------------------------------------------------------------------
+# peer cache tier: degradation discipline
+# ---------------------------------------------------------------------------
+
+def _dead_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_peer_failure_is_a_miss_never_an_error():
+    """A refused peer must read as a cache MISS (the caller proceeds
+    to the backend) and enter cooldown so the NEXT miss skips it."""
+    port = _dead_port()
+    tier = PeerCacheTier(lambda: [("dead", ("127.0.0.1", port))],
+                         timeout_s=0.5, cooldown_s=30.0)
+    assert tier.fetch("memory://x", "fp", 0, 128) is None
+    assert tier.stats.get("miss") == 1
+    t0 = time.monotonic()
+    assert tier.fetch("memory://x", "fp", 0, 128) is None
+    # the cooled-down peer was never dialed: instant miss
+    assert time.monotonic() - t0 < 0.2
+    assert tier.stats.get("miss") == 2
+
+
+def test_peer_corrupt_frame_is_quarantined_to_a_miss():
+    """A peer whose reply fails the traveling CRC delivers NOTHING to
+    the caller — the corrupt bytes become a miss + cooldown, and the
+    tier's ledger says 'corrupt', not 'hit'."""
+    from cobrix_tpu.serve.protocol import (FRAME_DATA, FRAME_FINAL,
+                                           read_frame, write_frame,
+                                           write_json_frame)
+
+    def liar(conn):
+        rf = conn.makefile("rb")
+        wf = conn.makefile("wb")
+        read_frame(rf)  # the peer_block request
+        write_frame(wf, FRAME_DATA, b"\x00" * 64)  # not framed bytes
+        write_json_frame(wf, FRAME_FINAL, {"found": True})
+        wf.flush()
+        conn.close()
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    addr = srv.getsockname()
+
+    def accept():
+        conn, _ = srv.accept()
+        liar(conn)
+
+    t = threading.Thread(target=accept, daemon=True)
+    t.start()
+    try:
+        tier = PeerCacheTier(lambda: [("liar", tuple(addr))],
+                             timeout_s=2.0, cooldown_s=30.0)
+        assert tier.fetch("memory://x", "fp", 0, 64) is None
+        assert tier.stats.get("corrupt") == 1
+    finally:
+        srv.close()
+
+
+def test_cold_miss_answered_from_warm_peer(tmp_path):
+    """Two fleet replicas with SEPARATE cache roots: replica B's first
+    scan of a file replica A already cached is answered from A's disk
+    over the serve protocol — visible as a peer HIT on B's tier and a
+    peer-served HIT on A, distinguishable from local block-cache hits."""
+    import fsspec
+
+    from cobrix_tpu.obs.metrics import scan_metrics, serve_metrics
+
+    with hard_timeout(180, "peer cache tier"):
+        fleet = str(tmp_path / "fleet")
+        raw = make_records(5000)
+        fs = fsspec.filesystem("memory")
+        with fs.open("/peer-tier/f.dat", "wb") as f:
+            f.write(raw)
+        url = "memory://peer-tier/f.dat"
+        servers = [
+            ScanServer(enable_http=False, fleet=True,
+                       replica_id=f"pc-{i}", fleet_dir=fleet,
+                       heartbeat_interval_s=0.2,
+                       server_options={"cache_dir": str(
+                           tmp_path / f"cache{i}")}).start()
+            for i in range(2)]
+        try:
+            reg = ReplicaRegistry(fleet)
+            deadline = time.monotonic() + 15
+            while (len(reg.read()) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            local = read_cobol(url, **OPTS).to_arrow()
+            served_before = serve_metrics()["peer_served"] \
+                .value(result="hit")
+            hits_before = scan_metrics()["peer_cache"] \
+                .value(result="hit")
+            # A scans cold (backend miss -> A's cache warms) ...
+            assert fetch_table(servers[0].address, url,
+                               **OPTS).equals(local)
+            # ... B's cold scan hits A's cache instead of the backend
+            assert fetch_table(servers[1].address, url,
+                               **OPTS).equals(local)
+            tier_b = servers[1]._peer_cache_host.peer_tier
+            assert tier_b.stats.get("hit", 0) >= 1, tier_b.stats
+            # /metrics keeps peer hits distinguishable from local hits
+            assert scan_metrics()["peer_cache"].value(
+                result="hit") > hits_before
+            assert serve_metrics()["peer_served"].value(
+                result="hit") > served_before
+        finally:
+            for srv in servers:
+                srv.stop()
+            try:
+                fs.rm("/peer-tier", recursive=True)
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# the composition: routed failover x {fixed, VRL, follow, pushdown}
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def routed_fleet(tmp_path):
+    """Two real fleet replicas + a 'lure' pseudo-replica whose scan
+    address is a cutting proxy in front of replica 1. Heat pins the
+    routed scan onto the lure, the proxy kills it mid-stream, and the
+    client's resume must ride the router around the corpse."""
+    fleet = str(tmp_path / "fleet")
+    audits = [str(tmp_path / f"audit{i}.log") for i in range(2)]
+    servers = [
+        ScanServer(enable_http=False, fleet=True,
+                   replica_id=f"real-{i}", fleet_dir=fleet,
+                   heartbeat_interval_s=0.2,
+                   audit_log=audits[i],
+                   slos=["first_batch_p99=0.000001",
+                         "error_rate=0.5"],
+                   server_options={"cache_dir": str(
+                       tmp_path / f"cache{i}")}).start()
+        for i in range(2)]
+    reg = ReplicaRegistry(fleet)
+    deadline = time.monotonic() + 15
+    while len(reg.read()) < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    state = {"proxies": []}
+
+    def lure(path: str, cut_after: int):
+        proxy = _CuttingProxy(servers[0].address, cut_after)
+        state["proxies"].append(proxy)
+        reg.write(_rec("lure", proxy.address[1], interval_s=120.0,
+                       heat=[{"key": f"file:{path}", "count": 9}]))
+        return proxy
+
+    front = RoutingFront(fleet, slo_aware=False,
+                         failure_cooldown_s=60.0,
+                         publish_interval_s=0.0)
+    router = RouteServer(front=front).start()
+    try:
+        yield {"servers": servers, "registry": reg, "front": front,
+               "router": router, "lure": lure, "audits": audits}
+    finally:
+        router.stop()
+        for proxy in state["proxies"]:
+            proxy.stop()
+        for srv in servers:
+            srv.stop()
+
+
+def _assert_failed_over_around_lure(front, stream):
+    assert stream.failovers >= 1, "the cut never landed mid-stream"
+    st = front.state()
+    assert st["failures"].get("lure", 0) >= 1, st
+    assert "recent_failure" in st["around"].get("lure", {}), st
+
+
+def test_routed_failover_fixed_width(routed_fleet, tmp_path):
+    """The tentpole composition: a client holding ONE address (the
+    router) streams a fixed-width scan; the preferred replica dies
+    mid-stream; reconnecting to the same router routes around the
+    corpse and the resume token finishes the scan byte-identically —
+    and the resumed audit record burns no SLO twice."""
+    with hard_timeout(300, "routed fixed failover"):
+        path = str(tmp_path / "fixed.dat")
+        with open(path, "wb") as f:
+            f.write(make_records(40_000))
+        local = read_cobol(path, **OPTS).to_arrow()
+        routed_fleet["lure"](path, cut_after=64 * 1024)
+        front = routed_fleet["front"]
+        with stream_scan(routed_fleet["router"].address, path,
+                         **OPTS) as stream:
+            table = pa.Table.from_batches(list(stream))
+        _assert_failed_over_around_lure(front, stream)
+        assert table.equals(local)
+        assert table.schema.metadata == local.schema.metadata
+        # the resumed attempt ties to the original via resume_of and
+        # carries NO slo_breaches despite the impossibly tight
+        # first-batch objective: resumes never double-burn
+        original = stream.request_id
+        deadline = time.monotonic() + 10
+        done = []
+        while time.monotonic() < deadline and not done:
+            records = [r for a in routed_fleet["audits"]
+                       if os.path.exists(a)
+                       for r in read_audit_log(a)]
+            done = [r for r in records
+                    if r.resume_of == original and r.outcome == "ok"]
+            if not done:
+                time.sleep(0.05)
+        assert done
+        assert all(not r.slo_breaches for r in done)
+
+
+def test_routed_failover_variable_length(routed_fleet, tmp_path):
+    """Same death, RDW-framed variable-length records: the resume
+    watermark must cut on RECORD boundaries the VRL reader re-finds."""
+    with hard_timeout(300, "routed VRL failover"):
+        path = str(tmp_path / "vrl.dat")
+        with open(path, "wb") as f:
+            f.write(generate_exp2(6000, seed=11))
+        opts = dict(EXP2_OPTS, chunk_size_mb="0.05",
+                    pipeline_workers="2")
+        local = read_cobol(path, **opts).to_arrow()
+        routed_fleet["lure"](path, cut_after=48 * 1024)
+        with stream_scan(routed_fleet["router"].address, path,
+                         **opts) as stream:
+            table = pa.Table.from_batches(list(stream))
+        _assert_failed_over_around_lure(routed_fleet["front"], stream)
+        assert table.equals(local)
+
+
+def test_routed_failover_follow_exactly_once(routed_fleet, tmp_path):
+    """A follow subscription through the router: the watermark token
+    must seed the resumed subscription on the next-preferred replica —
+    every record exactly once, none duplicated across the cut."""
+    with hard_timeout(300, "routed follow failover"):
+        path = str(tmp_path / "feed.dat")
+        total = 3000
+        with open(path, "wb") as f:
+            f.write(make_records(total))
+        local = read_cobol(path, copybook_contents=COPYBOOK).to_arrow()
+        routed_fleet["lure"](path, cut_after=20_000)
+        stream = stream_scan(
+            routed_fleet["router"].address, path,
+            copybook_contents=COPYBOOK,
+            follow={"poll_interval_s": 0.02, "idle_timeout_s": 5.0,
+                    "batch_max_mb": 0.005},
+            max_records=total)
+        table = pa.Table.from_batches(list(stream))
+        _assert_failed_over_around_lure(routed_fleet["front"], stream)
+        assert table.num_rows == total
+        got = table.replace_schema_metadata(None)
+        want = local.replace_schema_metadata(None)
+        assert got.equals(want)
+
+
+def test_routed_failover_pushdown(routed_fleet, tmp_path):
+    """Projection + predicate pushdown across the routed cut: the
+    resume token's plan fingerprint includes the filter, so the
+    resumed attempt continues the FILTERED row sequence."""
+    with hard_timeout(300, "routed pushdown failover"):
+        path = str(tmp_path / "filt.dat")
+        with open(path, "wb") as f:
+            f.write(make_records(40_000))
+        opts = dict(OPTS, filter="KEY < 30000", select="KEY")
+        local = read_cobol(path, **opts).to_arrow()
+        routed_fleet["lure"](path, cut_after=32 * 1024)
+        with stream_scan(routed_fleet["router"].address, path,
+                         **opts) as stream:
+            table = pa.Table.from_batches(list(stream))
+        _assert_failed_over_around_lure(routed_fleet["front"], stream)
+        assert table.num_rows == local.num_rows
+        assert table.equals(local)
+
+
+# ---------------------------------------------------------------------------
+# subprocess chaos: actuator-owned fleet, SIGKILL under load
+# ---------------------------------------------------------------------------
+
+def test_routecheck_quick():
+    """The routed chaos harness end to end: 3 actuator-owned replica
+    subprocesses, warm-affinity beats cold, SIGKILL mid-routed-stream
+    with byte-identical resume, respawn within 2 heartbeats, identity
+    reclaim, zero orphans."""
+    routecheck = _load_tool("routecheck")
+    with hard_timeout(420, "routecheck quick"):
+        assert routecheck.check_route(sweep=False)
+
+
+@pytest.mark.slow
+def test_routecheck_sweep():
+    """Chaos fuzz: several kill-under-load rounds with re-warm between
+    them — the fleet must regain affinity and survive every round."""
+    routecheck = _load_tool("routecheck")
+    with hard_timeout(900, "routecheck sweep"):
+        assert routecheck.check_route(sweep=True)

@@ -419,7 +419,8 @@ class TestServeSurface:
                 proxy = _CuttingProxy(srv.address, cut_after=8 * 1024)
                 try:
                     t = fetch_table([proxy.address, srv.address],
-                                    fixed_file, **opts)
+                                    fixed_file, replica_seed=0,
+                                    **opts)
                 finally:
                     proxy.stop()
                 assert t.equals(local)

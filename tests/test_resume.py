@@ -141,7 +141,7 @@ def test_mid_stream_cut_fails_over_and_resumes(server, fixed_file):
         proxy = _CuttingProxy(server.address, cut_after=256 * 1024)
         try:
             t = fetch_table([proxy.address, server.address],
-                            fixed_file, **OPTS)
+                            fixed_file, replica_seed=0, **OPTS)
         finally:
             proxy.stop()
         assert t.equals(local)
@@ -161,7 +161,7 @@ def test_iteration_surface_survives_cut(server, fixed_file):
             rows = 0
             keys = []
             with stream_scan([proxy.address, server.address],
-                             fixed_file, **OPTS) as stream:
+                             fixed_file, replica_seed=0, **OPTS) as stream:
                 for batch in stream:
                     rows += batch.num_rows
                     keys.append(batch.column(0)[0])
@@ -185,7 +185,7 @@ def test_cut_before_any_data_retries_fresh(server, fixed_file):
         proxy = _CuttingProxy(server.address, cut_after=1)
         try:
             t = fetch_table([proxy.address, server.address],
-                            fixed_file, **OPTS)
+                            fixed_file, replica_seed=0, **OPTS)
         finally:
             proxy.stop()
         assert t.equals(local)
@@ -206,7 +206,7 @@ def test_dead_first_replica_fails_over_at_connect(server, fixed_file):
         t = fetch_table([dead, server.address], fixed_file,
                         connect_retry=RetryPolicy(max_attempts=1,
                                                   deadline=1.0),
-                        **OPTS)
+                        replica_seed=0, **OPTS)
         assert t.equals(local)
 
 
@@ -294,7 +294,8 @@ def test_max_records_preserved_across_resume(server, fixed_file):
         proxy = _CuttingProxy(server.address, cut_after=4 * 1024 * 1024)
         try:
             t = fetch_table([proxy.address, server.address],
-                            fixed_file, max_records=cap, **OPTS)
+                            fixed_file, max_records=cap,
+                            replica_seed=0, **OPTS)
         finally:
             proxy.stop()
         assert t.num_rows == cap
@@ -359,7 +360,8 @@ def test_resumed_attempts_share_one_audit_identity(fixed_file, tmp_path):
             proxy = _CuttingProxy(srv.address, cut_after=4 * 1024 * 1024)
             try:
                 with stream_scan([proxy.address, srv.address],
-                                 fixed_file, **OPTS) as s:
+                                 fixed_file, replica_seed=0,
+                                 **OPTS) as s:
                     for _ in s:
                         pass
             finally:
@@ -444,7 +446,8 @@ def test_sigkilled_replica_resumes_on_survivor(fixed_file, tmp_path):
 
             threading.Thread(target=killer, daemon=True).start()
             t = fetch_table([addrs[0], addrs[1]], fixed_file,
-                            read_timeout_s=30.0, **OPTS)
+                            read_timeout_s=30.0, replica_seed=0,
+                            **OPTS)
             assert killed.is_set()
             assert t.equals(local)
             assert t.schema.metadata == local.schema.metadata

@@ -608,6 +608,7 @@ def test_follow_failover_resumes_exactly_once(tmp_path):
         try:
             stream = stream_scan(
                 [proxy.address, srv2.address], str(src),
+                replica_seed=0,
                 copybook_contents=FIXED_COPYBOOK,
                 follow={"poll_interval_s": 0.02, "idle_timeout_s": 5.0,
                         "batch_max_mb": 0.005},

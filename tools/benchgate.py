@@ -92,6 +92,12 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
     for key in ("exp1", "exp2", "hierarchical", "exp_serve",
                 "exp_pushdown"):
         add(doc.get(key))
+    # the fleet-mode serve experiment nests under exp_serve (it shares
+    # that experiment's dataset); its aggregate-scaling metric gates on
+    # its own history series like any top-level experiment
+    serve = doc.get("exp_serve")
+    if isinstance(serve, dict):
+        add(serve.get("fleet"))
     # the pushdown experiment's speedup vs full decode gates as its own
     # metric: the >=3x claim must hold run over run, not just once. A
     # doc that RAN the experiment but produced no speedup (it raised —
@@ -325,6 +331,24 @@ def _smoke() -> int:
     ratio_doc["e2e_vs_decode_only"] = 0.15
     check("fallback-only host (native_assembly=false) abstains",
           "e2e_vs_decode_only" not in extract_metrics(ratio_doc))
+
+    # the fleet aggregate nests under exp_serve and must gate on its
+    # own history series like a top-level experiment
+    fleet_doc = {"metric": "exp3_to_arrow", "value": 100.0,
+                 "unit": "MB/s",
+                 "exp_serve": {
+                     "metric": "exp_serve_streamed_to_arrow",
+                     "value": 60.0, "unit": "MB/s",
+                     "fleet": {"metric": "exp_serve_fleet_aggregate",
+                               "value": 200.0, "unit": "MB/s"}}}
+    fleet_hist = [extract_metrics(fleet_doc) for _ in range(3)]
+    check("fleet aggregate metric is extracted",
+          "exp_serve_fleet_aggregate" in extract_metrics(fleet_doc))
+    fleet_doc["exp_serve"]["fleet"]["value"] = 80.0
+    rows = gate(extract_metrics(fleet_doc), fleet_hist, 0.25, 2)
+    check("fleet aggregate-scaling drop is caught",
+          any(r["metric"] == "exp_serve_fleet_aggregate"
+              and r["verdict"] == "regression" for r in rows))
 
     # envelope parsing: failed rounds are excluded from the baseline
     import tempfile

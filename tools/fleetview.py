@@ -15,10 +15,20 @@ memory-pressure level, follow-mode watermark lag. Below the table:
 cluster totals, the autoscaling recommendation (desired replicas +
 reasons), and the hottest cache-affinity fingerprints.
 
+When a ROUTING FRONT publishes state under ``<fleet>/router/`` the
+view adds a routing section (per-replica routed share, affinity
+hit-rate, routed-around reasons, router-observed failures), and when
+an ACTUATOR owns replicas (``<fleet>/actuator/``) a supervisor section
+(desired vs running, per-child state/restarts, recent lifecycle
+events). ``--fleet-dir`` points at a fleet root decoupled from the
+block-cache root (per-node private cache dirs + peer cache tier).
+
 ``--json`` prints one machine-readable snapshot: the replica document,
-the SLO rollup, and the signals record (what ``/fleet/replicas|slo|
-signals`` serve, without needing a live replica to proxy through —
-fleetview federates client-side with the same library).
+the SLO rollup, the signals record, plus ``routing`` (every fresh
+router record) and ``actuator`` (state + event tail) — what
+``/fleet/replicas|slo|signals`` serve, without needing a live replica
+to proxy through; fleetview federates client-side with the same
+library.
 
 Read-only: fleetview never writes into the registry and never touches
 the scan ports — it scrapes the HTTP sidecars exactly like the
@@ -132,34 +142,99 @@ def render_table(view, prev_streamed: dict, dt_s: float,
     return streamed_now
 
 
+def render_routing(fleet_root: str, out=sys.stdout) -> None:
+    """The routing-front section: one block per fresh router record."""
+    from cobrix_tpu.fleet.router import read_router_state
+
+    for doc in read_router_state(fleet_root):
+        decisions = doc.get("decisions") or 0
+        hits = doc.get("affinity_hits") or 0
+        rate = hits / decisions if decisions else 0.0
+        print(f"\nrouter {doc.get('router_id')}: "
+              f"{decisions} decisions, affinity hit-rate {rate:.0%}",
+              file=out)
+        routed = doc.get("routed") or {}
+        if routed:
+            total = sum(routed.values()) or 1
+            print("  routed share: " + ", ".join(
+                f"{rid}={n} ({n / total:.0%})"
+                for rid, n in sorted(routed.items(),
+                                     key=lambda kv: -kv[1])),
+                file=out)
+        around = doc.get("around") or {}
+        for rid, reasons in sorted(around.items()):
+            print("  routed around " + rid + ": " + ", ".join(
+                f"{reason}x{n}"
+                for reason, n in sorted(reasons.items())), file=out)
+        failures = doc.get("failures") or {}
+        if failures:
+            print("  upstream failures: " + ", ".join(
+                f"{rid}x{n}" for rid, n in sorted(failures.items())),
+                file=out)
+
+
+def render_actuator(fleet_root: str, out=sys.stdout,
+                    events_tail: int = 5) -> None:
+    """The supervisor section: desired vs running + recent events."""
+    from cobrix_tpu.fleet.actuator import (read_actuator_events,
+                                           read_actuator_state)
+
+    state = read_actuator_state(fleet_root)
+    if state is None:
+        return
+    print(f"\nactuator (pid {state.get('pid')}): "
+          f"desired={state.get('desired')} "
+          f"running={state.get('running')} "
+          f"bounds=[{state.get('min_replicas')}"
+          f"..{state.get('max_replicas')}]", file=out)
+    for rep in state.get("replicas") or []:
+        print(f"  {rep.get('replica_id')}: {rep.get('state')} "
+              f"pid={rep.get('pid')} restarts={rep.get('restarts')} "
+              f"up={rep.get('uptime_s', 0):.0f}s", file=out)
+    events = read_actuator_events(fleet_root, tail=events_tail)
+    for ev in events:
+        ts = time.strftime("%H:%M:%S", time.localtime(ev.get("ts", 0)))
+        extra = {k: v for k, v in ev.items()
+                 if k not in ("ts", "event", "replica_id")}
+        print(f"  [{ts}] {ev.get('event')} {ev.get('replica_id')}"
+              + (f" {extra}" if extra else ""), file=out)
+
+
 def snapshot(cache_dir: str, timeout_s: float = 2.0,
-             federator=None) -> dict:
+             federator=None, fleet_dir: str = "") -> dict:
     """One machine-readable federation pass (the --json body)."""
+    from cobrix_tpu.fleet.actuator import (read_actuator_events,
+                                           read_actuator_state)
     from cobrix_tpu.fleet.federate import FleetFederator
     from cobrix_tpu.fleet.registry import ReplicaRegistry
+    from cobrix_tpu.fleet.router import read_router_state
     from cobrix_tpu.fleet.signals import derive_signals
 
+    root = fleet_dir or os.path.join(cache_dir, "fleet")
     fed = federator or FleetFederator(
-        ReplicaRegistry(os.path.join(cache_dir, "fleet")),
-        timeout_s=timeout_s)
+        ReplicaRegistry(root), timeout_s=timeout_s)
     view = fed.view(force=True)
     return {
         "replicas": view.replicas_doc(),
         "slo": fed.slo_rollup(view),
         "signals": derive_signals(view, history=fed.history(),
                                   slo_rollup=fed.slo_rollup(view)),
+        "routing": read_router_state(root),
+        "actuator": {
+            "state": read_actuator_state(root),
+            "events": read_actuator_events(root, tail=20),
+        },
     }
 
 
 def live(cache_dir: str, interval_s: float, timeout_s: float,
-         frames: int = 0, out=sys.stdout) -> int:
+         frames: int = 0, out=sys.stdout, fleet_dir: str = "") -> int:
     from cobrix_tpu.fleet.federate import FleetFederator
     from cobrix_tpu.fleet.registry import ReplicaRegistry
     from cobrix_tpu.fleet.signals import derive_signals
 
-    fed = FleetFederator(
-        ReplicaRegistry(os.path.join(cache_dir, "fleet")),
-        timeout_s=timeout_s)
+    root = fleet_dir or os.path.join(cache_dir, "fleet")
+    fed = FleetFederator(ReplicaRegistry(root), timeout_s=timeout_s)
     prev: dict = {}
     last_t = time.monotonic()
     n = 0
@@ -190,6 +265,12 @@ def live(cache_dir: str, interval_s: float, timeout_s: float,
                         for h in hot[:4]), file=out)
             except Exception as exc:
                 print(f"\nsignals unavailable: {exc}", file=out)
+            try:
+                render_routing(root, out=out)
+                render_actuator(root, out=out)
+            except Exception as exc:
+                print(f"\nrouting/actuator view unavailable: {exc}",
+                      file=out)
             n += 1
             if frames and n >= frames:
                 return 0
@@ -202,9 +283,14 @@ def main() -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("--cache-dir", required=True,
+    ap.add_argument("--cache-dir", default="",
                     help="the fleet's shared cache root (replicas "
                          "heartbeat under <cache-dir>/fleet)")
+    ap.add_argument("--fleet-dir", default="",
+                    help="explicit fleet root (overrides "
+                         "<cache-dir>/fleet; for fleets whose "
+                         "membership root is decoupled from per-node "
+                         "cache dirs)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh seconds in live mode")
     ap.add_argument("--timeout", type=float, default=2.0,
@@ -215,13 +301,17 @@ def main() -> int:
                     help="one machine-readable snapshot "
                          "(replicas + slo + signals) and exit")
     args = ap.parse_args()
+    if not (args.cache_dir or args.fleet_dir):
+        ap.error("one of --cache-dir / --fleet-dir is required")
     if args.json:
         print(json.dumps(snapshot(args.cache_dir,
-                                  timeout_s=args.timeout),
+                                  timeout_s=args.timeout,
+                                  fleet_dir=args.fleet_dir),
                          sort_keys=True, default=str))
         return 0
     return live(args.cache_dir, args.interval, args.timeout,
-                frames=1 if args.once else 0)
+                frames=1 if args.once else 0,
+                fleet_dir=args.fleet_dir)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,266 @@
+"""Routed data-plane chaos check: router + peer fleet + actuator.
+
+Drives PR 16's self-healing data plane end to end, the way its
+acceptance criteria demand:
+
+  1. a `FleetActuator` (min=max=3) owns three ``--fleet`` replica
+     SUBPROCESSES sharing one fleet dir; the check waits for three
+     live heartbeats;
+  2. repeated scans of one file through the ROUTING FRONT must
+     concentrate on a single warm replica: the affinity hit-rate after
+     warm-up must beat the cold baseline (the first decision, which is
+     always cold);
+  3. a routed scan is opened THROUGH the `RouteServer` proxy and the
+     preferred replica is SIGKILLed mid-stream: the client must
+     reconnect to the router, resume on the next-preferred replica,
+     and deliver a table BYTE-IDENTICAL to the in-process read —
+     exactly-once, no gap, no duplicate;
+  4. the actuator must respawn the killed replica within TWO heartbeat
+     intervals, and the registry must show it as ONE member (the
+     same-id reclaim rule), never a live+stale pair;
+  5. `stop()` leaves zero orphaned subprocesses — every child pid is
+     gone when it returns.
+
+    python tools/routecheck.py            # quick (~30 s)
+    python tools/routecheck.py --sweep    # + chaos fuzz: several
+                                          # kill-under-load rounds
+                                          # (slow tier)
+
+Exit code 0 = every assertion held; 1 otherwise.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+COPYBOOK = """
+        01  R.
+            05  KEY    PIC 9(7) COMP.
+            05  NAME   PIC X(9).
+"""
+RECORD_BYTES = 13
+N_ROWS = 200_000          # ~2.6 MB: several IPC batches, so a
+                          # mid-stream kill has a mid-stream to hit
+HEARTBEAT_S = 1.0
+POLL_S = 0.2
+
+
+def log(msg: str) -> None:
+    print(f"[routecheck] {msg}", flush=True)
+
+
+def make_records(n: int) -> bytes:
+    return b"".join(
+        i.to_bytes(4, "big") + f"ROW{i % 1000000:06d}".encode("ascii")
+        for i in range(n))
+
+
+def wait_for(predicate, deadline_s: float, what: str):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(0.05)
+    raise AssertionError(f"timed out after {deadline_s:.0f}s "
+                         f"waiting for {what}")
+
+
+def pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    return True
+
+
+def start_fleet(workdir: str):
+    """A 3-replica actuator-owned fleet plus an in-process routing
+    front + proxy over its fleet dir."""
+    from cobrix_tpu.fleet.actuator import FleetActuator
+    from cobrix_tpu.fleet.router import RouteServer, RoutingFront
+
+    cache_dir = os.path.join(workdir, "cache")
+    act = FleetActuator(
+        cache_dir, min_replicas=3, max_replicas=3,
+        poll_interval_s=POLL_S, heartbeat_interval_s=HEARTBEAT_S,
+        desired_fn=lambda: 3, drain_grace_s=10.0,
+        server_args=["--slo", "error_rate=0.01"]).start()
+    front = RoutingFront(act.fleet_dir, slo_aware=False,
+                         failure_cooldown_s=HEARTBEAT_S * 3)
+    router = RouteServer(front=front).start()
+
+    def three_live():
+        sts = [s for s in act.registry.read() if s.state == "live"]
+        return sts if len(sts) == 3 else None
+
+    wait_for(three_live, 60, "3 live actuator-owned replicas")
+    log(f"3 replicas live under the actuator; router on "
+        f"{router.address}")
+    return act, front, router
+
+
+def assert_affinity_beats_cold(front, router, path: str) -> None:
+    """Warm routed scans must hit the affinity override: the hit-rate
+    after warm-up strictly beats the cold baseline (first decision)."""
+    from cobrix_tpu.serve import fetch_table
+
+    base = front.state()
+    for i in range(3):
+        t = fetch_table(router.address, path,
+                        copybook_contents=COPYBOOK)
+        assert t.num_rows == N_ROWS, (i, t.num_rows)
+        # heat rides the NEXT heartbeat; give it one interval
+        time.sleep(HEARTBEAT_S * 1.5)
+    st = front.state()
+    decisions = st["decisions"] - base["decisions"]
+    hits = st["affinity_hits"] - base["affinity_hits"]
+    cold_rate = 0.0  # the first decision of a cold fleet, by definition
+    rate = hits / max(1, decisions)
+    assert hits >= 1 and rate > cold_rate, (
+        f"affinity never engaged: {hits}/{decisions} hits "
+        f"(routed={st['routed']})")
+    top = max(st["routed"].values())
+    assert top >= 2, f"warm scans did not concentrate: {st['routed']}"
+    log(f"affinity hit-rate {hits}/{decisions} beats cold baseline "
+        f"{cold_rate:.0%}; routed share {st['routed']}")
+
+
+def kill_routed_replica_mid_stream(act, front, router, path: str,
+                                   local_table) -> float:
+    """Open a routed stream, SIGKILL the replica serving it after the
+    first batch, and require a byte-identical table via resume plus an
+    actuator respawn. Returns the respawn latency."""
+    import pyarrow as pa
+
+    from cobrix_tpu.serve import stream_scan
+
+    base_routed = front.state()["routed"]
+    batches = []
+    killed_at = {}
+    victim = {}
+    with stream_scan(router.address, path,
+                     copybook_contents=COPYBOOK) as stream:
+        for batch in stream:
+            batches.append(batch)
+            if not killed_at:
+                # the victim is whichever replica THIS stream routed
+                # to: the one decision counter that moved since open
+                cur = front.state()["routed"]
+                moved = [rid for rid, n in cur.items()
+                         if n > base_routed.get(rid, 0)]
+                assert len(moved) == 1, (base_routed, cur)
+                victim_id = moved[0]
+                victim.update({r["replica_id"]: r
+                               for r in act.replicas()}[victim_id])
+                log(f"killing routed replica {victim_id} "
+                    f"(pid {victim['pid']}) mid-stream")
+                os.kill(victim["pid"], signal.SIGKILL)
+                killed_at["t"] = time.monotonic()
+                # let the TCP send buffers drain so the NEXT read hits
+                # the dead socket, not pre-buffered bytes
+                time.sleep(0.2)
+        failovers = stream.failovers
+    assert killed_at, "stream ended before the kill could land"
+    assert failovers >= 1, (
+        "the killed replica's stream never failed over (kill landed "
+        "after delivery finished — enlarge N_ROWS)")
+    table = pa.Table.from_batches(batches)
+    assert table.equals(local_table), (
+        f"routed resume is NOT byte-identical: {table.num_rows} rows "
+        f"vs {local_table.num_rows}")
+    log(f"routed stream resumed through the router "
+        f"({failovers} failover(s)), table byte-identical")
+
+    def respawned():
+        rep = {r["replica_id"]: r for r in act.replicas()} \
+            .get(victim_id)
+        if (rep and rep["pid"] != victim["pid"]
+                and rep["state"] == "running"):
+            return rep
+        return None
+
+    rep = wait_for(respawned, HEARTBEAT_S * 2 + 5.0,
+                   "actuator respawning the killed replica")
+    took = time.monotonic() - killed_at["t"]
+    assert took <= HEARTBEAT_S * 2, (
+        f"respawn took {took:.2f}s, over the 2-heartbeat budget "
+        f"({HEARTBEAT_S * 2:.1f}s)")
+    log(f"actuator respawned {victim_id} as pid {rep['pid']} in "
+        f"{took:.2f}s (2 heartbeats = {HEARTBEAT_S * 2:.1f}s)")
+
+    def one_live_member():
+        sts = act.registry.read()
+        mine = [s for s in sts if s.record.replica_id == victim_id]
+        return mine if (len(mine) == 1 and mine[0].state == "live") \
+            else None
+
+    wait_for(one_live_member, HEARTBEAT_S * 4 + 5.0,
+             "respawned replica reclaiming its registry identity")
+    log(f"{victim_id} reclaimed its heartbeat as ONE member")
+    return took
+
+
+def check_route(sweep: bool = False) -> bool:
+    from cobrix_tpu import read_cobol
+
+    with tempfile.TemporaryDirectory() as workdir:
+        path = os.path.join(workdir, "feed.dat")
+        with open(path, "wb") as f:
+            f.write(make_records(N_ROWS))
+        local = read_cobol(path, copybook_contents=COPYBOOK).to_arrow()
+        act, front, router = start_fleet(workdir)
+        pids = []
+        try:
+            assert_affinity_beats_cold(front, router, path)
+            rounds = 3 if sweep else 1
+            for i in range(rounds):
+                if sweep:
+                    log(f"chaos round {i + 1}/{rounds}")
+                kill_routed_replica_mid_stream(act, front, router,
+                                               path, local)
+                if sweep:
+                    # re-warm: the NEW topology must regain affinity
+                    # before the next kill picks a meaningful victim
+                    time.sleep(front.failure_cooldown_s)
+                    assert_affinity_beats_cold(front, router, path)
+            pids = [r["pid"] for r in act.replicas()]
+        finally:
+            router.stop()
+            act.stop()
+        leftovers = [p for p in pids if pid_alive(p)]
+        assert not leftovers, f"orphaned replica pids: {leftovers}"
+        log("actuator stop() left zero orphaned subprocesses")
+        return True
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--sweep", action="store_true",
+                    help="chaos fuzz: several kill-under-load rounds "
+                         "with re-warm between them (slow tier)")
+    args = ap.parse_args()
+    try:
+        ok = check_route(sweep=args.sweep)
+    except AssertionError as exc:
+        log(f"FAILED: {exc}")
+        return 1
+    log("all routing assertions held")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    # SIGALRM backstop: a wedged fleet must fail loud, never hang CI
+    if hasattr(signal, "SIGALRM"):
+        signal.signal(signal.SIGALRM, lambda *a: (_ for _ in ()).throw(
+            TimeoutError("routecheck exceeded its global deadline")))
+        signal.alarm(600)
+    sys.exit(main())
