@@ -1073,7 +1073,8 @@ def _build_obs_context(params: ReaderParameters, metrics: ReadMetrics,
                       progress=progress,
                       cache_scope=metrics.cache_scope,
                       io_stats=metrics.io_stats,
-                      field_costs=metrics.field_costs_acc)
+                      field_costs=metrics.field_costs_acc,
+                      pass_counts=metrics.pass_counts)
 
 
 def _finish_obs(obs_ctx, params: ReaderParameters, data) -> None:

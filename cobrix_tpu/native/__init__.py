@@ -35,6 +35,25 @@ _build_failed = False
 _disabled = (os.environ.get("COBRIX_NATIVE_DISABLE", "").strip().lower()
              in ("1", "true", "yes", "on"))
 
+# COBRIX_FORCE_CPU_LEVEL=scalar|sse|avx2 (or 0|1|2) pins the native SIMD
+# dispatch below the CPU's capability — the only way to exercise the
+# scalar/SSE kernels and tails on an AVX2 machine. The .so clamps to the
+# detected level, so forcing "avx2" on an SSE box degrades, never faults.
+_CPU_LEVELS = {"scalar": 0, "sse": 1, "sse4.2": 1, "avx2": 2,
+               "0": 0, "1": 1, "2": 2}
+
+
+def _forced_cpu_level_env() -> int:
+    raw = os.environ.get("COBRIX_FORCE_CPU_LEVEL", "").strip().lower()
+    if not raw:
+        return -1
+    if raw not in _CPU_LEVELS:
+        _logger.warning("COBRIX_FORCE_CPU_LEVEL=%r not in %s; ignored",
+                        raw, sorted(set(_CPU_LEVELS)))
+        return -1
+    return _CPU_LEVELS[raw]
+
+
 MAX_RDW_RECORD_SIZE = 100 * 1024 * 1024
 
 _I32P = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
@@ -172,12 +191,27 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_int64,
             _I64P, _I32P, _I32P, _I32P, _I32P,
             _I32P, _I32P, _I64P, _I32P,
-            ctypes.c_void_p, _I64P, ctypes.c_void_p, _I64P, _U8P]
+            ctypes.c_void_p, _I64P, ctypes.c_void_p, _I64P,
+            ctypes.c_void_p, _U8P]
         lib.pack_validity.restype = ctypes.c_int64
         lib.pack_validity.argtypes = [_U8P, ctypes.c_int64,
                                       ctypes.c_int64, _U8P]
         lib.simd_level.restype = ctypes.c_int32
         lib.simd_level.argtypes = []
+        lib.set_cpu_level.restype = None
+        lib.set_cpu_level.argtypes = [ctypes.c_int32]
+        lib.rdw_scan_segids.restype = ctypes.c_int64
+        lib.rdw_scan_segids.argtypes = [
+            _U8P, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            _I64P, _I64P, _U8P, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.fill_const_string.restype = None
+        lib.fill_const_string.argtypes = [
+            ctypes.c_int64, _U8P, ctypes.c_int64, _I32P, _U8P]
+        forced = _forced_cpu_level_env()
+        if forced >= 0:
+            lib.set_cpu_level(forced)
         _lib = lib
         return _lib
 
@@ -730,8 +764,10 @@ def _string_cols_arrow(buf, extent_or_size, rec_offsets, rec_lengths, n,
     # per-column capacity sized for all-ASCII output (the overwhelmingly
     # common case); columns whose UTF-8 output outgrows it fall back.
     # Each column owns its OWN buffers so retaining one column never pins
-    # the others' memory (zero-copy views below slice these per column)
-    data_caps = n * widths + 16
+    # the others' memory (zero-copy views below slice these per column).
+    # The +64 slack lets the AVX2 write-then-trim kernel store whole
+    # 32-byte chunks (up to 31 bytes past the last value's width)
+    data_caps = n * widths + 64
     if n + 1 > 2**31 - 16 or bool((data_caps > 2**31 - 16).any()):
         return None  # int32 offsets can't address this batch
     out_offsets = [np.empty(n + 1, dtype=np.int32) for _ in range(ncols)]
@@ -897,13 +933,16 @@ def assemble_cols_arrow(data, rec_offsets, rec_lengths, extent: int,
                         col_offsets, widths, kinds, flags, dyn_sfs,
                         out_kinds, dec_modes, shifts, maxds,
                         out_ptrs, out_strides, valid_ptrs, valid_strides,
-                        n: int):
+                        n: int, row_masks=None):
     """Fused decode -> Arrow assembly over many columns in one native
     pass with the GIL released: values land in the caller's final-dtype
     buffers (strided, so flat OCCURS planes share one buffer), validity
     lands in per-column byte planes for `pack_validity`. Descriptor
     arrays must be C-contiguous of matching length; `rec_offsets` None
-    means `data` is a packed [n, extent] batch. Returns the per-column
+    means `data` is a packed [n, extent] batch. `row_masks`: optional
+    per-column uint8[n] row-visibility masks (None entries = all rows) —
+    masked rows emit null/zero without decoding, so redefine-hidden
+    bytes never reach the cell kernels. Returns the per-column
     exact-representation bool array (False -> the caller rebuilds that
     decimal column via its Python fallback), or None when the native
     library is unavailable."""
@@ -913,6 +952,29 @@ def assemble_cols_arrow(data, rec_offsets, rec_lengths, extent: int,
     buf = _as_u8(data)
     ncols = len(col_offsets)
     ok = np.empty(ncols, dtype=np.uint8)
+    mask_ptrs_arg = None
+    mask_keep = None
+    if row_masks is not None and any(m is not None for m in row_masks):
+        # dedupe by identity: columns sharing one mask object must hand
+        # the kernel one POINTER (the uniform-plane fast path requires
+        # every column's mask pointer to match), and bool->uint8
+        # conversion would otherwise mint a fresh array per column
+        conv: dict = {}
+        mask_keep = []
+        for m in row_masks:
+            if m is None:
+                mask_keep.append(None)
+                continue
+            a = conv.get(id(m))
+            if a is None:
+                a = np.ascontiguousarray(m, dtype=np.uint8)
+                conv[id(m)] = a
+            mask_keep.append(a)
+        mask_ptrs = np.asarray(
+            [0 if m is None else m.ctypes.data for m in mask_keep],
+            dtype=np.uintp)
+        mask_keep.append(mask_ptrs)  # pin until the call returns
+        mask_ptrs_arg = mask_ptrs.ctypes.data
     lib.assemble_cols_arrow(
         buf, extent,
         None if rec_offsets is None else rec_offsets.ctypes.data,
@@ -920,7 +982,7 @@ def assemble_cols_arrow(data, rec_offsets, rec_lengths, extent: int,
         n, ncols, col_offsets, widths, kinds, flags, dyn_sfs,
         out_kinds, dec_modes, shifts, maxds,
         out_ptrs.ctypes.data, out_strides,
-        valid_ptrs.ctypes.data, valid_strides, ok)
+        valid_ptrs.ctypes.data, valid_strides, mask_ptrs_arg, ok)
     return ok.view(bool)
 
 
@@ -938,12 +1000,88 @@ def pack_validity(mask: np.ndarray):
 
 
 def simd_level() -> int:
-    """Runtime SIMD capability the loaded library reports (0 scalar,
-    1 SSE4.2, 2 AVX2); -1 when the library is unavailable."""
+    """Effective runtime SIMD level the loaded library reports (0 scalar,
+    1 SSE4.2, 2 AVX2) — the CPU probe clamped by any set_cpu_level /
+    COBRIX_FORCE_CPU_LEVEL override; -1 when the library is unavailable."""
     lib = _load()
     if lib is None:
         return -1
     return int(lib.simd_level())
+
+
+def set_cpu_level(level) -> bool:
+    """Pin the native dispatch level for this process: 0/'scalar',
+    1/'sse', 2/'avx2', or -1/None to restore auto-detection. The .so
+    clamps to the detected capability, so forcing a higher level than
+    the CPU supports degrades safely. Returns False when the library is
+    unavailable (the Python fallbacks have no dispatch to pin)."""
+    lib = _load()
+    if lib is None:
+        return False
+    if level is None:
+        lvl = -1
+    elif isinstance(level, str):
+        lvl = _CPU_LEVELS.get(level.strip().lower())
+        if lvl is None:
+            raise ValueError(f"unknown CPU level {level!r}; expected one "
+                             f"of {sorted(set(_CPU_LEVELS))}")
+    else:
+        lvl = int(level)
+    lib.set_cpu_level(lvl)
+    return True
+
+
+def rdw_scan_segids(data, big_endian: bool, seg_off: int, seg_w: int,
+                    rdw_adjustment: int = 0, file_header_bytes: int = 0,
+                    file_footer_bytes: int = 0):
+    """Fused RDW framing + segment-id gather: one native walk of the file
+    image returns (offsets, lengths, seg_bytes) where seg_bytes is the
+    [n, seg_w] matrix of each record's segment-id field bytes (zero-
+    padded past short records, exactly like pack_records). None when the
+    native library is unavailable (caller frames and packs separately).
+    Raises the same framing errors as rdw_scan."""
+    lib = _load()
+    if lib is None:
+        return None
+    buf = _as_u8(data)
+    size = buf.size
+    cap = max(16, size // 4 + 2)
+    offsets = np.empty(cap, dtype=np.int64)
+    lengths = np.empty(cap, dtype=np.int64)
+    seg_bytes = np.empty((cap, seg_w), dtype=np.uint8)
+    err = ctypes.c_int64(0)
+    n = lib.rdw_scan_segids(buf, size, int(big_endian),
+                            int(rdw_adjustment), file_header_bytes,
+                            file_footer_bytes, int(seg_off), int(seg_w),
+                            offsets, lengths, seg_bytes.reshape(-1), cap,
+                            ctypes.byref(err))
+    if n == -1:
+        raise _framing_error(buf, err.value, "zero")
+    if n == -2:
+        raise _framing_error(buf, err.value, "big")
+    return offsets[:n].copy(), lengths[:n].copy(), seg_bytes[:n].copy()
+
+
+def const_string_col(n: int, value: str):
+    """Constant string column as Arrow buffers: (int32 offsets [n+1],
+    UTF-8 data of n copies of `value`). Native when available, else a
+    numpy/bytes build — both shapes feed StringArray.from_buffers, so the
+    generated File-name column never pays a per-row Python object."""
+    enc = value.encode("utf-8")
+    ln = len(enc)
+    if n < 0 or (n + 1) * max(ln, 1) > 2**31 - 16:
+        return None
+    lib = _load()
+    if lib is not None and ln > 0:
+        out_offsets = np.empty(n + 1, dtype=np.int32)
+        out_data = np.empty(n * ln, dtype=np.uint8)
+        lib.fill_const_string(n, np.frombuffer(enc, dtype=np.uint8), ln,
+                              out_offsets, out_data)
+        return out_offsets, out_data
+    offsets = np.arange(n + 1, dtype=np.int32) * ln
+    data = np.frombuffer(enc * n, dtype=np.uint8) if ln else \
+        np.empty(0, dtype=np.uint8)
+    return offsets, data
 
 
 def pack_records(data, offsets: np.ndarray, lengths: np.ndarray,

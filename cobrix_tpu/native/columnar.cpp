@@ -382,7 +382,7 @@ static void bcd4_row_avx2(const uint8_t* q, int64_t ncols, int64_t step,
 }
 #endif  // __x86_64__
 
-static int cpu_simd_level() {
+static int detected_simd_level() {
   static int level = -1;
   if (level < 0) {
 #if defined(__x86_64__) || defined(_M_X64)
@@ -396,13 +396,18 @@ static int cpu_simd_level() {
   return level;
 }
 
+// Explicit dispatch override (set_cpu_level). Never raises the level
+// above what the CPU supports — forcing "avx2" on a non-AVX2 machine
+// must degrade to the detected level, not fault.
+static int g_forced_simd_level = -1;
+
 // Whole-plane drivers: rows in parallel, one specialized row kernel.
 // Returns false when the shape has no specialization (generic path).
 static bool assemble_uniform_plane(
     const uint8_t* data, int64_t extent_or_size,
     const int64_t* rec_offsets, const int64_t* rec_lengths, int64_t n,
     int64_t ncols, int64_t base_off, int64_t step, int32_t kind,
-    int32_t width, int32_t fl, int32_t out_kind,
+    int32_t width, int32_t fl, int32_t out_kind, const uint8_t* row_mask,
     uint8_t* out0, int64_t out_stride, uint8_t* valid0,
     int64_t valid_stride) {
   const bool bin4 = kind == K_BINARY && width == 4 && ((fl >> 1) & 1)
@@ -411,11 +416,20 @@ static bool assemble_uniform_plane(
   if (!bin4 && !bcd4) return false;
   const int32_t is_signed = fl & 1;
   const int64_t span = base_off + step * (ncols - 1) + width;
-  const bool avx2 = cpu_simd_level() >= 2;
+  const bool avx2 = simd_level() >= 2;
 #ifdef _OPENMP
 #pragma omp parallel for schedule(static)
 #endif
   for (int64_t r = 0; r < n; ++r) {
+    int32_t* dst = (int32_t*)(out0 + r * out_stride);
+    uint8_t* vdst = valid0 + r * valid_stride;
+    if (row_mask && !row_mask[r]) {
+      // row hidden by a redefine segment mask: null out the whole plane
+      // row (the masked-decode twin of the packed path's zero rows)
+      std::memset(dst, 0, ncols * 4);
+      std::memset(vdst, 0, ncols);
+      continue;
+    }
     const uint8_t* row;
     int64_t len;
     if (rec_offsets) {
@@ -425,8 +439,6 @@ static bool assemble_uniform_plane(
       row = data + r * extent_or_size;
       len = extent_or_size;
     }
-    int32_t* dst = (int32_t*)(out0 + r * out_stride);
-    uint8_t* vdst = valid0 + r * valid_stride;
     if (span > len) {
       // short record: zero/invalidate the columns past its end, decode
       // the covered prefix (callers exclude truncated columns, so this
@@ -493,6 +505,13 @@ extern "C" {
 //                        row (flat OCCURS planes share one buffer)
 //   valid_ptrs/valid_strides: per-column validity BYTE plane (1 = set);
 //                        pack_validity folds these into Arrow bitmaps
+//   row_masks: per-column row-visibility masks (nullable array of
+//                        nullable uint8[n] pointers): rows with mask 0
+//                        are emitted null with a zero value WITHOUT
+//                        decoding — decode-once multisegment batches
+//                        skip the rows a redefine segment hides, so
+//                        garbage bytes under the other redefine arm can
+//                        never trip a decimal fallback (ok[c]=0)
 //   ok: per-column exact-representation flag — 0 means at least one
 //       value of a decimal column needs the exact-Decimal fallback and
 //       the caller rebuilds that one column in Python
@@ -506,7 +525,7 @@ void assemble_cols_arrow(
     const int64_t* shifts, const int32_t* maxds,
     uint8_t* const* out_ptrs, const int64_t* out_strides,
     uint8_t* const* valid_ptrs, const int64_t* valid_strides,
-    uint8_t* ok) {
+    const uint8_t* const* row_masks, uint8_t* ok) {
   for (int64_t c = 0; c < ncols; ++c) ok[c] = 1;
   // uniform plane (flat OCCURS): one descriptor, arithmetic offsets,
   // contiguous per-row output -> specialized (SIMD) row kernels
@@ -525,7 +544,8 @@ void assemble_cols_arrow(
           || out_strides[c] != out_strides[0]
           || valid_strides[c] != valid_strides[0]
           || out_ptrs[c] - out_ptrs[c - 1] != item
-          || valid_ptrs[c] - valid_ptrs[c - 1] != 1) {
+          || valid_ptrs[c] - valid_ptrs[c - 1] != 1
+          || (row_masks && row_masks[c] != row_masks[0])) {
         uniform = false;
         break;
       }
@@ -534,7 +554,8 @@ void assemble_cols_arrow(
         && assemble_uniform_plane(
                data, extent_or_size, rec_offsets, rec_lengths, n, ncols,
                col_offsets[0], step, kinds[0], widths[0], flags[0],
-               out_kinds[0], out_ptrs[0], out_strides[0], valid_ptrs[0],
+               out_kinds[0], row_masks ? row_masks[0] : nullptr,
+               out_ptrs[0], out_strides[0], valid_ptrs[0],
                valid_strides[0])) {
       return;
     }
@@ -560,6 +581,19 @@ void assemble_cols_arrow(
       const int32_t out_kind = out_kinds[c];
       uint8_t* dst = out_ptrs[c] + r * out_strides[c];
       uint8_t* vdst = valid_ptrs[c] + r * valid_strides[c];
+      if (row_masks && row_masks[c] && !row_masks[c][r]) {
+        // hidden by this column's redefine segment mask: null, zero,
+        // and NEVER decode (the bytes belong to the other redefine arm)
+        *vdst = 0;
+        switch (out_kinds[c]) {
+          case O_INT32: *(int32_t*)dst = 0; break;
+          case O_INT64: *(int64_t*)dst = 0; break;
+          case O_FLOAT32: *(float*)dst = 0.0f; break;
+          case O_FLOAT64: *(double*)dst = 0.0; break;
+          default: std::memset(dst, 0, 16); break;
+        }
+        continue;
+      }
 
       Cell cell;
       cell.dots = 0;
@@ -741,12 +775,27 @@ int64_t pack_validity(const uint8_t* mask, int64_t n, int64_t stride,
   return nulls;
 }
 
-// Runtime SIMD capability of this host: 0 scalar, 1 SSE4.2, 2 AVX2.
-// The same probe gates the AVX2 plane kernels above; surfacing it
+// Effective runtime SIMD level of this process: 0 scalar, 1 SSE4.2,
+// 2 AVX2 — the CPU probe clamped by any set_cpu_level override. The
+// same value gates the AVX2 plane kernels above AND framing.cpp's
+// transcode kernels (via the decode_cells.h declaration); surfacing it
 // through native.simd_level() lets tests/reports assert which decode
 // path a machine actually runs.
 int32_t simd_level(void) {
-  return cpu_simd_level();
+  const int det = detected_simd_level();
+  if (g_forced_simd_level >= 0 && g_forced_simd_level < det) {
+    return g_forced_simd_level;
+  }
+  return det;
+}
+
+// Force the dispatch level (0 scalar, 1 SSE4.2, 2 AVX2; -1 restores
+// auto-detection). Clamped to the detected capability by simd_level()
+// so every forced level is safe to run. Wired to COBRIX_FORCE_CPU_LEVEL
+// in native/__init__.py; the parity tests sweep it to exercise the
+// scalar/SSE tails on AVX2 machines.
+void set_cpu_level(int32_t level) {
+  g_forced_simd_level = level < 0 ? -1 : (level > 2 ? 2 : level);
 }
 
 }  // extern "C"

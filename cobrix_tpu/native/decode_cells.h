@@ -12,6 +12,13 @@
 #include <cstdint>
 #include <cstring>
 
+// Effective SIMD dispatch level (0 scalar, 1 SSE4.2, 2 AVX2): the
+// runtime CPU probe clamped by an explicit override (set_cpu_level /
+// COBRIX_FORCE_CPU_LEVEL). Defined in columnar.cpp; framing.cpp's
+// transcode kernels consult the same value so one knob steers every
+// dispatch point in the .so.
+extern "C" int32_t simd_level(void);
+
 typedef unsigned __int128 cobrix_u128;
 
 // BCD pair LUT: value = hi*10+lo per byte (255 marks an invalid digit

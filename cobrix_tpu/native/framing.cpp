@@ -21,6 +21,10 @@
 #include <omp.h>
 #endif
 
+#if defined(__x86_64__) || defined(_M_X64)
+#include <immintrin.h>
+#endif
+
 // shared per-cell decode math (also used by columnar.cpp's fused
 // decode->Arrow assembly pass — the two must never diverge)
 #include "decode_cells.h"
@@ -99,6 +103,77 @@ int64_t rdw_scan(const uint8_t* data, int64_t size, int32_t big_endian,
     pos += 4 + len;
   }
   return n;
+}
+
+// Fused RDW framing + segment-id gather: the rdw_scan loop above, plus
+// the segment-id field bytes of every record copied out while its
+// header's cache lines are still resident — multisegment files are
+// walked ONCE instead of a framing pass plus a pack_records pass over
+// the same image. seg_bytes is a caller-allocated [max_records, seg_w]
+// row-major matrix; bytes past a record's end are zero, exactly like
+// pack_records' zero padding (the parity contract with the unfused
+// path's segment-id decode).
+int64_t rdw_scan_segids(const uint8_t* data, int64_t size,
+                        int32_t big_endian, int32_t rdw_adjustment,
+                        int64_t file_header_bytes, int64_t file_footer_bytes,
+                        int64_t seg_off, int64_t seg_w, int64_t* offsets,
+                        int64_t* lengths, uint8_t* seg_bytes,
+                        int64_t max_records, int64_t* error_pos) {
+  int64_t pos = 0;
+  int64_t n = 0;
+  int64_t body_end = size;
+  if (file_footer_bytes > 0 && file_footer_bytes < size) {
+    body_end = size - file_footer_bytes;
+  }
+  while (pos + 4 <= body_end && n < max_records) {
+    if (file_header_bytes > 4 && pos == 0) {
+      pos = file_header_bytes;
+      continue;
+    }
+    int64_t len;
+    if (big_endian) {
+      len = (int64_t)data[pos + 1] + 256 * (int64_t)data[pos];
+    } else {
+      len = (int64_t)data[pos + 2] + 256 * (int64_t)data[pos + 3];
+    }
+    len += rdw_adjustment;
+    if (len <= 0) {
+      *error_pos = pos;
+      return FRAMING_ZERO_LENGTH;
+    }
+    if (len > kMaxRdwRecordSize) {
+      *error_pos = pos;
+      return FRAMING_TOO_BIG;
+    }
+    const int64_t off = pos + 4;
+    const int64_t avail = body_end - off;
+    const int64_t rec_len = len < avail ? len : avail;
+    offsets[n] = off;
+    lengths[n] = rec_len;
+    uint8_t* seg_row = seg_bytes + n * seg_w;
+    const int64_t seg_avail = seg_off >= rec_len
+        ? 0 : (seg_off + seg_w <= rec_len ? seg_w : rec_len - seg_off);
+    if (seg_avail > 0) std::memcpy(seg_row, data + off + seg_off, seg_avail);
+    if (seg_avail < seg_w) {
+      std::memset(seg_row + seg_avail, 0, seg_w - seg_avail);
+    }
+    ++n;
+    pos += 4 + len;
+  }
+  return n;
+}
+
+// Constant string column straight into Arrow buffers: n copies of one
+// value -> int32 offsets [n+1] + repeated UTF-8 data. The generated
+// File-name column of every batch is this shape; building it natively
+// keeps the generated columns inside the no-Python assembly story.
+void fill_const_string(int64_t n, const uint8_t* val, int64_t len,
+                       int32_t* out_offsets, uint8_t* out_data) {
+  out_offsets[0] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (len > 0) std::memcpy(out_data + i * len, val, len);
+    out_offsets[i + 1] = (int32_t)((i + 1) * len);
+  }
 }
 
 // Scan records whose length comes from a field inside each record.
@@ -335,6 +410,120 @@ struct StrClassTables {
   uint8_t lut8[256], trim_both[256], trim_lr[256], wide_cp[256];
 };
 
+// AVX2 shuffle-table transcode (the Vectorized-VByte / "decoding
+// billions of integers" PSHUFB idiom applied to the 256-entry EBCDIC ->
+// code-point LUT): 16 PSHUFB rows keyed by the high nibble map 32 raw
+// bytes to their narrow (< 0x80) code points per step; any byte whose
+// code point is >= 0x80 maps to the 0xFF marker, so one MOVEMASK both
+// detects wide code points (bail to the scalar/UTF-8 path) and — since
+// narrow mapped bytes ARE their code points — lets the trailing-space
+// trim masks be computed on the mapped bytes directly. Byte-identical
+// to the scalar byte-LUT path by construction: same lut8 values, same
+// trim classes, and every value containing a wide code point falls back
+// to the exact scalar routine.
+struct TranscodeShuffleTables {
+  // row h = lutA[16h .. 16h+15] replicated in both 128-bit lanes
+  // (VPSHUFB shuffles within each lane); plain bytes so construction
+  // needs no AVX2 and the kernel loads them aligned
+  alignas(32) uint8_t rows[16][32];
+};
+
+static void build_transcode_tables(const StrClassTables& t,
+                                   TranscodeShuffleTables* out) {
+  for (int h = 0; h < 16; ++h) {
+    for (int j = 0; j < 16; ++j) {
+      const int b = h * 16 + j;
+      const uint8_t m = t.wide_cp[b] ? 0xFF : t.lut8[b];
+      out->rows[h][j] = m;
+      out->rows[h][j + 16] = m;
+    }
+  }
+}
+
+#if defined(__x86_64__) || defined(_M_X64)
+// One full-coverage value (avail == width), width >= kAvx2MinWidth:
+// write-then-trim. Mapped bytes are stored untrimmed at dst+cur (the
+// caller's data caps guarantee full width always fits; stores run in
+// whole 32-byte chunks against the +64 allocation slack), trim points
+// come from per-chunk MOVEMASK bit scans, and a left trim shifts the
+// kept range down with one memmove. Returns the new cursor, or -1 when
+// the value needs the scalar path (wide code point, or a cursor too
+// close to the cap for whole-chunk stores).
+__attribute__((target("avx2")))
+static int64_t transcode_value_avx2(
+    const uint8_t* p, int64_t width, const TranscodeShuffleTables* tbl,
+    int32_t trim_mode, uint8_t* dst, int64_t cur, int64_t data_cap) {
+  const int64_t nchunks = (width + 31) / 32;
+  // whole-chunk stores: every chunk must land inside the allocation
+  if (cur + nchunks * 32 > data_cap) return -1;
+  int64_t first_keep = -1, last_keep = -1;
+  const __m256i low_nib = _mm256_set1_epi8(0x0F);
+  for (int64_t i = 0; i < nchunks; ++i) {
+    const int64_t base = i * 32;
+    const int64_t rem = width - base;
+    __m256i v;
+    uint32_t lane_valid = 0xFFFFFFFFu;
+    if (rem >= 32) {
+      v = _mm256_loadu_si256((const __m256i*)(const void*)(p + base));
+    } else {
+      // tail chunk: stage through a zeroed 32-byte buffer so neither
+      // the load nor the trim masks ever touch bytes past the field
+      alignas(32) uint8_t buf[32] = {0};
+      std::memcpy(buf, p + base, (size_t)rem);
+      v = _mm256_load_si256((const __m256i*)(const void*)buf);
+      lane_valid = (1u << rem) - 1;
+    }
+    const __m256i lo = _mm256_and_si256(v, low_nib);
+    const __m256i hi = _mm256_and_si256(_mm256_srli_epi16(v, 4), low_nib);
+    __m256i m = _mm256_setzero_si256();
+    for (int h = 0; h < 16; ++h) {
+      const __m256i sel = _mm256_cmpeq_epi8(hi, _mm256_set1_epi8((char)h));
+      const __m256i part = _mm256_shuffle_epi8(
+          _mm256_load_si256((const __m256i*)(const void*)tbl->rows[h]), lo);
+      m = _mm256_or_si256(m, _mm256_and_si256(part, sel));
+    }
+    // narrow mapped bytes are < 0x80; a set top bit is the wide marker
+    if ((uint32_t)_mm256_movemask_epi8(m) & lane_valid) return -1;
+    _mm256_storeu_si256((__m256i*)(void*)(dst + cur + base), m);
+    uint32_t trim_bits;
+    if (trim_mode == 1) {  // cp <= 0x20 (mapped byte == code point)
+      trim_bits = (uint32_t)_mm256_movemask_epi8(
+          _mm256_cmpgt_epi8(_mm256_set1_epi8(0x21), m));
+    } else if (trim_mode == 2 || trim_mode == 3) {  // ' ' and '\t'
+      trim_bits = (uint32_t)_mm256_movemask_epi8(_mm256_or_si256(
+          _mm256_cmpeq_epi8(m, _mm256_set1_epi8(0x20)),
+          _mm256_cmpeq_epi8(m, _mm256_set1_epi8(0x09))));
+    } else {
+      trim_bits = 0;
+    }
+    const uint32_t keep = ~trim_bits & lane_valid;
+    if (keep) {
+      if (first_keep < 0) first_keep = base + __builtin_ctz(keep);
+      last_keep = base + 31 - __builtin_clz(keep);
+    }
+  }
+  int64_t s = 0, e = width;
+  if (trim_mode == 1) {
+    if (first_keep < 0) {
+      e = 0;  // all-trim value -> empty string, same as the scalar walk
+    } else {
+      s = first_keep;
+      e = last_keep + 1;
+    }
+  } else if (trim_mode == 2) {
+    s = first_keep < 0 ? width : first_keep;
+  } else if (trim_mode == 3) {
+    e = last_keep < 0 ? 0 : last_keep + 1;
+  }
+  if (s > 0 && e > s) std::memmove(dst + cur, dst + cur + s, (size_t)(e - s));
+  return cur + (e - s);
+}
+#endif  // __x86_64__
+
+// below this width the 16-step PSHUFB select costs more than the scalar
+// byte-LUT walk (one chunk is ~80 SIMD ops; scalar is ~3/byte)
+static const int64_t kAvx2TranscodeMinWidth = 16;
+
 // Per-value transcode+trim: emit one field's UTF-8 into dst at cur.
 // Returns the new cursor, or -1 when the value would overflow data_cap
 // (the caller rebuilds that one column in Python).
@@ -427,6 +616,13 @@ void transcode_string_cols_arrow(
     t.trim_lr[b] = (u == 0x20 || u == 0x09);
     t.wide_cp[b] = u >= 0x80;
   }
+  TranscodeShuffleTables shuf;
+  bool use_avx2 = false;
+#if defined(__x86_64__) || defined(_M_X64)
+  use_avx2 = simd_level() >= 2;
+  if (use_avx2) build_transcode_tables(t, &shuf);
+#endif
+  (void)use_avx2;
   int threads = 1;
 #ifdef _OPENMP
   threads = omp_get_max_threads();
@@ -462,8 +658,18 @@ void transcode_string_cols_arrow(
           p = data + r * extent_or_size + col;
           avail = width;
         }
-        const int64_t cur = transcode_one_value(
-            p, avail, width, lut, pad, t, trim_mode, dst, pos, data_cap);
+        int64_t cur = -1;
+#if defined(__x86_64__) || defined(_M_X64)
+        if (use_avx2 && avail == width
+            && width >= kAvx2TranscodeMinWidth) {
+          cur = transcode_value_avx2(p, width, &shuf, trim_mode, dst, pos,
+                                     data_cap);
+        }
+#endif
+        if (cur < 0) {
+          cur = transcode_one_value(
+              p, avail, width, lut, pad, t, trim_mode, dst, pos, data_cap);
+        }
         if (cur < 0) {
           overflow = true;
         } else {
@@ -506,9 +712,19 @@ void transcode_string_cols_arrow(
       const int64_t avail =
           col >= rec_len ? 0 : (col + width <= rec_len ? width
                                                        : rec_len - col);
-      const int64_t cur = transcode_one_value(
-          p, avail, width, lut, pad, t, trim_mode,
-          out_data_ptrs[c], pos[c], data_caps[c]);
+      int64_t cur = -1;
+#if defined(__x86_64__) || defined(_M_X64)
+      if (use_avx2 && avail == width
+          && width >= kAvx2TranscodeMinWidth) {
+        cur = transcode_value_avx2(p, width, &shuf, trim_mode,
+                                   out_data_ptrs[c], pos[c], data_caps[c]);
+      }
+#endif
+      if (cur < 0) {
+        cur = transcode_one_value(
+            p, avail, width, lut, pad, t, trim_mode,
+            out_data_ptrs[c], pos[c], data_caps[c]);
+      }
       if (cur < 0) {
         overflow[c] = 1;
       } else {

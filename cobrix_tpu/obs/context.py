@@ -27,11 +27,11 @@ class ObsContext:
     """The read's observability bundle (any member may be None)."""
 
     __slots__ = ("tracer", "metrics", "progress", "cache_scope",
-                 "io_stats", "field_costs")
+                 "io_stats", "field_costs", "pass_counts")
 
     def __init__(self, tracer=None, metrics: Optional[dict] = None,
                  progress=None, cache_scope=None, io_stats=None,
-                 field_costs=None):
+                 field_costs=None, pass_counts=None):
         self.tracer = tracer
         self.metrics = metrics      # obs.metrics.scan_metrics() dict
         self.progress = progress    # obs.progress.ProgressTracker
@@ -41,10 +41,24 @@ class ObsContext:
         # cost attribution; None = attribution off (the zero-cost
         # default: every timer site gates on this being None)
         self.field_costs = field_costs
+        # profiling.PassCounters — fused-native-pass engagement counts
+        # for the read (lands in ReadMetrics.as_dict()["native_passes"])
+        self.pass_counts = pass_counts
 
 
 def current() -> Optional[ObsContext]:
     return getattr(_tls, "ctx", None)
+
+
+def count_pass(name: str, n: int = 1) -> None:
+    """Record `n` engagements of a fused native pass against the active
+    read's PassCounters; no-op outside a read (or when the read carries
+    no metrics). Post-read assembly sites must NOT use this — the
+    context is gone by then; they increment through the PassCounters
+    reference their DecodedBatch captured at decode time."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None and ctx.pass_counts is not None:
+        ctx.pass_counts.incr(name, n)
 
 
 @contextlib.contextmanager

@@ -112,6 +112,36 @@ def timed_stage(stage_times: Optional[StageTimes], name: str):
     return stage_times.timed(name)
 
 
+class PassCounters:
+    """Thread-safe named counters for native-pass accounting.
+
+    Each increment records that one fused native kernel launch actually
+    engaged (`fused_frame`, `fused_assembly`, `string_transcode`,
+    `take_elided`, ...). asmcheck's quick mode asserts on these so a
+    silent fallback to the multi-pass shape fails loudly instead of
+    reading as a slowdown. Shared by reference: read-time threads reach
+    it through the ObsContext, and post-read Arrow assembly through the
+    reference each DecodedBatch captured at decode time (the same
+    capture pattern field-cost attribution uses — sequential reads
+    assemble Arrow after read_cobol returned and the context died)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + n
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def as_dict(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
 @dataclass
 class ReadMetrics:
     """Structured per-read metrics (the IndexBuilder/CobolScanners log
@@ -173,6 +203,9 @@ class ReadMetrics:
         # sequential reads assemble Arrow after the read returns, and
         # the snapshot must include that work like the pipelined path's
         self.field_costs_acc = None
+        # fused-native-pass engagement counters (always on — one locked
+        # dict increment per kernel launch, nowhere near hot-loop cost)
+        self.pass_counts = PassCounters()
         # root-span args dict + trace destination, kept so lazy
         # post-read assembly can fold its costs back into an already
         # written trace artifact (refresh_trace_field_costs)
@@ -339,6 +372,9 @@ class ReadMetrics:
         fc = self.field_costs
         if fc is not None:
             out["field_costs"] = fc
+        passes = self.pass_counts.as_dict()
+        if passes:
+            out["native_passes"] = passes
         roof = self.roofline()
         if roof is not None:
             out["roofline"] = roof

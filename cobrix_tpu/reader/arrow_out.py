@@ -394,10 +394,14 @@ class ArrowBatchBuilder:
     # -- fused native assembly (decode -> Arrow buffers in one pass) -------
 
     def _asm_call(self, specs, descs, out_ptrs, out_strides, valid_ptrs,
-                  valid_strides):
+                  valid_strides, row_masks=None, rows=None):
         """One fused-kernel invocation over prepared destinations: the
-        GIL is released for the whole decode+assemble pass. Returns the
-        per-column ok array, or None when the library is unavailable."""
+        GIL is released for the whole decode+assemble pass. `row_masks`:
+        per-spec row-visibility masks (decode-once redefines) — hidden
+        rows emit null in-kernel without decoding. `rows`: decode ONLY
+        these record indices (compact output of len(rows) rows — the
+        destinations must be sized for that). Returns the per-column ok
+        array, or None when the library is unavailable."""
         batch = self.batch
         k = len(specs)
         col_offsets = np.fromiter((s.offset for s in specs), np.int64, k)
@@ -413,14 +417,24 @@ class ArrowBatchBuilder:
         if rs is not None:
             src, offs, lens = rs
             extent = src.size
+            if rows is not None:
+                offs = offs[rows]
+                lens = lens[rows]
         else:
+            if rows is not None:
+                return None  # packed source: subsetting would copy bytes
             src = np.ascontiguousarray(batch.data)
             offs = lens = None
             extent = src.shape[1] if src.ndim == 2 else 0
-        return native.assemble_cols_arrow(
+        n = self.n if rows is None else len(rows)
+        ok = native.assemble_cols_arrow(
             src, offs, lens, extent, col_offsets, widths, kinds, flags,
             dyn_sfs, out_kinds, dec_modes, shifts, maxds,
-            out_ptrs, out_strides, valid_ptrs, valid_strides, self.n)
+            out_ptrs, out_strides, valid_ptrs, valid_strides, n,
+            row_masks=row_masks)
+        if ok is not None and batch.pass_counts is not None:
+            batch.pass_counts.incr("fused_assembly")
+        return ok
 
     def _native_scalar_array(self, col: int):
         """pa.Array for a scalar (non-OCCURS-slot) numeric/float column
@@ -457,10 +471,11 @@ class ArrowBatchBuilder:
                     trunc = trunc & relevant
                 if bool(trunc.any()):
                     continue  # the scalar path owns partial-field rules
-            if desc[3] == native.ASM_OUT_DECIMAL128 \
-                    and self.redefine_masks is not None and c.segment:
-                continue  # masked decimals keep the per-column routes
-            entries.append((c, pa_type, desc))
+            # masked columns ride the same pass: their row mask reaches
+            # the kernel, which emits null for hidden rows WITHOUT
+            # decoding them — so garbage under another redefine arm can
+            # neither leak values nor trip the decimal exactness bail
+            entries.append((c, pa_type, desc, relevant))
         if not entries:
             return {}
         fc = self.fc
@@ -476,7 +491,7 @@ class ArrowBatchBuilder:
             # pass could NOT serve (decimal ok=False) are excluded:
             # their fallback rebuild re-times itself, and charging them
             # here too would double-count (the fieldcost discard rule)
-            served = [c for c, _, _ in entries if c.index in arrays]
+            served = [c for c, _, _, _ in entries if c.index in arrays]
             if served:
                 fc.commit_weighted(
                     tok,
@@ -496,7 +511,7 @@ class ArrowBatchBuilder:
         out_strides = np.empty(k, dtype=np.int64)
         valid_ptrs = np.empty(k, dtype=np.uintp)
         valid_strides = np.ones(k, dtype=np.int64)
-        for j, (c, pa_type, d) in enumerate(entries):
+        for j, (c, pa_type, d, _m) in enumerate(entries):
             out_kind = d[3]
             if out_kind == native.ASM_OUT_DECIMAL128:
                 buf = np.empty((n, 16), dtype=np.uint8)
@@ -508,14 +523,18 @@ class ArrowBatchBuilder:
             out_ptrs[j] = buf.ctypes.data
             out_strides[j] = native.ASM_OUT_ITEMSIZE[out_kind]
             valid_ptrs[j] = valid.ctypes.data
-        ok = self._asm_call([c for c, _, _ in entries],
-                            [d for _, _, d in entries],
+        masks = [m for _, _, _, m in entries]
+        ok = self._asm_call([c for c, _, _, _ in entries],
+                            [d for _, _, d, _ in entries],
                             out_ptrs, out_strides, valid_ptrs,
-                            valid_strides)
+                            valid_strides,
+                            row_masks=(masks if any(m is not None
+                                                    for m in masks)
+                                       else None))
         if ok is None:
             return {}
         result = {}
-        for j, (c, pa_type, d) in enumerate(entries):
+        for j, (c, pa_type, d, _m) in enumerate(entries):
             if not ok[j]:
                 continue  # exact-Decimal fallback rebuilds this column
             packed = native.pack_validity(valids[j])
@@ -528,11 +547,17 @@ class ArrowBatchBuilder:
                 null_count=nulls)
         return result
 
-    def _native_flat_values(self, st, cols, spec0, pa_type, max_size: int):
+    def _native_flat_values(self, st, cols, spec0, pa_type, max_size: int,
+                            row_mask=None, compact_rows=None):
         """Record-major flat values array for ALL slots of one OCCURS
         numeric leaf via the fused kernel: every slot column writes into
         one shared buffer (slot s of row i at i*S+s) with one shared
         validity plane — the per-slot stack/astype/pack glue disappears.
+        `row_mask`: decode-once row visibility for the owning segment
+        (hidden rows emit null in-kernel, never decoded). `compact_rows`:
+        decode ONLY these visible rows into a len(rows)*S values array —
+        the caller gives hidden rows empty lists under their null parent
+        struct, so the kernel never touches (or sizes buffers for) them.
         None -> caller's existing paths."""
         batch = self.batch
         if not native.available():
@@ -542,7 +567,7 @@ class ArrowBatchBuilder:
             o = outm.get(c)
             if o is None or "lazy_numeric" not in o:
                 return None  # planes exist: the stack path serves them
-        key = (id(st), cols[0])
+        key = (id(st), cols[0], compact_rows is None)
         cached = batch._asm_flat_cache.get(key)
         if cached is not None:
             return cached
@@ -550,7 +575,7 @@ class ArrowBatchBuilder:
         if desc is None:
             return None
         pa = _pa()
-        n = self.n
+        n = self.n if compact_rows is None else len(compact_rows)
         total = n * max_size
         out_kind = desc[3]
         item = native.ASM_OUT_ITEMSIZE[out_kind]
@@ -572,7 +597,10 @@ class ArrowBatchBuilder:
         fc = self.fc
         tok = fc.begin() if fc is not None else None
         ok = self._asm_call(specs, [desc] * k, out_ptrs, out_strides,
-                            valid_ptrs, valid_strides)
+                            valid_ptrs, valid_strides,
+                            row_masks=([row_mask] * k
+                                       if row_mask is not None else None),
+                            rows=compact_rows)
         arr = None
         if ok is not None and bool(ok.all()):
             packed = native.pack_validity(valid)
@@ -902,12 +930,16 @@ class ArrowBatchBuilder:
         return np.where((v >= st.array_min_size) & (v <= st.array_max_size),
                         v, st.array_max_size)
 
-    def _flat_slot_values(self, st: Primitive, slot_path, max_size: int):
+    def _flat_slot_values(self, st: Primitive, slot_path, max_size: int,
+                          compact_mask=None, compact_rows=None):
         """One record-major flat array covering every OCCURS slot of a
         numeric leaf (the slots live in one kernel group; per-slot
         pa.array calls would dominate wide-OCCURS materialization —
-        exp3's 2000-element plane is 4000 such calls otherwise). None ->
-        caller uses the per-slot path."""
+        exp3's 2000-element plane is 4000 such calls otherwise).
+        `compact_mask`/`compact_rows` (decode-once): build values for
+        ONLY the visible rows — the caller verified hidden rows are
+        nulled at an enclosing struct, where child buffers are invisible.
+        None -> caller uses the per-slot path."""
         pa = _pa()
         pa_type = to_arrow_type(primitive_data_type(st))
         is_decimal = pa.types.is_decimal(pa_type)
@@ -919,19 +951,32 @@ class ArrowBatchBuilder:
         if any(c is None for c in cols):
             return None
         spec0 = self.decoder.plan.columns[cols[0]]
-        if self.redefine_masks is not None and spec0.segment:
-            return None  # decode-once hidden rows: keep the masked path
+        relevant = self._relevant_of(spec0)
+        if compact_mask is not None and relevant is not compact_mask:
+            return None  # leaf belongs to a different segment arm
         if is_decimal and (spec0.params.explicit_decimal
                            or _dyn_scale(spec0)):
             return None  # per-value exponent planes stay per slot
         lengths = self.batch.lengths
         if lengths is not None:
             last = self.decoder.plan.columns[cols[-1]]
-            if bool((lengths < last.offset + last.width).any()):
+            trunc = lengths < last.offset + last.width
+            if relevant is not None:
+                trunc = trunc & relevant
+            if bool(trunc.any()):
                 return None  # truncated tails own the partial-field rules
-        arr = self._native_flat_values(st, cols, spec0, pa_type, max_size)
+        if compact_rows is not None:
+            return self._native_flat_values(st, cols, spec0, pa_type,
+                                            max_size,
+                                            compact_rows=compact_rows)
+        arr = self._native_flat_values(st, cols, spec0, pa_type, max_size,
+                                       row_mask=relevant)
         if arr is not None:
             return arr
+        if relevant is not None:
+            # no native pass: hidden rows would need Python-side blanking
+            # — keep the existing masked per-slot route
+            return None
         if is_decimal and pa_type.precision > 18:
             return None  # the stack path below is exact-int64 only
         outs = [self.batch.column_arrays(c) for c in cols]
@@ -952,7 +997,8 @@ class ArrowBatchBuilder:
         return pa.array(
             flat.astype(_numpy_dtype_for(pa_type), copy=False), mask=mask)
 
-    def _flat_struct_values(self, group: Group, slot_path, max_size: int):
+    def _flat_struct_values(self, group: Group, slot_path, max_size: int,
+                            compact_mask=None, compact_rows=None):
         """Record-major flat StructArray over all OCCURS slots of a group
         element whose fields are all numeric leaves (exp3's
         STRATEGY-DETAIL). None -> per-slot path."""
@@ -963,7 +1009,9 @@ class ArrowBatchBuilder:
                 continue
             if isinstance(child, Group) or child.is_array:
                 return None
-            flat = self._flat_slot_values(child, slot_path, max_size)
+            flat = self._flat_slot_values(child, slot_path, max_size,
+                                          compact_mask=compact_mask,
+                                          compact_rows=compact_rows)
             if flat is None:
                 return None
             names.append(child.name)
@@ -1025,28 +1073,103 @@ class ArrowBatchBuilder:
             return None
         return pa.StructArray.from_arrays(children, names=names)
 
+    def _compact_visibility(self, st: Statement):
+        """Row mask under which `st` (a decode-once OCCURS subtree) is
+        visible, IF the hidden rows are guaranteed nulled at an enclosing
+        segment-redefine struct: there, child buffers are logically
+        invisible, so values need building only for the visible rows
+        (exp3: the 2000-slot STRATEGY plane shrinks from every record to
+        just the C records). None = no mask, or no null-struct
+        guarantee — callers must then build positionally."""
+        if self.redefine_masks is None:
+            return None
+        node, redef = st.parent, None
+        while node is not None:
+            if getattr(node, "is_segment_redefine", False):
+                redef = node
+                break
+            node = node.parent
+        # the redefine root only receives its struct null mask when it is
+        # built as a row-level struct: itself not an array, and not
+        # nested inside one (element structs are built unmasked)
+        if redef is None or redef.is_array:
+            return None
+        p = redef.parent
+        while p is not None:
+            if p.is_array:
+                return None
+            p = p.parent
+        mask = self.redefine_masks.get(redef.name.upper())
+        if mask is None or bool(mask.all()):
+            return None  # fully visible: the positional path IS compact
+        return mask
+
     def _list_array_impl(self, st: Statement, slot_path):
         pa = _pa()
         n, max_size = self.n, st.array_max_size
         counts_probe = self._occurs_counts(st)
-        if (counts_probe is None and n and max_size
-                and n * max_size < 2**31 - 1):
-            # constant-size OCCURS: one flat record-major values array,
-            # uniform offsets — no per-slot arrays, no interleave take
+        if n and max_size and n * max_size < 2**31 - 1:
+            # position-addressed assembly: ONE flat record-major values
+            # array (slot s of record i at i*S+s), built natively when
+            # the fused kernel applies and by the numpy stack path
+            # otherwise — never the slot-major concat + random-access
+            # take interleave below
             flat = None
             if not self._subtree_planned(st):
                 # projection pruned the whole plane: zero assembly —
                 # the pushdown claim that an unselected wide OCCURS
                 # (exp3's 2000-element STRATEGY) costs nothing
                 flat = self._flat_null_values(st, max_size)
+            if flat is None and counts_probe is None:
+                # decode-once + segment mask: values for visible rows
+                # only; hidden rows get EMPTY lists, invisible under
+                # their null redefine struct (Arrow equality and every
+                # consumer read nulls logically)
+                cmask = self._compact_visibility(st)
+                if cmask is not None:
+                    rows = np.nonzero(cmask)[0]
+                    cflat = (self._flat_struct_values(
+                                 st, slot_path, max_size,
+                                 compact_mask=cmask, compact_rows=rows)
+                             if isinstance(st, Group)
+                             else self._flat_slot_values(
+                                 st, slot_path, max_size,
+                                 compact_mask=cmask, compact_rows=rows))
+                    if cflat is not None:
+                        offsets = np.zeros(n + 1, dtype=np.int32)
+                        np.cumsum(np.where(cmask, max_size, 0),
+                                  out=offsets[1:])
+                        return pa.ListArray.from_arrays(pa.array(offsets),
+                                                        cflat)
             if flat is None:
                 flat = (self._flat_struct_values(st, slot_path, max_size)
                         if isinstance(st, Group)
                         else self._flat_slot_values(st, slot_path,
                                                     max_size))
             if flat is not None:
-                offsets = np.arange(n + 1, dtype=np.int32) * max_size
-                return pa.ListArray.from_arrays(pa.array(offsets), flat)
+                if counts_probe is None:
+                    # constant-size OCCURS: uniform offsets, zero copies
+                    offsets = np.arange(n + 1, dtype=np.int32) * max_size
+                    return pa.ListArray.from_arrays(pa.array(offsets),
+                                                    flat)
+                # DEPENDING ON: drop the unused tail slots with one
+                # ASCENDING-index gather over the record-major array (a
+                # sequential copy, not the interleave the slot-major
+                # shape forced); no gather at all when every record is
+                # full
+                counts = counts_probe
+                mask = np.arange(max_size)[None, :] < counts[:, None]
+                if bool(mask.all()):
+                    values = flat
+                else:
+                    indices = (np.arange(n, dtype=np.int64)[:, None]
+                               * max_size
+                               + np.arange(max_size,
+                                           dtype=np.int64)[None, :])[mask]
+                    values = flat.take(pa.array(indices))
+                offsets = np.zeros(n + 1, dtype=np.int32)
+                np.cumsum(counts, out=offsets[1:])
+                return pa.ListArray.from_arrays(pa.array(offsets), values)
         elems = [self._statement_array(st, slot_path + (k,), as_element=True)
                  for k in range(max_size)]
         counts = counts_probe
@@ -1207,6 +1330,17 @@ def segment_table(batch: DecodedBatch,
     # fields in BOTH cases (CobolSchema.scala:99-103) — the reference binds
     # Spark Rows positionally, so that (reference) misalignment is parity;
     # columns here are therefore labeled positionally, exactly like rows.
+    def file_name_col():
+        # constant string column straight into Arrow buffers (native
+        # memcpy fill) — never n Python string objects
+        bufs = native.const_string_col(n, input_file_name)
+        if bufs is not None:
+            offsets, data = bufs
+            return pa.Array.from_buffers(
+                pa.string(), n,
+                [None, pa.py_buffer(offsets), pa.py_buffer(data)])
+        return pa.array([input_file_name] * n, type=pa.string())
+
     cols: List[object] = []
     if output_schema.generate_record_id:
         cols.append(pa.array(np.full(n, file_id, dtype=np.int32)))
@@ -1214,12 +1348,12 @@ def segment_table(batch: DecodedBatch,
                 else np.arange(n, dtype=np.int64))
         cols.append(pa.array(rids))
         if output_schema.input_file_name_field:
-            cols.append(pa.array([input_file_name] * n, type=pa.string()))
+            cols.append(file_name_col())
         cols.extend(seg_arrays())
     else:
         cols.extend(seg_arrays())
         if output_schema.input_file_name_field:
-            cols.append(pa.array([input_file_name] * n, type=pa.string()))
+            cols.append(file_name_col())
     cols.extend(arr for _, arr in builder.body_columns(output_schema.policy))
     if getattr(output_schema, "corrupt_record_field", ""):
         cols.append(pa.nulls(n, pa.string()) if corrupt_reasons is None

@@ -38,6 +38,7 @@ from ..copybook.datatypes import (
     Usage,
 )
 from .. import native
+from ..obs import context as obs_context
 from ..obs import fieldcost
 from ..ops import batch_np
 from ..profiling import annotate
@@ -336,6 +337,11 @@ class DecodedBatch:
         # when it runs after read_cobol returned (sequential to_arrow)
         # or on a thread pool that never activated the context
         self.field_costs = fieldcost.current()
+        # the read's fused-pass counters, captured the same way: lazy
+        # Arrow assembly / string transcode increment these after the
+        # obs context died (profiling.PassCounters; None outside a read)
+        ctx = obs_context.current()
+        self.pass_counts = ctx.pass_counts if ctx is not None else None
 
     # -- vectorized access -------------------------------------------------
 
@@ -549,6 +555,8 @@ class DecodedBatch:
                     col_masks=masks)
         if res is None:
             res = [None] * len(col_offs)
+        elif self.pass_counts is not None:
+            self.pass_counts.incr("string_transcode")
         i = 0
         for g in gs:
             self._arrow_str_cache[id(g)] = (group_masks[id(g)],
@@ -1051,8 +1059,8 @@ class ColumnarDecoder:
 
     def decode_raw(self, data, rec_offsets, rec_lengths,
                    start_offset: int = 0,
-                   segment_row_masks: Optional[Dict[str, np.ndarray]] = None
-                   ) -> DecodedBatch:
+                   segment_row_masks: Optional[Dict[str, np.ndarray]] = None,
+                   lazy_masked: bool = False) -> DecodedBatch:
         """Decode framed records in place from the file image: numeric
         groups read straight through the native raw kernels (no
         [batch, extent] pack copy — for wide records the pack costs as
@@ -1065,7 +1073,14 @@ class ColumnarDecoder:
         decodes ONLY that segment's rows (subset kernel + scatter);
         hidden rows come back invalid instead of as decoded garbage.
         On interleaved multisegment profiles (hierarchical) this skips
-        the majority of the numeric decode work."""
+        the majority of the numeric decode work.
+
+        `lazy_masked`: defer even the masked numeric groups. The fused
+        native Arrow assembly applies the same row masks in-kernel
+        (hidden rows emit null without being decoded), so Arrow
+        consumers skip both the subset gather and the Python scatter —
+        the decode-once multisegment path uses this instead of
+        splitting size-skewed profiles into per-segment decodes."""
         rec_lengths = np.asarray(rec_lengths, dtype=np.int64)
         extent_full = self.plan.max_extent
         lengths = np.minimum(rec_lengths - start_offset, extent_full)
@@ -1109,7 +1124,7 @@ class ColumnarDecoder:
             g_rows = n
             gmask = (None if g.codec in _STRING_CODECS
                      else self._group_segment_mask(g, segment_row_masks))
-            if gmask is None and self._lazy_numeric_ok(g):
+            if (gmask is None or lazy_masked) and self._lazy_numeric_ok(g):
                 # deferred like the string groups: the Arrow path emits
                 # these columns straight from the raw image through the
                 # fused native assembly; rows materialize planes lazily

@@ -270,13 +270,30 @@ class FileResult:
             # ascending positions (all-records decode-once batches, or a
             # filtered subset) are already in record order — no gather
             if len(pos) == 0 or bool(np.all(np.diff(pos) > 0)):
+                self._count_pass("take_elided")
                 return table
             return table.take(_record_order_indices(pos))
         table = pa.concat_tables(tables)
         # rows currently ordered [seg0 rows..., seg1 rows...]; invert to
-        # record order
+        # record order — unless the batches happen to tile the position
+        # space in globally ascending order (contiguous shard splits),
+        # where the concatenation IS record order and the full-table
+        # gather copy disappears
         pos = np.concatenate(order)
+        if len(pos) == 0 or bool(np.all(np.diff(pos) > 0)):
+            self._count_pass("take_elided")
+            return table
         return table.take(_record_order_indices(pos))
+
+    def _count_pass(self, name: str) -> None:
+        """Fold one fused-pass engagement into the owning read's
+        counters, through any batch's captured reference (to_arrow runs
+        after the read's obs context died)."""
+        for seg in self.segments:
+            pc = seg.batch.pass_counts
+            if pc is not None:
+                pc.incr(name)
+                return
 
 
 def _record_order_indices(pos: np.ndarray) -> np.ndarray:

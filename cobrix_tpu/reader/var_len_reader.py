@@ -23,7 +23,7 @@ import numpy as np
 from ..copybook.ast import Group, Primitive
 from ..copybook.copybook import Copybook
 from ..plan.cache import copybook_for_params, decoder_cache_for
-from ..obs.context import current as obs_current
+from ..obs.context import count_pass, current as obs_current
 from ..profiling import timed_stage
 from .columnar import ColumnarDecoder, decoder_for_segment
 from .extractors import (
@@ -831,6 +831,8 @@ class VarLenReader:
                        if stream.size() >= stream.true_size else 0)
         corrupt_reasons: dict = {}
         with timed_stage(stage_times, "frame"):
+            seg_field = resolve_segment_id_field(p, self.copybook)
+            seg_bytes = None
             if p.is_permissive:
                 from .recovery import rdw_scan_permissive
 
@@ -841,14 +843,29 @@ class VarLenReader:
                     ledger if ledger is not None else p.new_diagnostics(),
                     file_name=stream.input_file_name, base_offset=base)
             else:
-                offsets, lengths = native.rdw_scan(
-                    data, p.is_rdw_big_endian, adjustment, file_header,
-                    file_footer)
-            seg_field = resolve_segment_id_field(p, self.copybook)
+                fused = None
+                if seg_field is not None:
+                    # fused frame + segment-id gather: one native walk
+                    # emits the record table AND each record's id-field
+                    # bytes, replacing rdw_scan + a whole-file
+                    # pack_records re-walk (None = no native library)
+                    fused = native.rdw_scan_segids(
+                        data, p.is_rdw_big_endian,
+                        p.start_offset + seg_field.binary_properties.offset,
+                        seg_field.binary_properties.actual_size,
+                        adjustment, file_header, file_footer)
+                if fused is not None:
+                    offsets, lengths, seg_bytes = fused
+                    count_pass("fused_frame")
+                else:
+                    offsets, lengths = native.rdw_scan(
+                        data, p.is_rdw_big_endian, adjustment, file_header,
+                        file_footer)
             segment_ids: Optional[List[str]] = None
             if seg_field is not None:
                 segment_ids = self._segment_ids_vectorized(
-                    data, offsets, lengths, seg_field)
+                    data, offsets, lengths, seg_field,
+                    field_bytes=seg_bytes)
         obs = obs_current()
         if obs is not None and obs.metrics is not None and len(lengths):
             # record-length distribution (one vectorized bucket count per
@@ -857,24 +874,30 @@ class VarLenReader:
         return data, base, offsets, lengths, segment_ids, corrupt_reasons
 
     def _segment_ids_vectorized(self, data, offsets, lengths,
-                                seg_field: Primitive) -> SegmentIds:
+                                seg_field: Primitive,
+                                field_bytes=None) -> SegmentIds:
         """Per-record segment ids (dictionary-coded): gather just the id
         field's bytes, decode each *unique* byte pattern once (the scalar
-        oracle) — the columnar analogue of getSegmentId per record."""
+        oracle) — the columnar analogue of getSegmentId per record.
+        `field_bytes`: the [n, width] id-field byte matrix when the fused
+        framing scan already gathered it (zero-padded past short records,
+        pack_records parity); None gathers here."""
         from .. import native
 
         start = self.params.start_offset
         seg_off = seg_field.binary_properties.offset
         seg_w = seg_field.binary_properties.actual_size
         extent = start + seg_off + seg_w
-        packed = native.pack_records(data, offsets, lengths, extent)
-        field_bytes = packed[:, start + seg_off:]
+        if field_bytes is None:
+            packed = native.pack_records(data, offsets, lengths, extent)
+            field_bytes = packed[:, start + seg_off:]
         short = lengths < extent  # id field truncated -> decode actual bytes
         options = DecodeOptions.from_copybook(self.copybook)
         out = decode_segment_id_bytes(field_bytes, seg_field, options)
         for i in np.nonzero(short)[0]:
-            chunk = bytes(packed[i, start + seg_off: int(lengths[i])])
-            value = options.decode(seg_field.dtype, chunk)
+            avail = max(0, int(lengths[i]) - (start + seg_off))
+            value = options.decode(seg_field.dtype,
+                                   bytes(field_bytes[i, :avail]))
             out.replace_at(int(i), "" if value is None else str(value).strip())
         return out
 
@@ -921,41 +944,38 @@ class VarLenReader:
         # Decode ONCE over every kept record with the full (all-redefines)
         # plan: redefines share byte offsets, so inactive rows decode
         # garbage that a per-redefine struct-validity mask hides — and the
-        # per-segment split + interleave gather disappears entirely. The
-        # split path remains for size-skewed profiles (e.g. exp3's 16KB 'C'
-        # vs 64B 'P' records), where running the wide plan's column checks
-        # over every narrow record would dominate.
+        # per-segment split + interleave gather disappears entirely.
+        # Size-skewed profiles (e.g. exp3's 16KB 'C' vs 64B 'P' records)
+        # come through here too: the segment row masks reach the decode
+        # (masked groups subset-decode or defer into the fused native
+        # assembly, which skips hidden rows in-kernel), so the wide
+        # plan's columns never run over the narrow records' bytes.
         if segment_ids is not None and self.segment_redefine_map:
             full = self._decoder_for_segment("", backend)
-            extent = full.plan.max_extent
-            kept_lengths = lengths[kept]
-            size_skewed = (extent > 512 and len(kept_lengths) > 0
-                           and float((kept_lengths
-                                      < extent // 4).mean()) > 0.5)
-            if not size_skewed:
-                decoded = full.decode_raw(
-                    data, offsets[kept], lengths[kept], start_offset=start)
-                active_of_uniq = segment_ids.map_uniq(
-                    self.segment_redefine_map)
-                distinct = sorted(set(active_of_uniq))
-                a_idx = {a: j for j, a in enumerate(distinct)}
-                per_uniq = np.asarray([a_idx[a] for a in active_of_uniq],
-                                      dtype=np.int32)
-                row_act = per_uniq[segment_ids.codes[kept]]
-                masks = {a.upper(): row_act == j
-                         for a, j in a_idx.items() if a}
-                kept64 = kept.astype(np.int64)
-                result.segments.append(SegmentBatch(
-                    decoded, None, kept64, start_record_id + kept64,
-                    seg_level_ids=(
-                        level_ids_per_record
-                        if level_ids_per_record is not None
-                        and len(kept) == n
-                        else level_ids_per_record.take(kept)
-                        if level_ids_per_record is not None else None),
-                    redefine_masks=masks,
-                    row_actives=SegmentIds(row_act, distinct)))
-                return
+            active_of_uniq = segment_ids.map_uniq(
+                self.segment_redefine_map)
+            distinct = sorted(set(active_of_uniq))
+            a_idx = {a: j for j, a in enumerate(distinct)}
+            per_uniq = np.asarray([a_idx[a] for a in active_of_uniq],
+                                  dtype=np.int32)
+            row_act = per_uniq[segment_ids.codes[kept]]
+            masks = {a.upper(): row_act == j
+                     for a, j in a_idx.items() if a}
+            decoded = full.decode_raw(
+                data, offsets[kept], lengths[kept], start_offset=start,
+                segment_row_masks=masks, lazy_masked=True)
+            kept64 = kept.astype(np.int64)
+            result.segments.append(SegmentBatch(
+                decoded, None, kept64, start_record_id + kept64,
+                seg_level_ids=(
+                    level_ids_per_record
+                    if level_ids_per_record is not None
+                    and len(kept) == n
+                    else level_ids_per_record.take(kept)
+                    if level_ids_per_record is not None else None),
+                redefine_masks=masks,
+                row_actives=SegmentIds(row_act, distinct)))
+            return
 
         # per-active-segment split: map segment ids -> active redefines per
         # UNIQUE id; same-active ids merge into one integer-code mask

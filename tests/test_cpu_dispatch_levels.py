@@ -1,0 +1,249 @@
+"""Forced CPU dispatch-level parity: scalar / SSE4.2 / AVX2 kernels.
+
+The native library picks its widest usable SIMD tier at load time and
+`COBRIX_FORCE_CPU_LEVEL` (or `native.set_cpu_level`) pins it down. Every
+kernel family must be byte-identical at every dispatch level — a
+lane-boundary bug in one tier must fail THIS matrix, never surface as a
+data difference between machines. The transcode cases deliberately sit
+on the AVX2 kernel's 32-byte chunk and 16-byte minimum-width boundaries
+(framing.cpp kAvx2TranscodeMinWidth), and the fuzz oracle is Python's
+own cp037 codec, independent of the repo's tables.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cobrix_tpu import native
+from cobrix_tpu.copybook.datatypes import TrimPolicy
+from cobrix_tpu.encoding.codepages import (code_page_lut_u16,
+                                           get_code_page_table)
+from cobrix_tpu.ops import batch_np
+from cobrix_tpu.ops.scalar_decoders import _trim
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable")
+
+LEVELS = ["scalar", "sse", "avx2"]
+
+_TRIM_MODES = {TrimPolicy.NONE: native.TRIM_NONE,
+               TrimPolicy.BOTH: native.TRIM_BOTH,
+               TrimPolicy.LEFT: native.TRIM_LEFT,
+               TrimPolicy.RIGHT: native.TRIM_RIGHT}
+
+
+@pytest.fixture(autouse=True)
+def _restore_dispatch_level():
+    yield
+    native.set_cpu_level("avx2")  # the library clamps to the hardware max
+
+
+def _force(level: str) -> None:
+    if not native.set_cpu_level(level):
+        pytest.skip("native library unavailable")
+    want = {"scalar": 0, "sse": 1, "avx2": 2}[level]
+    if native.simd_level() != want:
+        pytest.skip(f"hardware lacks {level} tier")
+
+
+# -- transcode + trim -------------------------------------------------------
+
+# widths straddling the AVX2 lane boundaries: below the 16-byte kernel
+# minimum, exactly at it, around one 32-byte chunk, and multi-chunk
+_WIDTHS = [1, 7, 15, 16, 17, 31, 32, 33, 48, 64]
+
+_SP = 0x40        # EBCDIC space
+_CASES = [
+    ("all_space", lambda w: bytes([_SP]) * w),
+    ("empty_after_left_pad", lambda w: bytes([_SP]) * (w - 1) + b"\xc1"),
+    ("empty_after_right_pad", lambda w: b"\xc1" + bytes([_SP]) * (w - 1)),
+    ("ascii_text", lambda w: (b"\xc8\xc5\xd3\xd3\xd6" * w)[:w]),
+    ("interior_spaces", lambda w: (b"\xc1" + bytes([_SP]) + b"\xc2"
+                                   + bytes([_SP]) * w)[:w]),
+    # non-ASCII EBCDIC: cp037 0x9A/0xB0/0x4A map outside 7-bit ASCII, so
+    # the shuffle kernel's wide-byte bail must engage and re-route
+    ("non_ascii", lambda w: (b"\x9a\xb0\x4a\xc1" * w)[:w]),
+    ("control_bytes", lambda w: (b"\x00\x05\x1f" + b"\xc4" * w)[:w]),
+    ("high_bytes", lambda w: (b"\xff\xfe\xc9" * w)[:w]),
+]
+
+
+def _packed_batch(width: int):
+    """[n, width] batch: one column, one crafted row per case."""
+    return np.frombuffer(
+        b"".join(make(width) for _, make in _CASES),
+        dtype=np.uint8).reshape(len(_CASES), width).copy()
+
+
+def _native_strings(batch, width, code_page, policy, n):
+    lut = code_page_lut_u16(code_page)
+    res = native.string_cols_arrow_packed(
+        batch, np.zeros(1, dtype=np.int64),
+        np.asarray([width], dtype=np.int64), lut, _TRIM_MODES[policy])
+    assert res is not None and res[0] is not None
+    offsets, data = res[0]
+    blob = data.tobytes().decode("utf-8")
+    # offsets index UTF-8 BYTES; slice bytes, then decode per row
+    return [data[offsets[i]:offsets[i + 1]].tobytes().decode("utf-8")
+            for i in range(n)], blob
+
+
+@pytest.mark.parametrize("level", LEVELS)
+@pytest.mark.parametrize("code_page", ["common", "cp037", "cp875"])
+@pytest.mark.parametrize("policy", [TrimPolicy.BOTH, TrimPolicy.RIGHT,
+                                    TrimPolicy.LEFT, TrimPolicy.NONE])
+def test_transcode_trim_parity(level, code_page, policy):
+    _force(level)
+    table = get_code_page_table(code_page)
+    for width in _WIDTHS:
+        batch = _packed_batch(width)
+        got, _ = _native_strings(batch, width, code_page, policy,
+                                 len(_CASES))
+        for (case, _), g, row in zip(_CASES, got, batch):
+            want = _trim("".join(table[b] for b in row), policy)
+            assert g == want, (
+                f"{case} w={width} cp={code_page} {policy}: "
+                f"{g!r} != {want!r}")
+
+
+@pytest.mark.parametrize("level", LEVELS)
+def test_transcode_zero_length_and_masked(level):
+    _force(level)
+    lut = code_page_lut_u16("common")
+    n = 37  # odd count: exercises partial final AVX2 chunk
+    batch = np.full((n, 32), _SP, dtype=np.uint8)
+    batch[::3, 5] = 0xC1
+    mask = np.zeros(n, dtype=bool)
+    mask[::2] = True
+    res = native.string_cols_arrow_packed(
+        batch, np.zeros(1, dtype=np.int64),
+        np.asarray([32], dtype=np.int64), lut, native.TRIM_BOTH,
+        col_masks=[mask])
+    assert res is not None and res[0] is not None
+    offsets, data = res[0]
+    table = get_code_page_table("common")
+    for i in range(n):
+        got = data[offsets[i]:offsets[i + 1]].tobytes().decode("utf-8")
+        want = ("" if not mask[i]
+                else _trim("".join(table[b] for b in batch[i]),
+                           TrimPolicy.BOTH))
+        assert got == want, f"row {i}: {got!r} != {want!r}"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("level", LEVELS)
+def test_transcode_fuzz_vs_codecs(level):
+    """Random fields checked against Python's OWN cp037 codec — an
+    oracle independent of the repo's code-page tables. The draw pool
+    excludes the bytes where the repo table intentionally diverges from
+    the standard codec (control bytes -> space, 0x6A, 0xFF: the
+    reference implementation's convention)."""
+    _force(level)
+    rng = np.random.default_rng(level == "sse" and 17 or 29)
+    # candidate bytes where repo table == codec, split by UTF-8 width:
+    # the native buffers are sized for all-ASCII output, so each row
+    # carries at most 2 two-byte chars, paid for by 4 trimmed trailing
+    # spaces — the column can never outgrow its buffer and bail
+    cand = [b for b in range(0x41, 0xFF) if b != 0x6A]
+    ref = {b: bytes([b]).decode("cp037") for b in cand}
+    ascii_pool = np.asarray([b for b in cand if len(ref[b]) == 1
+                             and ord(ref[b]) < 0x80], dtype=np.uint8)
+    wide_pool = np.asarray([b for b in cand
+                            if len(ref[b].encode("utf-8")) == 2],
+                           dtype=np.uint8)
+    for width in [16, 30, 32, 33, 61, 64, 128]:
+        n = 512
+        batch = ascii_pool[rng.integers(0, len(ascii_pool),
+                                        size=(n, width))]
+        wide_at = rng.integers(0, width - 4, size=(n, 2))
+        wide_val = wide_pool[rng.integers(0, len(wide_pool), size=(n, 2))]
+        batch[np.arange(n)[:, None], wide_at] = wide_val
+        batch[:, -4:] = _SP
+        batch[rng.random(n) < 0.4, :3] = _SP
+        for policy in [TrimPolicy.BOTH, TrimPolicy.RIGHT]:
+            got, _ = _native_strings(batch, width, "cp037", policy, n)
+            for i in range(n):
+                want = _trim(batch[i].tobytes().decode("cp037"), policy)
+                assert got[i] == want, (
+                    f"w={width} row={i} {policy}: {got[i]!r} != {want!r}")
+
+
+# -- numeric kernels at every tier ------------------------------------------
+
+@pytest.mark.parametrize("level", LEVELS)
+@pytest.mark.parametrize("width", [2, 4, 8])
+@pytest.mark.parametrize("signed", [False, True])
+def test_binary_parity_levels(level, width, signed):
+    _force(level)
+    rng = np.random.default_rng(width * 10 + signed)
+    batch = rng.integers(0, 256, size=(96, 64), dtype=np.uint8)
+    offsets = np.arange(0, 48, width, dtype=np.int64)
+    res = native.decode_binary_cols(batch, offsets, width, signed, True)
+    slab = batch[:, offsets[:, None] + np.arange(width)[None, :]]
+    exp_v, exp_ok = batch_np.decode_binary(slab, signed, True)
+    np.testing.assert_array_equal(res[0], exp_v)
+    np.testing.assert_array_equal(res[1], exp_ok)
+
+
+@pytest.mark.parametrize("level", LEVELS)
+@pytest.mark.parametrize("width", [1, 4, 10])
+def test_bcd_parity_levels(level, width):
+    _force(level)
+    rng = np.random.default_rng(width)
+    pool = np.asarray([0x00, 0x0C, 0x0D, 0x0F, 0x1C, 0x99, 0xC0, 0xF9],
+                      dtype=np.uint8)
+    batch = pool[rng.integers(0, len(pool), size=(96, 64))]
+    offsets = np.arange(0, 50, width, dtype=np.int64)
+    res = native.decode_bcd_cols(batch, offsets, width)
+    slab = batch[:, offsets[:, None] + np.arange(width)[None, :]]
+    exp_v, exp_ok = batch_np.decode_bcd(slab)
+    np.testing.assert_array_equal(res[0], exp_v)
+    np.testing.assert_array_equal(res[1], exp_ok)
+
+
+# -- full-stack read parity at every tier -----------------------------------
+
+@pytest.mark.parametrize("level", ["scalar", "sse"])
+def test_reader_parity_forced_levels(level, tmp_path):
+    """A multisegment read at a forced lower tier must produce the exact
+    table the full-width build produces (the tiers share one contract,
+    not merely similar output)."""
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing import generators as g
+
+    path = tmp_path / "exp3.dat"
+    path.write_bytes(g.generate_exp3(220, seed=5))
+    kw = dict(copybook_contents=g.EXP3_COPYBOOK, is_record_sequence="true",
+              segment_field="SEGMENT-ID",
+              redefine_segment_id_map="STATIC-DETAILS => C",
+              redefine_segment_id_map_1="CONTACTS => P")
+    baseline = read_cobol(str(path), **kw).to_arrow()
+    _force(level)
+    forced = read_cobol(str(path), **kw).to_arrow()
+    assert forced.equals(baseline)
+    assert forced.schema.metadata == baseline.schema.metadata
+
+
+# -- the env knob itself ----------------------------------------------------
+
+def _subprocess_level(env_value):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("COBRIX_FORCE_CPU_LEVEL", None)
+    if env_value is not None:
+        env["COBRIX_FORCE_CPU_LEVEL"] = env_value
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from cobrix_tpu import native; print(native.simd_level())"],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    return int(out.stdout.strip())
+
+
+def test_force_cpu_level_env_knob():
+    assert _subprocess_level("scalar") == 0
+    if native.simd_level() >= 1:
+        assert _subprocess_level("sse4.2") == 1
+    # unknown values are ignored (warn + full-width), never fatal
+    assert _subprocess_level("bogus") == _subprocess_level(None)

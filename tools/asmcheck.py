@@ -93,26 +93,37 @@ def _profiles(records: int | None, mb: float):
     child_opts = {f"segment-children:{i}": f"{parent} => {child}"
                   for i, (child, parent) in enumerate(
                       g.HIERARCHICAL_PARENT_MAP.items())}
+    # each profile names the fused native passes a healthy build MUST
+    # engage (ReadMetrics native_passes counters) — a silent fallback to
+    # the multi-pass shape then fails the check instead of reading as a
+    # slowdown. Asserted on the native-ON read of quick mode only
+    # (multihost workers count in their own processes).
     return [
         ("exp1_fixed", g.generate_exp1(n1, seed=7).tobytes(),
-         dict(copybook_contents=g.EXP1_COPYBOOK)),
+         dict(copybook_contents=g.EXP1_COPYBOOK),
+         {"fused_assembly", "string_transcode", "take_elided"}),
         ("exp3_multiseg", g.generate_exp3(n3, seed=7),
          dict(copybook_contents=g.EXP3_COPYBOOK,
               is_record_sequence="true", segment_field="SEGMENT-ID",
               redefine_segment_id_map="STATIC-DETAILS => C",
-              redefine_segment_id_map_1="CONTACTS => P")),
+              redefine_segment_id_map_1="CONTACTS => P"),
+         {"fused_frame", "fused_assembly", "string_transcode",
+          "take_elided"}),
         ("exp3_pruned_occurs", g.generate_exp3(n3, seed=7),
          dict(copybook_contents=g.EXP3_COPYBOOK,
               is_record_sequence="true", segment_field="SEGMENT-ID",
               redefine_segment_id_map="STATIC-DETAILS => C",
               redefine_segment_id_map_1="CONTACTS => P",
-              select="SEGMENT-ID,COMPANY-ID,COMPANY-NAME")),
+              select="SEGMENT-ID,COMPANY-ID,COMPANY-NAME"),
+         {"fused_frame", "string_transcode", "take_elided"}),
         ("hierarchical", g.generate_hierarchical(nh, seed=7),
          dict(copybook_contents=g.HIERARCHICAL_COPYBOOK,
               is_record_sequence="true", segment_field="SEGMENT-ID",
-              **seg_opts, **child_opts)),
+              **seg_opts, **child_opts),
+         {"fused_frame"}),
         ("decimals", _decimals_data(records or 1500),
-         dict(copybook_contents=DECIMALS_COPYBOOK)),
+         dict(copybook_contents=DECIMALS_COPYBOOK),
+         {"fused_assembly", "string_transcode", "take_elided"}),
     ]
 
 
@@ -126,10 +137,15 @@ def _snapshot(path: str, kw: dict):
     diag = out.diagnostics.as_dict() if out.diagnostics is not None else None
     # multihost results are Arrow-backed by contract (no Python rows)
     rows = None if "hosts" in kw else out.to_rows()
-    return rows, table, diag, dt
+    # counters accumulate through to_arrow's captured references, so the
+    # snapshot is taken AFTER the Arrow build
+    passes = (out.metrics.pass_counts.as_dict()
+              if getattr(out, "metrics", None) is not None else {})
+    return rows, table, diag, dt, passes
 
 
-def check_profile(name: str, data: bytes, kw: dict) -> dict:
+def check_profile(name: str, data: bytes, kw: dict,
+                  expect_passes=None) -> dict:
     from cobrix_tpu import native
 
     if not native.available():
@@ -140,10 +156,10 @@ def check_profile(name: str, data: bytes, kw: dict) -> dict:
         f.write(data)
         path = f.name
     try:
-        rows_n, table_n, diag_n, dt_n = _snapshot(path, kw)
+        rows_n, table_n, diag_n, dt_n, passes_n = _snapshot(path, kw)
         native.set_disabled(True)
         try:
-            rows_p, table_p, diag_p, dt_p = _snapshot(path, kw)
+            rows_p, table_p, diag_p, dt_p, _ = _snapshot(path, kw)
         finally:
             native.set_disabled(False)
     finally:
@@ -156,21 +172,31 @@ def check_profile(name: str, data: bytes, kw: dict) -> dict:
         raise AssertionError(f"{name}: schema metadata mismatch")
     if diag_n != diag_p:
         raise AssertionError(f"{name}: diagnostics ledger mismatch")
+    if expect_passes:
+        missing = sorted(p for p in expect_passes
+                         if not passes_n.get(p))
+        if missing:
+            raise AssertionError(
+                f"{name}: fused native pass(es) did not engage: "
+                f"{missing} (counters: {passes_n or '{}'}) — the "
+                f"multi-pass fallback shape is a failure here, not a "
+                f"slowdown")
     return {"rows": table_n.num_rows, "native_s": round(dt_n, 3),
-            "python_s": round(dt_p, 3)}
+            "python_s": round(dt_p, 3), "passes": passes_n}
 
 
 def run_quick(records: int | None, mb: float) -> int:
     failures = 0
-    for name, data, kw in _profiles(records, mb):
+    for name, data, kw, expect in _profiles(records, mb):
         try:
-            stats = check_profile(name, data, kw)
+            stats = check_profile(name, data, kw, expect_passes=expect)
         except Exception as exc:
             failures += 1
             print(f"FAIL {name}: {exc}")
             continue
         print(f"ok   {name:<20} rows={stats['rows']:<8} "
-              f"native={stats['native_s']}s python={stats['python_s']}s")
+              f"native={stats['native_s']}s python={stats['python_s']}s "
+              f"passes={','.join(sorted(stats['passes'])) or '-'}")
     return failures
 
 
@@ -180,10 +206,12 @@ def run_sweep(records: int | None, mb: float) -> int:
     modes = [("pipelined", dict(pipeline_workers="2",
                                 chunk_size_mb="0.5")),
              ("multihost", dict(hosts="2"))]
-    for name, data, kw in _profiles(records or 400, mb):
+    for name, data, kw, _expect in _profiles(records or 400, mb):
         if name == "hierarchical":
             continue  # single-shard layouts: modes covered by tests
         for mode, extra in modes:
+            # no expect_passes: multihost workers count in their own
+            # processes, and the pipelined chunking changes pass shapes
             try:
                 stats = check_profile(f"{name}/{mode}",
                                       data, dict(kw, **extra))
