@@ -1393,6 +1393,64 @@ def run_serve_fleet_metric(mb_target: float) -> dict:
     return result
 
 
+def run_roundtrip_side_metric(mb_target: float) -> dict:
+    """exp_roundtrip: the write half (cobrix_tpu.encode) measured beside
+    the read half it must mirror. Three numbers: encode MB/s (the
+    vectorized BatchEncoder streaming a >=1M-record synthetic TXN
+    corpus to disk, testing/corpus.py), decode MB/s of that same corpus
+    end to end (read_cobol -> Arrow, the exp3-style e2e view of
+    encoder-built data), and `roundtrip_parity` — decode->re-encode
+    byte equality on a sample file, which tools/benchgate.py gates as a
+    HARD failure with no history needed: fast encode of wrong bytes is
+    worthless."""
+    import shutil
+    import tempfile
+
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing import corpus
+
+    n_records = max(1_000_000, int(mb_target * 1024 * 1024) // 35)
+    tmpdir = tempfile.mkdtemp(prefix="bench_rt_")
+    path = os.path.join(tmpdir, "txn.dat")
+    try:
+        t0 = time.perf_counter()
+        info = corpus.write_fixed_corpus(path, n_records, seed=100)
+        encode_s = time.perf_counter() - t0
+        mb = info["bytes"] / (1024 * 1024)
+        times = []
+        rows = 0
+        for _ in range(2):
+            t0 = time.perf_counter()
+            table = read_cobol(path,
+                               **corpus.fixed_read_options()).to_arrow()
+            times.append(time.perf_counter() - t0)
+            rows = table.num_rows
+        # parity: a separate small corpus re-encoded byte-for-byte (the
+        # record-at-a-time write path; full-corpus parity is rtcheck's
+        # job, here it is a cheap in-run guard)
+        sample = 20_000
+        spath = os.path.join(tmpdir, "sample.dat")
+        corpus.write_fixed_corpus(spath, sample, seed=100)
+        with open(spath, "rb") as f:
+            sample_bytes = f.read()
+        out = read_cobol(spath, **corpus.fixed_read_options())
+        parity = out.to_ebcdic(framing="fixed") == sample_bytes
+        result = {
+            "metric": "exp_roundtrip_encode",
+            "value": round(mb / encode_s, 1),
+            "unit": "MB/s",
+            "records": rows,
+            "mb": round(mb, 1),
+            "decode_mbps": round(mb / min(times), 1),
+            "roundtrip_parity": bool(parity),
+            "parity_sample_records": sample,
+        }
+        _log(f"side metric exp_roundtrip: {result}")
+        return result
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def run_sink_side_metric(mb_target: float) -> dict:
     """exp_sink: the transactional lakehouse sink (cobrix_tpu.sink) vs
     bare streaming decode, same exp1 input tailed from a static file.
@@ -1514,6 +1572,13 @@ def _side_metrics(mb_target: float) -> dict:
         _log(f"exp_pushdown side metric failed: {exc}")
         side["exp_pushdown"] = {"metric": "exp_pushdown_to_arrow",
                                 "error": str(exc)[:400]}
+    try:
+        side["exp_roundtrip"] = run_roundtrip_side_metric(
+            min(mb_target, 40.0))
+    except Exception as exc:
+        _log(f"exp_roundtrip side metric failed: {exc}")
+        side["exp_roundtrip"] = {"metric": "exp_roundtrip_encode",
+                                 "error": str(exc)[:400]}
     return side
 
 

@@ -630,6 +630,58 @@ class CobolData:
         sink.commit_table(self.to_arrow(), source="read_cobol")
         return sink
 
+    def to_ebcdic(self, path: Optional[str] = None, *,
+                  framing: str = "fixed",
+                  rdw_big_endian: bool = False,
+                  rdw_adjustment: int = 0,
+                  rdw_part_of_record_length: bool = False,
+                  variable_size_occurs: bool = False,
+                  truncate: bool = True,
+                  fill_byte: Optional[int] = None):
+        """Encode the decoded records back to mainframe binary (the write
+        half of the bridge: the sink emits Parquet, this emits
+        fixed-length or RDW-framed EBCDIC/ASCII consumable by the same
+        copybook). Generated columns (File_Id/Record_Id/Seg_Id*/input
+        file name/corrupt-record) are stripped; the data columns are
+        re-encoded through `cobrix_tpu.encode` against this read's
+        copybook. Returns the bytes, or writes to `path` and returns
+        None."""
+        from .encode.encoder import RecordEncoder
+
+        schema = self.output_schema
+        enc = RecordEncoder(schema.copybook, policy=schema.policy,
+                            variable_size_occurs=variable_size_occurs,
+                            fill_byte=fill_byte)
+        nseg = schema.generate_seg_id_field_count
+        lead = ((3 + nseg) if (schema.generate_record_id
+                               and schema.input_file_name_field)
+                else (2 + nseg) if schema.generate_record_id
+                else (nseg + 1) if schema.input_file_name_field
+                else nseg)
+        tail = -1 if schema.corrupt_record_field else None
+
+        def bodies():
+            for row in self.to_rows():
+                yield row[lead:tail]
+
+        import io as _io
+        sink = _io.BytesIO() if path is None else open(path, "wb")
+        try:
+            if framing == "fixed":
+                enc.encode_fixed(bodies(), sink)
+            elif framing == "rdw":
+                enc.encode_rdw(
+                    bodies(), sink, big_endian=rdw_big_endian,
+                    adjustment=rdw_adjustment,
+                    part_of_record_length=rdw_part_of_record_length,
+                    truncate=truncate)
+            else:
+                raise ValueError(f"Unknown framing '{framing}' (fixed|rdw)")
+        finally:
+            if path is not None:
+                sink.close()
+        return sink.getvalue() if path is None else None
+
     def to_arrow(self):
         """pyarrow Table with schema-declared types, built from the kernel
         outputs without row materialization (the reference must feed Spark

@@ -1,0 +1,14 @@
+"""Copybook-driven EBCDIC/ASCII encoding: the write half of the bridge.
+
+`encode_field` inverts the scalar decode oracle field-by-field;
+`RecordEncoder`/`encode_file` invert the record extractors (fixed and
+RDW/VRL framing, multisegment redefines, OCCURS incl. DEPENDING ON);
+`BatchEncoder` is the vectorized column path feeding the synthetic load
+factory and the round-trip bench.
+"""
+from .fields import EncodeError, encode_field
+from .encoder import RecordEncoder, encode_file
+from .kernels import BatchEncoder
+
+__all__ = ["EncodeError", "encode_field", "RecordEncoder", "encode_file",
+           "BatchEncoder"]

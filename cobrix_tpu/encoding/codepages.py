@@ -86,6 +86,7 @@ def register_code_page(name: str, table: str) -> None:
         raise ValueError("A code page table must have exactly 256 entries")
     _CUSTOM[name] = table
     # a re-registration under the same name must not serve a stale LUT
+    _ENCODE_TABLES.pop(name, None)
     from ..plan.cache import invalidate_code_page
 
     invalidate_code_page(name)
@@ -146,6 +147,35 @@ def get_code_page_table(name: str) -> str:
         raise ValueError(
             f"The ebcdic code page '{name}' is not one of the builtin EBCDIC code "
             f"pages: {sorted(_TABLES)} (or a registered custom one)") from None
+
+
+_ENCODE_TABLES: Dict[str, Dict[str, int]] = {}
+
+
+def get_code_page_encode_table(name: str) -> Dict[str, int]:
+    """Unicode char -> EBCDIC byte, inverted from the SAME decode table so
+    encode and decode cannot drift. When several bytes decode to the same
+    char the lowest byte wins (deterministic), except the canonical EBCDIC
+    space 0x40 which is preferred over control-range aliases so encoded
+    text stays recognizably EBCDIC."""
+    cached = _ENCODE_TABLES.get(name)
+    if cached is not None:
+        return cached
+    table = get_code_page_table(name)
+    inv: Dict[str, int] = {}
+    for byte in range(255, -1, -1):  # reversed: lowest byte wins the dict
+        inv[table[byte]] = byte
+    if table[0x40] == " ":
+        inv[" "] = 0x40
+    _ENCODE_TABLES[name] = inv
+    return inv
+
+
+def code_page_encode_str_table(name: str) -> Dict[int, str]:
+    """str.translate mapping (ord(char) -> latin-1 char of the EBCDIC byte)
+    for vectorized whole-string encoding in the batch kernels."""
+    return {ord(ch): chr(b)
+            for ch, b in get_code_page_encode_table(name).items()}
 
 
 def code_page_lut_u16(name: str) -> np.ndarray:

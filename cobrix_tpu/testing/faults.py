@@ -99,6 +99,87 @@ def every_structural_truncation(data: bytes, big_endian: bool = False
     return out
 
 
+# -- encoder-aware record corruption --------------------------------------
+#
+# The injectors above damage FRAMING (headers, tails, splices). The
+# helpers below damage the *content* of one record in ways only a
+# decoder notices — an invalid packed sign nibble, a non-digit BCD
+# nibble, a segment id no redefine maps — plus the two framing flavors
+# a per-record corruptor needs (RDW length damage, mid-record torn
+# write). Each damage class has a SPECIFIC observable diagnostic, which
+# tests/test_fault_tolerance.py asserts per kind:
+#
+#   sign-nibble   the damaged COMP-3 field decodes to None (0x0A is not
+#                 a sign), neighbors intact;
+#   packed-digit  same field-level None (a nibble >= 0x0A is not a
+#                 digit);
+#   rdw-length    zeroed header => "zero-length RDW header" resync
+#                 ledger entry; oversized => clamped-tail truncation;
+#   segment-id    no redefine branch matches => every segment column
+#                 of the row is None;
+#   torn-write    the record's tail is lost mid-field => permissive
+#                 nulls the tail and ledgers a truncation.
+
+CORRUPT_RECORD_KINDS = ("sign-nibble", "packed-digit", "rdw-length",
+                        "segment-id", "torn-write")
+
+
+def field_site(copybook, field_name: str):
+    """(byte_offset, byte_size) of a named primitive inside one record
+    — the encoder-aware aim point for `corrupt_record`. Accepts
+    copybook text or a parsed `Copybook`."""
+    from ..copybook.ast import transform_identifier
+    from ..copybook.copybook import parse_copybook
+
+    if isinstance(copybook, str):
+        copybook = parse_copybook(copybook)
+    want = transform_identifier(field_name)
+    for st in copybook.ast.walk_primitives():
+        if st.name == want:
+            return (st.binary_properties.offset,
+                    st.binary_properties.data_size)
+    raise KeyError(f"no primitive named {field_name!r} in copybook")
+
+
+def corrupt_record(record: bytes, kind: str, *, site=None,
+                   header: bool = False, big_endian: bool = False,
+                   seed: int = 0) -> bytes:
+    """Damage ONE record's bytes in an encoder-aware way and return the
+    corrupted record. `site` is the (offset, size) of the targeted field
+    *within the record body* (from `field_site`); `header=True` means
+    `record` starts with its own 4-byte RDW (sites shift by 4, and
+    'rdw-length' is applicable). `kind` is one of CORRUPT_RECORD_KINDS.
+    """
+    out = bytearray(record)
+    base = 4 if header else 0
+    if kind == "sign-nibble":
+        off, size = site
+        pos = base + off + size - 1  # sign lives in the final nibble
+        out[pos] = (out[pos] & 0xF0) | 0x0A  # 0xA: not C/D/F
+    elif kind == "packed-digit":
+        off, size = site
+        pos = base + off  # first digit byte
+        out[pos] = 0xBB   # nibbles 0xB: not decimal digits
+    elif kind == "rdw-length":
+        if not header:
+            raise ValueError("rdw-length damage needs header=True "
+                             "(the record must carry its own RDW)")
+        out[0:4] = b"\x00\x00\x00\x00" if seed % 2 == 0 else (
+            b"\xff\xff\x00\x00" if big_endian else b"\x00\x00\xff\xff")
+    elif kind == "segment-id":
+        off, size = site
+        # 0x5A..: EBCDIC punctuation — never a mapped segment id value
+        for i in range(size):
+            out[base + off + i] = 0x5A
+    elif kind == "torn-write":
+        keep = base + max(1, (len(record) - base) * 2 // 3)
+        return bytes(out[:keep])
+    else:
+        raise ValueError(f"unknown corruption kind {kind!r}; one of "
+                         f"{CORRUPT_RECORD_KINDS}")
+    return bytes(out)
+
+
 class FlakySource(ByteRangeSource):
     """A ByteRangeSource that fails its first `fail_reads` read() calls
     (raising IOError), then recovers — the transient-storage profile the

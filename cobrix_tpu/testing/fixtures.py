@@ -1,0 +1,208 @@
+"""Encoder-built stand-ins for the reference golden dataset.
+
+The parity test matrix (tests/test_arrow_out.py, test_select_projection,
+parts of test_api / test_ported_specs) historically skipped wholesale on
+machines without the upstream golden dataset at /root/reference/data.
+Those tests compare two *independent decode paths against each other*
+(fast columnar vs object oracle, full read vs projection, numpy vs jax),
+so they don't actually need the upstream bytes — any decodable dataset
+with the right shape exercises them. This module rebuilds every testN
+dataset whose copybook is expressible THROUGH the encoder
+(cobrix_tpu.encode), and tests/util.py points REFERENCE_DATA here when
+the real dataset is absent.
+
+Value-golden artifacts (testN_expected/*.txt, *_schema.json) are
+deliberately NOT synthesized: tests asserting upstream values keep
+skipping via the read_copybook/read_binary/read_golden_lines helpers,
+which stay pinned to the real dataset.
+
+The set is built once per machine into a versioned temp directory and
+reused across runs (a marker file makes the build atomic); bump
+FIXTURE_VERSION when changing any layout below.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from decimal import Decimal
+from typing import Optional
+
+FIXTURE_VERSION = 1
+_MARKER = ".complete"
+
+
+TEST1_COPYBOOK = """\
+       01  RECORD.
+           05  ID            PIC 9(4)  COMP.
+           05  COMPANY-NAME  PIC X(15).
+           05  AMOUNT        PIC S9(7)V99 COMP-3.
+           05  COUNT-NUM     PIC 9(5).
+           05  RATIO         COMP-2.
+           05  NOTE          PIC X(10).
+"""
+
+TEST3_COPYBOOK = """\
+       01  REC.
+           05  FIRST-STR   PIC X(8).
+           05  SECOND-STR  PIC X(8).
+           05  SEQ-NUM     PIC 9(3).
+"""
+
+TEST4_COPYBOOK = """\
+       01  COMPANY-DETAILS.
+           05  SEGMENT-ID      PIC X(1).
+           05  COMPANY-ID      PIC X(10).
+           05  INFO            PIC X(20).
+"""
+
+TEST6_COPYBOOK = """\
+       01  STATIC-DETAILS.
+           05  ID              PIC 9(4)  COMP.
+           05  STRING-VAL      PIC X(10).
+           05  NUM-STR-INT05   PIC 9(5).
+           05  NUM-BCD-SDEC04  PIC S9(2)V9(2) COMP-3.
+           05  FLOAT-NUMBER    COMP-1.
+           05  DOUBLE-NUMBER   COMP-2.
+"""
+
+TEST19_COPYBOOK = """\
+       01  DETAILS.
+           05  WS-DATE-NUM     PIC 9(8).
+           05  WS-AMOUNT       PIC S9(5)V99.
+           05  WS-RATE         PIC 9(2)V9(4).
+           05  WS-SIGN-LEAD    PIC S9(4) SIGN IS LEADING SEPARATE.
+"""
+
+TEST21_COPYBOOK = """\
+       01  REC.
+           05  CNT    PIC 9(1).
+           05  ITEMS  OCCURS 0 TO 5 TIMES DEPENDING ON CNT.
+              10  VAL  PIC S9(3) COMP-3.
+"""
+
+
+def _write(root: str, name: str, data) -> None:
+    path = os.path.join(root, name)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    if isinstance(data, str):
+        with open(path, "w", encoding="ascii") as f:
+            f.write(data)
+    else:
+        with open(path, "wb") as f:
+            f.write(data)
+
+
+def _test1_bodies(n: int, start: int = 0):
+    names = ("Acme Ltd.", "Globex", "Initech", "Umbrella", "Hooli")
+    ratios = (1.5, -2.25, 0.0, 1024.0625, -0.5)
+    for i in range(start, start + n):
+        yield [(i + 1,
+                names[i % len(names)],
+                Decimal(i * 1000 - 2500) / 100,
+                i * 37 % 100000,
+                ratios[i % len(ratios)],
+                f"note{i:02d}")]
+
+
+def build_reference_fixtures(root: str) -> None:
+    """Write every expressible testN dataset under `root`."""
+    from ..copybook.datatypes import Encoding, FloatingPointFormat
+    from ..encode import RecordEncoder, encode_file
+    from .generators import EXP2_COPYBOOK, generate_exp2
+
+    # test1: fixed-length type variety (string + COMP-3 + binary + IBM
+    # float + DISPLAY), a DIRECTORY of part files so list_input_files
+    # and directory reads are exercised; exactly 10 records total
+    _write(root, "test1_copybook.cob", TEST1_COPYBOOK)
+    _write(root, "test1_data/part0.bin",
+           encode_file(TEST1_COPYBOOK, _test1_bodies(6)))
+    _write(root, "test1_data/part1.bin",
+           encode_file(TEST1_COPYBOOK, _test1_bodies(4, start=6)))
+    _write(root, "test1_data/.hidden", b"junk")     # must be skipped
+    _write(root, "test1_data/_SUCCESS", b"")        # must be skipped
+
+    # test2: another fixed-length directory (read with test1's copybook)
+    _write(root, "test2_data/part0.bin",
+           encode_file(TEST1_COPYBOOK, _test1_bodies(5)))
+
+    # test3: strings with leading/trailing spaces (trimming policies)
+    _write(root, "test3_copybook.cob", TEST3_COPYBOOK)
+    _write(root, "test3_data", encode_file(TEST3_COPYBOOK, [
+        [("  lead", "trail   ", 1)],
+        [(" both  ", " x ", 22)],
+        [("", "        ", 333)],
+        [("fullwide", "midl sp", 4)],
+    ]))
+
+    # test4: ASCII RDW multisegment (C roots, P children) with the file
+    # name the reference dataset uses
+    _write(root, "test4_copybook.cob", TEST4_COPYBOOK)
+    seg_rows = []
+    for c in range(4):
+        seg_rows.append([("C", f"C{c:09d}", f"Company {c:02d}")])
+        for p in range(c % 3):
+            seg_rows.append([("P", f"C{c:09d}", f"+555000{p:04d}")])
+    _write(root, "test4_data/COMP.DETAILS.SEP30.DATA.dat",
+           encode_file(TEST4_COPYBOOK, seg_rows, framing="rdw",
+                       data_encoding=Encoding.ASCII, fill_byte=0x20))
+
+    # test5: EBCDIC RDW multisegment with segment redefines — the exp2
+    # profile IS the reference test5 shape (COMPANY-ID/COMPANY-NAME,
+    # TAXPAYER group with a REDEFINES leaf, C/P segments)
+    _write(root, "test5_copybook.cob", EXP2_COPYBOOK)
+    _write(root, "test5_data", bytes(generate_exp2(20, seed=42)))
+
+    # test6: IEEE754 floats beside strings/DISPLAY/COMP-3
+    _write(root, "test6_copybook.cob", TEST6_COPYBOOK)
+    _write(root, "test6_data", encode_file(
+        TEST6_COPYBOOK,
+        [[(i + 1, f"val{i:02d}", i * 11, Decimal(i * 7 - 20) / 100,
+           i * 0.5 - 1.0, i * 0.125 - 0.25)] for i in range(8)],
+        floating_point_format=FloatingPointFormat.IEEE754))
+
+    # test19: DISPLAY numerics (implied point, separate leading sign)
+    _write(root, "test19_display_num.cob", TEST19_COPYBOOK)
+    _write(root, "test19_display_num", encode_file(TEST19_COPYBOOK, [
+        [(20260807, Decimal("123.45"), Decimal("0.1234"), -42)],
+        [(19991231, Decimal("-999.99"), Decimal("99.9999"), 42)],
+        [(20000101, Decimal("0.00"), Decimal("0.0000"), 0)],
+    ]))
+
+    # test21: OCCURS DEPENDING ON without RDW — records are concatenated
+    # at their true walked length (VarOccursRecordExtractor computes it)
+    _write(root, "test21_copybook.cob", TEST21_COPYBOOK)
+    enc = RecordEncoder(TEST21_COPYBOOK, variable_size_occurs=True)
+    _write(root, "test21_data", b"".join(
+        enc.encode_record(body, pad=False) for body in [
+            [(3, [(5,), (-6,), (7,)])],
+            [(0, [])],
+            [(5, [(1,), (2,), (3,), (4,), (5,)])],
+            [(1, [(-999,)])],
+        ]))
+
+
+def ensure_reference_fixtures() -> Optional[str]:
+    """Build (once) and return the generated stand-in directory, or
+    None when generation fails — callers fall back to skipping."""
+    base = os.path.join(
+        tempfile.gettempdir(),
+        f"cobrix-tpu-generated-reference-v{FIXTURE_VERSION}")
+    marker = os.path.join(base, _MARKER)
+    if os.path.isfile(marker):
+        return base
+    try:
+        tmp = tempfile.mkdtemp(prefix="cobrix-ref-build-")
+        build_reference_fixtures(tmp)
+        with open(os.path.join(tmp, _MARKER), "w"):
+            pass
+        if os.path.isdir(base):  # stale partial build
+            shutil.rmtree(base, ignore_errors=True)
+        try:
+            os.rename(tmp, base)
+        except OSError:
+            # lost a race with a concurrent build
+            shutil.rmtree(tmp, ignore_errors=True)
+        return base if os.path.isfile(marker) else None
+    except Exception:
+        return None

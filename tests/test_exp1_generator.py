@@ -23,7 +23,9 @@ from cobrix_tpu.testing.generators import (EXP1_COPYBOOK, EXP1_RECORD_SIZE,
                                            EXP1_SPEC, _exp1_width,
                                            generate_exp1)
 
-from util import REFERENCE_DATA
+# layout-golden vs the reference's own 195-field copybook: needs the
+# real upstream file, not the generated stand-in
+from util import REAL_REFERENCE_DATA
 
 
 def _primitive_layout(cb):
@@ -44,7 +46,7 @@ def _primitive_layout(cb):
 def test_embedded_copybook_matches_reference_layout():
     """The emitted copybook parses to the same 195-primitive layout as the
     reference's data/test6_copybook.cob."""
-    ref_path = os.path.join(REFERENCE_DATA, "test6_copybook.cob")
+    ref_path = os.path.join(REAL_REFERENCE_DATA, "test6_copybook.cob")
     if not os.path.exists(ref_path):
         pytest.skip("reference data dir not available")
     ours = _primitive_layout(parse_copybook(EXP1_COPYBOOK))

@@ -20,9 +20,12 @@ from cobrix_tpu.reader.diagnostics import (
 )
 from cobrix_tpu.reader.recovery import find_next_rdw, rdw_scan_permissive
 from cobrix_tpu.reader.stream import RetryPolicy, open_stream
+from cobrix_tpu.testing import corpus
 from cobrix_tpu.testing.faults import (
     FlakySource,
+    corrupt_record,
     every_structural_truncation,
+    field_site,
     flip_bit,
     garbage_run,
     oversize_rdw,
@@ -242,9 +245,17 @@ class TestTruncatedTail:
 
 
 class TestBitFlip:
-    def test_payload_bit_flip_never_raises(self, tmp_path, clean):
+    def test_payload_damage_never_raises(self, tmp_path, clean):
+        # encoder-aware payload damage (an unmapped segment id) instead
+        # of an arbitrary byte flip: framing is untouched, so every
+        # record still decodes
         starts = rdw_record_starts(clean)
-        bad = flip_bit(clean, starts[4] + 4 + 8, bit=5)
+        s, e = starts[4], starts[5]
+        bad = (clean[:s]
+               + corrupt_record(clean[s:e], "segment-id", header=True,
+                                site=field_site(EXP2_COPYBOOK,
+                                                "SEGMENT-ID"))
+               + clean[e:])
         data = _read(_write(tmp_path, "flip.dat", bad), "permissive")
         assert len(data.to_rows()) == 60
 
@@ -277,6 +288,112 @@ class TestBitFlip:
                     result = _read(path, "permissive")
                     result.to_rows()
                     result.to_arrow()
+
+
+class TestEncoderAwareDamage:
+    """faults.corrupt_record: every damage class has a SPECIFIC
+    diagnostic — packed damage nulls exactly the aimed field with no
+    framing entry, RDW damage ledgers a framing reason, an unmapped
+    segment id blanks every redefine branch, a torn tail is ledgered
+    as a truncation."""
+
+    @pytest.fixture(scope="class")
+    def txn(self, tmp_path_factory):
+        path = str(tmp_path_factory.mktemp("txn") / "txn.dat")
+        info = corpus.write_fixed_corpus(path, 60, seed=21)
+        rows = read_cobol(path, **corpus.fixed_read_options()).to_rows()
+        return open(path, "rb").read(), info["record_size"], rows
+
+    def _damaged_fixed(self, tmp_path, txn, kind):
+        data, rec, good_rows = txn
+        site = field_site(corpus.TXN_COPYBOOK, "AMOUNT")
+        s = 7 * rec
+        bad = (data[:s] + corrupt_record(data[s:s + rec], kind, site=site)
+               + data[s + rec:])
+        out = read_cobol(_write(tmp_path, "bad.dat", bad),
+                         **corpus.fixed_read_options(),
+                         record_error_policy="permissive")
+        return out, good_rows
+
+    @pytest.mark.parametrize("kind", ["sign-nibble", "packed-digit"])
+    def test_packed_damage_nulls_exactly_the_aimed_field(
+            self, tmp_path, txn, kind):
+        out, good_rows = self._damaged_fixed(tmp_path, txn, kind)
+        rows = out.to_rows()
+        # the aimed COMP-3 field is None; its neighbors are intact
+        assert rows[7][0][3] is None
+        assert rows[7][0][:3] == good_rows[7][0][:3]
+        assert rows[7][0][4:] == good_rows[7][0][4:]
+        assert rows[:7] == good_rows[:7] and rows[8:] == good_rows[8:]
+        # field-level damage is NOT a framing fault: the ledger is clean
+        assert out.diagnostics.corrupt_records == 0
+        assert out.diagnostics.resyncs == 0
+
+    def test_rdw_length_zeroed_ledgers_and_resyncs(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        s, e = starts[5], starts[6]
+        bad = (clean[:s] + corrupt_record(clean[s:e], "rdw-length",
+                                          header=True, seed=0)
+               + clean[e:])
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        data = _read(_write(tmp_path, "rdwz.dat", bad), "permissive")
+        assert data.to_rows() == good_rows[:5] + good_rows[6:]
+        diag = data.diagnostics
+        assert diag.resyncs == 1
+        assert diag.entries[0].offset == starts[5]
+        assert diag.entries[0].reason == "zero-length RDW header"
+
+    def test_rdw_length_oversized_clamps_tail(self, tmp_path, clean):
+        starts = rdw_record_starts(clean)
+        s, e = starts[5], starts[6]
+        bad = (clean[:s] + corrupt_record(clean[s:e], "rdw-length",
+                                          header=True, seed=1)
+               + clean[e:])
+        good_rows = _read(_write(tmp_path, "good.dat", clean)).to_rows()
+        data = _read(_write(tmp_path, "rdwo.dat", bad), "permissive")
+        rows = data.to_rows()
+        assert rows[:5] == good_rows[:5]
+        assert len(rows) == 6
+        assert data.diagnostics.corrupt_records == 1
+        assert "truncated" in data.diagnostics.entries[0].reason
+
+    def test_segment_id_damage_blanks_every_branch(self, tmp_path):
+        path = str(tmp_path / "seg.dat")
+        corpus.write_multiseg_corpus(path, 30, seed=4)
+        data = open(path, "rb").read()
+        good_rows = read_cobol(
+            path, **corpus.multiseg_read_options()).to_rows()
+        starts = rdw_record_starts(data)
+        s, e = starts[0], starts[1]
+        site = field_site(corpus.MULTISEG_COPYBOOK, "SEGMENT-ID")
+        bad = (data[:s] + corrupt_record(data[s:e], "segment-id",
+                                         site=site, header=True)
+               + data[e:])
+        out = read_cobol(_write(tmp_path, "segbad.dat", bad),
+                         **corpus.multiseg_read_options(),
+                         record_error_policy="permissive")
+        rows = out.to_rows()
+        # no redefine branch matches the damaged id: every segment
+        # column of the row is None; all other rows are untouched
+        assert rows[0][0][2] is None and rows[0][0][3] is None
+        assert rows[0][0][0] != good_rows[0][0][0]
+        assert rows[1:] == good_rows[1:]
+        assert out.diagnostics.corrupt_records == 0
+
+    def test_torn_write_ledgers_truncation(self, tmp_path):
+        path = str(tmp_path / "seg.dat")
+        info = corpus.write_multiseg_corpus(path, 30, seed=4)
+        data = open(path, "rb").read()
+        bad, sites = corpus.corrupt_multiseg_corpus(
+            data, seed=2, kinds=("torn-write",))
+        assert sites[-1]["kind"] == "torn-write"
+        out = read_cobol(_write(tmp_path, "torn.dat", bad),
+                         **corpus.multiseg_read_options(),
+                         record_error_policy="permissive")
+        assert len(out.to_rows()) == info["records"]
+        diag = out.diagnostics
+        assert diag.corrupt_records == 1
+        assert "truncated" in diag.entries[0].reason
 
 
 class TestHostOracleParity:

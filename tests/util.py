@@ -6,22 +6,45 @@ import signal
 
 import pytest
 
-REFERENCE_DATA = "/root/reference/data"
+REAL_REFERENCE_DATA = "/root/reference/data"
+HAVE_GOLDEN_REFERENCE = os.path.isdir(REAL_REFERENCE_DATA)
 
-# decorator for tests that touch the reference golden fixtures via
-# explicit paths (tests calling read_copybook/read_binary/
-# read_golden_lines skip automatically): on machines without the
-# dataset the parity matrix SKIPS visibly instead of failing
+
+def _generated_reference() -> str:
+    """Encoder-built stand-in datasets (cobrix_tpu.testing.fixtures) for
+    machines without the upstream golden set. Parity tests compare two
+    independent decode paths against each other, so any decodable data
+    of the right shape exercises them; only value-golden assertions
+    (which go through read_copybook/read_binary/read_golden_lines and
+    stay pinned to the real dataset below) still require the upstream
+    bytes."""
+    try:
+        from cobrix_tpu.testing.fixtures import ensure_reference_fixtures
+        return ensure_reference_fixtures() or REAL_REFERENCE_DATA
+    except Exception:
+        return REAL_REFERENCE_DATA
+
+
+REFERENCE_DATA = (REAL_REFERENCE_DATA if HAVE_GOLDEN_REFERENCE
+                  else _generated_reference())
+
+# decorator for tests that touch the reference fixtures via explicit
+# paths: with the upstream dataset absent these now run against the
+# encoder-built stand-ins, and only skip if generation itself failed
 needs_reference_data = pytest.mark.skipif(
     not os.path.isdir(REFERENCE_DATA),
-    reason=f"reference golden fixtures absent ({REFERENCE_DATA}): "
-           "parity against the upstream dataset cannot run here")
+    reason=f"reference fixtures absent ({REFERENCE_DATA}) and the "
+           "encoder-built stand-ins could not be generated")
 
 
 def require_reference_data():
-    """Skip the calling test when the golden dataset is absent."""
-    if not os.path.isdir(REFERENCE_DATA):
-        pytest.skip(f"reference golden fixtures absent ({REFERENCE_DATA})")
+    """Skip the calling test when the real golden dataset is absent.
+    Used by the read_* helpers below, whose callers assert upstream
+    golden VALUES — those cannot run on generated stand-ins."""
+    if not HAVE_GOLDEN_REFERENCE:
+        pytest.skip("upstream golden fixtures absent "
+                    f"({REAL_REFERENCE_DATA}): value-golden assertions "
+                    "cannot run on generated stand-in data")
 
 
 @contextlib.contextmanager
@@ -53,14 +76,14 @@ def hard_timeout(seconds: float, label: str = "test"):
 
 def read_copybook(name: str) -> str:
     require_reference_data()
-    with open(os.path.join(REFERENCE_DATA, name), encoding="utf-8") as f:
+    with open(os.path.join(REAL_REFERENCE_DATA, name), encoding="utf-8") as f:
         return f.read()
 
 
 def read_binary(name: str) -> bytes:
     """Read a data file; reference data entries may be directories of .bin files."""
     require_reference_data()
-    path = os.path.join(REFERENCE_DATA, name)
+    path = os.path.join(REAL_REFERENCE_DATA, name)
     if os.path.isdir(path):
         chunks = []
         for f in sorted(glob.glob(os.path.join(path, "*"))):
@@ -76,5 +99,5 @@ def read_binary(name: str) -> bytes:
 
 def read_golden_lines(name: str):
     require_reference_data()
-    with open(os.path.join(REFERENCE_DATA, name), encoding="iso-8859-1") as f:
+    with open(os.path.join(REAL_REFERENCE_DATA, name), encoding="iso-8859-1") as f:
         return f.read().splitlines()

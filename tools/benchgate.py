@@ -38,6 +38,10 @@ DEFAULT_MIN_HISTORY = 2
 # acceptance claim: select 3-of-110 + ~1% filter must be >= 3x) — gated
 # even with NO history, unlike the noise-relative metrics
 DEFAULT_PUSHDOWN_FLOOR = 3.0
+# round-trip parity (exp_roundtrip: decode->re-encode byte equality on
+# the synthetic corpus) is a correctness bit, not a throughput: any run
+# that RAN the experiment and lost parity fails outright, history-free
+DEFAULT_PARITY_FLOOR = 1.0
 # absolute floor for exp3's end-to-end/decode-only ratio (ISSUE 17: the
 # one-fused-pass claim — ISSUE 15's native assembly lifted the honest
 # e2e from ~0.15 of decode-only to ~0.6; the fused frame+segid scan,
@@ -92,7 +96,7 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
     add(doc)
     add(doc.get("decode_only"))
     for key in ("exp1", "exp2", "hierarchical", "exp_serve",
-                "exp_pushdown"):
+                "exp_pushdown", "exp_roundtrip"):
         add(doc.get(key))
     # the fleet-mode serve experiment nests under exp_serve (it shares
     # that experiment's dataset); its aggregate-scaling metric gates on
@@ -112,6 +116,16 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
         out["exp_pushdown_speedup"] = {
             "value": (float(speedup)
                       if isinstance(speedup, (int, float)) else 0.0),
+            "fraction": None}
+    # round-trip parity gates as its own metric whenever the doc ran
+    # the exp_roundtrip experiment: parity lost (or the experiment
+    # erroring — no parity field) gates as 0 against the absolute 1.0
+    # floor. Docs predating the experiment are simply not gated
+    rt = doc.get("exp_roundtrip")
+    if isinstance(rt, dict):
+        parity = rt.get("roundtrip_parity")
+        out["exp_roundtrip_parity"] = {
+            "value": 1.0 if parity is True else 0.0,
             "fraction": None}
     # the assembly-overhead ratio: present whenever the doc carries BOTH
     # exp3 measurements (decode_only merged under an e2e headline), or
@@ -137,15 +151,18 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
 def gate(fresh: Dict[str, dict], history: List[Dict[str, dict]],
          tolerance: float, min_history: int,
          pushdown_floor: float = DEFAULT_PUSHDOWN_FLOOR,
-         e2e_ratio_floor: float = DEFAULT_E2E_RATIO_FLOOR) -> List[dict]:
+         e2e_ratio_floor: float = DEFAULT_E2E_RATIO_FLOOR,
+         parity_floor: float = DEFAULT_PARITY_FLOOR) -> List[dict]:
     """Evaluate every fresh metric against its history series; returns
     one row per comparable metric with verdict 'ok' | 'regression' |
-    'insufficient_history'. `exp_pushdown_speedup` and
-    `e2e_vs_decode_only` additionally gate against ABSOLUTE floors —
-    the 3x pushdown claim and the native-assembly-overhead claim need
+    'insufficient_history'. `exp_pushdown_speedup`,
+    `e2e_vs_decode_only`, and `exp_roundtrip_parity` additionally gate
+    against ABSOLUTE floors — the 3x pushdown claim, the
+    native-assembly-overhead claim, and encode/decode byte parity need
     no history to be falsifiable."""
     floors = {"exp_pushdown_speedup": pushdown_floor,
-              "e2e_vs_decode_only": e2e_ratio_floor}
+              "e2e_vs_decode_only": e2e_ratio_floor,
+              "exp_roundtrip_parity": parity_floor}
     rows: List[dict] = []
     for name, entry in sorted(fresh.items()):
         floor = floors.get(name, 0.0)
@@ -333,6 +350,40 @@ def _smoke() -> int:
     ratio_doc["e2e_vs_decode_only"] = 0.15
     check("fallback-only host (native_assembly=false) abstains",
           "e2e_vs_decode_only" not in extract_metrics(ratio_doc))
+
+    # round-trip parity gates as a hard, history-free failure; the
+    # encode throughput rides the ordinary history-median gate
+    rt_doc = {"metric": "exp3_to_arrow", "value": 100.0, "unit": "MB/s",
+              "exp_roundtrip": {"metric": "exp_roundtrip_encode",
+                                "value": 13.0, "unit": "MB/s",
+                                "decode_mbps": 190.0,
+                                "roundtrip_parity": True}}
+    rows = gate(extract_metrics(rt_doc), [], 0.25, 2)
+    check("round-trip parity passes with no history",
+          any(r["metric"] == "exp_roundtrip_parity"
+              and r["verdict"] == "ok" for r in rows))
+    rt_hist = [extract_metrics(rt_doc) for _ in range(3)]
+    rt_doc["exp_roundtrip"]["roundtrip_parity"] = False
+    rows = gate(extract_metrics(rt_doc), rt_hist, 0.25, 2)
+    check("lost round-trip parity is a hard failure",
+          any(r["metric"] == "exp_roundtrip_parity"
+              and r["verdict"] == "regression" for r in rows))
+    rt_doc["exp_roundtrip"] = {"metric": "exp_roundtrip_encode",
+                               "error": "boom"}
+    rows = gate(extract_metrics(rt_doc), rt_hist, 0.25, 2)
+    check("errored round-trip experiment fails the parity floor",
+          any(r["metric"] == "exp_roundtrip_parity"
+              and r["verdict"] == "regression" for r in rows))
+    rt_doc["exp_roundtrip"] = {"metric": "exp_roundtrip_encode",
+                               "value": 6.0, "unit": "MB/s",
+                               "roundtrip_parity": True}
+    rows = gate(extract_metrics(rt_doc), rt_hist, 0.25, 2)
+    check("encode throughput drop gates on history",
+          any(r["metric"] == "exp_roundtrip_encode"
+              and r["verdict"] == "regression" for r in rows))
+    check("docs predating exp_roundtrip are not gated on parity",
+          "exp_roundtrip_parity" not in extract_metrics(
+              _doc(100.0, 50.0)))
 
     # the fleet aggregate nests under exp_serve and must gate on its
     # own history series like a top-level experiment

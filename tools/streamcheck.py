@@ -69,6 +69,21 @@ RDW_COPYBOOK = """
 """
 
 
+def make_corpus_records(n: int) -> bytes:
+    """Encoder-built TXN corpus (testing/corpus.py) as the live feed:
+    continuous ingestion exercised on multi-field encoder-produced
+    records (COMP-3, big/little-endian binary, DISPLAY decimals)
+    instead of the toy layouts above — the synthetic load factory and
+    the streaming tier meeting end to end."""
+    from cobrix_tpu.testing import corpus as _corpus
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "txn.dat")
+        _corpus.write_fixed_corpus(path, n, seed=33)
+        with open(path, "rb") as f:
+            return f.read()
+
+
 # -- durable output log (the consumer side of exactly-once) ---------------
 
 def append_batch(out_path: str, table) -> int:
@@ -265,6 +280,13 @@ def main() -> int:
     ok = check_exactly_once(
         "fixed", make_records(args.records), fixed_opts,
         kill_cycles=3 if not args.sweep else 5)
+    from cobrix_tpu.testing import corpus as _corpus
+    ok = check_exactly_once(
+        "corpus",
+        make_corpus_records(args.records if args.sweep
+                            else max(2000, args.records // 3)),
+        dict(_corpus.fixed_read_options()),
+        kill_cycles=2 if not args.sweep else 4) and ok
     if args.sweep:
         vrl_opts = {"copybook_contents": RDW_COPYBOOK,
                     "is_record_sequence": "true",
