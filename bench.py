@@ -807,6 +807,100 @@ def run_exp_pushdown(mb_target: float) -> dict:
     return result
 
 
+def run_exp_stats(mb_target: float) -> dict:
+    """Statistics chunk-skipping end-to-end: a key-sorted fixed-length
+    input (disjoint per-chunk zone maps) profiled once with
+    `collect_stats`, then a ~1-chunk-selective equality scan measured
+    warm with `use_stats` against the SAME scan answered by PR 13's
+    record-level pushdown alone. The value is the warm skipped scan's
+    effective MB/s (input bytes over wall time); `speedup_vs_pushdown`
+    is the claim tools/benchgate.py gates (>= 2x, ISSUE 19
+    acceptance): dropping proven-no-match chunks BEFORE framing must
+    beat framing + stage-1-deciding every record. Parity is asserted
+    in-run (stats table == pushdown table, byte-identical), and the
+    aggregate path is timed beside its decode ground truth."""
+    import tempfile
+
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.query import dataset
+    from cobrix_tpu.stats.aggregate import parse_specs
+
+    copybook = """
+       01  REC.
+           05  KEY-ID    PIC 9(8).
+           05  NAME      PIC X(8).
+    """
+    n = max(4096, int(mb_target * 1024 * 1024) // 16)
+    raw = bytearray()
+    for i in range(n):
+        raw += bytes(0xF0 + int(d) for d in f"{i:08d}")
+        raw += bytes((0xC1 + i % 3,)) * 8
+    mb = len(raw) / (1024 * 1024)
+    kw = dict(copybook_contents=copybook)
+    flt = f"KEY_ID == {n // 2}"
+    path = cache = None
+    try:
+        with tempfile.NamedTemporaryFile(suffix=".dat", delete=False) as f:
+            f.write(bytes(raw))
+            path = f.name
+        cache = tempfile.mkdtemp(prefix="bench_stats_")
+        t0 = time.perf_counter()
+        read_cobol(path, cache_dir=cache, collect_stats="true",
+                   stats_chunk_mb="0.25", **kw)
+        profile_build_s = time.perf_counter() - t0
+        push_best, push_table, _ = _best_to_arrow(
+            path, dict(kw, filter=flt))
+        warm_kw = dict(kw, filter=flt, cache_dir=cache,
+                       use_stats="true", stats_chunk_mb="0.25")
+        warm_best, warm_table, warm_metrics = _best_to_arrow(
+            path, warm_kw)
+        if not warm_table.equals(push_table):
+            # a wrong skip would RAISE the speedup (fewer chunks read)
+            # and sail through the gate — parity failure must fail the
+            # experiment, not ride along as data
+            raise RuntimeError(
+                f"exp_stats parity violation: skipped scan "
+                f"{warm_table.num_rows} rows vs pushdown "
+                f"{push_table.num_rows}")
+        aggs = ["count", "min:KEY_ID", "max:KEY_ID", "sum:KEY_ID"]
+        ds = dataset(path, cache_dir=cache, use_stats="true", **kw)
+        t0 = time.perf_counter()
+        fast = ds._aggregate_from_stats(parse_specs(aggs))
+        agg_stats_ms = (time.perf_counter() - t0) * 1000
+        t0 = time.perf_counter()
+        plain = dataset(path, **kw).aggregate(aggs)
+        agg_decode_ms = (time.perf_counter() - t0) * 1000
+        if fast is None or fast != plain:
+            raise RuntimeError(
+                f"exp_stats aggregate divergence: {fast} != {plain}")
+    finally:
+        if path:
+            os.unlink(path)
+        if cache:
+            import shutil
+
+            shutil.rmtree(cache, ignore_errors=True)
+    push_mbps = mb / push_best
+    warm_mbps = mb / warm_best
+    pushdown = warm_metrics.get("pushdown") or {}
+    result = {
+        "metric": "exp_stats_to_arrow",
+        "value": round(warm_mbps, 2),
+        "unit": "MB/s",
+        "pushdown_MBps": round(push_mbps, 2),
+        "speedup_vs_pushdown": round(warm_mbps / push_mbps, 2),
+        "profile_build_s": round(profile_build_s, 3),
+        "chunks_skipped": pushdown.get("chunks_skipped"),
+        "chunks_considered": pushdown.get("chunks_considered"),
+        "aggregate_from_stats_ms": round(agg_stats_ms, 2),
+        "aggregate_decode_ms": round(agg_decode_ms, 2),
+        "parity": True,
+        "roofline": _roofline_field(warm_mbps),
+    }
+    _log(f"exp_stats: {result}")
+    return result
+
+
 def _headline(decode_only: dict, e2e: dict) -> dict:
     """Merge the two exp3 measurements into the emitted headline: the
     honest end-to-end number carries `value`/`vs_baseline`; the
@@ -1572,6 +1666,12 @@ def _side_metrics(mb_target: float) -> dict:
         _log(f"exp_pushdown side metric failed: {exc}")
         side["exp_pushdown"] = {"metric": "exp_pushdown_to_arrow",
                                 "error": str(exc)[:400]}
+    try:
+        side["exp_stats"] = run_exp_stats(min(mb_target, 24.0))
+    except Exception as exc:
+        _log(f"exp_stats side metric failed: {exc}")
+        side["exp_stats"] = {"metric": "exp_stats_to_arrow",
+                             "error": str(exc)[:400]}
     try:
         side["exp_roundtrip"] = run_roundtrip_side_metric(
             min(mb_target, 40.0))

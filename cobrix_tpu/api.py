@@ -318,6 +318,9 @@ def parse_options(options: Dict[str, object],
             opts.get("progress_interval_s", "") or 0.5),
         stream_batch_rows=opts.get_int("stream_batch_rows", 0),
         field_costs=opts.get_bool("field_costs"),
+        collect_stats=opts.get_bool("collect_stats"),
+        use_stats=opts.get_bool("use_stats"),
+        stats_chunk_mb=float(opts.get("stats_chunk_mb", "") or 4.0),
     )
     # recognized keys consumed later by read_cobol — mark used before the
     # pedantic unused-key audit runs
@@ -440,6 +443,16 @@ def _validate_options(opts: Options, params: ReaderParameters,
         raise ValueError(
             f"Invalid 'stream_batch_rows' of {params.stream_batch_rows}; "
             "it must be >= 0 (0 streams one batch per assembled chunk).")
+    if (params.collect_stats or params.use_stats) \
+            and not params.cache_dir:
+        raise ValueError(
+            "Options 'collect_stats'/'use_stats' require 'cache_dir': "
+            "profiles persist in (and load from) the cache directory's "
+            "stats plane.")
+    if params.stats_chunk_mb <= 0:
+        raise ValueError(
+            f"Invalid 'stats_chunk_mb' of {params.stats_chunk_mb}; it "
+            "must be a positive size in megabytes.")
     if params.trace_file:
         # fail BEFORE the scan, not after minutes of decode: the trace is
         # written at read end, so an unwritable destination would
@@ -1056,6 +1069,18 @@ def read_cobol(path=None,
         # record order — the callback sees the same batches, just with
         # one-shot latency
         batch_tap.emit_data(data)
+    if params.collect_stats:
+        # the profiling pass runs AFTER the read (an explicit,
+        # separately-billed cost — never hidden inside scan time);
+        # warm profiles load instead of rebuilding. Gated on the option
+        # so a stats-off read never imports the stats package
+        from .stats.collect import build_and_store_profiles
+
+        profiles = build_and_store_profiles(files, copybook_contents,
+                                            params, backend,
+                                            io=_io_config(params))
+        data.stats_profiles = {url: profile.summary()
+                               for url, profile in profiles.items()}
     from .plan.cache import parse_fingerprint
 
     data.plan_fingerprint = parse_fingerprint(copybook_contents, params)
@@ -1199,6 +1224,14 @@ def _read_cobol_single_host(files, copybook_contents,
         else:
             reader = FixedLenReader(copybook_contents, params)
         copybook_obj = reader.copybook
+
+    if params.use_stats:
+        # arm zone-map chunk skipping from warm profiles (stats/skip.py);
+        # gated on the option so a stats-off read never imports the
+        # stats package at all
+        from .stats.skip import maybe_attach_skipper
+
+        maybe_attach_skipper(reader, files, params, io=io)
 
     # the output schema is a pure function of copybook + options; built
     # before the scan so the pipelined path can assemble per-chunk Arrow
@@ -1374,10 +1407,23 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
 
     rs = reader.record_size
     size = source_size(file_path, retry=retry, on_retry=on_retry)
+    skipper = getattr(reader, "chunk_skipper", None)
+    # fixed chunking is output-invariant (record-aligned strides,
+    # absolute Record_Id bases), so with zone-map skipping armed the
+    # scan stride shrinks to the profile grid — skip granularity then
+    # matches what the profile can actually prove
+    stride_bytes = FIXED_READ_CHUNK_BYTES
+    if skipper is not None:
+        from .reader.parameters import MEGABYTE
+
+        stride_bytes = min(stride_bytes, max(
+            rs, int(params.stats_chunk_mb * MEGABYTE) // rs * rs))
     # the SAME predicate drives the pipelined chunk planner — the
     # pipelined-vs-sequential parity guarantee needs one split rule
-    if not fixed_file_chunkable(size, rs, params, FIXED_READ_CHUNK_BYTES,
+    if not fixed_file_chunkable(size, rs, params, stride_bytes,
                                 ignore_file_size):
+        if skipper is not None and skipper.should_skip(file_path, 0, -1):
+            return []
         return [track(reader.read_result(
             _read_file_bytes(file_path, retry, on_retry, io),
             backend=backend,
@@ -1385,8 +1431,35 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
             input_file_name=file_path, ignore_file_size=ignore_file_size,
             stage_times=stage_times),
             size)]
-    chunk_bytes = max(rs, (FIXED_READ_CHUNK_BYTES // rs) * rs)
+    chunk_bytes = max(rs, (stride_bytes // rs) * rs)
     results: List[FileResult] = []
+    if skipper is not None:
+        # zone-map skipping armed: bounded per-chunk streams, so a
+        # skipped range's bytes are never read at all (the single-stream
+        # loop below would have to read past them)
+        done = 0
+        while done < size:
+            nbytes = min(chunk_bytes, size - done)
+            if skipper.should_skip(file_path, done, done + nbytes):
+                done += nbytes
+                continue
+            with open_stream(file_path, start_offset=done,
+                             maximum_bytes=nbytes, retry=retry,
+                             on_retry=on_retry, io=io) as stream:
+                data = stream.next_view(nbytes)
+                if not data:
+                    break
+                if len(data) % rs and done + len(data) < size:
+                    raise IOError(
+                        f"Short read from {file_path} at {done}")
+                results.append(track(reader.read_result(
+                    data, backend=backend, file_id=file_order,
+                    first_record_id=base_record_id + done // rs,
+                    input_file_name=file_path,
+                    ignore_file_size=ignore_file_size,
+                    stage_times=stage_times), len(data)))
+            done += len(data)
+        return results
     done = 0
     with open_stream(file_path, retry=retry, on_retry=on_retry,
                      io=io) as stream:
@@ -1426,6 +1499,14 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
         else:
             reader = FixedLenReader(copybook_contents, params)
             prefix = ""
+    if params.use_stats and is_var_len:
+        # multihost VRL shards come from the same sparse-index planner
+        # as single-host scans, so warm profiles skip there too (fixed
+        # multihost shards are host-balanced ranges, left unfiltered)
+        from .stats.skip import maybe_attach_skipper
+
+        maybe_attach_skipper(reader, files, params,
+                             io=_io_config(params))
     with stage(metrics, "plan_index"):
         if is_var_len:
             shards = _plan_var_len_shards(reader, files, params,
@@ -1441,6 +1522,11 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
             ignore_file_size=debug_ignore_file_size)
     if metrics is not None:
         metrics.supervision = supervision
+        pushdown = getattr(reader, "pushdown", None)
+        if pushdown is not None:
+            # planning runs in-parent, so chunk-skip counters are real;
+            # per-record pruning counters stay in the forked workers
+            metrics.pushdown = pushdown.stats.as_dict()
     # merge the per-shard ledgers the workers shipped back as IPC schema
     # metadata (stripped here so shard keys don't leak into — or break
     # concatenation of — the unified table); shard order is canonical, so

@@ -18,6 +18,14 @@ Known inversion gaps (named in ROADMAP item 3):
 * Values a type cannot represent (None in a binary/float field, digits
   beyond the PIC precision, characters outside the code page) raise
   `EncodeError` rather than guessing.
+
+Closed former gaps, now invariants the fuzzer covers unpinned: blank
+fill is the spelling of None for EVERY display numeric — integrals,
+explicit-dot decimals, and implied-point V-decimals alike — and the
+decoders return null (never 0.00) for digit-less content, so
+encode(None)→decode round-trips. Duplicate-glyph code pages invert
+deterministically lowest-byte-wins (space always canonicalizes to
+0x40), pinned end to end by rtcheck's P3 alias matrix.
 """
 from __future__ import annotations
 

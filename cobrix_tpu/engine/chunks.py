@@ -13,6 +13,12 @@ Two planners, one chunk contract:
 Chunk plans are EXECUTION plans only: a pipelined read with the same
 split options decodes the same records with the same Record_Ids as the
 sequential path, so turning the pipeline on can never change results.
+
+When a read armed a chunk skipper (``use_stats=true`` + a filter + a
+warm profile, stats/skip.py), both planners drop ranges the profile
+PROVES cannot frame a matching record — before any byte is read.
+Offsets and Record_Id bases of surviving chunks are absolute, so
+skipping never renumbers or reorders what remains.
 """
 from __future__ import annotations
 
@@ -83,7 +89,15 @@ def plan_fixed_chunks(reader, files, params, chunk_bytes: int,
     chunk, so tail handling and ledger offsets match the sequential read
     byte for byte.
     """
+    from ..reader.parameters import MEGABYTE
+
     rs = reader.record_size
+    skipper = getattr(reader, "chunk_skipper", None)
+    if skipper is not None:
+        # chunking is output-invariant: with skipping armed, plan on
+        # the profile grid so skip granularity matches the proofs
+        chunk_bytes = min(chunk_bytes, max(
+            rs, int(params.stats_chunk_mb * MEGABYTE)))
     chunk_bytes = max(rs, (chunk_bytes // rs) * rs)  # record-aligned
     chunks: List[FixedChunk] = []
     for file_order, file_path in enumerate(files):
@@ -91,14 +105,20 @@ def plan_fixed_chunks(reader, files, params, chunk_bytes: int,
         size = source_size(file_path, retry=retry, on_retry=on_retry)
         if not fixed_file_chunkable(size, rs, params, chunk_bytes,
                                     ignore_file_size):
+            if skipper is not None \
+                    and skipper.should_skip(file_path, 0, -1):
+                continue
             chunks.append(FixedChunk(file_path, file_order, 0, 0, base,
                                      whole_file=True))
             continue
         done = 0
         while done < size:
             n = min(chunk_bytes, size - done)
-            chunks.append(FixedChunk(file_path, file_order, done, n,
-                                     base + done // rs, whole_file=False))
+            if skipper is None \
+                    or not skipper.should_skip(file_path, done, done + n):
+                chunks.append(FixedChunk(file_path, file_order, done, n,
+                                         base + done // rs,
+                                         whole_file=False))
             done += n
     return chunks
 
@@ -113,6 +133,7 @@ def plan_var_len_chunks(reader, files, params,
     (process) executor."""
     from ..parallel.planner import WorkShard
 
+    skipper = getattr(reader, "chunk_skipper", None)
     shards: List[WorkShard] = []
     for file_order, file_path in enumerate(files):
         base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
@@ -125,10 +146,14 @@ def plan_var_len_chunks(reader, files, params,
             # streams bound it to the file end themselves, so no extra
             # size round trip is needed for registry-backed storage
             for e in entries:
+                if skipper is not None and skipper.should_skip(
+                        file_path, e.offset_from, e.offset_to):
+                    continue
                 shards.append(WorkShard(file_path, file_order,
                                         e.offset_from, e.offset_to,
                                         base + e.record_index))
-        else:
+        elif skipper is None \
+                or not skipper.should_skip(file_path, 0, -1):
             shards.append(WorkShard(file_path, file_order, 0, -1, base))
     return shards
 

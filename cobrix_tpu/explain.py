@@ -69,7 +69,7 @@ class ScanReport:
 
     def __init__(self, copybook_summary: dict, fields: List[dict],
                  groups: List[dict], plan: dict, cache_planes: dict,
-                 data=None, metrics=None, pushdown=None):
+                 data=None, metrics=None, pushdown=None, stats=None):
         self.copybook = copybook_summary
         self.fields = fields          # FieldPlan.describe() rows
         self.groups = groups          # FieldPlan.group_summary() rows
@@ -81,6 +81,9 @@ class ScanReport:
         # retained vs pruned fields, per-depth decisions, the
         # late-materialized set — None when no select/filter configured
         self.pushdown = pushdown
+        # per-file profile summaries (stats/profile.FileProfile.summary)
+        # when the read collected statistics (collect_stats=true)
+        self.stats = stats
 
     # -- measured costs (post-scan) --------------------------------------
 
@@ -144,6 +147,8 @@ class ScanReport:
         }
         if self.pushdown is not None:
             out["pushdown"] = self.pushdown
+        if self.stats is not None:
+            out["statistics"] = self.stats
         if (self.metrics is not None
                 and self.metrics.pushdown is not None):
             out.setdefault("pushdown", {})
@@ -210,6 +215,22 @@ class ScanReport:
                     f"{measured['records_scanned']} records pruned, "
                     f"{measured['bytes_skipped']} bytes skipped, "
                     f"selectivity {measured['selectivity']}")
+        stats = self.stats
+        if stats:
+            lines.append(f"statistics: {len(stats)} file profile(s) "
+                         "collected")
+            for url, prof in list(stats.items())[:3]:
+                lines.append(
+                    f"  {url}: {prof['chunks']} chunk(s), "
+                    f"{prof['records']} record(s), "
+                    f"{len(prof['fields'])} profiled field(s)")
+        measured_skips = (self.metrics.pushdown
+                         if self.metrics is not None else None) or {}
+        if measured_skips.get("chunks_considered"):
+            lines.append(
+                f"chunk skipping: {measured_skips['chunks_skipped']}/"
+                f"{measured_skips['chunks_considered']} chunk(s) "
+                "proven no-match and dropped before framing")
         roof = self.roofline
         if roof is not None:
             line = f"roofline: {roof['bandwidth_GBps']} GB/s calibrated"
@@ -386,4 +407,5 @@ def build_scan_report(params, files: List[str], data,
         data=data,
         metrics=metrics,
         pushdown=describe_pushdown(copybook_obj, params),
+        stats=getattr(data, "stats_profiles", None),
     )

@@ -56,7 +56,8 @@ TMP_ORPHAN_AGE_S = 300.0
 # cache was evicted to protect
 QUARANTINE_KEEP = 32
 
-PLANES = ("block", "index", "roofline", "checkpoint", "fleet", "sink")
+PLANES = ("block", "index", "roofline", "checkpoint", "fleet", "sink",
+          "stats")
 
 
 def checksum(data: bytes) -> int:

@@ -411,6 +411,11 @@ def scan_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "cobrix_bytes_skipped_total",
             "Record bytes that never reached the full decode because "
             "filter pushdown dropped their records"),
+        # -- scan-time data profiler (cobrix_tpu.stats) -----------------
+        "chunks_skipped": r.counter(
+            "cobrix_chunks_skipped_total",
+            "Planned chunks dropped before framing because a persisted "
+            "profile proved no record in them can match the filter"),
         # achieved scan bytes/s of the most recent read as a fraction
         # of the calibrated host memory bandwidth (obs.roofline) — the
         # decode-throughput-law view: a regression shows as a smaller
@@ -519,6 +524,16 @@ def stream_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
         "checkpoints": r.counter(
             "cobrix_stream_checkpoints_total",
             "Durable checkpoint commits (acks) by the ingest layer"),
+        "stats_drift": r.counter(
+            "cobrix_stats_drift_events_total",
+            "Ingest drift records from successive-generation profile "
+            "comparison, by kind (segment_mix, null_rate, "
+            "out_of_range, record_length)",
+            label_names=("kind",)),
+        "stats_last_drift": r.gauge(
+            "cobrix_stats_last_drift_events",
+            "Drift records emitted by the most recent generation "
+            "comparison (0 = the last rotation compared clean)"),
     }
 
 
@@ -577,6 +592,7 @@ def sink_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
 FLEET_GAUGE_MERGE = {
     "cobrix_inflight_chunks": "sum",
     "cobrix_roofline_fraction": "max",
+    "cobrix_stats_last_drift_events": "max",
     "cobrix_process_uptime_seconds": "max",
     "cobrix_process_rss_bytes": "sum",
     "cobrix_serve_open_scans": "sum",

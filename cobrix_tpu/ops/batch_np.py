@@ -174,9 +174,10 @@ def _classify_display_ascii(data: np.ndarray):
 
 def _display_valid(valid_base, n_digits, n_dots, negative, signed: bool,
                    allow_dot: bool, require_digits: bool) -> np.ndarray:
-    # empty (no digits) is null for integrals and explicit-dot decimals
-    # (JVM toInt/BigDecimal("") fail) but decodes to 0 for V-decimals, where
-    # the reference wraps the empty digit string via addDecimalPoint.
+    # empty (no digits) is null everywhere: integrals and explicit-dot
+    # decimals (JVM toInt/BigDecimal("") fail) AND implied-point
+    # V-decimals, where blank fill is the encoder's spelling of None —
+    # require_digits is passed unconditionally True by the planners.
     valid = valid_base.copy()
     if require_digits:
         valid &= n_digits >= 1

@@ -475,7 +475,10 @@ def decode_field(dtype,
                 return _to_decimal(s)
             s = (decode_ebcdic_number(data, is_unsigned) if is_ebcdic
                  else decode_ascii_number(data, is_unsigned))
-            if s is None:
+            # digit-less content (blank fill) is null, not 0 scaled to
+            # 0.00 — parity with the columnar kernels' unconditional
+            # require_digits and the encoder's blank fill for None
+            if s is None or not any("0" <= c <= "9" for c in s):
                 return None
             try:
                 return _to_decimal(add_decimal_point(s, dtype.scale, dtype.scale_factor))

@@ -343,6 +343,8 @@ class ReadMetrics:
                 m["records_pruned"].labels(depth=depth).inc(count)
         if pd.get("bytes_skipped"):
             m["bytes_skipped"].inc(pd["bytes_skipped"])
+        if pd.get("chunks_skipped"):
+            m["chunks_skipped"].inc(pd["chunks_skipped"])
         roof = self.roofline()
         if roof is not None:
             m["roofline"].set(roof["fraction"])

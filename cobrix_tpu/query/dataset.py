@@ -215,8 +215,84 @@ class CobolDataset:
         return [self.schema.names[0]] if self.schema.names else None
 
     def count_rows(self, filter=None) -> int:
+        if filter is None:
+            fast = self._aggregate_from_stats([("count", None)])
+            if fast is not None:
+                return fast["count"]
         return self.scanner(self._narrowest_columns(filter),
                             filter).count_rows()
+
+    def aggregate(self, aggs: Sequence[str], filter=None) -> dict:
+        """Evaluate simple aggregates over the dataset.
+
+        `aggs` is a list of specs: ``"count"``, ``"min:FIELD"``,
+        ``"max:FIELD"``, ``"sum:FIELD"``. Returns ``{spec: value}``
+        (``None`` = SQL NULL over no values; nulls are ignored by
+        min/max/sum, counted by count).
+
+        With ``use_stats=true``, no filter, and a warm profile for
+        EVERY input file, the answer comes from persisted statistics
+        without decoding a byte (stats/aggregate.py) — and is exact by
+        construction: anything short of proof (missing profile,
+        NaN-tainted chunk, float sum, unknown field) silently falls
+        back to the decode path below, never an approximate answer.
+        """
+        from ..stats.aggregate import parse_specs
+
+        specs = parse_specs(aggs)
+        if filter is None:
+            fast = self._aggregate_from_stats(specs)
+            if fast is not None:
+                return fast
+        return self._aggregate_by_decode(specs, filter)
+
+    def _aggregate_from_stats(self, specs) -> Optional[dict]:
+        """Stats-only answer, or None (then the caller decodes)."""
+        from ..api import parse_options
+
+        params, _opts = parse_options(dict(self.options))
+        if not params.use_stats:
+            return None
+        from ..plan.cache import copybook_for_params
+        from ..stats.aggregate import (aggregates_from_profiles,
+                                       load_all_profiles)
+
+        profiles = load_all_profiles(self.files, self.copybook_contents,
+                                     params)
+        if profiles is None:
+            return None
+        copybook = copybook_for_params(self.copybook_contents, params)
+        return aggregates_from_profiles(profiles, copybook, specs)
+
+    def _aggregate_by_decode(self, specs, filter_) -> dict:
+        """The ground-truth path: decode, then pyarrow compute. The
+        semantics here DEFINE what the stats path must reproduce."""
+        import pyarrow.compute as pc
+
+        from ..stats.collect import leaf_columns
+
+        wanted = sorted({field for _, field in specs if field})
+        known = set(self.schema.names)
+        cols = (wanted if wanted and all(f in known for f in wanted)
+                else None)  # nested leaves need the full-width decode
+        table = self.to_table(columns=cols, filter=filter_)
+        leaves = leaf_columns(table)
+        out: dict = {}
+        for fn, field in specs:
+            if fn == "count":
+                out["count"] = table.num_rows
+                continue
+            if field not in leaves:
+                raise KeyError(
+                    f"aggregate field {field!r} is not a primitive "
+                    "column of the decoded output")
+            _kind, col = leaves[field]
+            if fn == "sum":
+                out[f"sum:{field}"] = pc.sum(col).as_py()
+            else:
+                mm = pc.min_max(col).as_py()
+                out[f"{fn}:{field}"] = mm[fn]
+        return out
 
     def __repr__(self) -> str:
         return (f"<CobolDataset files={len(self.files)} "

@@ -51,7 +51,7 @@ class PushdownStats:
 
     __slots__ = ("_lock", "records_scanned", "records_pruned_segment",
                  "records_pruned_filter", "records_pruned_residual",
-                 "bytes_skipped")
+                 "bytes_skipped", "chunks_considered", "chunks_skipped")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -60,16 +60,23 @@ class PushdownStats:
         self.records_pruned_filter = 0
         self.records_pruned_residual = 0
         self.bytes_skipped = 0
+        # the fourth pushdown depth (stats/skip.py): chunks the planners
+        # asked the zone maps about, and how many never reached framing
+        self.chunks_considered = 0
+        self.chunks_skipped = 0
 
     def note(self, scanned: int = 0, pruned_segment: int = 0,
              pruned_filter: int = 0, pruned_residual: int = 0,
-             bytes_skipped: int = 0) -> None:
+             bytes_skipped: int = 0, chunks_considered: int = 0,
+             chunks_skipped: int = 0) -> None:
         with self._lock:
             self.records_scanned += int(scanned)
             self.records_pruned_segment += int(pruned_segment)
             self.records_pruned_filter += int(pruned_filter)
             self.records_pruned_residual += int(pruned_residual)
             self.bytes_skipped += int(bytes_skipped)
+            self.chunks_considered += int(chunks_considered)
+            self.chunks_skipped += int(chunks_skipped)
 
     @property
     def records_pruned(self) -> int:
@@ -89,6 +96,8 @@ class PushdownStats:
                 "records_pruned_filter": self.records_pruned_filter,
                 "records_pruned_residual": self.records_pruned_residual,
                 "bytes_skipped": self.bytes_skipped,
+                "chunks_considered": self.chunks_considered,
+                "chunks_skipped": self.chunks_skipped,
             }
         out["selectivity"] = (round((scanned - pruned) / scanned, 6)
                               if scanned else None)

@@ -206,7 +206,9 @@ def _asm_descriptor(spec, pa_type):
                 else native.ASM_KIND_DISPLAY_A)
         kind = base + (4 if wide else 0)
         allow_dot = bool(p.explicit_decimal)
-        require_digits = isinstance(spec.dtype, Integral) or allow_dot
+        # unconditional, matching columnar._variant_key: blank-filled
+        # implied-point decimals decode to null, not 0.00
+        require_digits = True
         flags = (int(bool(p.signed)) | (int(allow_dot) << 2)
                  | (int(require_digits) << 3))
         dyn_sf = min(p.scale_factor, 0)

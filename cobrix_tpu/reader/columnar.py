@@ -87,13 +87,15 @@ def _variant_key(spec: ColumnSpec) -> tuple:
     if spec.codec is Codec.BCD:
         return (p.precision <= MAX_INTEGER_PRECISION, _is_wide(spec))
     if spec.codec in (Codec.DISPLAY_NUM, Codec.DISPLAY_NUM_ASCII):
-        is_integral = isinstance(spec.dtype, Integral)
         # only a NEGATIVE scale factor changes the kernel (the dyn_sf
         # digit-count plane); positive sf is applied per column at
         # materialization, so grouping on min(sf, 0) avoids splitting
-        # otherwise-identical columns into separate kernel launches
-        return (p.signed, p.explicit_decimal,
-                is_integral or p.explicit_decimal,
+        # otherwise-identical columns into separate kernel launches.
+        # require_digits is unconditional: a digit-less DISPLAY field
+        # (blank fill) decodes to null for integrals, explicit-point
+        # AND implied-point decimals alike — the value the encoder's
+        # blank fill for None round-trips back to
+        return (p.signed, p.explicit_decimal, True,
                 spec.width <= MAX_INTEGER_PRECISION,
                 min(p.scale_factor, 0),
                 _is_wide(spec))

@@ -194,6 +194,20 @@ class ReaderParameters:
     # per 16 MB chunk if it renders incrementally). 0 = one batch per
     # assembled chunk/file table
     stream_batch_rows: int = 0
+    # -- scan-time data profiler (cobrix_tpu.stats) ----------------------
+    # collect per-chunk per-field statistics (zone maps, null counts,
+    # segment histograms) on a canonical grid after the read and persist
+    # them under <cache_dir>/stats/. Requires cache_dir. Off = the stats
+    # package is never even imported
+    collect_stats: bool = False
+    # consume persisted profiles: chunk skipping before framing (with a
+    # filter) and stats-answered dataset aggregates. Requires cache_dir
+    use_stats: bool = False
+    # the profiler's canonical chunk grid stride, in MB (fractional
+    # accepted — tests force multi-chunk profiles on tiny files).
+    # Deliberately NOT part of the profile's config fingerprint: skip
+    # decisions are grid-independent (stats/skip.py union coverage)
+    stats_chunk_mb: float = 4.0
 
     def resolved_pipeline_workers(self) -> int:
         """Effective worker count: 0 = sequential, negative = auto."""

@@ -19,7 +19,11 @@ answers health checks.
   debug surface (`debug_fn` serves it; see ScanServer._debug):
   active scans with live ScanProgress, the flight-recorder ring,
   SLO status, and the effective config.
-* ``/fleet/replicas|metrics|slo|signals`` — the cluster-level view
+* ``/stats`` — the data-statistics snapshot (`stats_fn` serves it —
+  stats/service.py): file-profile summaries this process built or
+  loaded and the recent ingest-drift record ring, so "what does the
+  data look like / is it drifting" is answerable without a scan.
+* ``/fleet/replicas|metrics|slo|signals|stats`` — the cluster-level view
   (`fleet_fn` serves it; present only on fleet-mode servers — see
   ScanServer._fleet_endpoint): replica registry with liveness,
   federated Prometheus exposition, cluster SLO rollup, autoscaling
@@ -49,7 +53,8 @@ class ObsHttpServer:
                  host: str = "127.0.0.1", port: int = 0,
                  debug_fn: Optional[Callable] = None,
                  pre_scrape: Optional[Callable[[], None]] = None,
-                 fleet_fn: Optional[Callable] = None):
+                 fleet_fn: Optional[Callable] = None,
+                 stats_fn: Optional[Callable[[], dict]] = None):
         self._t0 = time.monotonic()
         snapshot = snapshot_fn or (lambda: {})
         outer = self
@@ -82,6 +87,21 @@ class ObsHttpServer:
                     code = (200 if doc["status"] == "ok"
                             else 503 if doc["status"] == "draining"
                             else 500)
+                elif path == "/stats" and stats_fn is not None:
+                    # data-statistics snapshot (stats/service.py):
+                    # profile summaries this process built/loaded and
+                    # the recent ingest-drift record ring
+                    try:
+                        doc = stats_fn()
+                    except Exception as exc:
+                        doc = {"error": f"{type(exc).__name__}: {exc}"}
+                        body = (json.dumps(doc) + "\n").encode()
+                        self._reply(500, "application/json", body)
+                        return
+                    body = (json.dumps(doc, sort_keys=True,
+                                       default=str) + "\n").encode()
+                    ctype = "application/json"
+                    code = 200
                 elif path.startswith("/fleet/") and fleet_fn is not None:
                     # fleet_fn returns None (404), a (body, ctype) pair
                     # (pre-rendered text, e.g. the federated Prometheus

@@ -521,6 +521,7 @@ class ScanServer(socketserver.ThreadingTCPServer):
                 pre_scrape=self._pre_scrape,
                 fleet_fn=(self._fleet_endpoint if self._fleet is not None
                           else None),
+                stats_fn=self._stats_snapshot,
                 host=http_host if http_host is not None else host,
                 port=http_port)
         self._thread: Optional[threading.Thread] = None
@@ -740,9 +741,9 @@ class ScanServer(socketserver.ThreadingTCPServer):
 
     def _fleet_endpoint(self, path: str, query: dict):
         """`/fleet/<path>` documents (None -> 404). `replicas`, `slo`,
-        and `signals` are JSON; `metrics` is a federated Prometheus
-        exposition. A federation refusal (bucket mismatch) propagates
-        and the sidecar answers a structured 500."""
+        `signals`, and `stats` are JSON; `metrics` is a federated
+        Prometheus exposition. A federation refusal (bucket mismatch)
+        propagates and the sidecar answers a structured 500."""
         fed = self._fleet["federator"]
         if path == "replicas":
             return fed.view().replicas_doc()
@@ -759,9 +760,44 @@ class ScanServer(socketserver.ThreadingTCPServer):
                 view, history=fed.history(),
                 slo_rollup=fed.slo_rollup(view),
                 queue_wait_target_s=self.queue_wait_target_s)
+        if path == "stats":
+            # federate the per-replica data-statistics snapshots: one
+            # document per registry replica (unreachable ones degrade
+            # to an error entry, never a failed endpoint)
+            import json as _json
+
+            from ..fleet.federate import _http_get
+
+            per_replica = {}
+            for scrape in fed.view().replicas:
+                addr = scrape.status.record.http_address
+                rid = scrape.replica_id
+                if rid == self.replica_id:
+                    per_replica[rid] = self._stats_snapshot()
+                    continue
+                if scrape.status.state != "live" or not addr:
+                    per_replica[rid] = {"error": scrape.status.state}
+                    continue
+                try:
+                    per_replica[rid] = _json.loads(_http_get(
+                        f"http://{addr[0]}:{int(addr[1])}/stats",
+                        timeout_s=2.0))
+                except Exception as exc:
+                    per_replica[rid] = {
+                        "error": f"{type(exc).__name__}: {exc}"}
+            return {"replicas": per_replica}
         return None
 
     # -- health + /debug -------------------------------------------------
+
+    def _stats_snapshot(self) -> dict:
+        """The `/stats` document: profile summaries this process built
+        or loaded plus the recent ingest-drift ring (stats/service.py —
+        imported lazily so a server that never touches statistics never
+        imports the stats package)."""
+        from ..stats import service
+
+        return service.snapshot()
 
     def _health_snapshot(self) -> dict:
         doc: dict = {}

@@ -1,0 +1,363 @@
+"""The profiler: a standalone deterministic decode pass that builds
+:class:`~cobrix_tpu.stats.profile.FileProfile` artifacts.
+
+Determinism across execution modes is BY CONSTRUCTION: the profiler
+never rides the scan's own execution plan. It builds its own reader
+(filter/select stripped, generated columns off) and walks a canonical
+chunk grid — fixed files in ``stats_chunk_mb`` record-aligned strides,
+variable-length files along a sparse index generated at
+``stats_chunk_mb`` splits — so a profile collected during a sequential
+scan is byte-identical to one collected during a pipelined or
+multihost scan of the same bytes.
+
+Zero-overhead contract: a read with both stats options off never
+imports this module (api.py gates the import on the option flags), and
+``overhead_events()`` counts every entry into the stats machinery so
+tests can assert exactly that.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple
+
+from .profile import (
+    LENGTH_HISTOGRAM_LIMIT,
+    SKETCH_LIMIT,
+    ChunkStats,
+    FieldStats,
+    FileProfile,
+)
+from .store import StatsStore, local_fingerprint, stats_config_fingerprint
+
+# names of generated/bookkeeping columns that never carry record data
+_GENERATED = ("Record_Id", "File_Id", "Record_Byte_Length")
+
+_overhead_events = 0
+
+
+def overhead_events() -> int:
+    """How many times any stats machinery ran in this process — the
+    zero-overhead assertion's witness (reads with stats off must leave
+    it unchanged, which the tier-1 suite checks)."""
+    return _overhead_events
+
+
+def bump_overhead() -> None:
+    global _overhead_events
+    _overhead_events += 1
+
+
+def _kind_of(arrow_type) -> Optional[str]:
+    import pyarrow as pa
+
+    if pa.types.is_boolean(arrow_type):
+        return "bool"
+    if pa.types.is_integer(arrow_type):
+        return "int"
+    if pa.types.is_floating(arrow_type):
+        return "float"
+    if pa.types.is_decimal(arrow_type):
+        return "decimal"
+    if (pa.types.is_string(arrow_type)
+            or pa.types.is_large_string(arrow_type)):
+        return "string"
+    return None  # binary / nested / anything else: not profiled
+
+
+def leaf_columns(table) -> Dict[str, Tuple[str, object]]:
+    """{leaf name -> (kind, column)} for every profilable column of an
+    assembled table: structs flattened, lists and generated columns
+    dropped, DUPLICATE leaf names dropped entirely (a filter name that
+    is ambiguous in the copybook must not consult either column's zone
+    map)."""
+    import pyarrow as pa
+
+    while any(pa.types.is_struct(f.type) for f in table.schema):
+        table = table.flatten()
+    out: Dict[str, Tuple[str, object]] = {}
+    dupes = set()
+    for name, col in zip(table.column_names, table.columns):
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _GENERATED or leaf.startswith("Seg_Id"):
+            continue
+        kind = _kind_of(col.type)
+        if kind is None:
+            continue
+        if leaf in dupes or leaf in out:
+            out.pop(leaf, None)
+            dupes.add(leaf)
+            continue
+        out[leaf] = (kind, col)
+    return out
+
+
+def _field_stats(kind: str, col) -> FieldStats:
+    import pyarrow.compute as pc
+
+    n = len(col)
+    nulls = col.null_count
+    fs = FieldStats(kind, null_count=nulls)
+    if nulls == n:
+        # all-null: the zone map is vacuously empty, the sum of no
+        # values folds as 0 for the exact kinds (the aggregate layer
+        # reports SQL NULL when the grand non-null count is zero)
+        if kind in ("int", "decimal"):
+            fs.sum = 0
+        if kind == "string":
+            fs.distinct = ()
+        return fs
+    if kind == "float":
+        try:
+            if bool(pc.any(pc.is_nan(col)).as_py()):
+                return fs  # NaN taint: min/max unknown for this chunk
+        except Exception:
+            return fs
+    try:
+        mm = pc.min_max(col)
+        fs.min = mm["min"].as_py()
+        fs.max = mm["max"].as_py()
+    except Exception:
+        fs.min = fs.max = None
+    if kind in ("int", "decimal"):
+        try:
+            fs.sum = pc.sum(col).as_py()
+        except Exception:
+            fs.sum = None  # overflow: inexact, never wrong
+    if kind == "string":
+        try:
+            uniq = pc.unique(pc.drop_null(col))
+            if len(uniq) <= SKETCH_LIMIT:
+                fs.distinct = tuple(sorted(uniq.to_pylist()))
+        except Exception:
+            pass
+    return fs
+
+
+def _segment_hist(table, seg_leaf: str) -> Dict[str, int]:
+    """Complete per-chunk segment-id histogram (every record counted —
+    nulls and empties under ''), or {} when the column is absent."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    while any(pa.types.is_struct(f.type) for f in table.schema):
+        table = table.flatten()
+    col = None
+    for name in table.column_names:
+        if name.rsplit(".", 1)[-1] == seg_leaf:
+            col = table.column(name)
+            break
+    if col is None:
+        return {}
+    hist: Dict[str, int] = {}
+    for row in pc.value_counts(col):
+        value = row["values"].as_py()
+        key = str(value).strip() if value is not None else ""
+        hist[key] = hist.get(key, 0) + row["counts"].as_py()
+    return hist
+
+
+def profile_table(table, seg_leaf: str = "") -> Tuple[
+        Dict[str, FieldStats], Dict[str, str], Dict[str, int]]:
+    """One decoded chunk's (field stats, field kinds, segment
+    histogram) — shared by the batch profiler here and the streaming
+    drift accumulator."""
+    fields = {}
+    kinds = {}
+    for leaf, (kind, col) in leaf_columns(table).items():
+        fields[leaf] = _field_stats(kind, col)
+        kinds[leaf] = kind
+    segments = _segment_hist(table, seg_leaf) if seg_leaf else {}
+    return fields, kinds, segments
+
+
+def segment_leaf_name(copybook, params) -> str:
+    """The Arrow leaf name of the configured segment-id field (''
+    when the read is not multisegment)."""
+    seg = params.multisegment
+    if seg is None or not seg.segment_id_field:
+        return ""
+    try:
+        return copybook.get_field_by_name(seg.segment_id_field).name
+    except (KeyError, ValueError):
+        return ""
+
+
+def profiling_eligibility(files, params, backend) -> Optional[str]:
+    """None when profiles can be collected for this read, else a short
+    human reason (surfaced through explain)."""
+    from ..reader.stream import path_scheme
+
+    if not params.cache_dir:
+        return "no cache_dir to persist profiles in"
+    if backend == "host":
+        return "host backend decodes row-wise (no columnar chunks)"
+    if params.is_text:
+        return "text mode is not profiled"
+    if params.file_start_offset or params.file_end_offset:
+        return "file byte trims shift chunk offsets"
+    for path in files:
+        if path_scheme(path) not in (None, "file"):
+            return f"non-local file {path!r} (profiles are local-only)"
+    return None
+
+
+def _bare_params(params):
+    """The profiler's decode configuration: the scan's parameters minus
+    everything that changes WHICH rows/columns come back (the profile
+    must describe the whole file) and minus generated columns."""
+    return replace(params, filter=None, select=None,
+                   generate_record_id=False, input_file_name_column="",
+                   corrupt_record_column="")
+
+
+def _profile_fixed(reader, path: str, params, backend,
+                   schema) -> FileProfile:
+    from ..reader.parameters import MEGABYTE
+    from ..reader.stream import open_stream
+
+    rs = reader.record_size
+    size = os.path.getsize(path)
+    if rs <= 0 or size % rs:
+        raise ValueError(
+            f"{path!r}: size {size} is not a multiple of the "
+            f"{rs}-byte record (not profiled)")
+    stride = max(rs, (int(params.stats_chunk_mb * MEGABYTE) // rs) * rs)
+    seg_leaf = segment_leaf_name(reader.copybook, params)
+    chunks: List[ChunkStats] = []
+    kinds: Dict[str, str] = {}
+    done = 0
+    with open_stream(path) as stream:
+        while done < size:
+            nbytes = min(stride, size - done)
+            data = stream.next_view(nbytes)
+            if not data:
+                break
+            result = reader.read_result(data, backend=backend,
+                                        file_id=0,
+                                        first_record_id=done // rs)
+            table = result.to_arrow(schema)
+            fields, chunk_kinds, segments = profile_table(table, seg_leaf)
+            kinds.update(chunk_kinds)
+            lengths = {rs: table.num_rows}
+            if len(lengths) > LENGTH_HISTOGRAM_LIMIT:  # pragma: no cover
+                lengths = {-1: table.num_rows}
+            chunks.append(ChunkStats(done, len(data), table.num_rows,
+                                     fields, segments, lengths))
+            done += len(data)
+    return FileProfile(path, "fixed", rs,
+                       sum(c.records for c in chunks), size, kinds,
+                       chunks)
+
+
+def _profile_var_len(reader, path: str, params, backend, schema,
+                     io=None) -> FileProfile:
+    from ..reader.index import file_index_entries
+    from ..reader.stream import open_stream
+
+    size = os.path.getsize(path)
+    entries = file_index_entries(reader, path, 0, params, io=io)
+    if entries:
+        grid = [(e.offset_from,
+                 size if e.offset_to == -1 else e.offset_to,
+                 e.record_index) for e in entries]
+    else:
+        grid = [(0, size, 0)]  # too small to index: one chunk
+    seg = params.multisegment
+    prefix = (seg.segment_id_prefix if seg and seg.segment_id_prefix
+              else None)
+    seg_leaf = segment_leaf_name(reader.copybook, params)
+    chunks: List[ChunkStats] = []
+    kinds: Dict[str, str] = {}
+    for start, end, record_index in grid:
+        with open_stream(path, start_offset=start,
+                         maximum_bytes=end - start, io=io) as stream:
+            result = reader.read_result_columnar(
+                stream, file_id=0, backend=backend,
+                segment_id_prefix=prefix,
+                start_record_id=record_index,
+                starting_file_offset=start)
+            table = result.to_arrow(schema)
+        fields, chunk_kinds, segments = profile_table(table, seg_leaf)
+        kinds.update(chunk_kinds)
+        # per-record lengths are not recovered here: the unbucketed
+        # count keys on -1 (drift compares the bytes/records average)
+        chunks.append(ChunkStats(start, end - start, table.num_rows,
+                                 fields, segments,
+                                 {-1: table.num_rows}))
+    return FileProfile(path, "vrl", 0, sum(c.records for c in chunks),
+                       size, kinds, chunks)
+
+
+def build_and_store_profiles(files, copybook_contents, params,
+                             backend: str, io=None) -> Dict[str, FileProfile]:
+    """Profile every eligible file of a read (``collect_stats=true``):
+    warm entries load from the store, cold files run the canonical
+    profiling pass and persist. Returns {path: profile}; files that
+    cannot be profiled are skipped (best-effort — profiling must never
+    fail the read that triggered it)."""
+    import logging
+
+    from ..plan.cache import parse_fingerprint
+    from ..reader.fixed_len_reader import FixedLenReader
+    from ..reader.schema import output_schema_for
+    from ..reader.stream import normalize_local
+    from ..reader.var_len_reader import VarLenReader
+
+    bump_overhead()
+    profiles: Dict[str, FileProfile] = {}
+    if profiling_eligibility(files, params, backend) is not None:
+        return profiles
+    bare = _bare_params(params)
+    is_var_len = bare.needs_var_len_reader
+    if is_var_len:
+        # the profiling grid: the caller's explicit split options when
+        # given, else a sparse index at stats_chunk_mb splits (clamped
+        # to the index validator's 1 MB floor). Any grid is safe — the
+        # config fingerprint excludes split knobs and the skipper's
+        # union-coverage rule tolerates grid mismatches — so explicit
+        # options just make the profile match the scan's granularity
+        if (params.input_split_records is None
+                and params.input_split_size_mb is None):
+            bare = replace(
+                bare, input_split_records=None,
+                input_split_size_mb=max(1.0,
+                                        float(params.stats_chunk_mb)))
+        bare = replace(bare, is_index_generation_needed=True)
+        reader = VarLenReader(copybook_contents, bare)
+        if reader.copybook.is_hierarchical:
+            return profiles  # no flat chunk/record model to profile
+    else:
+        reader = FixedLenReader(copybook_contents, bare)
+    schema = output_schema_for(reader.copybook, bare, is_var_len)
+    store = StatsStore(params.cache_dir)
+    config_fp = stats_config_fingerprint(
+        parse_fingerprint(copybook_contents, params), params)
+    for path in files:
+        local = normalize_local(path)
+        fingerprint = local_fingerprint(local)
+        if fingerprint is None:
+            continue
+        cached = store.load(local, fingerprint, config_fp)
+        if cached is not None:
+            profiles[path] = cached
+            continue
+        try:
+            if is_var_len:
+                profile = _profile_var_len(reader, local, bare, backend,
+                                           schema, io=io)
+            else:
+                profile = _profile_fixed(reader, local, bare, backend,
+                                         schema)
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "stats profiling failed for %s; the read is unaffected",
+                path, exc_info=True)
+            continue
+        store.save_for_local_path(local, config_fp, profile)
+        profiles[path] = profile
+    if profiles:
+        from .service import note_profiles
+
+        note_profiles(profiles)
+    return profiles

@@ -35,6 +35,7 @@ whole-file flavors of those).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
@@ -264,6 +265,13 @@ class ContinuousIngestor:
 
         self.io = IoConfig.from_params(self.params)
         self.metrics = stream_metrics()
+        # ingest drift observability (collect_stats=true): per-source
+        # {"prev": GenerationProfile, "live": GenerationProfile} — the
+        # live profile folds every delivered batch; a drained
+        # generation is compared against its predecessor on rotation /
+        # finalize (stats/drift.py). Plain dict here: the stats package
+        # itself is imported only when collect_stats is on
+        self._drift: Dict[str, dict] = {}
         # -- durable + live state --------------------------------------
         self.store = (CheckpointStore(checkpoint_dir, stream_id)
                       if checkpoint_dir else None)
@@ -710,6 +718,10 @@ class ContinuousIngestor:
 
     def _switch_generation(self, live: _LiveSource,
                            drained: bool) -> None:
+        if not drained:
+            # truncation/restart: the generation's profile is partial —
+            # discard it rather than emit drift from incomplete data
+            self._drift_generation_end(live, drained=False)
         state = live.state
         if drained and state.ino:
             self._finished[str(state.ino)] = {
@@ -740,6 +752,7 @@ class ContinuousIngestor:
         index, then either switch to the successor (rotation) or mark
         the source done (stream finalize)."""
         state = live.state
+        self._drift_generation_end(live, drained=True)
         self._persist_final_index(live)
         state.offset = state.pending_offset
         state.records = state.pending_records
@@ -1154,6 +1167,85 @@ class ContinuousIngestor:
         self._delivered_records += batch.records
         self.metrics["batches"].inc()
         self.metrics["records"].inc(batch.records)
+        if self.params.collect_stats:
+            self._drift_fold(batch)
+
+    # -- drift observability (collect_stats=true) -------------------------
+
+    def _drift_fold(self, batch: IngestBatch) -> None:
+        """Fold one delivered batch into its generation's live profile
+        (every delivery path — sequential, pipelined backlog, directory
+        — funnels through `_advance_metrics`, so no batch is missed)."""
+        from ..stats import collect
+        from ..stats.drift import GenerationProfile
+
+        entry = self._drift.setdefault(batch.source,
+                                       {"prev": None, "live": None})
+        name = f"{batch.source}#gen{batch.generation}"
+        prof = entry["live"]
+        if prof is None or prof.name != name:
+            prof = GenerationProfile(
+                name, collect.segment_leaf_name(self.reader.copybook,
+                                                self.params))
+            entry["live"] = prof
+        try:
+            prof.fold(batch.to_arrow(),
+                      nbytes=max(0, batch.offset_to - batch.offset_from))
+        except Exception:
+            # observability must never fail delivery; a fold error just
+            # leaves this window out of the profile
+            _logger.debug("drift profile fold failed for %s",
+                          batch.source, exc_info=True)
+
+    def _drift_generation_end(self, live: _LiveSource,
+                              drained: bool) -> None:
+        """A generation ended: compare its completed profile against
+        the previous generation's and emit drift records (metrics +
+        stats service ring + a JSONL trail under the cache root)."""
+        if not self.params.collect_stats:
+            return
+        entry = self._drift.get(live.state.path)
+        if entry is None:
+            return
+        cur, entry["live"] = entry["live"], None
+        if cur is None or not drained:
+            return
+        prev, entry["prev"] = entry["prev"], cur
+        if prev is None:
+            return  # first completed generation: nothing to compare
+        from ..stats import service
+        from ..stats.drift import compare_generations
+
+        events = compare_generations(prev, cur)
+        self.metrics["stats_last_drift"].set(len(events))
+        if not events:
+            return
+        for ev in events:
+            self.metrics["stats_drift"].labels(kind=ev["kind"]).inc()
+        service.note_drift(events)
+        self._drift_append_jsonl(events)
+        _logger.warning(
+            "data drift detected on %s (%d record(s)): %s",
+            live.state.path, len(events),
+            ", ".join(sorted({ev["kind"] for ev in events})))
+
+    def _drift_append_jsonl(self, events: List[dict]) -> None:
+        """Durable drift trail: `<cache_dir>/stats/drift.jsonl`, one
+        JSON record per event. Best-effort — the cache must never fail
+        the stream."""
+        if self.io is None or not self.io.cache_enabled:
+            return
+        import json as _json
+
+        path = os.path.join(self.io.cache_dir, "stats", "drift.jsonl")
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "a", encoding="utf-8") as f:
+                for ev in events:
+                    f.write(_json.dumps(dict(ev, ts=time.time()),
+                                        sort_keys=True) + "\n")
+        except OSError:
+            pass
 
     def _update_gauges(self) -> None:
         lag = self.lag_bytes()

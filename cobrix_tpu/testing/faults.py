@@ -345,17 +345,21 @@ def register_chaos_backend(scheme: str, data: bytes,
 def cache_entry_paths(cache_dir: str, plane: str = "block"):
     """Every durable entry file of one cache plane under `cache_dir`,
     sorted for determinism. Planes: 'block' (aligned .blk entries),
-    'index' (sparse-index .json payloads), 'checkpoint' (continuous-
-    ingest watermark slots — pass the CHECKPOINT directory)."""
+    'index' (sparse-index .json payloads), 'stats' (scan-profile .json
+    payloads), 'checkpoint' (continuous-ingest watermark slots — pass
+    the CHECKPOINT directory)."""
     if plane == "checkpoint":
         from ..streaming.checkpoint import checkpoint_files
 
         return checkpoint_files(cache_dir)
-    sub = {"block": "blocks", "index": "index"}[plane]
-    suffix = {"block": ".blk", "index": ".json"}[plane]
+    sub = {"block": "blocks", "index": "index", "stats": "stats"}[plane]
+    suffix = {"block": ".blk", "index": ".json", "stats": ".json"}[plane]
     root = os.path.join(cache_dir, sub)
     out = []
-    for dirpath, _dirs, files in os.walk(root):
+    for dirpath, dirs, files in os.walk(root):
+        if os.path.basename(dirpath) == "quarantine":
+            dirs[:] = []
+            continue
         for name in files:
             if name.endswith(suffix):
                 out.append(os.path.join(dirpath, name))

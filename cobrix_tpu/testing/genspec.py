@@ -20,12 +20,15 @@ this module provides:
   record) pair to a minimal reproduction by dropping fields and
   trivializing leaf values while the failure persists.
 
-Known round-trip gaps are *excluded from generation* and documented in
-cobrix_tpu/encode/fields.py: IBM-format COMP-1 (the reader's
-sign-mask-as-exponent quirk means nonzero singles never round-trip — the
-fuzzer pins floating_point_format=ieee754), and non-explicit DISPLAY
-decimals where blank fill decodes to 0 rather than None (the fuzzer
-never emits None for those fields).
+The one remaining round-trip gap is *excluded from generation* and
+documented in cobrix_tpu/encode/fields.py: IBM-format COMP-1 (the
+reader's sign-mask-as-exponent quirk means nonzero singles never
+round-trip — the fuzzer pins floating_point_format=ieee754). Blank
+implied-point DISPLAY decimals now decode to None (so None is in every
+DISPLAY decimal's canonical domain), and duplicate-glyph code pages
+encode deterministically lowest-byte-wins (raw alias bytes reach one
+canonical fixed point after a single decode→encode round — P3 in
+tools/rtcheck.py covers that surface).
 """
 from __future__ import annotations
 
@@ -211,9 +214,9 @@ def _rand_primitive(rng: random.Random, name: str,
         if kind == "display_dec":
             f.scale = rng.randint(1, 4)
             f.explicit_dot = rng.random() < 0.4
-            # blank fill decodes to 0 for implied-point decimals: None
-            # is canonical only with an explicit point
-            f.allow_none = f.explicit_dot and rng.random() < 0.5
+            # blank fill decodes back to None for implied-point AND
+            # explicit-point decimals alike, so None is canonical on both
+            f.allow_none = rng.random() < 0.5
         else:
             f.allow_none = rng.random() < 0.5
     return f

@@ -67,9 +67,10 @@ SCALAR_BODIES = [
     [(Decimal("-123.45"), -12345678, 9999, Decimal("98765.43"),
       -10 ** 20 - 7, 2.5, -1234.0625, "Ab.9-Z")],
     [(Decimal("0.00"), 0, 0, Decimal("0.00"), 0, 0.0, 0.0, "")],
-    # None is canonical for COMP-3 only here: an implied-point DISPLAY
-    # decimal decodes blank fill to 0.00 (documented encoder gap)
-    [(Decimal("-0.07"), 1, 1, None, None, 1.5, -0.25, "x")],
+    # None is canonical everywhere blank fill can express it — including
+    # the implied-point DISPLAY decimal (blank decodes to null, not 0.00)
+    [(None, 1, 1, None, None, 1.5, -0.25, "x")],
+    [(Decimal("-0.07"), 2, 2, Decimal("0.01"), 5, 0.5, 2.0, "y")],
 ]
 
 
@@ -245,6 +246,39 @@ def test_safe_alphabet_round_trips_per_code_page():
         enc = get_code_page_encode_table(cp)
         for ch in safe_alphabet(cp):
             assert table[enc[ch]] == ch
+
+
+def test_duplicate_glyph_encode_is_lowest_byte_wins():
+    """Every glyph that several EBCDIC bytes decode to must encode to
+    the LOWEST of those bytes on every builtin page — the deterministic
+    inversion that makes decode→encode→decode byte-stable once the
+    aliases canonicalize (rtcheck P3 covers the end-to-end surface)."""
+    from cobrix_tpu.encoding.codepages import (
+        get_code_page_encode_table,
+        get_code_page_table,
+    )
+
+    for cp in rtcheck.ALIAS_CODE_PAGES:
+        table = get_code_page_table(cp)
+        enc = get_code_page_encode_table(cp)
+        first_byte = {}
+        duplicated = set()
+        for byte in range(256):
+            ch = table[byte]
+            if ch in first_byte:
+                duplicated.add(ch)
+            else:
+                first_byte[ch] = byte
+        assert duplicated, cp  # every builtin page carries alias glyphs
+        for ch in duplicated:
+            want = 0x40 if ch == " " else first_byte[ch]
+            assert enc[ch] == want, (cp, ch, hex(enc[ch]))
+
+
+def test_rtcheck_alias_matrix():
+    """P3: raw alias bytes canonicalize in one decode→encode round on
+    every builtin code page."""
+    assert rtcheck.run_alias_matrix(seeds=(0,)) == 0
 
 
 def test_rtcheck_quick_harness():

@@ -1,0 +1,531 @@
+"""Scan-time data profiler (ISSUE 19): persisted per-chunk statistics,
+zone-map chunk skipping, stats-answered aggregates, drift observability.
+
+The invariants pinned here:
+
+* chunk skipping NEVER changes results — a ``use_stats`` warm read is
+  byte-identical to the stats-off read (fixed + VRL + multisegment,
+  sequential / pipelined / multihost), and a 1%-selective warm scan
+  skips >=90% of chunks (counter-verified);
+* the persisted profile payload is identical whichever execution mode
+  ran the collecting read;
+* a corrupt stats entry is quarantined + counted and the scan falls
+  back to reading everything (never a wrong skip); a profile collected
+  under a different decode configuration is a clean miss; the split
+  grid is deliberately NOT part of the configuration;
+* stats-off reads pay zero stats overhead (counter-asserted);
+* aggregates answered from statistics alone are byte-identical to the
+  decode path (fixed decimal/int sums, VRL multisegment string ranges);
+* rotated ingest generations are compared and material shifts become
+  drift records (stream metrics, the /stats ring, the JSONL trail).
+"""
+import json
+import os
+
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+
+from cobrix_tpu import read_cobol, tail_cobol
+from cobrix_tpu.obs.metrics import stream_metrics
+from cobrix_tpu.query import dataset
+from cobrix_tpu.stats import collect, service
+from cobrix_tpu.stats.aggregate import parse_specs
+from cobrix_tpu.testing.faults import (
+    cache_entry_paths,
+    corrupt_cache_entry,
+    rotate_source,
+)
+from cobrix_tpu.testing.generators import (
+    EXP2_COPYBOOK,
+    TRANSDATA_COPYBOOK,
+    generate_exp2,
+    generate_transactions,
+)
+
+from util import hard_timeout
+
+SORTED_COPYBOOK = """
+       01  REC.
+           05  KEY-ID    PIC 9(4).
+           05  NAME      PIC X(4).
+"""
+
+VRL_OPTS = dict(copybook_contents=EXP2_COPYBOOK,
+                is_record_sequence="true", segment_field="SEGMENT_ID",
+                schema_retention_policy="collapse_root",
+                redefine_segment_id_map="STATIC-DETAILS => C",
+                **{"redefine-segment-id-map:1": "CONTACTS => P"})
+
+FIXED_OPTS = dict(copybook_contents=TRANSDATA_COPYBOOK,
+                  schema_retention_policy="collapse_root")
+
+STREAM_COPYBOOK = """
+        01  R.
+            05  KEY    PIC 9(7) COMP.
+            05  NAME   PIC X(9).
+"""
+
+
+def _sorted_fixed_bytes(n=4096):
+    """n 8-byte EBCDIC records with KEY-ID == record ordinal, so chunk
+    zone maps are disjoint and equality filters are ~1-chunk
+    selective."""
+    out = bytearray()
+    for i in range(n):
+        out += bytes(0xF0 + int(d) for d in f"{i:04d}")
+        out += bytes((0xC1 if i % 2 == 0 else 0xC2,)) * 4
+    return bytes(out)
+
+
+def _stream_records(n, start=0):
+    return b"".join(
+        (start + i).to_bytes(4, "big")
+        + f"ROW{(start + i) % 1000000:06d}".encode("ascii")
+        for i in range(n))
+
+
+@pytest.fixture()
+def sorted_fixed(tmp_path):
+    path = tmp_path / "sorted.dat"
+    path.write_bytes(_sorted_fixed_bytes())
+    return str(path)
+
+
+@pytest.fixture()
+def vrl_file(tmp_path):
+    path = tmp_path / "companies.dat"
+    path.write_bytes(bytes(generate_exp2(1200, seed=7)))
+    return str(path)
+
+
+def _stats_payloads(cache_dir):
+    out = []
+    for path in cache_entry_paths(cache_dir, "stats"):
+        with open(path, encoding="utf-8") as f:
+            out.append(json.load(f))
+    return out
+
+
+# -- zero overhead when off ----------------------------------------------
+
+def test_stats_off_reads_pay_zero_overhead(sorted_fixed, tmp_path):
+    before = collect.overhead_events()
+    d = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                   filter="KEY_ID == 5",
+                   cache_dir=str(tmp_path / "cache"))
+    assert d.to_arrow().num_rows == 1
+    assert collect.overhead_events() == before
+    # the new pushdown depth reports (as zeros) even without stats
+    pd = d.metrics.pushdown
+    assert pd["chunks_considered"] == 0 and pd["chunks_skipped"] == 0
+
+
+# -- profile determinism across execution modes --------------------------
+
+@pytest.mark.parametrize("mode_opts", [
+    {},
+    {"pipeline_workers": "2", "chunk_size_mb": "0.008"},
+    {"hosts": "2"},
+], ids=["sequential", "pipelined", "multihost"])
+def test_fixed_profile_identical_across_modes(sorted_fixed, tmp_path,
+                                              mode_opts):
+    with hard_timeout(120, "fixed profile modes"):
+        seq_cache = str(tmp_path / "seq")
+        read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                   cache_dir=seq_cache, collect_stats="true",
+                   stats_chunk_mb="0.002")
+        reference = _stats_payloads(seq_cache)
+        assert len(reference) == 1
+        mode_cache = str(tmp_path / "mode")
+        d = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                       cache_dir=mode_cache, collect_stats="true",
+                       stats_chunk_mb="0.002", **mode_opts)
+        assert len(d.stats_profiles) == 1
+        assert _stats_payloads(mode_cache) == reference
+
+
+@pytest.mark.parametrize("mode_opts", [
+    {},
+    {"pipeline_workers": "2"},
+    {"hosts": "2"},
+], ids=["sequential", "pipelined", "multihost"])
+def test_vrl_profile_identical_across_modes(vrl_file, tmp_path,
+                                            mode_opts):
+    with hard_timeout(180, "vrl profile modes"):
+        seq_cache = str(tmp_path / "seq")
+        read_cobol(vrl_file, cache_dir=seq_cache, collect_stats="true",
+                   input_split_records="200", **VRL_OPTS)
+        reference = _stats_payloads(seq_cache)
+        assert len(reference) == 1
+        assert reference[0]["profile"]["record_kind"] == "vrl"
+        mode_cache = str(tmp_path / "mode")
+        read_cobol(vrl_file, cache_dir=mode_cache, collect_stats="true",
+                   input_split_records="200", **mode_opts, **VRL_OPTS)
+        assert _stats_payloads(mode_cache) == reference
+
+
+# -- chunk skipping ------------------------------------------------------
+
+def test_selective_warm_scan_skips_90pct_byte_identical(sorted_fixed,
+                                                        tmp_path):
+    cache = str(tmp_path / "cache")
+    read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+               cache_dir=cache, collect_stats="true",
+               stats_chunk_mb="0.002")
+    base = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      filter="KEY_ID == 5").to_arrow()
+    warm = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      cache_dir=cache, use_stats="true",
+                      stats_chunk_mb="0.002", filter="KEY_ID == 5")
+    assert warm.to_arrow().equals(base)
+    pd = warm.metrics.pushdown
+    assert pd["chunks_considered"] >= 10
+    assert pd["chunks_skipped"] / pd["chunks_considered"] >= 0.9, pd
+    assert pd["bytes_skipped"] > 0
+
+
+@pytest.mark.parametrize("flt", [
+    "KEY_ID == 5", "KEY_ID >= 4000", "KEY_ID == 9999",
+    "NAME == 'ZZZZ'", "KEY_ID < 300 and NAME == 'AAAA'",
+])
+@pytest.mark.parametrize("mode_opts", [
+    {}, {"pipeline_workers": "2", "chunk_size_mb": "0.008"},
+], ids=["sequential", "pipelined"])
+def test_fixed_skipping_parity(sorted_fixed, tmp_path, flt, mode_opts):
+    cache = str(tmp_path / "cache")
+    read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+               cache_dir=cache, collect_stats="true",
+               stats_chunk_mb="0.002")
+    base = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      filter=flt, **mode_opts).to_arrow()
+    warm = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      cache_dir=cache, use_stats="true",
+                      stats_chunk_mb="0.002", filter=flt, **mode_opts)
+    assert warm.to_arrow().equals(base)
+    assert warm.metrics.pushdown["chunks_considered"] > 0
+
+
+@pytest.mark.parametrize("mode_opts", [
+    {}, {"pipeline_workers": "2"}, {"hosts": "2"},
+], ids=["sequential", "pipelined", "multihost"])
+def test_vrl_multisegment_skipping_parity(vrl_file, tmp_path,
+                                          mode_opts):
+    with hard_timeout(180, "vrl skipping parity"):
+        cache = str(tmp_path / "cache")
+        read_cobol(vrl_file, cache_dir=cache, collect_stats="true",
+                   input_split_records="200", **VRL_OPTS)
+        for flt in ("COMPANY_ID == '00'",
+                    "SEGMENT_ID == 'C' and COMPANY_ID < '3'"):
+            base = read_cobol(vrl_file, filter=flt,
+                              input_split_records="200", **mode_opts,
+                              **VRL_OPTS).to_arrow()
+            warm = read_cobol(vrl_file, cache_dir=cache,
+                              use_stats="true", filter=flt,
+                              input_split_records="200", **mode_opts,
+                              **VRL_OPTS)
+            assert warm.to_arrow().equals(base), flt
+            pd = warm.metrics.pushdown
+            assert pd["chunks_considered"] > 0
+            if flt == "COMPANY_ID == '00'":
+                # provably below the file-wide min: everything skips
+                assert pd["chunks_skipped"] == pd["chunks_considered"]
+
+
+def test_profile_survives_grid_mismatch(sorted_fixed, tmp_path):
+    """The split grid is deliberately OUTSIDE the config fingerprint: a
+    profile collected on one grid serves scans planned on any other."""
+    cache = str(tmp_path / "cache")
+    read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+               cache_dir=cache, collect_stats="true",
+               stats_chunk_mb="0.002")
+    base = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      filter="KEY_ID == 9999").to_arrow()
+    warm = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      cache_dir=cache, use_stats="true",
+                      stats_chunk_mb="0.008",  # 4x the profile grid
+                      filter="KEY_ID == 9999")
+    assert warm.to_arrow().equals(base)
+    pd = warm.metrics.pushdown
+    assert pd["chunks_skipped"] == pd["chunks_considered"] > 0
+
+
+# -- corruption + config mismatch ----------------------------------------
+
+@pytest.mark.parametrize("mode", ["bitflip", "garbage", "truncate"])
+def test_corrupt_stats_entry_quarantines_and_falls_back(
+        sorted_fixed, tmp_path, mode):
+    from cobrix_tpu.io.integrity import corruption_counter
+
+    cache = str(tmp_path / "cache")
+    read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+               cache_dir=cache, collect_stats="true",
+               stats_chunk_mb="0.002")
+    corrupt_cache_entry(cache, "stats", mode=mode)
+    before = corruption_counter().value(plane="stats")
+    base = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      filter="KEY_ID == 5").to_arrow()
+    warm = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      cache_dir=cache, use_stats="true",
+                      stats_chunk_mb="0.002", filter="KEY_ID == 5")
+    # full-scan fallback: byte-identical, nothing skipped
+    assert warm.to_arrow().equals(base)
+    assert warm.metrics.pushdown["chunks_skipped"] == 0
+    assert corruption_counter().value(plane="stats") == before + 1
+    assert not cache_entry_paths(cache, "stats")  # quarantined away
+    assert os.listdir(os.path.join(cache, "quarantine"))
+    # the next collecting read rebuilds, and skipping resumes
+    read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+               cache_dir=cache, collect_stats="true",
+               stats_chunk_mb="0.002")
+    again = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                       cache_dir=cache, use_stats="true",
+                       stats_chunk_mb="0.002", filter="KEY_ID == 5")
+    assert again.to_arrow().equals(base)
+    assert again.metrics.pushdown["chunks_skipped"] > 0
+
+
+def test_wrong_config_profile_is_a_clean_miss(sorted_fixed, tmp_path):
+    cache = str(tmp_path / "cache")
+    read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+               cache_dir=cache, collect_stats="true",
+               stats_chunk_mb="0.002")
+    # record_error_policy is part of the decode configuration: the
+    # warm profile must NOT serve a differently-configured scan
+    base = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      record_error_policy="permissive",
+                      filter="KEY_ID == 5").to_arrow()
+    warm = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      cache_dir=cache, use_stats="true",
+                      record_error_policy="permissive",
+                      stats_chunk_mb="0.002", filter="KEY_ID == 5")
+    assert warm.to_arrow().equals(base)
+    assert warm.metrics.pushdown["chunks_skipped"] == 0
+
+
+def test_stale_file_version_is_a_clean_miss(sorted_fixed, tmp_path):
+    cache = str(tmp_path / "cache")
+    read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+               cache_dir=cache, collect_stats="true",
+               stats_chunk_mb="0.002")
+    # rewrite the file (same decodable content, new version): the old
+    # profile must not produce skips against the new bytes
+    data = open(sorted_fixed, "rb").read()
+    with open(sorted_fixed, "wb") as f:
+        f.write(data)
+    os.utime(sorted_fixed, ns=(1, 1))
+    warm = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      cache_dir=cache, use_stats="true",
+                      stats_chunk_mb="0.002", filter="KEY_ID == 5")
+    assert warm.to_arrow().num_rows == 1
+    assert warm.metrics.pushdown["chunks_skipped"] == 0
+
+
+# -- aggregates ----------------------------------------------------------
+
+def test_fixed_aggregates_from_stats_match_decode(tmp_path):
+    path = tmp_path / "trans.dat"
+    path.write_bytes(bytes(generate_transactions(1500, seed=3)))
+    cache = str(tmp_path / "cache")
+    read_cobol(str(path), cache_dir=cache, collect_stats="true",
+               stats_chunk_mb="0.01", **FIXED_OPTS)
+    aggs = ["count", "min:AMOUNT", "max:AMOUNT", "sum:AMOUNT",
+            "min:CURRENCY", "max:CURRENCY", "sum:WEALTH_QFY"]
+    ds_stats = dataset(str(path), cache_dir=cache, use_stats="true",
+                       **FIXED_OPTS)
+    fast = ds_stats._aggregate_from_stats(parse_specs(aggs))
+    assert fast is not None, "stats path did not answer"
+    plain = dataset(str(path), **FIXED_OPTS).aggregate(aggs)
+    assert fast == plain
+    # types too: Decimal stays Decimal, int stays int
+    assert [type(fast[k]) for k in sorted(fast)] \
+        == [type(plain[k]) for k in sorted(plain)]
+    assert ds_stats.aggregate(aggs) == plain
+    assert ds_stats.count_rows() == 1500
+    # a filtered aggregate always takes the decode path — and agrees
+    filt = ds_stats.aggregate(["count"], filter="WEALTH_QFY == 1")
+    assert filt == dataset(str(path), **FIXED_OPTS).aggregate(
+        ["count"], filter="WEALTH_QFY == 1")
+
+
+def test_vrl_multisegment_aggregates_match_decode(vrl_file, tmp_path):
+    cache = str(tmp_path / "cache")
+    read_cobol(vrl_file, cache_dir=cache, collect_stats="true",
+               input_split_records="200", **VRL_OPTS)
+    aggs = ["count", "min:COMPANY_ID", "max:COMPANY_ID",
+            "min:SEGMENT_ID", "max:SEGMENT_ID"]
+    ds_stats = dataset(vrl_file, cache_dir=cache, use_stats="true",
+                       **VRL_OPTS)
+    fast = ds_stats._aggregate_from_stats(parse_specs(aggs))
+    assert fast is not None, "stats path did not answer"
+    plain = dataset(vrl_file, **VRL_OPTS).aggregate(aggs)
+    assert fast == plain
+    assert ds_stats.count_rows() == 1200
+
+
+def test_cold_aggregate_falls_back_to_decode(tmp_path):
+    path = tmp_path / "trans.dat"
+    path.write_bytes(bytes(generate_transactions(200, seed=3)))
+    ds = dataset(str(path), cache_dir=str(tmp_path / "cache"),
+                 use_stats="true", **FIXED_OPTS)
+    # no profile collected: the stats path declines, decode answers
+    assert ds._aggregate_from_stats(parse_specs(["count"])) is None
+    assert ds.aggregate(["count"])["count"] == 200
+
+
+# -- observability surfaces ----------------------------------------------
+
+def test_explain_reports_statistics_and_skips(sorted_fixed, tmp_path):
+    cache = str(tmp_path / "cache")
+    rep = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                     cache_dir=cache, collect_stats="true",
+                     stats_chunk_mb="0.002", explain=True)
+    doc = rep.as_dict()
+    assert "statistics" in doc
+    assert "statistics:" in rep.render()
+    rep2 = read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+                      cache_dir=cache, use_stats="true",
+                      stats_chunk_mb="0.002", filter="KEY_ID == 9999",
+                      explain=True)
+    assert "chunk skipping:" in rep2.render()
+    measured = rep2.as_dict()["pushdown"]["measured"]
+    assert measured["chunks_skipped"] == measured["chunks_considered"]
+
+
+def test_stats_http_sidecar(sorted_fixed, tmp_path):
+    import urllib.request
+
+    from cobrix_tpu.serve.http import ObsHttpServer
+
+    service.reset_for_tests()
+    read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+               cache_dir=str(tmp_path / "cache"), collect_stats="true",
+               stats_chunk_mb="0.002")
+    srv = ObsHttpServer(stats_fn=service.snapshot)
+    srv.start()
+    try:
+        host, port = srv.address
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/stats", timeout=10) as r:
+            snap = json.loads(r.read())
+    finally:
+        srv.stop()
+    assert snap["counts"]["profiles_built"] >= 1
+    assert snap["profiles"]
+    prof = next(iter(snap["profiles"].values()))
+    assert prof["records"] == 4096 and prof["fields"]["KEY_ID"]
+
+
+def test_fleet_stats_federation_single_replica(sorted_fixed, tmp_path):
+    import urllib.request
+
+    from cobrix_tpu.serve import ScanServer
+
+    service.reset_for_tests()
+    read_cobol(sorted_fixed, copybook_contents=SORTED_COPYBOOK,
+               cache_dir=str(tmp_path / "cache"), collect_stats="true",
+               stats_chunk_mb="0.002")
+    with hard_timeout(120, "fleet stats"):
+        srv = ScanServer(
+            port=0, http_port=0,
+            server_options={"cache_dir": str(tmp_path / "scache")},
+            fleet=True, replica_id="solo",
+            heartbeat_interval_s=0.2).start()
+        try:
+            host, port = srv.http_address
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/stats", timeout=10) as r:
+                own = json.loads(r.read())
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/fleet/stats", timeout=10) as r:
+                fleet = json.loads(r.read())
+        finally:
+            srv.stop()
+    assert own["counts"]["profiles_built"] >= 1
+    assert fleet["replicas"]["solo"]["counts"] \
+        == own["counts"]
+
+
+# -- ingest drift --------------------------------------------------------
+
+def test_rotation_emits_drift_records(tmp_path):
+    """gen0 keys 0..49, gen1 keys 1000000..1000049: the generation
+    comparison must emit an out_of_range drift record for KEY — to the
+    stream metrics, the /stats ring, and the JSONL trail."""
+    with hard_timeout(120, "ingest drift"):
+        service.reset_for_tests()
+        cache = tmp_path / "cache"
+        src = tmp_path / "feed.dat"
+        src.write_bytes(_stream_records(50))
+        m = stream_metrics()
+        drift_before = m["stats_drift"].value(kind="out_of_range")
+        ing = tail_cobol(str(src), checkpoint_dir=str(tmp_path / "ck"),
+                         poll_interval_s=0.02,
+                         copybook_contents=STREAM_COPYBOOK,
+                         collect_stats="true", cache_dir=str(cache))
+        it = ing.batches()
+        rows = next(it).records
+        rotate_source(str(src), _stream_records(50, 1000000))
+        while rows < 100:
+            rows += next(it).records
+        ing.close(finalize=True)
+        assert m["stats_drift"].value(kind="out_of_range") \
+            == drift_before + 1
+        assert m["stats_last_drift"].value() == 1
+        ring = service.snapshot()["drift"]
+        assert any(ev["kind"] == "out_of_range"
+                   and ev["field"] == "KEY" for ev in ring), ring
+        trail = cache / "stats" / "drift.jsonl"
+        lines = [json.loads(line)
+                 for line in trail.read_text().splitlines()]
+        assert any(ev["kind"] == "out_of_range" for ev in lines)
+
+
+# -- statscheck smoke (the execution grid stays behind `slow`) -----------
+
+def test_statscheck_quick():
+    import subprocess
+    import sys
+
+    with hard_timeout(420, "statscheck quick"):
+        proc = subprocess.run(
+            [sys.executable, "tools/statscheck.py", "--mb", "0.5"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=400)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.slow
+def test_statscheck_sweep():
+    import subprocess
+    import sys
+
+    with hard_timeout(900, "statscheck sweep"):
+        proc = subprocess.run(
+            [sys.executable, "tools/statscheck.py", "--mb", "2",
+             "--sweep"],
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))),
+            capture_output=True, text=True, timeout=880)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_unrotated_stream_compares_clean(tmp_path):
+    """finalize without rotation = one generation: nothing to compare,
+    no drift records, and folding never perturbs delivery."""
+    with hard_timeout(60, "single generation"):
+        src = tmp_path / "feed.dat"
+        src.write_bytes(_stream_records(80))
+        m = stream_metrics()
+        before = m["stats_drift"].value(kind="out_of_range")
+        ing = tail_cobol(str(src), checkpoint_dir=str(tmp_path / "ck"),
+                         poll_interval_s=0.02,
+                         copybook_contents=STREAM_COPYBOOK,
+                         collect_stats="true",
+                         cache_dir=str(tmp_path / "cache"))
+        it = ing.batches()
+        rows = next(it).records
+        ing.close(finalize=True)
+        assert rows >= 1
+        assert m["stats_drift"].value(kind="out_of_range") == before

@@ -42,6 +42,11 @@ DEFAULT_PUSHDOWN_FLOOR = 3.0
 # the synthetic corpus) is a correctness bit, not a throughput: any run
 # that RAN the experiment and lost parity fails outright, history-free
 DEFAULT_PARITY_FLOOR = 1.0
+# absolute floor for exp_stats' warm zone-map-skipped scan vs the plain
+# pushdown scan of the SAME selective filter (the ISSUE 19 acceptance
+# claim: skipping whole chunks before framing must be >= 2x on top of
+# what PR 13's record-level pushdown already delivers) — history-free
+DEFAULT_STATS_FLOOR = 2.0
 # absolute floor for exp3's end-to-end/decode-only ratio (ISSUE 17: the
 # one-fused-pass claim — ISSUE 15's native assembly lifted the honest
 # e2e from ~0.15 of decode-only to ~0.6; the fused frame+segid scan,
@@ -96,7 +101,7 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
     add(doc)
     add(doc.get("decode_only"))
     for key in ("exp1", "exp2", "hierarchical", "exp_serve",
-                "exp_pushdown", "exp_roundtrip"):
+                "exp_pushdown", "exp_roundtrip", "exp_stats"):
         add(doc.get(key))
     # the fleet-mode serve experiment nests under exp_serve (it shares
     # that experiment's dataset); its aggregate-scaling metric gates on
@@ -114,6 +119,16 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
     if isinstance(pd, dict):
         speedup = pd.get("speedup")
         out["exp_pushdown_speedup"] = {
+            "value": (float(speedup)
+                      if isinstance(speedup, (int, float)) else 0.0),
+            "fraction": None}
+    # the stats experiment's speedup vs the plain pushdown scan gates
+    # the chunk-skipping claim the same way: ran-but-raised (no
+    # speedup field — incl. the in-run parity assertion) gates as 0
+    st = doc.get("exp_stats")
+    if isinstance(st, dict):
+        speedup = st.get("speedup_vs_pushdown")
+        out["exp_stats_speedup"] = {
             "value": (float(speedup)
                       if isinstance(speedup, (int, float)) else 0.0),
             "fraction": None}
@@ -152,17 +167,20 @@ def gate(fresh: Dict[str, dict], history: List[Dict[str, dict]],
          tolerance: float, min_history: int,
          pushdown_floor: float = DEFAULT_PUSHDOWN_FLOOR,
          e2e_ratio_floor: float = DEFAULT_E2E_RATIO_FLOOR,
-         parity_floor: float = DEFAULT_PARITY_FLOOR) -> List[dict]:
+         parity_floor: float = DEFAULT_PARITY_FLOOR,
+         stats_floor: float = DEFAULT_STATS_FLOOR) -> List[dict]:
     """Evaluate every fresh metric against its history series; returns
     one row per comparable metric with verdict 'ok' | 'regression' |
     'insufficient_history'. `exp_pushdown_speedup`,
-    `e2e_vs_decode_only`, and `exp_roundtrip_parity` additionally gate
-    against ABSOLUTE floors — the 3x pushdown claim, the
-    native-assembly-overhead claim, and encode/decode byte parity need
+    `e2e_vs_decode_only`, `exp_roundtrip_parity`, and
+    `exp_stats_speedup` additionally gate against ABSOLUTE floors —
+    the 3x pushdown claim, the native-assembly-overhead claim,
+    encode/decode byte parity, and the 2x chunk-skipping claim need
     no history to be falsifiable."""
     floors = {"exp_pushdown_speedup": pushdown_floor,
               "e2e_vs_decode_only": e2e_ratio_floor,
-              "exp_roundtrip_parity": parity_floor}
+              "exp_roundtrip_parity": parity_floor,
+              "exp_stats_speedup": stats_floor}
     rows: List[dict] = []
     for name, entry in sorted(fresh.items()):
         floor = floors.get(name, 0.0)
@@ -318,6 +336,31 @@ def _smoke() -> int:
     check("errored pushdown experiment fails the floor",
           any(r["metric"] == "exp_pushdown_speedup"
               and r["verdict"] == "regression" for r in rows))
+
+    # exp_stats' speedup over the plain pushdown scan gates on the
+    # absolute 2x floor, history-free
+    st_doc = {"metric": "exp3_to_arrow", "value": 100.0, "unit": "MB/s",
+              "exp_stats": {"metric": "exp_stats_to_arrow",
+                            "value": 2400.0, "unit": "MB/s",
+                            "speedup_vs_pushdown": 3.1}}
+    rows = gate(extract_metrics(st_doc), [], 0.25, 2)
+    check("stats chunk-skip speedup >= floor passes with no history",
+          any(r["metric"] == "exp_stats_speedup"
+              and r["verdict"] == "ok" for r in rows))
+    st_doc["exp_stats"]["speedup_vs_pushdown"] = 1.2
+    rows = gate(extract_metrics(st_doc), [], 0.25, 2)
+    check("stats speedup below the 2x floor is caught",
+          any(r["metric"] == "exp_stats_speedup"
+              and r["verdict"] == "regression" for r in rows))
+    st_doc["exp_stats"] = {"metric": "exp_stats_to_arrow",
+                           "error": "boom"}
+    rows = gate(extract_metrics(st_doc), [], 0.25, 2)
+    check("errored stats experiment fails the floor",
+          any(r["metric"] == "exp_stats_speedup"
+              and r["verdict"] == "regression" for r in rows))
+    check("docs predating exp_stats are not gated on it",
+          "exp_stats_speedup" not in extract_metrics(
+              _doc(100.0, 50.0)))
 
     # e2e_vs_decode_only gates on its absolute floor, history-free
     ratio_doc = {"metric": "exp3_to_arrow", "value": 500.0,

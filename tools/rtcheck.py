@@ -7,7 +7,12 @@ decode path. This check enforces two properties end to end through
   P1 (value round-trip)  decode(encode(body)) == body for every body
      drawn from the canonical value domain (testing/genspec.py);
   P2 (byte stability)    re-encoding the decoded rows reproduces the
-     original file byte for byte.
+     original file byte for byte;
+  P3 (alias canonicalization)  RAW bytes — including duplicate-glyph
+     alias bytes the encoder can never emit — reach a canonical fixed
+     point after ONE decode→encode round: the re-encoded file decodes
+     to the same rows and re-encodes to the same bytes (deterministic
+     lowest-byte-wins inversion on every builtin code page).
 
 Quick mode runs a deterministic seed matrix over both framings (fixed
 and RDW) in a few seconds — tier-1 runs it via tests/test_roundtrip.py.
@@ -69,6 +74,76 @@ def roundtrip_failure(spec, bodies, framing: str):
         return (f"P2 byte instability at offset {at}: re-encode gives "
                 f"{len(rebytes)} bytes vs {len(data)} original")
     return None
+
+
+ALIAS_CODE_PAGES = ("common", "common_extended", "cp037",
+                    "cp037_extended", "cp875")
+
+
+def alias_roundtrip_failure(code_page: str, raw: bytes,
+                            width: int = 16):
+    """P3 for one raw byte image on one code page: decode the raw
+    bytes through a PIC X(width) reader, re-encode, and demand the
+    canonical fixed point — decode(canon) == decode-after-one-round
+    and re-encoding reproduces `canon` byte for byte. None if P3
+    holds, else a short failure tag."""
+    from cobrix_tpu import read_cobol
+
+    copybook = f"""
+       01  R.
+           05  S  PIC X({width}).
+"""
+    if len(raw) % width:
+        raw = raw + b"\x40" * (width - len(raw) % width)
+
+    def decode_reencode(data: bytes):
+        with tempfile.NamedTemporaryFile(suffix=".dat",
+                                         delete=False) as f:
+            f.write(data)
+            path = f.name
+        try:
+            out = read_cobol(path, copybook_contents=copybook,
+                             ebcdic_code_page=code_page)
+            return out.to_rows(), out.to_ebcdic(framing="fixed")
+        finally:
+            os.unlink(path)
+
+    rows1, canon = decode_reencode(raw)
+    rows2, stable = decode_reencode(canon)
+    if rows2 != rows1:
+        return (f"P3 value instability on {code_page}: canonical "
+                f"bytes decode to different rows than the raw image")
+    if stable != canon:
+        n = min(len(stable), len(canon))
+        at = next((i for i in range(n) if stable[i] != canon[i]), n)
+        return (f"P3 alias bytes on {code_page} do not reach a fixed "
+                f"point after one round (first divergence at byte "
+                f"{at})")
+    return None
+
+
+def run_alias_matrix(seeds=(0, 1, 2)) -> int:
+    """P3 over every builtin code page: the full byte space (all 256
+    values, so every duplicate-glyph alias byte is exercised) plus a
+    few random images per page."""
+    failures = 0
+    cases = 0
+    every_byte = bytes(range(256))
+    for code_page in ALIAS_CODE_PAGES:
+        images = [every_byte]
+        for seed in seeds:
+            rng = random.Random(7000 + seed)
+            images.append(bytes(rng.randrange(256)
+                                for _ in range(16 * 24)))
+        for raw in images:
+            cases += 1
+            failure = alias_roundtrip_failure(code_page, raw)
+            if failure:
+                failures += 1
+                print(f"FAIL code_page={code_page}: {failure}")
+    print(f"rtcheck alias: {cases} raw images over "
+          f"{len(ALIAS_CODE_PAGES)} code pages, {failures} failure(s)")
+    return failures
 
 
 def _framing_for(spec, rng=None) -> str:
@@ -135,7 +210,7 @@ def run_quick() -> int:
                                1000 + seed)
     print(f"rtcheck quick: {cases} copybooks, "
           f"{failures} failure(s)")
-    return failures
+    return failures + run_alias_matrix()
 
 
 def run_sweep(n: int, base_seed: int) -> int:
