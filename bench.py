@@ -1545,6 +1545,98 @@ def run_roundtrip_side_metric(mb_target: float) -> dict:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def run_compressed_side_metric(mb_target: float) -> dict:
+    """exp_compressed: the streaming decompression plane measured end
+    to end. Two gzip feeds of the SAME synthetic TXN corpus at
+    different compression ratios — the corpus writer's member-per-chunk
+    level-1 stream (restartable, the production shape) and a solid
+    level-9 single member — decode through read_cobol with a cache_dir.
+    The headline is cold member-feed e2e MB/s of DECOMPRESSED bytes;
+    `warm` re-scans the cache the cold pass populated (zero inflate
+    work) as its own gated metric; `compressed_parity` asserts every
+    leg byte-identical to the raw file's decode, which
+    tools/benchgate.py gates as a HARD failure with no history needed:
+    a fast inflate of wrong bytes is worthless."""
+    import gzip as _gzip
+    import shutil
+    import tempfile
+
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing import corpus
+
+    n_records = max(50_000, int(mb_target * 1024 * 1024) // 35)
+    work = tempfile.mkdtemp(prefix="bench-comp-")
+    try:
+        raw = os.path.join(work, "txn.dat")
+        chunk = max(1, n_records // 8)
+        info = corpus.write_fixed_corpus(raw, n_records, seed=55,
+                                         chunk_records=chunk)
+        mb = info["bytes"] / (1024 * 1024)
+        kw = corpus.fixed_read_options()
+        base = read_cobol(raw, **kw).to_arrow()
+
+        def matches(t) -> bool:
+            return (t.num_rows == base.num_rows
+                    and all(t.column(c).equals(base.column(c))
+                            for c in base.column_names
+                            if "File_Name" not in c))
+
+        members = os.path.join(work, "txn.dat.gz")
+        minfo = corpus.write_fixed_corpus(members, n_records, seed=55,
+                                          chunk_records=chunk,
+                                          compression="gzip")
+        solid = os.path.join(work, "solid", "txn.dat.gz")
+        os.makedirs(os.path.dirname(solid))
+        with open(raw, "rb") as f:
+            solid_wire = _gzip.compress(f.read(), compresslevel=9)
+        with open(solid, "wb") as f:
+            f.write(solid_wire)
+
+        def timed(path, cache):
+            t0 = time.perf_counter()
+            out = read_cobol(path, cache_dir=cache,
+                             compress_block_mb="2", **kw)
+            table = out.to_arrow()
+            return (time.perf_counter() - t0, table,
+                    out.metrics.as_dict()["io"])
+
+        parity = True
+        cold_s, cold_table, _ = timed(members, os.path.join(work, "c1"))
+        parity &= matches(cold_table)
+        warm_times, warm_io = [], {}
+        for _ in range(2):
+            s, t, warm_io = timed(members, os.path.join(work, "c1"))
+            parity &= matches(t)
+            warm_times.append(s)
+        solid_s, solid_table, _ = timed(solid, os.path.join(work, "c2"))
+        parity &= matches(solid_table)
+        warm_s = min(warm_times)
+        result = {
+            "metric": "exp_compressed_e2e",
+            "value": round(mb / cold_s, 1),
+            "unit": "MB/s",
+            "roofline": _roofline_field(mb / cold_s),
+            "mb": round(mb, 1),
+            "records": base.num_rows,
+            "ratio": round(info["bytes"] / minfo["wire_bytes"], 2),
+            "solid_cold_MBps": round(mb / solid_s, 1),
+            "solid_ratio": round(info["bytes"] / len(solid_wire), 2),
+            "compressed_parity": bool(parity),
+            "warm": {
+                "metric": "exp_compressed_warm",
+                "value": round(mb / warm_s, 1),
+                "unit": "MB/s",
+                "zero_inflate":
+                    warm_io.get("decompressed_bytes_out", 0) == 0,
+                "speedup_vs_cold": round(cold_s / warm_s, 2),
+            },
+        }
+        _log(f"side metric exp_compressed: {result}")
+        return result
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+
 def run_sink_side_metric(mb_target: float) -> dict:
     """exp_sink: the transactional lakehouse sink (cobrix_tpu.sink) vs
     bare streaming decode, same exp1 input tailed from a static file.
@@ -1679,6 +1771,13 @@ def _side_metrics(mb_target: float) -> dict:
         _log(f"exp_roundtrip side metric failed: {exc}")
         side["exp_roundtrip"] = {"metric": "exp_roundtrip_encode",
                                  "error": str(exc)[:400]}
+    try:
+        side["exp_compressed"] = run_compressed_side_metric(
+            min(mb_target, 16.0))
+    except Exception as exc:
+        _log(f"exp_compressed side metric failed: {exc}")
+        side["exp_compressed"] = {"metric": "exp_compressed_e2e",
+                                  "error": str(exc)[:400]}
     return side
 
 

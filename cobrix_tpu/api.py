@@ -299,6 +299,9 @@ def parse_options(options: Dict[str, object],
         cache_max_mb=float(opts.get("cache_max_mb", "") or 1024.0),
         prefetch_blocks=opts.get_int("prefetch_blocks", 2),
         io_block_mb=float(opts.get("io_block_mb", "") or 8.0),
+        compression=(opts.get("compression", "auto") or "auto").lower(),
+        compress_block_mb=float(
+            opts.get("compress_block_mb", "") or 4.0),
         pipeline_workers=opts.get_int("pipeline_workers", 0),
         pipeline_chunk_mb=float(opts.get("chunk_size_mb", "") or 16.0),
         pipeline_max_inflight=opts.get_int("max_inflight_chunks", 0),
@@ -396,6 +399,17 @@ def _validate_options(opts: Options, params: ReaderParameters,
         raise ValueError(
             f"Invalid 'io_block_mb' of {params.io_block_mb}; it must be "
             "a positive block size in megabytes.")
+    if params.compression not in ("auto", "none", "off", "raw"):
+        from .io.compress import codec_by_name
+
+        try:
+            codec_by_name(params.compression)
+        except ValueError as exc:
+            raise ValueError(f"Invalid 'compression' option: {exc}")
+    if params.compress_block_mb <= 0:
+        raise ValueError(
+            f"Invalid 'compress_block_mb' of {params.compress_block_mb}; "
+            "it must be a positive block size in megabytes.")
     if params.cache_dir:
         cache_parent = os.path.dirname(
             os.path.abspath(params.cache_dir)) or "."
@@ -766,29 +780,40 @@ def _io_config(params: ReaderParameters):
     return IoConfig.from_params(params)
 
 
-def _total_input_bytes(files: Sequence[str], io_stats=None) -> int:
-    """Input bytes across local AND backend-resolved files (progress
-    totals + throughput metrics); sizing failures never fail the read —
-    an unknown size just reports as 0. Remote sizes seed the read's
-    metadata memo (this runs before the obs context activates), so the
-    planners and validators downstream reuse them without another
-    backend round trip."""
+def _total_input_bytes(files: Sequence[str], io_stats=None,
+                       io=None, retry: Optional[RetryPolicy] = None,
+                       on_retry=None) -> int:
+    """LOGICAL input bytes across local AND backend-resolved files
+    (progress totals + throughput metrics — decompressed bytes for
+    compressed feeds, since every downstream offset lives in that
+    space); sizing failures never fail the read — an unknown size just
+    reports as 0. This runs before the read's obs context activates, so
+    it activates a sizing-scoped context around the probes: remote
+    sizes, codec sniffs, and inflate-discovery results all seed the
+    read's metadata memo for the planners and validators downstream.
+    Probes run under the read's retry policy so transient backend
+    failures here are retried AND ledgered like any other IO."""
+    from .io.compress import active_codec
+    from .obs.context import ObsContext
+    from .obs.context import activate as obs_activate
     from .reader.stream import source_size
 
-    memo = io_stats.memo if io_stats is not None else None
     total = 0
-    for f in files:
-        try:
-            if path_scheme(f) in (None, "file"):
-                if os.path.exists(f):
-                    total += os.path.getsize(f)
-            else:
-                size = source_size(f)
-                if memo is not None:
-                    memo[("size", f)] = size
-                total += size
-        except Exception:
-            continue
+    with obs_activate(ObsContext(io_stats=io_stats)
+                      if io_stats is not None else None):
+        for f in files:
+            try:
+                if path_scheme(f) in (None, "file"):
+                    if active_codec(f, io) is not None:
+                        total += source_size(f, io=io, retry=retry,
+                                             on_retry=on_retry)
+                    elif os.path.exists(f):
+                        total += os.path.getsize(f)
+                else:
+                    total += source_size(f, io=io, retry=retry,
+                                         on_retry=on_retry)
+            except Exception:
+                continue
     return total
 
 
@@ -1022,12 +1047,18 @@ def read_cobol(path=None,
 
     metrics = ReadMetrics(files=len(files), backend=backend,
                           hosts=max(hosts, 1))
-    metrics.bytes_read = _total_input_bytes(files, metrics.io_stats)
+    io_cfg = _io_config(params)
+    prescan_retries: List[int] = []
+    metrics.bytes_read = _total_input_bytes(
+        files, metrics.io_stats, io=io_cfg, retry=_retry_policy(params),
+        on_retry=lambda: prescan_retries.append(1))
+    # sizing-probe retries fold into the read ledger downstream (both
+    # execution paths see them via the metrics object)
+    metrics.prescan_io_retries = len(prescan_retries)
     if params.field_costs:
         from .obs.fieldcost import FieldCostAccumulator
 
         metrics.field_costs_acc = FieldCostAccumulator()
-    io_cfg = _io_config(params)
 
     # the read's observability context: per-read cache-counter scope
     # always; tracer/progress only when asked for. Activated on this
@@ -1331,9 +1362,10 @@ def _read_cobol_single_host(files, copybook_contents,
                         io, stage_times=seq_stage_times))
 
     data = CobolData.from_results(results, schema, parallelism=parallelism)
-    data.diagnostics = _aggregate_diagnostics(params, results,
-                                              len(retries_seen),
-                                              shard_failures)
+    data.diagnostics = _aggregate_diagnostics(
+        params, results,
+        len(retries_seen) + getattr(metrics, "prescan_io_retries", 0),
+        shard_failures)
     pushdown = getattr(reader, "pushdown", None)
     if pushdown is not None:
         # pruning counters into the read's metrics BEFORE finalize, so
@@ -1406,7 +1438,7 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
         return result
 
     rs = reader.record_size
-    size = source_size(file_path, retry=retry, on_retry=on_retry)
+    size = source_size(file_path, retry=retry, on_retry=on_retry, io=io)
     skipper = getattr(reader, "chunk_skipper", None)
     # fixed chunking is output-invariant (record-aligned strides,
     # absolute Record_Id bases), so with zone-map skipping armed the
@@ -1418,10 +1450,15 @@ def _read_fixed_len_chunked(reader, file_path: str, params, backend: str,
 
         stride_bytes = min(stride_bytes, max(
             rs, int(params.stats_chunk_mb * MEGABYTE) // rs * rs))
-    # the SAME predicate drives the pipelined chunk planner — the
+    from .io.compress import compressed_chunkable
+
+    # the SAME predicates drive the pipelined chunk planner — the
     # pipelined-vs-sequential parity guarantee needs one split rule
+    # (compressed inputs without a decompressed cache plane stay whole:
+    # chunk offsets would re-inflate the prefix per chunk)
     if not fixed_file_chunkable(size, rs, params, stride_bytes,
-                                ignore_file_size):
+                                ignore_file_size) \
+            or not compressed_chunkable(file_path, io):
         if skipper is not None and skipper.should_skip(file_path, 0, -1):
             return []
         return [track(reader.read_result(
@@ -1550,10 +1587,15 @@ def _read_cobol_multihost(files, copybook_contents, params, hosts: int,
     # missing from the output — say so on the read's ledger
     for failure in shard_failures:
         diagnostics.record_shard_failure(failure)
+    # sizing-probe retries happened in-parent, before the fork: fold
+    # them in here (workers ledger their own retries per shard)
+    prescan = (getattr(metrics, "prescan_io_retries", 0)
+               if metrics is not None else 0)
+    diagnostics.io_retries += prescan
     data = CobolData.from_arrow_tables(cleaned, schema)
     data.diagnostics = (diagnostics
                         if params.is_permissive or found or shard_failures
-                        else None)
+                        or prescan else None)
     if metrics is not None:
         metrics.finalize(data, len(shards))
     return data

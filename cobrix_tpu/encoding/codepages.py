@@ -68,12 +68,40 @@ _CP875 = (
     "\\\u20afSTUVWXYZ\xb2\xa7\u037a \xab\xac0123456789\xb3\xa9\u20ac \xbb "
 )
 
+def _variant(base: str, diffs: Dict[int, str]) -> str:
+    """A code page that differs from `base` at a few byte positions —
+    how the related EBCDIC Latin-1 pages actually relate (cp500/cp1047
+    are cp037 with a handful of punctuation moved). Deriving them keeps
+    the shared 249+ positions provably identical to the base tables the
+    fuzz matrix already pins."""
+    out = list(base)
+    for pos, ch in diffs.items():
+        out[pos] = ch
+    return "".join(out)
+
+
+# EBCDIC 500 (International Latin-1): cp037 with seven punctuation
+# cells rotated ([ ] ! | ^ ¢ ¬) — verified against the stdlib cp500
+# codec position by position
+_CP500_DIFFS = {0x4A: "[", 0x4F: "!", 0x5A: "]", 0x5F: "^",
+                0xB0: "\xa2", 0xBA: "\xac", 0xBB: "|"}
+
+# EBCDIC 1047 (Latin-1 / Open Systems, the z/OS Unix page): cp037 with
+# six cells rotated (^ ¬ [ ] Ý ¨) — verified against glibc/iconv
+# IBM-1047 position by position
+_CP1047_DIFFS = {0x5F: "^", 0xAD: "[", 0xB0: "\xac", 0xBA: "\xdd",
+                 0xBB: "\xa8", 0xBD: "]"}
+
 _TABLES: Dict[str, str] = {
     "common": _COMMON,
     "common_extended": _COMMON_EXTENDED,
     "cp037": _CP037,
     "cp037_extended": _CP037_EXTENDED,
+    "cp500": _variant(_CP037, _CP500_DIFFS),
+    "cp500_extended": _variant(_CP037_EXTENDED, _CP500_DIFFS),
     "cp875": _CP875,
+    "cp1047": _variant(_CP037, _CP1047_DIFFS),
+    "cp1047_extended": _variant(_CP037_EXTENDED, _CP1047_DIFFS),
 }
 
 _CUSTOM: Dict[str, str] = {}

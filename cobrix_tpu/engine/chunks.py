@@ -78,7 +78,7 @@ def fixed_file_chunkable(size: int, record_size: int, params,
 def plan_fixed_chunks(reader, files, params, chunk_bytes: int,
                       ignore_file_size: bool,
                       retry: Optional[RetryPolicy] = None,
-                      on_retry=None) -> List[FixedChunk]:
+                      on_retry=None, io=None) -> List[FixedChunk]:
     """Byte-stride chunk plan over fixed-length input files.
 
     A file splits only when the same conditions hold that make the
@@ -98,13 +98,17 @@ def plan_fixed_chunks(reader, files, params, chunk_bytes: int,
         # the profile grid so skip granularity matches the proofs
         chunk_bytes = min(chunk_bytes, max(
             rs, int(params.stats_chunk_mb * MEGABYTE)))
+    from ..io.compress import compressed_chunkable
+
     chunk_bytes = max(rs, (chunk_bytes // rs) * rs)  # record-aligned
     chunks: List[FixedChunk] = []
     for file_order, file_path in enumerate(files):
         base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
-        size = source_size(file_path, retry=retry, on_retry=on_retry)
+        size = source_size(file_path, retry=retry, on_retry=on_retry,
+                           io=io)
         if not fixed_file_chunkable(size, rs, params, chunk_bytes,
-                                    ignore_file_size):
+                                    ignore_file_size) \
+                or not compressed_chunkable(file_path, io):
             if skipper is not None \
                     and skipper.should_skip(file_path, 0, -1):
                 continue

@@ -846,7 +846,7 @@ def pipelined_fixed_scan(reader, files, params, backend: str,
     assembled chunk out incrementally (see `_finalizers`)."""
     chunk_bytes = max(1, int(params.pipeline_chunk_mb * 1024 * 1024))
     chunks = plan_fixed_chunks(reader, files, params, chunk_bytes,
-                               ignore_file_size, retry, on_retry)
+                               ignore_file_size, retry, on_retry, io=io)
 
     def failure_info(index, attempts, reason, error):
         c = chunks[index]

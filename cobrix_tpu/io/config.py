@@ -34,14 +34,27 @@ class IoConfig:
     cache_max_bytes: int = 1024 * MEGABYTE
     prefetch_depth: int = 2          # blocks of read-ahead; 0 = off
     block_bytes: int = 8 * MEGABYTE  # cache + read-ahead granularity
+    compression: str = "auto"        # codec name | 'auto' | 'none'
+    compress_block_bytes: int = 4 * MEGABYTE  # decompressed-plane block
+    permissive_errors: bool = False  # record_error_policy != fail_fast
 
     @classmethod
     def from_params(cls, params) -> Optional["IoConfig"]:
         """The read's IoConfig, or None when every io feature is off
-        (plain buffered backend reads, exactly the pre-io behavior)."""
+        (plain buffered backend reads, exactly the pre-io behavior).
+        A non-default compression option rides here too: the
+        decompression plane needs a config even with cache/prefetch off
+        (detection stays 'auto' either way — a None io still
+        auto-detects with the default block size)."""
         cache_dir = getattr(params, "cache_dir", "") or ""
         prefetch = int(getattr(params, "prefetch_blocks", 0))
-        if not cache_dir and prefetch <= 0:
+        compression = (getattr(params, "compression", "auto")
+                       or "auto").lower()
+        compress_block_mb = float(
+            getattr(params, "compress_block_mb", 4.0) or 4.0)
+        permissive = bool(getattr(params, "is_permissive", False))
+        if (not cache_dir and prefetch <= 0 and compression == "auto"
+                and compress_block_mb == 4.0 and not permissive):
             return None
         return cls(
             cache_dir=cache_dir,
@@ -50,6 +63,10 @@ class IoConfig:
             prefetch_depth=prefetch,
             block_bytes=max(1, int(
                 float(getattr(params, "io_block_mb", 8.0)) * MEGABYTE)),
+            compression=compression,
+            compress_block_bytes=max(64 * 1024,
+                                     int(compress_block_mb * MEGABYTE)),
+            permissive_errors=permissive,
         )
 
     @property

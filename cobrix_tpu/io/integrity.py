@@ -57,7 +57,7 @@ TMP_ORPHAN_AGE_S = 300.0
 QUARANTINE_KEEP = 32
 
 PLANES = ("block", "index", "roofline", "checkpoint", "fleet", "sink",
-          "stats")
+          "stats", "compress")
 
 
 def checksum(data: bytes) -> int:
@@ -98,7 +98,8 @@ def note_corruption(plane: str, path: str, detail: str,
     hits."""
     if plane not in PLANES:
         plane = "other"
-    key = {"block": "block_corrupt", "index": "index_corrupt"}.get(plane)
+    key = {"block": "block_corrupt", "index": "index_corrupt",
+           "compress": "compress_corrupt"}.get(plane)
     if key:
         if io_stats is None:
             from .stats import current_io_stats

@@ -33,7 +33,16 @@ KEYS = (
     "peer_hits",          # local misses answered by a warm fleet peer
     "peer_misses",        # peer-tier attempts that fell through to backend
     "bytes_from_peer",    # bytes served out of a peer's block cache
+    "compressed_bytes_in",     # wire bytes fed through the inflater
+    "decompressed_bytes_out",  # bytes the inflater produced this read
+    "inflate_s",               # seconds spent inside codec decompress
+    "inflate_skipped",         # decompressed blocks served without inflating
+    "compress_corrupt",        # compressed-plane damage (stream or index)
 )
+
+# counters carrying fractional values (everything else coerces to int on
+# merge so version-skewed workers can't ship floats into exact counters)
+FLOAT_KEYS = frozenset({"inflate_s"})
 
 
 class IoStats:
@@ -62,7 +71,8 @@ class IoStats:
         with self._lock:
             for k, v in counts.items():
                 if k in self.counts and v:
-                    self.counts[k] += int(v)
+                    self.counts[k] += (float(v) if k in FLOAT_KEYS
+                                       else int(v))
 
     def as_dict(self) -> Dict[str, int]:
         with self._lock:

@@ -385,6 +385,20 @@ def scan_metrics(registry: Optional[MetricsRegistry] = None) -> dict:
             "cobrix_io_remote_bytes_total",
             "Bytes fetched from remote storage backends",
             label_names=("source",)),
+        # -- streaming decompression plane (cobrix_tpu.io.compress) ------
+        "inflate_bytes": r.counter(
+            "cobrix_io_inflate_bytes_total",
+            "Streaming-decompression byte volume by direction "
+            "(in = compressed bytes consumed, out = decompressed bytes "
+            "produced); warm cached scans move neither",
+            label_names=("direction",)),
+        "inflate_seconds": r.counter(
+            "cobrix_io_inflate_seconds_total",
+            "Wall seconds spent inside streaming decompressors"),
+        "inflate_skipped": r.counter(
+            "cobrix_io_inflate_skipped_total",
+            "Decompressed blocks served from the post-decompression "
+            "block cache instead of re-inflating the compressed feed"),
         # -- peer block-cache tier (cobrix_tpu.io.peercache) -------------
         # distinct from cobrix_io_cache_events_total on purpose: a peer
         # hit is still a LOCAL miss, and capacity planning needs the two

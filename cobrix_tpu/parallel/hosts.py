@@ -126,26 +126,33 @@ def plan_fixed_len_shards(reader, files: Sequence[str], params,
     as in a single-process read), or sub-record files — stay whole.
     Remote files split too when their backend can size them (the fsspec
     adapter and any backend registered with `sizer=`); a failed size
-    probe degrades to one whole-file shard, never to a failed plan."""
+    probe degrades to one whole-file shard, never to a failed plan.
+    Compressed files size (and split) in DECOMPRESSED space; without a
+    cache_dir they stay whole — each worker's byte-range open would
+    re-inflate the prefix."""
+    from ..io.compress import active_codec, compressed_chunkable
+    from ..io.config import IoConfig
     from ..reader.parameters import DEFAULT_FILE_RECORD_ID_INCREMENT
     from ..reader.stream import path_scheme, source_size
 
+    io = IoConfig.from_params(params)
     shards: List[WorkShard] = []
     rs = reader.record_size  # effective stride: overrides + start/end pad
     for file_order, file_path in enumerate(files):
         base = file_order * DEFAULT_FILE_RECORD_ID_INCREMENT
         is_local = path_scheme(file_path) in (None, "file")
-        if is_local:
+        if is_local and active_codec(file_path, io) is None:
             size = os.path.getsize(file_path)
         else:
             try:
-                size = source_size(file_path)
+                size = source_size(file_path, io=io)
             except Exception:
                 size = -1
         splittable = (hosts > 1 and size >= 2 * rs
                       and size % rs == 0
                       and not params.file_start_offset
-                      and not params.file_end_offset)
+                      and not params.file_end_offset
+                      and compressed_chunkable(file_path, io))
         if not splittable:
             shards.append(WorkShard(file_path, file_order, 0, -1, base))
             continue

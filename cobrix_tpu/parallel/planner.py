@@ -108,12 +108,16 @@ def plan_files(files: Sequence[str], n_hosts: int,
     file, balanced across hosts. Remote files size through their storage
     backend; an unsizable file enters at size -1 (unknown), which is
     exactly the case `reallocate_idle` redistributes."""
+    from ..io.compress import is_compressed
     from ..reader.stream import path_scheme, source_size
 
     def size_of(f: str) -> int:
+        # logical (decompressed) sizes throughout: shard bounds live in
+        # the same byte space the streams serve
         try:
             return (os.path.getsize(f)
                     if path_scheme(f) in (None, "file")
+                    and not is_compressed(f)
                     else source_size(f))
         except Exception:
             return -1

@@ -308,7 +308,7 @@ class ReadMetrics:
                 cache, _, result = key.rpartition("_")
                 m["cache"].labels(cache=cache, result=result).inc(count)
         io = self.io or {}
-        for plane in ("block", "index"):
+        for plane in ("block", "index", "compress"):
             for result, label in (("hits", "hit"), ("misses", "miss")):
                 count = io.get(f"{plane}_{result}", 0)
                 if count:
@@ -331,6 +331,16 @@ class ReadMetrics:
         if io.get("bytes_from_cache"):
             m["remote_bytes"].labels(source="cache").inc(
                 io["bytes_from_cache"])
+        if io.get("compressed_bytes_in"):
+            m["inflate_bytes"].labels(direction="in").inc(
+                io["compressed_bytes_in"])
+        if io.get("decompressed_bytes_out"):
+            m["inflate_bytes"].labels(direction="out").inc(
+                io["decompressed_bytes_out"])
+        if io.get("inflate_s"):
+            m["inflate_seconds"].inc(io["inflate_s"])
+        if io.get("inflate_skipped"):
+            m["inflate_skipped"].inc(io["inflate_skipped"])
         if io.get("bytes_from_peer"):
             # peer-tier EVENTS are counted live by PeerCacheTier; here
             # only the byte volume joins the backend/cache split

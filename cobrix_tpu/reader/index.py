@@ -100,7 +100,15 @@ def file_index_entries(reader, file_path: str, file_order: int, params,
             if io_stats is not None:
                 io_stats.bump("index_saves")
 
-    if path_scheme(file_path) in (None, "file"):
+    from ..io.compress import active_codec, compressed_chunkable
+
+    if not compressed_chunkable(file_path, io):
+        # compressed input without a decompressed cache plane: byte-range
+        # shards would each re-inflate the prefix, so one whole-file
+        # shard (the streaming-discovery fallback) is strictly cheaper
+        return None
+    if path_scheme(file_path) in (None, "file") \
+            and active_codec(file_path, io) is None:
         if too_small(os.path.getsize(file_path)):
             return None
         fingerprint = None
@@ -135,9 +143,11 @@ def file_index_entries(reader, file_path: str, file_order: int, params,
                 entries = reader.generate_index(stream, file_order)
         to_store(fingerprint, entries)
         return entries
-    # registry-backed storage: one stream serves the size probe, the
-    # fingerprint probe, and the index scan (a backend open is typically
-    # a network round trip)
+    # registry-backed storage (and compressed local files, whose raw
+    # bytes cannot be mmap-framed): one stream serves the size probe,
+    # the fingerprint probe, and the index scan (a backend open is
+    # typically a network round trip; a cold compressed open is the
+    # discovery inflate)
     with open_stream(file_path, retry=retry, on_retry=on_retry,
                      io=io) as stream:
         if too_small(stream.size()):

@@ -128,6 +128,15 @@ class ReaderParameters:
     prefetch_blocks: int = 2
     # block granularity (MB) shared by the cache and the prefetcher
     io_block_mb: float = 8.0
+    # -- compressed feeds (cobrix_tpu.io.compress) -----------------------
+    # codec for compressed inputs: 'auto' (strict magic-byte detection
+    # with extension fallback), 'none' (disable detection — read the
+    # raw bytes), or a codec name ('gzip'/'zlib'/'bz2'/'xz'/'zstd') to
+    # pin a misnamed or extensionless feed
+    compression: str = "auto"
+    # decompressed-plane granularity (MB): the inflate-index checkpoint
+    # stride and the post-decompression block-cache entry size
+    compress_block_mb: float = 4.0
     # -- chunked pipeline executor (cobrix_tpu.engine) -------------------
     # worker threads overlapping read -> frame -> decode -> Arrow assembly
     # across chunks. 0 = today's sequential path (the safe fallback);

@@ -236,6 +236,10 @@ def retrying_read(fn: Callable[[], bytes], policy: RetryPolicy,
         try:
             return fn()
         except Exception as exc:  # backend exceptions are retryable
+            if getattr(exc, "permanent", False):
+                raise  # deterministic damage (e.g. CompressedStreamError):
+                # retrying cannot help, and wrapping would strip the
+                # structured attributes callers dispatch on
             if policy.max_attempts <= 1:
                 raise  # no retry configured: keep the backend's own type
             elapsed = time.monotonic() - start
@@ -376,6 +380,14 @@ class _LocalFileSource(ByteRangeSource):
         self._f.seek(offset)
         return self._f.read(n)
 
+    def fingerprint(self) -> str:
+        # size+mtime version key (the idiom reader/index.py uses for
+        # local files): the decompressed cache plane keys generations
+        # off this, and the size-only default would miss a same-size
+        # rewrite of a compressed feed
+        st = os.fstat(self._f.fileno())
+        return f"local:{st.st_size}:{st.st_mtime_ns}"
+
     @property
     def name(self) -> str:
         return self._path
@@ -440,23 +452,51 @@ def stream_lister(scheme: str) -> Optional[Callable[[str], list]]:
 
 
 def source_size(path: str, retry: Optional[RetryPolicy] = None,
-                on_retry: Optional[Callable[[], None]] = None) -> int:
-    """Byte size of one input (local or backend-resolved) without
-    building a buffered stream; the planning/validation sizer. A remote
-    size is one backend metadata round trip, so it memoizes on the
-    active read (metrics totals, shard planning, and divisibility
-    validation probe each file once per read, not once each)."""
-    scheme = path_scheme(path)
-    if scheme in (None, "file"):
-        return os.path.getsize(normalize_local(path))
+                on_retry: Optional[Callable[[], None]] = None,
+                io=None) -> int:
+    """LOGICAL byte size of one input (local or backend-resolved)
+    without building a buffered stream; the planning/validation sizer.
+    For a compressed input this is the DECOMPRESSED size — every
+    downstream consumer (chunk planners, shard planning, divisibility
+    validation, metrics totals) addresses decompressed offsets, so the
+    sizer answers in the same space (warm: the persisted inflate index;
+    cold: one memoized streaming-discovery pass). A remote size is one
+    backend metadata round trip, so it memoizes on the active read."""
+    from ..io import compress as _compress
     from ..io.stats import current_io_stats
 
+    scheme = path_scheme(path)
+    if scheme in (None, "file"):
+        local = normalize_local(path)
+        codec = _compress.active_codec(local, io)
+        if codec is None:
+            return os.path.getsize(local)
+        return _compress.decompressed_size(local, codec, io=io,
+                                           retry=retry,
+                                           on_retry=on_retry)
     stats = current_io_stats()
     memo = stats.memo if stats is not None else None
     if memo is not None:
         size = memo.get(("size", path))
         if size is not None:
             return size
+    # remote codec detection: the pin, the per-read memo, and the
+    # extension map are free; the magic sniff (one tiny backend read)
+    # only runs inside an active read, so a bare planning probe of an
+    # extensionless raw file costs exactly what it used to
+    codec = None
+    mode = _compress.compression_mode(io)
+    if mode not in ("auto",):
+        codec = _compress.active_codec(path, io)
+    elif memo is not None or _compress.codec_for_path(path) is not None:
+        codec = _compress.active_codec(path, io, retry=retry,
+                                       on_retry=on_retry)
+    if codec is not None:
+        size = _compress.decompressed_size(path, codec, io=io,
+                                           retry=retry, on_retry=on_retry)
+        if memo is not None:
+            memo[("size", path)] = size
+        return size
     sizer = None
     if resolve_stream_backend(scheme) is not None:
         sizer = _STREAM_SIZERS.get(scheme)
@@ -468,7 +508,8 @@ def source_size(path: str, retry: Optional[RetryPolicy] = None,
         else:
             size = sizer(path)
     else:
-        with open_stream(path, retry=retry, on_retry=on_retry) as stream:
+        with open_stream(path, retry=retry, on_retry=on_retry,
+                         io=io) as stream:
             size = stream.size()
     if memo is not None:
         memo[("size", path)] = size
@@ -504,10 +545,26 @@ def open_stream(path: str, start_offset: int = 0, maximum_bytes: int = 0,
     to registry-backed storage only (local file IO is left to the OS);
     `on_retry` is called once per retried read (diagnostics hook).
     `io` (cobrix_tpu.io.IoConfig) stacks the persistent block cache and
-    the read-ahead prefetcher onto registry-backed sources."""
+    the read-ahead prefetcher onto registry-backed sources, and carries
+    the `compression=` pin for the decompression plane.
+
+    Compressed inputs (codec pinned, magic-sniffed, or extension-
+    detected — io/compress.py) wrap the backend source in a
+    DecompressingSource BEFORE the buffered stream, so `start_offset`/
+    `maximum_bytes` and everything downstream address DECOMPRESSED
+    offsets; the decompressed plane owns its own block caching, so the
+    raw-bytes cache/prefetch stack is skipped for them."""
+    from ..io import compress as _compress
+
     scheme = path_scheme(path)
     if scheme in (None, "file"):
         local = path[len("file://"):] if scheme == "file" else path
+        codec = _compress.active_codec(local, io)
+        if codec is not None:
+            return _compress.open_compressed_stream(
+                _LocalFileSource(local), local, codec, io=io,
+                start_offset=start_offset, maximum_bytes=maximum_bytes,
+                chunk_size=chunk_size, retry=retry, on_retry=on_retry)
         return FSStream(local, start_offset=start_offset,
                         maximum_bytes=maximum_bytes)
     factory = resolve_stream_backend(scheme)
@@ -520,6 +577,14 @@ def open_stream(path: str, start_offset: int = 0, maximum_bytes: int = 0,
     source = (retrying_read(lambda: factory(path), retry,
                             describe=f"open of '{path}'", on_retry=on_retry)
               if retry is not None else factory(path))
+    codec = _compress.active_codec_from_source(path, io, source,
+                                               retry=retry,
+                                               on_retry=on_retry)
+    if codec is not None:
+        return _compress.open_compressed_stream(
+            source, path, codec, io=io, start_offset=start_offset,
+            maximum_bytes=maximum_bytes, chunk_size=chunk_size,
+            retry=retry, on_retry=on_retry)
     if io is not None:
         from ..io.config import wrap_source
 

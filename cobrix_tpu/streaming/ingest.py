@@ -439,6 +439,7 @@ class ContinuousIngestor:
         for f in listed:
             if f in self._sources:
                 continue
+            self._refuse_compressed(f)
             if path_scheme(f) in (None, "file"):
                 stat = stat_local(f)
                 if stat is None:
@@ -479,6 +480,30 @@ class ContinuousIngestor:
 
     def _is_remote(self, path: str) -> bool:
         return path_scheme(path) not in (None, "file")
+
+    def _refuse_compressed(self, path: str) -> None:
+        """A compressed feed cannot be tailed: the decompressed tail is
+        not addressable until the member closes, and the compressed tail
+        bytes are rewritten in place as the writer flushes — both break
+        the offset/CRC watermark contract. Refuse loudly instead of
+        framing garbage. Local files are magic-sniffed; remote files are
+        judged by extension only (no extra round trips per poll)."""
+        from ..io.compress import active_codec, codec_for_path
+
+        codec = None
+        if self._is_remote(path):
+            codec = codec_for_path(path)
+        else:
+            try:
+                codec = active_codec(path, self.io)
+            except (OSError, ValueError):
+                return  # unreadable now; the normal drain path reports
+        if codec is not None:
+            raise ValueError(
+                f"continuous ingestion cannot tail compressed input "
+                f"{path!r} (detected codec: {codec.name}); decompress "
+                f"the feed before tailing, or use read_cobol on the "
+                f"closed compressed file")
 
     def _forget(self, path: str) -> None:
         live = self._sources.pop(path, None)
@@ -1279,6 +1304,13 @@ def _validate_tailable(params: ReaderParameters) -> None:
     seg = params.multisegment
     if seg and (seg.segment_level_ids or seg.field_parent_map):
         blockers.append("segment_id_level*/segment-children")
+    if getattr(params, "compression", "auto") not in (
+            "auto", "none", "off", "raw"):
+        # a growing compressed member has no stable byte identity: the
+        # tail bytes a poll observed are rewritten when the writer
+        # flushes more input into the same member, so offset/CRC
+        # watermarks cannot survive a restart
+        blockers.append("compression")
     if blockers:
         raise ValueError(
             "continuous ingestion supports record-header-parser framing "

@@ -83,6 +83,69 @@ def fixed_read_options() -> Dict[str, str]:
     return {"copybook_contents": TXN_COPYBOOK}
 
 
+def member_compressor(compression: str):
+    """One-shot `bytes -> compressed member` for a canonical codec name
+    (io.compress registry names/aliases). Corpus writers emit ONE member
+    per flushed chunk, so generated compressed corpora are seekable:
+    every chunk boundary is a restartable checkpoint for the streaming
+    inflate index."""
+    from ..io.compress import codec_by_name
+
+    name = codec_by_name(compression).name
+    if name == "gzip":
+        import gzip as _gzip
+
+        return name, lambda b: _gzip.compress(b, compresslevel=1,
+                                              mtime=0)
+    if name == "zlib":
+        import zlib as _zlib
+
+        return name, lambda b: _zlib.compress(b, 1)
+    if name == "bz2":
+        import bz2 as _bz2
+
+        return name, lambda b: _bz2.compress(b, 1)
+    if name == "xz":
+        import lzma as _lzma
+
+        return name, lambda b: _lzma.compress(b, preset=0)
+    if name == "zstd":
+        try:
+            import zstandard
+        except ImportError as exc:
+            raise ImportError(
+                "writing a zstd corpus needs the optional 'zstandard' "
+                "package (pip install zstandard)") from exc
+        cctx = zstandard.ZstdCompressor()
+        return name, cctx.compress
+    raise ValueError(f"no corpus compressor for codec {name!r}")
+
+
+class _CorpusSink:
+    """File sink for the chunked corpus writers: plain pass-through, or
+    one compressed member per write() when `compression` is given."""
+
+    def __init__(self, path: str, compression: Optional[str] = None):
+        self._f = open(path, "wb")
+        self._compress = None
+        self.wire_bytes = 0
+        if compression:
+            _name, self._compress = member_compressor(compression)
+
+    def write(self, data: bytes) -> None:
+        if self._compress is not None:
+            data = self._compress(bytes(data))
+        self._f.write(data)
+        self.wire_bytes += len(data)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+        return False
+
+
 def multiseg_read_options() -> Dict[str, str]:
     return {
         "copybook_contents": MULTISEG_COPYBOOK,
@@ -97,9 +160,14 @@ def write_fixed_corpus(path: str, num_records: int, *, seed: int = 7,
                        chunk_records: int = 262144,
                        distinct_accounts: int = 1000,
                        status_weights: Optional[Sequence[float]] = None,
+                       compression: Optional[str] = None,
                        ) -> Dict[str, int]:
     """Stream `num_records` fixed-length TXN records to `path` through
-    the vectorized encoder. Returns {records, bytes, record_size}."""
+    the vectorized encoder. With `compression` (a codec name the
+    io.compress registry knows) each flushed chunk becomes one
+    compressed member. Returns {records, bytes, record_size} — `bytes`
+    is the DECOMPRESSED payload size; `wire_bytes` joins it when
+    compressed."""
     from ..encode import BatchEncoder
 
     enc = BatchEncoder(TXN_COPYBOOK)
@@ -114,7 +182,7 @@ def write_fixed_corpus(path: str, num_records: int, *, seed: int = 7,
         weights = weights / weights.sum()
     written = 0
     total = 0
-    with open(path, "wb") as f:
+    with _CorpusSink(path, compression) as f:
         while written < num_records:
             n = min(chunk_records, num_records - written)
             cols = [
@@ -130,8 +198,11 @@ def write_fixed_corpus(path: str, num_records: int, *, seed: int = 7,
             f.write(data)
             written += n
             total += len(data)
-    return {"records": written, "bytes": total,
-            "record_size": enc.record_size}
+    out = {"records": written, "bytes": total,
+           "record_size": enc.record_size}
+    if compression:
+        out["wire_bytes"] = f.wire_bytes
+    return out
 
 
 def _interleave_positions(contacts: np.ndarray
@@ -151,11 +222,14 @@ def _interleave_positions(contacts: np.ndarray
 def write_multiseg_corpus(path: str, num_companies: int, *,
                           seed: int = 7, chunk_companies: int = 131072,
                           contacts_per_company: Tuple[int, int] = (0, 4),
-                          big_endian_rdw: bool = False
+                          big_endian_rdw: bool = False,
+                          compression: Optional[str] = None
                           ) -> Dict[str, int]:
     """Stream an RDW-framed COMPANY/CONTACT corpus to `path`. The
     contact range drives both the segment mix and the record-length
-    distribution. Returns {records, companies, contacts, bytes}."""
+    distribution. With `compression` each flushed chunk becomes one
+    compressed member. Returns {records, companies, contacts, bytes}
+    (plus `wire_bytes` when compressed)."""
     from ..encode import BatchEncoder
 
     enc_c = BatchEncoder(_SEG_C_LAYOUT)
@@ -172,7 +246,7 @@ def write_multiseg_corpus(path: str, num_companies: int, *,
     records = 0
     contacts_total = 0
     total = 0
-    with open(path, "wb") as f:
+    with _CorpusSink(path, compression) as f:
         while done < num_companies:
             c = min(chunk_companies, num_companies - done)
             k = rng.integers(lo, hi + 1, size=c)
@@ -213,8 +287,11 @@ def write_multiseg_corpus(path: str, num_companies: int, *,
             records += c + kt
             contacts_total += kt
             total += buf.nbytes
-    return {"records": records, "companies": done,
-            "contacts": contacts_total, "bytes": total}
+    out = {"records": records, "companies": done,
+           "contacts": contacts_total, "bytes": total}
+    if compression:
+        out["wire_bytes"] = f.wire_bytes
+    return out
 
 
 def corrupt_fixed_corpus(data: bytes, *, count: int = 3, seed: int = 0,

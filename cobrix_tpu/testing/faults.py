@@ -330,6 +330,88 @@ def register_chaos_backend(scheme: str, data: bytes,
     return source
 
 
+# -- compressed-feed fault injection --------------------------------------
+#
+# The injectors below damage COMPRESSED WIRE BYTES, not the decompressed
+# payload: a feed torn mid-member (an aborted upload), a member whose
+# trailer CRC no longer matches (a bit rotted in transit), and foreign
+# bytes spliced between members (a concatenation gone wrong). The
+# streaming decompression plane (io/compress.py) must turn each into a
+# structured `CompressedStreamError` carrying BOTH offsets (where in the
+# wire bytes and where in the decompressed stream), honor
+# `record_error_policy`, and count the damage under the `compress`
+# integrity plane. Driven by tests/test_compressed_io.py and
+# tools/compcheck.py.
+
+
+def compressed_member_spans(data: bytes, codec: str = "gzip"
+                            ) -> List[Tuple[int, int]]:
+    """[(start, end)) wire-byte span of every member/frame in a
+    concatenated compressed stream — the structural boundaries the
+    compressed injectors aim at. Found by actually decoding (magic
+    bytes can occur inside compressed payloads, so scanning is not
+    safe)."""
+    from ..io.compress import codec_by_name
+
+    c = codec_by_name(codec)
+    spans: List[Tuple[int, int]] = []
+    pos = 0
+    while pos < len(data):
+        d = c.new_decoder()
+        chunk = data[pos:]
+        d.decompress(chunk)
+        if not d.eof:
+            raise ValueError(
+                f"stream ends mid-member at wire offset {pos} "
+                f"(already damaged?)")
+        consumed = len(chunk) - len(d.unused_data)
+        spans.append((pos, pos + consumed))
+        pos += consumed
+    return spans
+
+
+def truncate_compressed_member(data: bytes, codec: str = "gzip",
+                               which: int = -1,
+                               keep_fraction: float = 0.5
+                               ) -> Tuple[bytes, int]:
+    """Tear the stream mid-member: keep everything before member
+    `which` plus `keep_fraction` of that member's wire bytes. Returns
+    (torn_stream, cut_wire_offset). The inflate must fail (or, under a
+    permissive policy, stop) AT the cut — never frame garbage past it.
+    """
+    spans = compressed_member_spans(data, codec)
+    start, end = spans[which % len(spans)]
+    cut = start + max(1, int((end - start) * keep_fraction))
+    return data[:cut], cut
+
+
+def corrupt_compressed_trailer(data: bytes, codec: str = "gzip",
+                               which: int = -1) -> Tuple[bytes, int]:
+    """Flip one bit inside member `which`'s trailer region (the final
+    bytes of the member's wire span — for gzip the CRC32/ISIZE words).
+    Returns (corrupted_stream, flip_offset). The decoder's own
+    integrity check must surface as `CompressedStreamError`, not as
+    silently wrong decompressed bytes."""
+    spans = compressed_member_spans(data, codec)
+    start, end = spans[which % len(spans)]
+    pos = max(start, end - 5)  # inside gzip CRC32; tail bytes otherwise
+    return flip_bit(data, pos), pos
+
+
+def garbage_between_members(data: bytes, codec: str = "gzip",
+                            which: int = 0, length: int = 64,
+                            seed: int = 0) -> Tuple[bytes, int]:
+    """Splice non-codec garbage at the boundary AFTER member `which` —
+    the mis-concatenated feed. Returns (spliced_stream, splice_offset).
+    The inflater tolerates NUL padding there (tape-style blocking) but
+    must refuse anything else with a structured error at the splice."""
+    spans = compressed_member_spans(data, codec)
+    _start, end = spans[which % len(spans)]
+    rng = np.random.default_rng(seed)
+    junk = bytes(rng.integers(1, 255, size=length, dtype=np.uint8))
+    return splice_garbage(data, end, junk), end
+
+
 # -- durable-state fault injection ---------------------------------------
 #
 # The injectors below break DISK, not bytes-in-flight or workers: the
@@ -346,14 +428,17 @@ def cache_entry_paths(cache_dir: str, plane: str = "block"):
     """Every durable entry file of one cache plane under `cache_dir`,
     sorted for determinism. Planes: 'block' (aligned .blk entries),
     'index' (sparse-index .json payloads), 'stats' (scan-profile .json
-    payloads), 'checkpoint' (continuous-ingest watermark slots — pass
-    the CHECKPOINT directory)."""
+    payloads), 'compress' (seekable inflate-index .json payloads),
+    'checkpoint' (continuous-ingest watermark slots — pass the
+    CHECKPOINT directory)."""
     if plane == "checkpoint":
         from ..streaming.checkpoint import checkpoint_files
 
         return checkpoint_files(cache_dir)
-    sub = {"block": "blocks", "index": "index", "stats": "stats"}[plane]
-    suffix = {"block": ".blk", "index": ".json", "stats": ".json"}[plane]
+    sub = {"block": "blocks", "index": "index", "stats": "stats",
+           "compress": "compress"}[plane]
+    suffix = {"block": ".blk", "index": ".json", "stats": ".json",
+              "compress": ".json"}[plane]
     root = os.path.join(cache_dir, sub)
     out = []
     for dirpath, dirs, files in os.walk(root):
@@ -434,13 +519,13 @@ class cache_write_faults:
     def __enter__(self):
         # patching utils.atomic also covers late `from ..utils.atomic
         # import write_atomic` call sites (roofline's lazy import)
-        from ..io import blockcache, index_store
+        from ..io import blockcache, compress_index, index_store
         from ..utils import atomic
 
         fail = self._raiser()
         # patch each consumer's bound symbol AND the source module (for
         # late importers)
-        for mod in (blockcache, index_store, atomic):
+        for mod in (blockcache, index_store, compress_index, atomic):
             self._patched.append((mod, "write_atomic",
                                   mod.write_atomic))
             mod.write_atomic = fail

@@ -1,0 +1,394 @@
+"""Compressed-feed ingestion (cobrix_tpu.io.compress).
+
+The contract under test: a compressed feed is a TRANSPARENT view of its
+decompressed bytes. Every execution mode (sequential, pipelined,
+multihost) over every framing (fixed, VRL multisegment) must produce
+byte-identical results to the uncompressed file; planners address
+decompressed offsets; a warm cache serves decompressed blocks without
+touching the inflater (``inflate_skipped``); damage in the wire bytes
+surfaces as structured ``CompressedStreamError`` honoring
+``record_error_policy``; and the persisted inflate index self-heals
+through the integrity plane like every other cache artifact.
+
+zstd legs skip visibly when the optional ``zstandard`` package is
+absent (this container does not ship it).
+"""
+import gzip
+import math
+import os
+import zlib
+
+import pytest
+
+from cobrix_tpu import api, read_cobol
+from cobrix_tpu.io.compress import (
+    CompressedStreamError,
+    codec_by_name,
+    codec_for_path,
+    compressed_chunkable,
+    known_codecs,
+    sniff_magic,
+)
+from cobrix_tpu.io.config import IoConfig
+from cobrix_tpu.testing.corpus import (
+    TXN_COPYBOOK,
+    fixed_read_options,
+    multiseg_read_options,
+    write_fixed_corpus,
+    write_multiseg_corpus,
+)
+from cobrix_tpu.testing.faults import (
+    corrupt_cache_entry,
+    corrupt_compressed_trailer,
+    garbage_between_members,
+    truncate_compressed_member,
+)
+
+RECORDS = 6000
+CHUNK_RECORDS = 1500  # 4 members per corpus — several restart points
+
+
+def _table_eq(a, b):
+    assert a.num_rows == b.num_rows
+    for name in a.column_names:
+        if "File_Name" in name:
+            continue  # the one column allowed to differ (path string)
+        assert a.column(name).equals(b.column(name)), name
+
+
+@pytest.fixture(scope="module")
+def fixed_pair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("comp-fixed")
+    raw = str(d / "txn.dat")
+    gz = str(d / "txn.dat.gz")
+    write_fixed_corpus(raw, RECORDS, seed=11, chunk_records=CHUNK_RECORDS)
+    write_fixed_corpus(gz, RECORDS, seed=11, chunk_records=CHUNK_RECORDS,
+                       compression="gzip")
+    return raw, gz
+
+
+@pytest.fixture(scope="module")
+def multiseg_pair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("comp-vrl")
+    raw = str(d / "co.dat")
+    gz = str(d / "co.dat.gz")
+    write_multiseg_corpus(raw, 1500, seed=11, chunk_companies=400)
+    write_multiseg_corpus(gz, 1500, seed=11, chunk_companies=400,
+                          compression="gzip")
+    return raw, gz
+
+
+# -- codec registry + detection -------------------------------------------
+
+
+def test_registry_knows_the_builtin_codecs():
+    names = known_codecs()
+    for name in ("gzip", "zlib", "bz2", "xz", "zstd"):
+        assert name in names
+    # aliases canonicalize; unknown names fail loudly naming the options
+    assert codec_by_name("gz").name == "gzip"
+    assert codec_by_name("bzip2").name == "bz2"
+    assert codec_by_name("zstandard").name == "zstd"
+    with pytest.raises(ValueError, match="gzip"):
+        codec_by_name("snappy")
+
+
+def test_magic_sniffing_is_strict():
+    assert sniff_magic(gzip.compress(b"x")[:6]).name == "gzip"
+    assert sniff_magic(b"BZh91AY").name == "bz2"
+    assert sniff_magic(b"\x28\xb5\x2f\xfd\x00\x00").name == "zstd"
+    assert sniff_magic(b"\xfd7zXZ\x00").name == "xz"
+    # EBCDIC data full of 0x1f/0x8b lookalikes must NOT match: the gzip
+    # magic requires the deflate method byte and zeroed reserved flags
+    assert sniff_magic(b"\x1f\x8b\xff\xff\xff\xff") is None
+    assert sniff_magic(b"\x1f\x8b\x08\xe0\x00\x00") is None
+    assert sniff_magic(b"") is None
+    # zlib has no safe magic: extension/pin only
+    assert sniff_magic(zlib.compress(b"x")[:6]) is None
+
+
+def test_extension_mapping():
+    assert codec_for_path("a/b.dat.gz").name == "gzip"
+    assert codec_for_path("a/b.GZ").name == "gzip"
+    assert codec_for_path("x.bz2").name == "bz2"
+    assert codec_for_path("x.zst").name == "zstd"
+    assert codec_for_path("x.xz").name == "xz"
+    assert codec_for_path("x.zz").name == "zlib"
+    assert codec_for_path("x.dat") is None
+
+
+def test_api_option_validation(fixed_pair):
+    raw, _gz = fixed_pair
+    with pytest.raises(ValueError, match="compression"):
+        read_cobol(raw, compression="snappy", **fixed_read_options())
+    with pytest.raises(ValueError, match="compress_block_mb"):
+        read_cobol(raw, compress_block_mb="0", **fixed_read_options())
+
+
+def test_compressed_files_single_shard_without_cache(fixed_pair):
+    _raw, gz = fixed_pair
+    assert compressed_chunkable(gz, None) is False
+    io = IoConfig(cache_dir="/tmp/x")  # cache_enabled derives from this
+    assert compressed_chunkable(gz, io) is True
+    assert compressed_chunkable("plain.dat", None) is True
+
+
+# -- the parity matrix -----------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["sequential", "pipelined", "multihost"])
+def test_fixed_parity(fixed_pair, tmp_path, mode):
+    raw, gz = fixed_pair
+    opts = fixed_read_options()
+    if mode == "pipelined":
+        opts.update(pipeline_workers="2", chunk_size_mb="0.1",
+                    cache_dir=str(tmp_path / "cache"),
+                    compress_block_mb="0.25")
+    elif mode == "multihost":
+        opts.update(hosts="2", cache_dir=str(tmp_path / "cache"),
+                    compress_block_mb="0.25")
+    base = read_cobol(raw, **opts).to_arrow()
+    got = read_cobol(gz, **opts).to_arrow()
+    _table_eq(base, got)
+
+
+@pytest.mark.parametrize("mode", ["sequential", "pipelined"])
+def test_vrl_parity(multiseg_pair, tmp_path, mode):
+    raw, gz = multiseg_pair
+    opts = multiseg_read_options()
+    if mode == "pipelined":
+        opts.update(pipeline_workers="2", input_split_size_mb="1",
+                    cache_dir=str(tmp_path / "cache"),
+                    compress_block_mb="0.25")
+    base = read_cobol(raw, **opts).to_arrow()
+    got = read_cobol(gz, **opts).to_arrow()
+    _table_eq(base, got)
+
+
+@pytest.mark.parametrize("codec,ext", [("bz2", "bz2"), ("xz", "xz"),
+                                       ("zstd", "zst")])
+def test_other_codecs_fixed_parity(fixed_pair, tmp_path, codec, ext):
+    if codec == "zstd":
+        pytest.importorskip("zstandard")
+    raw, _gz = fixed_pair
+    path = str(tmp_path / f"txn.dat.{ext}")
+    write_fixed_corpus(path, RECORDS, seed=11,
+                       chunk_records=CHUNK_RECORDS, compression=codec)
+    base = read_cobol(raw, **fixed_read_options()).to_arrow()
+    got = read_cobol(path, **fixed_read_options()).to_arrow()
+    _table_eq(base, got)
+
+
+def test_pinned_and_disabled_compression(fixed_pair, tmp_path):
+    raw, gz = fixed_pair
+    base = read_cobol(raw, **fixed_read_options()).to_arrow()
+    # pinned codec on an extensionless name (zlib has no magic either)
+    hidden = str(tmp_path / "feed.bin")
+    with open(hidden, "wb") as f:
+        f.write(zlib.compress(open(raw, "rb").read()))
+    got = read_cobol(hidden, compression="zlib",
+                     **fixed_read_options()).to_arrow()
+    _table_eq(base, got)
+    # compression=none reads a RAW file through a .gz name untouched
+    misnamed = str(tmp_path / "raw.dat.gz")
+    with open(misnamed, "wb") as f:
+        f.write(open(raw, "rb").read())
+    got2 = read_cobol(misnamed, compression="none",
+                      **fixed_read_options()).to_arrow()
+    _table_eq(base, got2)
+    # and auto mode sniffs: the magic veto overrides the extension
+    got3 = read_cobol(misnamed, **fixed_read_options()).to_arrow()
+    _table_eq(base, got3)
+
+
+# -- post-decompression caching -------------------------------------------
+
+
+def test_warm_scan_skips_inflate_entirely(fixed_pair, tmp_path):
+    raw, gz = fixed_pair
+    cache = str(tmp_path / "cache")
+    cold_opts = dict(fixed_read_options(), cache_dir=cache,
+                     compress_block_mb="0.25", pipeline_workers="2",
+                     chunk_size_mb="0.1")
+    base = read_cobol(raw, **fixed_read_options()).to_arrow()
+    cold = read_cobol(gz, **cold_opts)
+    _table_eq(base, cold.to_arrow())
+    cold_io = cold.metrics.as_dict()["io"]
+    assert cold_io["decompressed_bytes_out"] > 0
+    assert cold_io["compressed_bytes_in"] > 0
+    # warm sequential scan over the cache the pipelined run populated:
+    # ZERO inflate work, and (one source reading forward) each planned
+    # block is materialized from the cache exactly once
+    warm = read_cobol(gz, **dict(fixed_read_options(), cache_dir=cache,
+                                 compress_block_mb="0.25"))
+    _table_eq(base, warm.to_arrow())
+    io = warm.metrics.as_dict()["io"]
+    assert io["decompressed_bytes_out"] == 0
+    assert io["compressed_bytes_in"] == 0
+    total = os.path.getsize(raw)
+    block = int(0.25 * 1024 * 1024)
+    assert io["inflate_skipped"] == math.ceil(total / block)
+
+
+def test_inflate_index_survives_corruption(fixed_pair, tmp_path):
+    raw, gz = fixed_pair
+    cache = str(tmp_path / "cache")
+    opts = dict(fixed_read_options(), cache_dir=cache,
+                compress_block_mb="0.25")
+    base = read_cobol(raw, **fixed_read_options()).to_arrow()
+    _table_eq(base, read_cobol(gz, **opts).to_arrow())
+    # a bit-flipped inflate-index entry must be detected, quarantined,
+    # counted under the compress plane, and transparently rebuilt
+    corrupt_cache_entry(cache, "compress", "bitflip")
+    healed = read_cobol(gz, **opts)
+    _table_eq(base, healed.to_arrow())
+    assert healed.metrics.as_dict()["io"]["compress_corrupt"] >= 1
+    held = os.listdir(os.path.join(cache, "quarantine"))
+    assert held, "corrupt inflate-index entry was not quarantined"
+    # the rebuilt entry serves the NEXT scan clean
+    clean = read_cobol(gz, **opts)
+    _table_eq(base, clean.to_arrow())
+    assert clean.metrics.as_dict()["io"]["compress_corrupt"] == 0
+
+
+def test_fsckcache_verifies_and_repairs_compress_plane(fixed_pair,
+                                                       tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import fsckcache
+
+    _raw, gz = fixed_pair
+    cache = str(tmp_path / "cache")
+    opts = dict(fixed_read_options(), cache_dir=cache,
+                compress_block_mb="0.25")
+    read_cobol(gz, **opts).to_arrow()
+    clean = fsckcache.check_compress(cache, repair=False)
+    assert clean["ok"] >= 1 and clean["corrupt"] == 0
+    corrupt_cache_entry(cache, "compress", "garbage")
+    found = fsckcache.check_compress(cache, repair=False)
+    assert found["corrupt"] == 1
+    assert not fsckcache.fsck(cache, out=open(os.devnull, "w"))
+    assert fsckcache.fsck(cache, repair=True, out=open(os.devnull, "w"))
+    after = fsckcache.check_compress(cache, repair=False)
+    assert after["corrupt"] == 0
+
+
+def test_compcheck_quick_matrix():
+    """tools/compcheck.py quick mode is the tier-1 smoke for the whole
+    plane: codec parity matrix, warm zero-inflate, damage taxonomy, and
+    inflate-index self-heal in one pass."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import compcheck
+
+    assert compcheck.run_quick(mb=1.0) == 0
+
+
+@pytest.mark.slow
+def test_compcheck_sweep():
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir,
+                                    "tools"))
+    import compcheck
+
+    assert compcheck.run_sweep(mb=4.0) == 0
+
+
+# -- damage taxonomy -------------------------------------------------------
+
+
+def _damaged(tmp_path, fixed_pair, injector, label):
+    _raw, gz = fixed_pair
+    bad, off = injector(open(gz, "rb").read())
+    path = str(tmp_path / f"{label}.dat.gz")
+    with open(path, "wb") as f:
+        f.write(bad)
+    return path, off
+
+
+@pytest.mark.parametrize("injector,label", [
+    (truncate_compressed_member, "torn"),
+    (corrupt_compressed_trailer, "crc"),
+    (garbage_between_members, "spliced"),
+])
+def test_damage_fails_fast_with_both_offsets(fixed_pair, tmp_path,
+                                             injector, label):
+    path, _off = _damaged(tmp_path, fixed_pair, injector, label)
+    with pytest.raises(CompressedStreamError) as exc_info:
+        read_cobol(path, **fixed_read_options()).to_arrow()
+    err = exc_info.value
+    assert err.codec == "gzip"
+    assert err.compressed_offset >= 0
+    assert err.decompressed_offset >= 0
+
+
+def test_truncated_member_permissive_keeps_clean_prefix(fixed_pair,
+                                                        tmp_path):
+    raw, _gz = fixed_pair
+    path, _cut = _damaged(tmp_path, fixed_pair,
+                          truncate_compressed_member, "torn-perm")
+    base = read_cobol(raw, **fixed_read_options()).to_arrow()
+    out = read_cobol(path, record_error_policy="permissive",
+                     **fixed_read_options())
+    t = out.to_arrow()
+    # the undamaged prefix decodes identically; the torn tail is dropped.
+    # The final surviving row may straddle the truncation point (a
+    # partially decoded record padded out), so parity is asserted on
+    # every row before it.
+    assert 0 < t.num_rows < base.num_rows
+    keep = t.num_rows - 1
+    _table_eq(base.slice(0, keep), t.slice(0, keep))
+    io = out.metrics.as_dict()["io"]
+    assert io["compress_corrupt"] >= 1
+
+
+# -- zstd visibility -------------------------------------------------------
+
+
+def test_zstd_without_package_is_actionable(tmp_path):
+    try:
+        import zstandard  # noqa: F401
+        pytest.skip("zstandard installed; the gate cannot fire")
+    except ImportError:
+        pass
+    path = str(tmp_path / "x.dat.zst")
+    with open(path, "wb") as f:
+        f.write(b"\x28\xb5\x2f\xfd" + b"\x00" * 64)
+    with pytest.raises(Exception, match="zstandard"):
+        read_cobol(path, **fixed_read_options()).to_arrow()
+
+
+# -- serve: streamed scans over compressed feeds --------------------------
+
+
+@pytest.mark.slow
+def test_serve_resume_mid_compressed_stream(fixed_pair):
+    """A mid-stream connection cut while serving a COMPRESSED feed
+    fails over and resumes byte-identical — resume tokens count
+    records, so the compression plane rides underneath untouched."""
+    from cobrix_tpu.serve import ScanServer, fetch_table
+    from test_resume import _CuttingProxy
+
+    raw, gz = fixed_pair
+    opts = dict(fixed_read_options(), chunk_size_mb="1")
+    local = read_cobol(gz, **opts).to_arrow()
+    srv = ScanServer().start()
+    try:
+        proxy = _CuttingProxy(srv.address, cut_after=96 * 1024)
+        try:
+            t = fetch_table([proxy.address, srv.address], gz,
+                            replica_seed=0, **opts)
+        finally:
+            proxy.stop()
+    finally:
+        srv.stop()
+    assert t.num_rows == local.num_rows
+    for name in t.column_names:
+        if "File_Name" in name:
+            continue
+        assert t.column(name).equals(local.column(name)), name

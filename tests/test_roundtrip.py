@@ -75,7 +75,8 @@ SCALAR_BODIES = [
 
 
 @pytest.mark.parametrize("framing", ["fixed", "rdw"])
-@pytest.mark.parametrize("code_page", ["common", "cp037"])
+@pytest.mark.parametrize("code_page",
+                         ["common", "cp037", "cp500", "cp1047"])
 def test_scalar_matrix(tmp_path, framing, code_page):
     """DISPLAY/COMP/COMP-9/COMP-3 (narrow + wide)/COMP-1/COMP-2/X
     across framings and code pages."""
@@ -241,11 +242,41 @@ def test_safe_alphabet_round_trips_per_code_page():
         get_code_page_table,
     )
 
-    for cp in ("common", "cp037"):
+    for cp in ("common", "cp037", "cp500", "cp1047"):
         table = get_code_page_table(cp)
         enc = get_code_page_encode_table(cp)
         for ch in safe_alphabet(cp):
             assert table[enc[ch]] == ch
+
+
+def test_cp500_matches_stdlib_codec():
+    """The cp500 table's printable region must agree with Python's own
+    cp500 codec position by position (the control region follows the
+    repo-wide convention the cp037 tables established instead)."""
+    from cobrix_tpu.encoding.codepages import get_code_page_table
+
+    table = get_code_page_table("cp500_extended")
+    ours_037 = get_code_page_table("cp037_extended")
+    for byte in range(0x40, 0x100):
+        want = bytes([byte]).decode("cp500")
+        # positions the repo cp037 table already diverges from stdlib
+        # cp037 on (deliberate reference-compat choices) carry over
+        if ours_037[byte] == bytes([byte]).decode("cp037"):
+            assert table[byte] == want, (hex(byte), table[byte], want)
+
+
+def test_cp1047_bracket_rotation():
+    """cp1047's signature cells (the z/OS Open Systems bracket layout)
+    sit where IBM-1047 puts them, and everything else matches cp037."""
+    from cobrix_tpu.encoding.codepages import get_code_page_table
+
+    t1047 = get_code_page_table("cp1047_extended")
+    t037 = get_code_page_table("cp037_extended")
+    rotated = {0x5F: "^", 0xAD: "[", 0xB0: "\xac", 0xBA: "\xdd",
+               0xBB: "\xa8", 0xBD: "]"}
+    for byte in range(256):
+        want = rotated.get(byte, t037[byte])
+        assert t1047[byte] == want, (hex(byte), t1047[byte], want)
 
 
 def test_duplicate_glyph_encode_is_lowest_byte_wins():

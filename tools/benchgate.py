@@ -101,7 +101,8 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
     add(doc)
     add(doc.get("decode_only"))
     for key in ("exp1", "exp2", "hierarchical", "exp_serve",
-                "exp_pushdown", "exp_roundtrip", "exp_stats"):
+                "exp_pushdown", "exp_roundtrip", "exp_stats",
+                "exp_compressed"):
         add(doc.get(key))
     # the fleet-mode serve experiment nests under exp_serve (it shares
     # that experiment's dataset); its aggregate-scaling metric gates on
@@ -142,6 +143,17 @@ def extract_metrics(doc: dict) -> Dict[str, dict]:
         out["exp_roundtrip_parity"] = {
             "value": 1.0 if parity is True else 0.0,
             "fraction": None}
+    # compressed-feed parity gates identically: a doc that ran
+    # exp_compressed must have decoded every compressed leg
+    # byte-identical to the raw file (or it erred — also 0). The warm
+    # re-scan nests under the experiment and gates on its own series
+    ce = doc.get("exp_compressed")
+    if isinstance(ce, dict):
+        add(ce.get("warm"))
+        parity = ce.get("compressed_parity")
+        out["exp_compressed_parity"] = {
+            "value": 1.0 if parity is True else 0.0,
+            "fraction": None}
     # the assembly-overhead ratio: present whenever the doc carries BOTH
     # exp3 measurements (decode_only merged under an e2e headline), or
     # when the e2e experiment errored (`to_arrow` error record) — the
@@ -180,6 +192,7 @@ def gate(fresh: Dict[str, dict], history: List[Dict[str, dict]],
     floors = {"exp_pushdown_speedup": pushdown_floor,
               "e2e_vs_decode_only": e2e_ratio_floor,
               "exp_roundtrip_parity": parity_floor,
+              "exp_compressed_parity": parity_floor,
               "exp_stats_speedup": stats_floor}
     rows: List[dict] = []
     for name, entry in sorted(fresh.items()):
@@ -426,6 +439,47 @@ def _smoke() -> int:
               and r["verdict"] == "regression" for r in rows))
     check("docs predating exp_roundtrip are not gated on parity",
           "exp_roundtrip_parity" not in extract_metrics(
+              _doc(100.0, 50.0)))
+
+    # compressed-feed parity gates hard and history-free; the cold
+    # headline and the nested warm re-scan gate on their own series
+    ce_doc = {"metric": "exp3_to_arrow", "value": 100.0, "unit": "MB/s",
+              "exp_compressed": {"metric": "exp_compressed_e2e",
+                                 "value": 80.0, "unit": "MB/s",
+                                 "compressed_parity": True,
+                                 "warm": {"metric":
+                                          "exp_compressed_warm",
+                                          "value": 160.0,
+                                          "unit": "MB/s"}}}
+    rows = gate(extract_metrics(ce_doc), [], 0.25, 2)
+    check("compressed parity passes with no history",
+          any(r["metric"] == "exp_compressed_parity"
+              and r["verdict"] == "ok" for r in rows))
+    check("warm compressed re-scan metric is extracted",
+          "exp_compressed_warm" in extract_metrics(ce_doc))
+    ce_hist = [extract_metrics(ce_doc) for _ in range(3)]
+    ce_doc["exp_compressed"]["compressed_parity"] = False
+    rows = gate(extract_metrics(ce_doc), ce_hist, 0.25, 2)
+    check("lost compressed parity is a hard failure",
+          any(r["metric"] == "exp_compressed_parity"
+              and r["verdict"] == "regression" for r in rows))
+    ce_doc["exp_compressed"] = {"metric": "exp_compressed_e2e",
+                                "error": "boom"}
+    rows = gate(extract_metrics(ce_doc), ce_hist, 0.25, 2)
+    check("errored compressed experiment fails the parity floor",
+          any(r["metric"] == "exp_compressed_parity"
+              and r["verdict"] == "regression" for r in rows))
+    ce_doc["exp_compressed"] = {"metric": "exp_compressed_e2e",
+                                "value": 30.0, "unit": "MB/s",
+                                "compressed_parity": True,
+                                "warm": {"metric": "exp_compressed_warm",
+                                         "value": 40.0, "unit": "MB/s"}}
+    rows = gate(extract_metrics(ce_doc), ce_hist, 0.25, 2)
+    check("warm compressed re-scan drop gates on history",
+          any(r["metric"] == "exp_compressed_warm"
+              and r["verdict"] == "regression" for r in rows))
+    check("docs predating exp_compressed are not gated on it",
+          "exp_compressed_parity" not in extract_metrics(
               _doc(100.0, 50.0)))
 
     # the fleet aggregate nests under exp_serve and must gate on its

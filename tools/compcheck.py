@@ -1,0 +1,244 @@
+"""Compressed-feed smoke check: codec parity + the decompressed planes.
+
+Drives the streaming decompression plane (cobrix_tpu.io.compress) end
+to end on a synthetic TXN corpus:
+
+  1. parity: every locally decodable codec (gzip/zlib/bz2/xz, plus
+     zstd when the optional module is installed) must decode
+     byte-identical to the raw file;
+  2. cold pipelined scan with `cache_dir=` -> warm scan: the warm read
+     must inflate ZERO bytes and fetch ZERO compressed bytes (every
+     planned block served from the decompressed block cache);
+  3. damage: a torn final member fails fast with the codec and BOTH
+     offsets under the strict policy, and under
+     record_error_policy=permissive serves the clean prefix with the
+     corruption on the ledger;
+  4. a bit-flipped persisted inflate index self-heals (quarantined +
+     rebuilt) without changing results.
+
+    python tools/compcheck.py              # quick (tier-1 runs this)
+    python tools/compcheck.py --mb 8       # bigger corpus
+    python tools/compcheck.py --sweep      # codec x block x mode grid
+                                           # + VRL legs (slow)
+
+Exit code 0 = all parity + plane checks hold; 1 = any failure.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_EXTS = {"gzip": "gz", "zlib": "zz", "bz2": "bz2", "xz": "xz",
+         "zstd": "zst"}
+
+
+def _codecs():
+    names = ["gzip", "zlib", "bz2", "xz"]
+    try:
+        import zstandard  # noqa: F401
+
+        names.append("zstd")
+    except ImportError:
+        pass  # optional dependency: the zstd leg skips visibly
+    return names
+
+
+def _diff(base, got) -> str:
+    """'' when the tables match on every non-path column."""
+    if got.num_rows != base.num_rows:
+        return f"row count {got.num_rows} != {base.num_rows}"
+    for name in base.column_names:
+        if "File_Name" in name:
+            continue
+        if not got.column(name).equals(base.column(name)):
+            return f"column {name} diverges"
+    return ""
+
+
+def run_quick(mb: float = 2.0) -> int:
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.io.compress import CompressedStreamError
+    from cobrix_tpu.testing import corpus, faults
+
+    failures = []
+
+    def check(label: str, problem: str) -> None:
+        if problem:
+            failures.append(label)
+            print(f"FAIL {label}: {problem}")
+        else:
+            print(f"  ok {label}")
+
+    n = max(2000, int(mb * 1024 * 1024) // 35)
+    chunk = max(1, n // 4)
+    work = tempfile.mkdtemp(prefix="compcheck-")
+    try:
+        raw = os.path.join(work, "txn.dat")
+        corpus.write_fixed_corpus(raw, n, seed=23, chunk_records=chunk)
+        kw = corpus.fixed_read_options()
+        base = read_cobol(raw, **kw).to_arrow()
+
+        # 1. codec parity matrix
+        for codec in _codecs():
+            path = os.path.join(work, f"txn.dat.{_EXTS[codec]}")
+            corpus.write_fixed_corpus(path, n, seed=23,
+                                      chunk_records=chunk,
+                                      compression=codec)
+            got = read_cobol(path, **kw).to_arrow()
+            check(f"parity[{codec}]", _diff(base, got))
+        if "zstd" not in _codecs():
+            print("  skip parity[zstd] (zstandard not installed)")
+
+        # 2. cold pipelined -> warm: zero inflate work on the re-scan
+        gz = os.path.join(work, f"txn.dat.{_EXTS['gzip']}")
+        cache = os.path.join(work, "cache")
+        copts = dict(kw, cache_dir=cache, compress_block_mb="0.25",
+                     pipeline_workers="2", chunk_size_mb="0.1")
+        cold = read_cobol(gz, **copts)
+        check("cold pipelined parity", _diff(base, cold.to_arrow()))
+        cold_io = cold.metrics.as_dict()["io"]
+        check("cold scan inflated",
+              "" if cold_io.get("decompressed_bytes_out", 0) > 0
+              else "no decompressed_bytes_out counted")
+        warm = read_cobol(gz, **dict(kw, cache_dir=cache,
+                                     compress_block_mb="0.25"))
+        check("warm parity", _diff(base, warm.to_arrow()))
+        warm_io = warm.metrics.as_dict()["io"]
+        check("warm zero inflate",
+              "" if (warm_io.get("decompressed_bytes_out", 0) == 0
+                     and warm_io.get("compressed_bytes_in", 0) == 0
+                     and warm_io.get("inflate_skipped", 0) > 0)
+              else f"warm counters {warm_io.get('compressed_bytes_in')}"
+                   f"/{warm_io.get('decompressed_bytes_out')} not zero")
+
+        # 3. damage taxonomy on a torn final member
+        torn_bytes, _ = faults.truncate_compressed_member(
+            open(gz, "rb").read())
+        torn = os.path.join(work, "torn.dat.gz")
+        with open(torn, "wb") as f:
+            f.write(torn_bytes)
+        try:
+            read_cobol(torn, **kw).to_arrow()
+            check("strict damage raises", "no error raised")
+        except CompressedStreamError as exc:
+            check("strict damage raises",
+                  "" if (exc.codec == "gzip"
+                         and exc.compressed_offset >= 0
+                         and exc.decompressed_offset >= 0)
+                  else f"unstructured error {exc!r}")
+        perm = read_cobol(torn, record_error_policy="permissive", **kw)
+        t = perm.to_arrow()
+        keep = max(t.num_rows - 1, 0)
+        check("permissive clean prefix",
+              _diff(base.slice(0, keep), t.slice(0, keep))
+              if 0 < t.num_rows < base.num_rows
+              else f"{t.num_rows} rows vs {base.num_rows}")
+        check("permissive corruption on the ledger",
+              "" if perm.metrics.as_dict()["io"].get(
+                  "compress_corrupt", 0) >= 1
+              else "compress_corrupt not counted")
+
+        # 4. inflate-index self-heal
+        faults.corrupt_cache_entry(cache, "compress", "bitflip")
+        healed = read_cobol(gz, **dict(kw, cache_dir=cache,
+                                       compress_block_mb="0.25"))
+        check("corrupt index self-heals", _diff(base, healed.to_arrow()))
+        q = os.path.join(cache, "quarantine")
+        check("corrupt index quarantined",
+              "" if os.path.isdir(q) and os.listdir(q)
+              else "nothing quarantined")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    print(f"compcheck quick: {len(failures)} failure(s)")
+    return len(failures)
+
+
+def run_sweep(mb: float = 4.0) -> int:
+    """Grid: codec x compress-block x execution mode, plus VRL
+    multisegment legs — every cell must hold byte parity."""
+    from cobrix_tpu import read_cobol
+    from cobrix_tpu.testing import corpus
+
+    failures = 0
+    n = max(4000, int(mb * 1024 * 1024) // 35)
+    work = tempfile.mkdtemp(prefix="compcheck-sweep-")
+    try:
+        raw = os.path.join(work, "txn.dat")
+        corpus.write_fixed_corpus(raw, n, seed=29,
+                                  chunk_records=max(1, n // 6))
+        kw = corpus.fixed_read_options()
+        base = read_cobol(raw, **kw).to_arrow()
+        cells = 0
+        for codec in _codecs():
+            path = os.path.join(work, f"txn.dat.{_EXTS[codec]}")
+            corpus.write_fixed_corpus(path, n, seed=29,
+                                      chunk_records=max(1, n // 6),
+                                      compression=codec)
+            for block in ("0.25", "1"):
+                for mode in ("sequential", "pipelined", "multihost"):
+                    cells += 1
+                    cache = os.path.join(
+                        work, f"c-{codec}-{block}-{mode}")
+                    opts = dict(kw, cache_dir=cache,
+                                compress_block_mb=block)
+                    if mode == "pipelined":
+                        opts.update(pipeline_workers="2",
+                                    chunk_size_mb="0.2")
+                    elif mode == "multihost":
+                        opts.update(hosts="2")
+                    problem = _diff(base,
+                                    read_cobol(path, **opts).to_arrow())
+                    if problem:
+                        failures += 1
+                        print(f"FAIL sweep[{codec} block={block} "
+                              f"{mode}]: {problem}")
+        # VRL multisegment legs ride the same plane
+        vraw = os.path.join(work, "co.dat")
+        vgz = os.path.join(work, "co.dat.gz")
+        corpus.write_multiseg_corpus(vraw, 800, seed=29,
+                                     chunk_companies=200)
+        corpus.write_multiseg_corpus(vgz, 800, seed=29,
+                                     chunk_companies=200,
+                                     compression="gzip")
+        vkw = corpus.multiseg_read_options()
+        vbase = read_cobol(vraw, **vkw).to_arrow()
+        for mode in ("sequential", "pipelined"):
+            cells += 1
+            opts = dict(vkw, cache_dir=os.path.join(work, f"cv-{mode}"),
+                        compress_block_mb="0.25")
+            if mode == "pipelined":
+                opts.update(pipeline_workers="2",
+                            input_split_size_mb="1")
+            problem = _diff(vbase, read_cobol(vgz, **opts).to_arrow())
+            if problem:
+                failures += 1
+                print(f"FAIL sweep[vrl gzip {mode}]: {problem}")
+        print(f"compcheck sweep: {cells} cells, {failures} failure(s)")
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+    return failures
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mb", type=float, default=2.0,
+                    help="approximate raw corpus size (default 2)")
+    ap.add_argument("--sweep", action="store_true",
+                    help="codec x block x mode grid + VRL (slow)")
+    args = ap.parse_args()
+    failures = (run_sweep(max(args.mb, 4.0)) if args.sweep
+                else run_quick(args.mb))
+    if failures:
+        print("compcheck: FAILURES")
+        return 1
+    print("compcheck: compressed-feed parity and cache planes hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,18 +105,78 @@ def check_index(cache_dir: str, repair: bool) -> dict:
     return stats
 
 
+def check_compress(cache_dir: str, repair: bool) -> dict:
+    """Verify the seekable inflate-index plane (io.compress_index):
+    each entry must be CRC-clean AND structurally sane — checkpoints
+    sorted, in-range, and restartable (compressed offsets within the
+    recorded member size). A bad entry only costs a re-inflation on the
+    next scan, but silent drift here would quietly serve stale
+    decompressed sizes to planners, so it is checked like the others."""
+    from cobrix_tpu.io.integrity import quarantine, verify_json_payload
+
+    root = os.path.join(cache_dir, "compress")
+    stats = {"ok": 0, "corrupt": 0, "stale_format": 0}
+    bad = []
+    for path in _iter_files(root, ".json"):
+        try:
+            payload = json.loads(open(path, encoding="utf-8").read())
+        except ValueError:
+            stats["corrupt"] += 1
+            bad.append((path, "undecodable JSON"))
+            continue
+        if not isinstance(payload, dict) or "crc" not in payload:
+            stats["stale_format"] += 1
+            continue
+        if not verify_json_payload(payload):
+            stats["corrupt"] += 1
+            bad.append((path, "checksum mismatch"))
+            continue
+        defect = _inflate_entry_defect(payload)
+        if defect:
+            stats["corrupt"] += 1
+            bad.append((path, defect))
+        else:
+            stats["ok"] += 1
+    if repair:
+        for path, _why in bad:
+            quarantine(path, os.path.join(cache_dir, "quarantine"))
+        stats["repaired"] = len(bad)
+    stats["bad_entries"] = [p for p, _ in bad]
+    return stats
+
+
+def _inflate_entry_defect(payload: dict):
+    """Structural defect in a CRC-clean inflate-index payload, or None."""
+    try:
+        total = int(payload["total"])
+        comp_size = int(payload["comp_size"])
+        cps = [(int(c), int(d)) for c, d in payload["checkpoints"]]
+    except (KeyError, TypeError, ValueError):
+        return "malformed fields"
+    if total < 0 or comp_size < 0:
+        return "negative sizes"
+    last_d = -1
+    for comp, dec in cps:
+        if not (0 <= comp <= comp_size) or not (0 <= dec <= total):
+            return f"checkpoint ({comp},{dec}) out of range"
+        if dec <= last_d:
+            return "checkpoints not strictly increasing"
+        last_d = dec
+    return None
+
+
 def check_orphans(cache_dir: str, repair: bool) -> dict:
     from cobrix_tpu.io.integrity import sweep_cache_root
 
     stats = {"tmp_orphans": 0}
-    for sub in ("blocks", "index"):
+    for sub in ("blocks", "index", "compress"):
         root = os.path.join(cache_dir, sub)
         for path in _iter_files(root, ""):
             if os.path.basename(path).startswith(".tmp-"):
                 stats["tmp_orphans"] += 1
     if repair:
         removed = {"tmp_orphans": 0, "truncated": 0}
-        for sub in ("blocks", "index"):
+        for sub in ("blocks", "index", "compress"):
             got = sweep_cache_root(os.path.join(cache_dir, sub))
             for k in removed:
                 removed[k] += got[k]
@@ -209,6 +269,7 @@ def fsck(cache_dir: str, repair: bool = False,
         return False
     blocks = check_blocks(cache_dir, repair)
     index = check_index(cache_dir, repair)
+    compress = check_compress(cache_dir, repair)
     orphans = check_orphans(cache_dir, repair)
     quarantined = check_quarantine(cache_dir)
     ckpt_root = checkpoint_dir or os.path.join(cache_dir, "checkpoints")
@@ -219,17 +280,20 @@ def fsck(cache_dir: str, repair: bool = False,
           f"{blocks['unparseable_name']} unparseable", file=out)
     print(f"index  : {index['ok']} ok, {index['corrupt']} corrupt, "
           f"{index['stale_format']} stale-format", file=out)
+    print(f"inflate: {compress['ok']} ok, {compress['corrupt']} corrupt, "
+          f"{compress['stale_format']} stale-format", file=out)
     print(f"ckpts  : {ckpts['ok']} ok, {ckpts['corrupt']} corrupt",
           file=out)
     print(f"orphans: {orphans['tmp_orphans']} temp file(s)"
           + (f", swept {orphans['swept']}" if repair else ""), file=out)
     print(f"quarantine: {quarantined['held']} held entr(ies)", file=out)
     for path in (blocks["bad_entries"] + index["bad_entries"]
-                 + ckpts["bad_entries"]):
+                 + compress["bad_entries"] + ckpts["bad_entries"]):
         print(f"  CORRUPT {path}"
               + ("  [quarantined]" if repair else ""), file=out)
     corrupt = (blocks["corrupt"] + blocks["unparseable_name"]
-               + index["corrupt"] + ckpts["corrupt"])
+               + index["corrupt"] + compress["corrupt"]
+               + ckpts["corrupt"])
     return corrupt == 0 or repair
 
 

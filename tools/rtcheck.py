@@ -77,7 +77,13 @@ def roundtrip_failure(spec, bodies, framing: str):
 
 
 ALIAS_CODE_PAGES = ("common", "common_extended", "cp037",
-                    "cp037_extended", "cp875")
+                    "cp037_extended", "cp500", "cp500_extended",
+                    "cp875", "cp1047", "cp1047_extended")
+
+# the P1/P2 fuzz rotation: every Latin-1 page takes seeds (cp875's
+# Greek alphabet needs genspec's safe-alphabet filtering, exercised by
+# the alias matrix instead)
+FUZZ_CODE_PAGES = ("common", "cp037", "cp500", "cp1047")
 
 
 def alias_roundtrip_failure(code_page: str, raw: bytes,
@@ -190,8 +196,8 @@ def _shrink_and_report(spec, bodies, framing: str, failure: str,
 
 
 def run_quick() -> int:
-    """Deterministic seed matrix: both framings, both code pages,
-    every grammar feature reachable from the seeds."""
+    """Deterministic seed matrix: both framings, every fuzzable code
+    page, every grammar feature reachable from the seeds."""
     from cobrix_tpu.testing.genspec import CopybookSpec
 
     failures = 0
@@ -199,7 +205,7 @@ def run_quick() -> int:
     for seed in range(12):
         rng = random.Random(1000 + seed)
         spec = CopybookSpec.random(
-            rng, code_page="cp037" if seed % 3 == 2 else "common")
+            rng, code_page=FUZZ_CODE_PAGES[seed % len(FUZZ_CODE_PAGES)])
         bodies = [spec.random_body(rng) for _ in range(3)]
         framing = _framing_for(spec, rng)
         cases += 1
@@ -222,7 +228,7 @@ def run_sweep(n: int, base_seed: int) -> int:
         rng = random.Random(seed)
         spec = CopybookSpec.random(
             rng, max_fields=10,
-            code_page=rng.choice(["common", "cp037"]))
+            code_page=rng.choice(list(FUZZ_CODE_PAGES)))
         bodies = [spec.random_body(rng) for _ in range(4)]
         framing = _framing_for(spec, rng)
         try:
